@@ -1,0 +1,23 @@
+"""sagecal-tpu: TPU-native direction-dependent radio interferometric calibration.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of SAGECal
+(aroffringa/sagecal): direction-dependent calibration of radio
+interferometer visibilities by expectation-maximization over sky
+directions, with robust (Student's t) statistics, Riemannian
+trust-region / LBFGS / Levenberg-Marquardt solvers, and distributed
+consensus-ADMM across frequency subbands via `jax.sharding` meshes.
+
+Layer map (mirrors reference SURVEY.md section 1, re-architected):
+
+- ``sagecal_tpu.skymodel``  — sky-model/cluster parsing into padded struct-of-arrays
+- ``sagecal_tpu.coords``    — celestial coordinate transforms
+- ``sagecal_tpu.rime``      — visibility prediction (the RIME) in JAX
+- ``sagecal_tpu.solvers``   — per-direction Jones solvers + SAGE-EM driver
+- ``sagecal_tpu.consensus`` — frequency-consensus ADMM, polynomials, manifold ops
+- ``sagecal_tpu.parallel``  — device mesh / sharding helpers
+- ``sagecal_tpu.io``        — datasets, measurement-set access, solution files
+"""
+
+__version__ = "0.1.0"
+
+from sagecal_tpu import config as config

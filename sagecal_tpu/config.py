@@ -1,0 +1,136 @@
+"""Typed run configuration with CLI parity to the reference binaries.
+
+The reference scatters getopt single-letter flags into mutable globals
+(``src/MS/data.h:129-198``, ``src/MPI/main.cpp:107-242``). Here the whole
+configuration is one frozen dataclass; the CLI maps the documented flags
+onto its fields so reference invocations translate 1:1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax.numpy as jnp
+
+
+class SolverMode(enum.IntEnum):
+    """Solver selection, parity with ``-j`` (reference Dirac.h:1533-1539 SM_*)."""
+
+    LM_LBFGS = 0          # SM_LM_LBFGS: LM + LBFGS refine
+    OSLM_LBFGS = 1        # ordered-subsets LM + LBFGS
+    OSLM_OSRLM_RLBFGS = 2 # robust LM (Student's t) + robust LBFGS
+    RLM_RLBFGS = 3        # robust LM
+    RTR_OSLM_LBFGS = 4    # Riemannian trust region
+    RTR_OSRLM_RLBFGS = 5  # robust RTR (production default)
+    NSD_RLBFGS = 6        # Nesterov accelerated steepest descent, robust
+
+
+class BeamMode(enum.IntEnum):
+    """Parity with ``-B`` (reference Dirac_common.h:97-109 DOBEAM_*)."""
+
+    NONE = 0
+    ARRAY = 1          # array (station) beam only
+    ELEMENT = 2        # element beam only
+    FULL = 3           # array * element
+
+
+class SimulationMode(enum.IntEnum):
+    """Parity with ``-a`` (reference fullbatch_mode.cpp:524-578)."""
+
+    OFF = 0
+    SIMULATE = 1       # replace data with model (optionally corrupted by -p solutions)
+    ADD = 2            # add model to data
+    SUBTRACT = 3       # subtract model from data
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    """Device dtype policy.
+
+    The reference CPU path is float64 end-to-end while its CUDA production
+    path solves in float32 with float64 control state
+    (``sagefit_visibilities_dual_pt_flt``, SURVEY.md section 2.6). On TPU we
+    default to the same split: complex64/float32 bulk math, float64 only for
+    small host-side control quantities.
+    """
+
+    real: jnp.dtype = jnp.float32
+    complex: jnp.dtype = jnp.complex64
+
+    @property
+    def real_np(self):
+        return jnp.dtype(self.real)
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """Full calibration run configuration (CLI flag in comments)."""
+
+    # --- inputs (reference src/MS/main.cpp:115-257)
+    ms: str | None = None              # -d : measurement set (or SimMS dir)
+    ms_list: str | None = None         # -f : file listing multiple MSs / glob
+    sky_model: str | None = None       # -s
+    cluster_file: str | None = None    # -c
+    solutions_file: str | None = None  # -p : output (or input for simulation)
+    init_solutions: str | None = None  # -q : warm start
+    format_3: bool = False             # -F 1 : 3rd-order spectral indices
+
+    # --- solve shape
+    tile_size: int = 120               # -t : timeslots per solve interval
+    max_em_iter: int = 3               # -e : EM iterations
+    single_max_iter: int = 2           # -g : iterations for single-cluster solves... (-g)
+    max_iter: int = 10                 # -l : LM/RTR iterations per cluster solve
+    max_lbfgs: int = 10                # -m : LBFGS iterations
+    lbfgs_m: int = 7                   # -x : LBFGS memory size
+    gpu_threads: int = 64              # -S (unused on TPU; kept for parity)
+    n_threads: int = 4                 # -n : host threads for IO
+    solver_mode: SolverMode = SolverMode.RTR_OSRLM_RLBFGS  # -j
+    robust_nulow: float = 2.0          # -L
+    robust_nuhigh: float = 30.0        # -H
+    linsolv: int = 1                   # -y : 0 Cholesky 1 QR 2 SVD
+    randomize: bool = True             # -R : ordered-subsets randomization
+
+    # --- data selection / conditioning
+    uvmin: float = 0.0                 # -I (lambda)
+    uvmax: float = 1e9                 # -o
+    uvtaper: float = 0.0               # -A (MS app meaning: taper)
+    whiten: bool = False               # -W : uv-density whitening
+    channel_avg_per_band: int = 1      # -w : mini-bands (bandpass)
+    per_channel_bfgs: bool = False     # -b 1 : per-channel re-solve
+
+    # --- simulation
+    simulation: SimulationMode = SimulationMode.OFF  # -a
+    ignore_clusters_file: str | None = None          # -z
+    correct_cluster: int | None = None               # -k : cluster id to correct residual by
+
+    # --- beam
+    beam_mode: BeamMode = BeamMode.NONE              # -B
+
+    # --- stochastic calibration (minibatch)
+    n_epochs: int = 0                  # -N : >0 enables stochastic mode
+    n_minibatches: int = 1             # -M
+
+    # --- consensus / distributed (reference src/MPI/main.cpp:107-242)
+    n_admm: int = 1                    # -A : ADMM iterations
+    n_poly: int = 2                    # -P : polynomial terms
+    poly_type: int = 2                 # -Q : 0/1 monomial, 2 Bernstein
+    admm_rho: float = 5.0              # -r
+    rho_file: str | None = None        # -G : per-cluster rho
+    adaptive_rho: bool = False         # -C : Barzilai-Borwein rho
+    max_timeslots: int = 0             # -T : 0 = all
+    skip_timeslots: int = 0            # -K
+    federated_alpha: float = 0.0       # -u
+    spatialreg: tuple | None = None    # -X : (l2, l1, order, fista_iters, cadence)
+    use_global_solution: bool = False  # -U
+    mdl_report: bool = False           # -M (mpi app): model-order selection report
+    verbose: bool = False              # -V
+
+    # --- device policy
+    precision: Precision = dataclasses.field(default_factory=Precision)
+
+    def replace(self, **kw) -> "RunConfig":
+        return dataclasses.replace(self, **kw)
+
+
+DEFAULT = RunConfig()

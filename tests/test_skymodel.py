@@ -1,0 +1,130 @@
+"""Sky model / cluster parsing tests (formats from reference README.md:54-101)."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from sagecal_tpu import skymodel
+
+
+SKY = """\
+# name h m s d m s I Q U V si RM eX eY eP f0
+P1C1 0 12 42.996 85 43 21.514 0.030498 0 0 0 -5.713060 0 0 0 0 115039062.0
+P5C1 1 18 5.864 85 58 39.755 0.041839 0 0 0 -6.672879 0 0 0 0 115039062.0
+G0  5 34 31.75 22 0 52.86 100 0 0 0 0.00 0 0.0012 0.0008 -2.329615801 130.0e6
+D01 23 23 25.67 58 48 58 80 0 0 0 0 0 0.000715 0.000715 0 130e6
+R01 23 23 25.416 58 48 57 70 0 0 0 0 0 0.00052 0.00052 0 130e6
+"""
+
+CLUSTER = """\
+# id chunk sources
+0 1 P1C1 P5C1
+-2 3 G0 D01 R01
+"""
+
+
+@pytest.fixture
+def skyfiles(tmp_path):
+    sky = tmp_path / "sky.txt"
+    sky.write_text(SKY)
+    clus = tmp_path / "sky.txt.cluster"
+    clus.write_text(CLUSTER)
+    return str(sky), str(clus)
+
+
+def test_parse_and_build(skyfiles):
+    sky, clus = skyfiles
+    ra0 = (0 + 12 / 60 + 42.996 / 3600) * math.pi / 12.0
+    dec0 = (85 + 43 / 60 + 21.514 / 3600) * math.pi / 180.0
+    c = skymodel.read_sky_cluster(sky, clus, ra0, dec0, freq0=120e6)
+
+    assert c.n_clusters == 2
+    assert c.max_sources == 3
+    assert list(c.cluster_ids) == [0, -2]
+    assert list(c.nchunk) == [1, 3]
+    assert c.n_eff_clusters == 4
+    assert c.subtract_mask().tolist() == [True, False]
+    # P1C1 sits at the phase center: l=m=0, n-1=0
+    np.testing.assert_allclose(c.ll[0, 0], 0, atol=1e-12)
+    np.testing.assert_allclose(c.mm[0, 0], 0, atol=1e-12)
+    np.testing.assert_allclose(c.nn[0, 0], 0, atol=1e-12)
+    # spectral scaling to 120 MHz: exp(log I0 + si*log(120/115.039...))
+    expect = math.exp(math.log(0.030498) - 5.713060 * math.log(120e6 / 115039062.0))
+    np.testing.assert_allclose(c.sI[0, 0], expect, rtol=1e-12)
+    # catalog flux retained
+    np.testing.assert_allclose(c.sI0[0, 0], 0.030498)
+
+    # morphology by name prefix; Gaussian axes scaled by 2 at parse time
+    assert c.stype[1, 0] == skymodel.STYPE_GAUSSIAN
+    assert c.stype[1, 1] == skymodel.STYPE_DISK
+    assert c.stype[1, 2] == skymodel.STYPE_RING
+    np.testing.assert_allclose(c.eX[1, 0], 2 * 0.0012)
+    # padding mask
+    assert c.smask.sum() == 5
+    assert not c.smask[0, 2]
+    assert c.sI[0, 2] == 0.0
+
+
+def test_negative_declination_sign():
+    src = skymodel.parse_sky_model.__wrapped__ if hasattr(
+        skymodel.parse_sky_model, "__wrapped__") else None
+    # -0 deg declination must stay negative (sign read from the token)
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "s.txt")
+        with open(p, "w") as f:
+            f.write("PX 1 0 0 -0 30 0 1 0 0 0 0 0 0 0 0 150e6\n")
+        srcs = skymodel.parse_sky_model(p, 0.0, 0.0, 150e6)
+    assert srcs["PX"].dec < 0
+
+
+def test_ignore_and_rho(tmp_path):
+    ig = tmp_path / "ignore.txt"
+    ig.write_text("-1\n10\n999\n")
+    assert skymodel.read_ignore_list(str(ig)) == {-1, 10, 999}
+
+    rho = tmp_path / "rho.txt"
+    rho.write_text("# id hybrid rho\n0 1 12.5\n-2 1 3.0\n")
+    arr = skymodel.read_cluster_rho(str(rho), np.array([0, -2, 7]), default_rho=5.0)
+    np.testing.assert_allclose(arr, [12.5, 3.0, 5.0])
+
+
+def test_shapelet_modes(tmp_path):
+    # n0=2, beta=0.01, 4 modes
+    mf = tmp_path / "S1.fits.modes"
+    mf.write_text("0 0 0.0 0 0 0.0\n2 0.01\n0 1.0\n1 0.5\n2 -0.25\n3 0.125\n")
+    sky = tmp_path / "sky.txt"
+    sky.write_text("S1 0 0 0 0 0 0 1 0 0 0 0 0 1 1 0 150e6\n")
+    srcs = skymodel.parse_sky_model(str(sky), 0.0, 0.0, 150e6)
+    s = srcs["S1"]
+    assert s.stype == skymodel.STYPE_SHAPELET
+    assert s.sh_n0 == 2
+    np.testing.assert_allclose(s.sh_beta, 0.01)
+    np.testing.assert_allclose(s.sh_modes, [1.0, 0.5, -0.25, 0.125])
+
+
+def test_coords_roundtrip():
+    from sagecal_tpu import coords
+    import jax.numpy as jnp
+    # geodetic round-trip sanity: LOFAR core approx position
+    lon, lat, h = coords.xyz2llh(jnp.array(3826577.0), jnp.array(461022.0),
+                                 jnp.array(5064892.0))
+    assert abs(float(lon) - 0.12) < 0.05   # ~6.87 deg E
+    assert abs(float(lat) - 0.924) < 0.01  # ~52.9 deg N
+    assert abs(float(h)) < 200.0
+
+    # az/el: a source near the pole seen from mid-latitude has
+    # el close to the latitude, for any time of day
+    az, el = coords.radec2azel(jnp.array(0.3), jnp.array(jnp.pi / 2 - 1e-6),
+                               jnp.array(0.1), jnp.array(0.9),
+                               jnp.array(2455000.5))
+    np.testing.assert_allclose(float(el), 0.9, atol=1e-4)
+    assert 0.0 <= float(az) < 2 * np.pi
+
+    # precession over ~26 yr moves coordinates by arcminutes, not degrees
+    pm = coords.precession_matrix(jnp.array(2455000.5))
+    ra, dec = coords.precess_radec(jnp.array(1.0), jnp.array(0.5), pm)
+    assert abs(float(ra) - 1.0) < 0.01
+    assert abs(float(dec) - 0.5) < 0.01

@@ -1,0 +1,2 @@
+from sagecal_tpu.io import dataset as dataset
+from sagecal_tpu.io import solutions as solutions

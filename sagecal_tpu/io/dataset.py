@@ -1,0 +1,260 @@
+"""Visibility containers, the SimMS on-disk format, and synthetic generation.
+
+Toward parity with reference ``src/MS/data.cpp`` semantics (loadData:522:
+TIME/ANT sort, autocorrelation drop, channel averaging, flag ratio),
+re-expressed over an abstract dataset. Per-channel flags and the
+>=half-unflagged channel-averaging rule (data.cpp:594-610) belong to the
+casacore MS backend and are not represented here yet — VisTile flags are
+per-row:
+
+- :class:`VisTile` — one solve interval of device-ready arrays.
+- :class:`SimMS` — a minimal columnar on-disk dataset (npz per tile group)
+  standing in for a CASA MeasurementSet: the image has no casacore, so
+  MS access is a backend interface; SimMS is the native backend and a
+  python-casacore backend can slot in where available.
+- :func:`simulate_dataset` — the analogue of the reference test harness
+  (test/Calibration/Generate_sources.py + Change_freq.py): synthesize
+  uvw tracks for an array, predict a sky, corrupt with known Jones + noise.
+  This is the round-trip oracle for calibration tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+
+C_M_S = 299792458.0
+OMEGA_E = 7.2921150e-5  # earth angular velocity rad/s
+
+
+@dataclasses.dataclass
+class VisTile:
+    """One solve interval (tile) of visibilities, host-side numpy.
+
+    Layout matches the reference data model (SURVEY.md section 1): rows are
+    ordered [tilesz, nbase] flattened, i.e. row = t*nbase + bl; u,v,w in
+    seconds; ``x`` is the multi-channel data [B, F, 2, 2] complex;
+    ``flags`` per row (0 ok, 1 flagged, 2 uv-cut).
+    """
+
+    u: np.ndarray            # [B] seconds
+    v: np.ndarray
+    w: np.ndarray
+    x: np.ndarray            # [B, F, 2, 2] complex
+    flags: np.ndarray        # [B] int8
+    sta1: np.ndarray         # [B] int32
+    sta2: np.ndarray         # [B] int32
+    freqs: np.ndarray        # [F] Hz
+    freq0: float             # reference (mean) frequency Hz
+    fdelta: float            # total bandwidth Hz
+    tdelta: float            # integration time s
+    dec0: float              # phase-center declination rad
+    ra0: float               # phase-center RA rad
+    n_stations: int
+    nbase: int               # baselines per timeslot
+    tilesz: int              # timeslots in this tile
+    time_mjd: np.ndarray | None = None   # [tilesz] time centroid (s, MJD)
+
+    @property
+    def nrows(self) -> int:
+        return self.u.shape[0]
+
+    @property
+    def flag_ratio(self) -> float:
+        """Fraction of flagged rows (data.cpp:659-663 ``fratio``)."""
+        return float(np.mean(self.flags == 1))
+
+    def averaged(self):
+        """Channel-average data -> [B, 2, 2]; flagged rows zeroed.
+
+        Mirrors loadData's averaging into ``x`` while ``xo`` keeps channels
+        (data.cpp:594-610). Weighting is a plain mean over channels.
+        """
+        xa = self.x.mean(axis=1)
+        xa[self.flags == 1] = 0.0
+        return xa
+
+
+def generate_baselines(n_stations: int):
+    """All cross-correlation pairs (p < q), reference generate_baselines."""
+    p, q = np.triu_indices(n_stations, k=1)
+    return p.astype(np.int32), q.astype(np.int32)
+
+
+def uvw_tracks(xyz: np.ndarray, dec0: float, ha: np.ndarray):
+    """Baseline uvw (meters) for source hour angles ``ha`` [T] given station
+    ITRF-like positions ``xyz`` [N, 3]. Standard synthesis rotation; the
+    phase-center RA enters only through ha = LST - ra0, which the caller
+    supplies."""
+    p, q = generate_baselines(xyz.shape[0])
+    bl = xyz[q] - xyz[p]  # [B0, 3]
+    sh, ch = np.sin(ha), np.cos(ha)
+    sd, cd = np.sin(dec0), np.cos(dec0)
+    # [T, B0]
+    u = sh[:, None] * bl[None, :, 0] + ch[:, None] * bl[None, :, 1]
+    v = (-sd * ch[:, None] * bl[None, :, 0] + sd * sh[:, None] * bl[None, :, 1]
+         + cd * bl[None, :, 2])
+    w = (cd * ch[:, None] * bl[None, :, 0] - cd * sh[:, None] * bl[None, :, 1]
+         + sd * bl[None, :, 2])
+    return u, v, w, p, q
+
+
+def random_array(n_stations: int, extent_m: float = 3000.0,
+                 seed: int = 7) -> np.ndarray:
+    """Pseudo-random LOFAR-like station layout: dense core + outliers."""
+    rng = np.random.default_rng(seed)
+    r = extent_m * rng.random(n_stations) ** 2
+    th = 2 * np.pi * rng.random(n_stations)
+    x = r * np.cos(th)
+    y = r * np.sin(th)
+    z = rng.normal(0.0, extent_m * 0.01, n_stations)
+    return np.stack([x, y, z], axis=1)
+
+
+def random_jones(n_clusters: int, n_chunks, n_stations: int, seed: int = 3,
+                 scale: float = 0.3, diag_dominant: bool = True):
+    """Random smooth per-(cluster, chunk, station) 2x2 Jones, padded
+    [M, Kmax, N, 2, 2] complex."""
+    rng = np.random.default_rng(seed)
+    n_chunks = np.asarray(n_chunks)
+    kmax = int(n_chunks.max())
+    M = n_clusters
+    J = (rng.normal(size=(M, kmax, n_stations, 2, 2))
+         + 1j * rng.normal(size=(M, kmax, n_stations, 2, 2))) * scale
+    if diag_dominant:
+        J = J + np.eye(2)[None, None, None]
+    return J
+
+
+def simulate_dataset(sky_arrays, n_stations: int, tilesz: int,
+                     freqs, ra0: float, dec0: float, tdelta: float = 10.0,
+                     jones: np.ndarray | None = None, nchunk=None,
+                     noise_sigma: float = 0.0, seed: int = 11,
+                     extent_m: float = 3000.0,
+                     flag_fraction: float = 0.0,
+                     chan_width: float | None = None) -> VisTile:
+    """Synthesize a corrupted dataset from a device sky model.
+
+    This is the test oracle (SURVEY.md section 4): model visibilities are
+    predicted per channel with full spectral scaling, corrupted by ``jones``
+    (if given) per cluster, noise added, and packed into a VisTile.
+    """
+    import jax.numpy as jnp
+    from sagecal_tpu.rime import predict as rime_predict
+
+    freqs = np.atleast_1d(np.asarray(freqs, np.float64))
+    xyz = random_array(n_stations, extent_m=extent_m, seed=seed)
+    ha = np.linspace(0.0, OMEGA_E * tdelta * tilesz, tilesz, endpoint=False)
+    u, v, w, p, q = uvw_tracks(xyz, dec0, ha)
+    nbase = p.shape[0]
+    # flatten [T, B0] row-major: row = t*nbase + bl; seconds
+    us = (u / C_M_S).reshape(-1)
+    vs = (v / C_M_S).reshape(-1)
+    ws = (w / C_M_S).reshape(-1)
+    sta1 = np.tile(p, tilesz)
+    sta2 = np.tile(q, tilesz)
+
+    if chan_width is None:
+        chan_width = (float(freqs[1] - freqs[0]) if len(freqs) > 1
+                      else 0.18e6)  # LOFAR-like default channel width
+    fdelta_tot = float(freqs[-1] - freqs[0]) + chan_width
+    fdelta_chan = fdelta_tot / len(freqs)
+
+    coh = rime_predict.coherencies(
+        sky_arrays, jnp.asarray(us), jnp.asarray(vs), jnp.asarray(ws),
+        jnp.asarray(freqs), fdelta_chan, per_channel_flux=True)
+    coh = np.asarray(coh)  # [M, B, F, 2, 2]
+
+    M = coh.shape[0]
+    if nchunk is None:
+        nchunk = np.ones(M, np.int32)
+    if jones is not None:
+        cidx = rime_predict.chunk_indices(tilesz, nbase, nchunk)
+        Jp = jones[np.arange(M)[:, None], cidx, sta1[None, :]]  # [M,B,2,2]
+        Jq = jones[np.arange(M)[:, None], cidx, sta2[None, :]]
+        vis = np.einsum("mbij,mbfjk,mblk->bfil", Jp, coh, Jq.conj())
+    else:
+        vis = coh.sum(axis=0)
+
+    rng = np.random.default_rng(seed + 1)
+    if noise_sigma > 0:
+        vis = vis + noise_sigma * (
+            rng.normal(size=vis.shape) + 1j * rng.normal(size=vis.shape))
+
+    flags = np.zeros(us.shape[0], np.int8)
+    if flag_fraction > 0:
+        nf = int(flag_fraction * len(flags))
+        flags[rng.choice(len(flags), nf, replace=False)] = 1
+
+    return VisTile(
+        u=us, v=vs, w=ws, x=vis.astype(np.complex128), flags=flags,
+        sta1=sta1, sta2=sta2, freqs=freqs, freq0=float(freqs.mean()),
+        fdelta=fdelta_tot, tdelta=tdelta, dec0=dec0, ra0=ra0,
+        n_stations=n_stations, nbase=nbase, tilesz=tilesz)
+
+
+# ---------------------------------------------------------------------------
+# SimMS: minimal columnar on-disk dataset (the native MS stand-in)
+# ---------------------------------------------------------------------------
+
+class SimMS:
+    """Directory dataset: meta.json + per-tile npz files.
+
+    Stands in for a CASA MeasurementSet where casacore is unavailable.
+    Supports the reference's streaming tile iteration (MSIter analogue,
+    fullbatch_mode.cpp:297) and write-back of residuals
+    (Data::writeData, data.cpp:1259).
+    """
+
+    META = "meta.json"
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(os.path.join(path, self.META)) as f:
+            self.meta = json.load(f)
+
+    @classmethod
+    def create(cls, path: str, tiles: list[VisTile]) -> "SimMS":
+        os.makedirs(path, exist_ok=True)
+        t0 = tiles[0]
+        meta = {
+            "n_tiles": len(tiles), "n_stations": t0.n_stations,
+            "nbase": t0.nbase, "tilesz": t0.tilesz,
+            "freqs": list(map(float, t0.freqs)), "freq0": t0.freq0,
+            "fdelta": t0.fdelta, "tdelta": t0.tdelta,
+            "ra0": t0.ra0, "dec0": t0.dec0,
+        }
+        with open(os.path.join(path, cls.META), "w") as f:
+            json.dump(meta, f, indent=1)
+        for i, t in enumerate(tiles):
+            np.savez(os.path.join(path, f"tile{i:05d}.npz"),
+                     u=t.u, v=t.v, w=t.w, x=t.x, flags=t.flags,
+                     sta1=t.sta1, sta2=t.sta2)
+        return cls(path)
+
+    @property
+    def n_tiles(self) -> int:
+        return self.meta["n_tiles"]
+
+    def read_tile(self, i: int) -> VisTile:
+        z = np.load(os.path.join(self.path, f"tile{i:05d}.npz"))
+        m = self.meta
+        return VisTile(
+            u=z["u"], v=z["v"], w=z["w"], x=z["x"], flags=z["flags"],
+            sta1=z["sta1"], sta2=z["sta2"],
+            freqs=np.asarray(m["freqs"]), freq0=m["freq0"],
+            fdelta=m["fdelta"], tdelta=m["tdelta"], dec0=m["dec0"],
+            ra0=m["ra0"], n_stations=m["n_stations"], nbase=m["nbase"],
+            tilesz=m["tilesz"])
+
+    def write_tile(self, i: int, tile: VisTile) -> None:
+        np.savez(os.path.join(self.path, f"tile{i:05d}.npz"),
+                 u=tile.u, v=tile.v, w=tile.w, x=tile.x, flags=tile.flags,
+                 sta1=tile.sta1, sta2=tile.sta2)
+
+    def tiles(self):
+        for i in range(self.n_tiles):
+            yield i, self.read_tile(i)

@@ -1,0 +1,2 @@
+from sagecal_tpu.rime import envelopes as envelopes
+from sagecal_tpu.rime import predict as predict

@@ -3,6 +3,9 @@
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on XLA's host-platform virtual devices, exactly how the driver's
 ``dryrun_multichip`` exercises the code.
+
+Note: pytest plugins import jax before this conftest runs, so env vars are
+too late — use jax.config updates (valid until a backend is initialized).
 """
 
 import os
@@ -12,8 +15,12 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "true")
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 jax.config.update("jax_enable_x64", True)
+
+assert jax.devices()[0].platform == "cpu"
+assert len(jax.devices()) == 8

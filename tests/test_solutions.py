@@ -1,0 +1,42 @@
+"""Solution-file format tests (reference README.md:184-200 layout)."""
+
+import numpy as np
+
+from sagecal_tpu.io import solutions as sol
+
+
+def test_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    N, M = 3, 2
+    nchunk = np.array([1, 2])
+    kmax = 2
+    J = rng.normal(size=(M, kmax, N, 2, 2)) + 1j * rng.normal(size=(M, kmax, N, 2, 2))
+    J[0, 1] = J[0, 0]  # unused slot mirrors last live chunk
+
+    path = str(tmp_path / "sol.txt")
+    with sol.SolutionWriter(path, 150e6, 10e6, 2.0, N, M, int(nchunk.sum())) as w:
+        w.write_interval(J, nchunk)
+        w.write_interval(J * 2, nchunk)
+
+    header, blocks = sol.read_solutions(path, nchunk)
+    assert header["n_stations"] == N
+    assert header["n_eff_clusters"] == 3
+    assert len(blocks) == 2
+    np.testing.assert_allclose(blocks[0], J, rtol=1e-5)
+    np.testing.assert_allclose(blocks[1], 2 * J, rtol=1e-5)
+
+
+def test_reference_column_order():
+    # clusters are written reversed (fullbatch_mode.cpp:586): with M=2,
+    # first column belongs to cluster 1
+    N = 1
+    nchunk = np.array([1, 1])
+    J = np.zeros((2, 1, N, 2, 2), complex)
+    J[0, 0, 0] = np.array([[1.0, 0], [0, 1.0]])
+    J[1, 0, 0] = np.array([[2.0, 0], [0, 2.0]])
+    cols = sol.jones_to_columns(J, nchunk)
+    assert cols.shape == (8, 2)
+    assert cols[0, 0] == 2.0  # cluster 1 first
+    assert cols[0, 1] == 1.0
+    back = sol.columns_to_jones(cols, nchunk)
+    np.testing.assert_allclose(back, J)

@@ -173,9 +173,11 @@ def simulate_dataset(sky_arrays, n_stations: int, tilesz: int,
         nchunk = np.ones(M, np.int32)
     if jones is not None:
         cidx = rime_predict.chunk_indices(tilesz, nbase, nchunk)
-        Jp = jones[np.arange(M)[:, None], cidx, sta1[None, :]]  # [M,B,2,2]
-        Jq = jones[np.arange(M)[:, None], cidx, sta2[None, :]]
-        vis = np.einsum("mbij,mbfjk,mblk->bfil", Jp, coh, Jq.conj())
+        vis = np.zeros(coh.shape[1:], coh.dtype)
+        for m in range(M):
+            vis += np.asarray(rime_predict.apply_jones(
+                jnp.asarray(coh[m]), jnp.asarray(jones[m]),
+                jnp.asarray(sta1), jnp.asarray(sta2), jnp.asarray(cidx[m])))
     else:
         vis = coh.sum(axis=0)
 

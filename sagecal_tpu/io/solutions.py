@@ -129,4 +129,11 @@ def read_solutions(path: str, nchunk: np.ndarray):
                 blocks.append(columns_to_jones(np.asarray(rows).reshape(n8, -1),
                                                nchunk))
                 rows = []
+    if rows:
+        # fail loudly on a truncated interval, like the reference reader's
+        # EOF warning (readsky.c:733) — resuming from a half-written
+        # checkpoint must not silently drop state
+        raise ValueError(
+            f"solution file {path!r} ends mid-interval "
+            f"({len(rows)}/{n8} rows); truncated checkpoint?")
     return header, blocks

@@ -163,7 +163,11 @@ def coherencies(sky: SkyArrays, u, v, w, freqs, fdelta,
     ``with_shapelets`` defaults to auto-detect (static) from the model.
     """
     if with_shapelets is None:
-        with_shapelets = bool(np.any(np.asarray(sky.sh_n0) > 0))
+        if isinstance(sky.sh_n0, jax.core.Tracer):
+            # under jit we cannot inspect values; keep the general path
+            with_shapelets = True
+        else:
+            with_shapelets = bool(np.any(np.asarray(sky.sh_n0) > 0))
     n0max = int(np.sqrt(sky.sh_modes.shape[-1]).round())
 
     def per_cluster(csky):

@@ -325,7 +325,12 @@ def build_cluster_sky(sources: dict, clusters: list,
             if s.stype == STYPE_SHAPELET:
                 c.sh_n0[ci, sj] = s.sh_n0
                 c.sh_beta[ci, sj] = s.sh_beta
-                c.sh_modes[ci, sj, : s.sh_n0 ** 2] = s.sh_modes
+                # re-grid the n0-stride mode vector onto the padded
+                # n0max-stride grid so mode (n2, n1) keeps its identity
+                grid = np.zeros((n0max, n0max), dtype=dtype)
+                grid[: s.sh_n0, : s.sh_n0] = np.asarray(
+                    s.sh_modes).reshape(s.sh_n0, s.sh_n0)
+                c.sh_modes[ci, sj] = grid.ravel()
             c.smask[ci, sj] = True
     return c
 

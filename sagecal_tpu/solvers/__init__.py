@@ -1,0 +1,5 @@
+from sagecal_tpu.solvers import lbfgs as lbfgs
+from sagecal_tpu.solvers import lm as lm
+from sagecal_tpu.solvers import normal_eq as normal_eq
+from sagecal_tpu.solvers import robust as robust
+from sagecal_tpu.solvers import sage as sage

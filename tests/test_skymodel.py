@@ -105,6 +105,33 @@ def test_shapelet_modes(tmp_path):
     np.testing.assert_allclose(s.sh_modes, [1.0, 0.5, -0.25, 0.125])
 
 
+def test_mixed_order_shapelet_padding(tmp_path):
+    # two shapelets with n0=1 and n0=2: the n0=1 source's single mode must
+    # land at grid (0,0) of the padded n0max=2 grid, not be scrambled
+    (tmp_path / "S1.fits.modes").write_text(
+        "0 0 0 0 0 0\n1 0.02\n0 3.0\n")
+    (tmp_path / "S2.fits.modes").write_text(
+        "0 0 0 0 0 0\n2 0.01\n0 1.0\n1 0.5\n2 -0.25\n3 0.125\n")
+    sky = tmp_path / "sky.txt"
+    sky.write_text("S1 0 0 0 0 0 0 1 0 0 0 0 0 1 1 0 150e6\n"
+                   "S2 1 0 0 0 0 0 1 0 0 0 0 0 1 1 0 150e6\n")
+    srcs = skymodel.parse_sky_model(str(sky), 0.0, 0.0, 150e6)
+    c = skymodel.build_cluster_sky(srcs, [(0, 1, ["S1", "S2"])])
+    # padded grid stride is n0max=2: S1's mode at flat index 0, rest zero
+    np.testing.assert_allclose(c.sh_modes[0, 0], [3.0, 0, 0, 0])
+    # S2 occupies the full 2x2 grid in (n2, n1) order
+    np.testing.assert_allclose(c.sh_modes[0, 1], [1.0, 0.5, -0.25, 0.125])
+
+
+def test_truncated_solution_file(tmp_path):
+    import pytest as _pytest
+    from sagecal_tpu.io import solutions as sol
+    p = tmp_path / "sol.txt"
+    p.write_text("150.0 10.0 2.0 2 1 1\n0 1.0\n1 0.0\n2 0.0\n")  # 3 of 16 rows
+    with _pytest.raises(ValueError, match="mid-interval"):
+        sol.read_solutions(str(p), np.array([1]))
+
+
 def test_coords_roundtrip():
     from sagecal_tpu import coords
     import jax.numpy as jnp

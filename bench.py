@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Benchmark: visibilities calibrated per second per chip.
+
+Runs one SAGE-EM solve interval (the fullbatch hot path: coherency predict +
+EM cluster solves + joint LBFGS refine) on the default JAX device (the real
+TPU chip under the driver), f32, and prints ONE JSON line:
+
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md), so the
+recorded ratio is against this machine's host CPU running the identical
+program — the honest locally-measurable stand-in until a reference CPU
+build is benchmarked.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# problem shape: LOFAR-like smoke config (BASELINE.json configs[0] scaled):
+N_STATIONS = 62
+N_CLUSTERS = 8
+TILESZ = 10
+SEED = 17
+
+
+def build_problem(dtype):
+    import jax.numpy as jnp
+    from sagecal_tpu import skymodel
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.rime import predict as rp
+
+    rng = np.random.default_rng(SEED)
+    srcs, clusters = {}, []
+    for m in range(N_CLUSTERS):
+        names = []
+        for s in range(3):
+            nm = f"P{m}_{s}"
+            ll, mm = rng.normal(0, 0.03, 2)
+            nn = np.sqrt(1 - ll * ll - mm * mm)
+            flux = float(1 + 2 * rng.random())
+            srcs[nm] = skymodel.Source(
+                name=nm, ra=0, dec=0, ll=ll, mm=mm, nn=nn - 1, sI=flux,
+                sQ=0.0, sU=0.0, sV=0.0, sI0=flux, sQ0=0, sU0=0, sV0=0,
+                spec_idx=0, spec_idx1=0, spec_idx2=0, f0=150e6)
+            names.append(nm)
+        clusters.append((m, 1, names))
+    sky = skymodel.build_cluster_sky(srcs, clusters)
+    dsky = rp.sky_to_device(sky, dtype)
+    Jtrue = ds.random_jones(N_CLUSTERS, sky.nchunk, N_STATIONS, seed=SEED + 1,
+                            scale=0.2)
+    tile = ds.simulate_dataset(dsky, n_stations=N_STATIONS, tilesz=TILESZ,
+                               freqs=[150e6], ra0=0.1, dec0=0.9,
+                               jones=Jtrue, nchunk=sky.nchunk,
+                               noise_sigma=0.01, seed=SEED + 2)
+    return sky, dsky, tile
+
+
+def run_once(device, dtype):
+    import jax
+    import jax.numpy as jnp
+    from sagecal_tpu import utils
+    from sagecal_tpu.config import SolverMode
+    from sagecal_tpu.rime import predict as rp
+    from sagecal_tpu.solvers import lm as lm_mod, normal_eq as ne, sage
+
+    sky, dsky, tile = build_problem(dtype)
+    kmax = int(sky.nchunk.max())
+    cidx = rp.chunk_indices(TILESZ, tile.nbase, sky.nchunk)
+    cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
+    xa = tile.averaged()
+    x8 = np.stack([xa.reshape(-1, 4).real, xa.reshape(-1, 4).imag],
+                  -1).reshape(-1, 8)
+    J0 = np.tile(np.eye(2, dtype=complex),
+                 (N_CLUSTERS, kmax, N_STATIONS, 1, 1))
+    cfg = sage.SageConfig(max_emiter=3, max_iter=10, max_lbfgs=10,
+                          solver_mode=int(SolverMode.RTR_OSRLM_RLBFGS))
+
+    put = lambda a, dt: jax.device_put(jnp.asarray(a, dt), device)
+
+    u, v, w = (put(tile.u, dtype), put(tile.v, dtype), put(tile.w, dtype))
+    wt = lm_mod.make_weights(put(tile.flags, jnp.int32), dtype)
+    # Jones cross the boundary as [.., 8] reals (complex h2d/d2h is
+    # unimplemented on the axon TPU runtime)
+    J0d = put(utils.jones_c2r_np(J0), dtype)
+    cidx_d = put(cidx, jnp.int32)
+    cmask_d = put(cmask, bool)
+    freq = put([tile.freq0], dtype)
+    dsky = jax.device_put(dsky, device)
+
+    @jax.jit
+    def step(x8, u, v, w, sta1, sta2, wt, J0_r8):
+        coh = rp.coherencies(dsky, u, v, w, freq, tile.fdelta)[:, :, 0]
+        J, info = sage.sagefit(x8, coh, sta1, sta2, cidx_d, cmask_d,
+                               ne.jones_r2c(J0_r8), N_STATIONS, wt,
+                               config=cfg)
+        return ne.jones_c2r(J), info["res_0"], info["res_1"]
+
+    x8d = put(x8, dtype)
+    s1, s2 = put(tile.sta1, jnp.int32), put(tile.sta2, jnp.int32)
+    # warmup/compile
+    J, r0, r1 = step(x8d, u, v, w, s1, s2, wt, J0d)
+    jax.block_until_ready(J)
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        J, r0, r1 = step(x8d, u, v, w, s1, s2, wt, J0d)
+    jax.block_until_ready(J)
+    dt = (time.perf_counter() - t0) / reps
+    nvis = tile.nrows * len(tile.freqs)  # rows x channels calibrated
+    return nvis / dt, float(r0), float(r1)
+
+
+def main():
+    import jax
+    dev = jax.devices()[0]
+    import jax.numpy as jnp
+    vis_per_sec, r0, r1 = run_once(dev, jnp.float32)
+
+    try:
+        cpu = jax.devices("cpu")[0]
+        cpu_vis_per_sec, _, _ = run_once(cpu, jnp.float32)
+        vs = vis_per_sec / cpu_vis_per_sec
+    except Exception:
+        vs = 1.0
+
+    print(json.dumps({
+        "metric": "visibilities calibrated/sec/chip",
+        "value": round(vis_per_sec, 1),
+        "unit": "vis/s",
+        "vs_baseline": round(vs, 3),
+    }))
+    print(f"# device={dev.platform} res_0={r0:.4g} res_1={r1:.4g} "
+          f"reduction={r1 / max(r0, 1e-30):.3g}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

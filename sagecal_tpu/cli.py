@@ -1,0 +1,115 @@
+"""``sagecal-tpu`` command line: flag parity with the reference binary.
+
+Reference: ``src/MS/main.cpp:107-257`` (ParseCmdLine). Single-letter flags
+keep their reference meaning so existing invocations translate directly;
+long aliases are added for readability. Dispatch mirrors main.cpp:288-299:
+stochastic-consensus if -N>0 and -A>1 and -w>1; stochastic if -N>0;
+otherwise full batch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from sagecal_tpu.config import (BeamMode, RunConfig, SimulationMode,
+                                SolverMode)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sagecal-tpu",
+        description="TPU-native direction-dependent calibration "
+                    "(capability parity with sagecal)")
+    a = p.add_argument
+    a("-d", "--ms", help="dataset (SimMS directory or MS)")
+    a("-f", "--ms-list", help="file/glob listing multiple datasets")
+    a("-s", "--sky-model", required=False)
+    a("-c", "--cluster-file", required=False)
+    a("-p", "--solutions-file", help="solutions out (or in, for -a modes)")
+    a("-q", "--init-solutions", help="warm-start solutions file")
+    a("-F", "--format", type=int, default=0,
+      help="1: sky model has 3rd-order spectral indices")
+    a("-t", "--tile-size", type=int, default=120)
+    a("-e", "--max-em-iter", type=int, default=3)
+    a("-g", "--single-max-iter", type=int, default=2)
+    a("-l", "--max-iter", type=int, default=10)
+    a("-m", "--max-lbfgs", type=int, default=10)
+    a("-x", "--lbfgs-m", type=int, default=7)
+    a("-n", "--n-threads", type=int, default=4)
+    a("-j", "--solver-mode", type=int, default=5,
+      help="0 LM, 1 OSLM, 2 OSRLM, 3 RLM, 4 RTR, 5 RRTR (default), 6 NSD")
+    a("-L", "--nulow", type=float, default=2.0)
+    a("-H", "--nuhigh", type=float, default=30.0)
+    a("-y", "--linsolv", type=int, default=1)
+    a("-R", "--randomize", type=int, default=1)
+    a("-I", "--uvmin", type=float, default=0.0)
+    a("-o", "--uvmax", type=float, default=1e9)
+    a("-W", "--whiten", type=int, default=0)
+    a("-w", "--nsolbw", type=int, default=1,
+      help="frequency mini-bands for bandpass consensus")
+    a("-b", "--per-channel", type=int, default=0)
+    a("-a", "--simulation", type=int, default=0,
+      help="1 simulate, 2 add model, 3 subtract model")
+    a("-z", "--ignore-clusters", help="file of cluster ids to ignore")
+    a("-k", "--correct-cluster", type=int, default=None,
+      help="cluster id whose solutions correct the residual")
+    a("-B", "--beam", type=int, default=0)
+    a("-N", "--epochs", type=int, default=0,
+      help=">0 enables stochastic (minibatch) calibration")
+    a("-M", "--minibatches", type=int, default=1)
+    a("-A", "--admm", type=int, default=1)
+    a("-P", "--npoly", type=int, default=2)
+    a("-Q", "--polytype", type=int, default=2)
+    a("-r", "--rho", type=float, default=5.0)
+    a("-G", "--rho-file", default=None)
+    a("-T", "--max-timeslots", type=int, default=0)
+    a("-V", "--verbose", action="store_true")
+    return p
+
+
+def config_from_args(args) -> RunConfig:
+    return RunConfig(
+        ms=args.ms, ms_list=args.ms_list, sky_model=args.sky_model,
+        cluster_file=args.cluster_file, solutions_file=args.solutions_file,
+        init_solutions=args.init_solutions, format_3=bool(args.format),
+        tile_size=args.tile_size, max_em_iter=args.max_em_iter,
+        single_max_iter=args.single_max_iter, max_iter=args.max_iter,
+        max_lbfgs=args.max_lbfgs, lbfgs_m=args.lbfgs_m,
+        n_threads=args.n_threads, solver_mode=SolverMode(args.solver_mode),
+        robust_nulow=args.nulow, robust_nuhigh=args.nuhigh,
+        linsolv=args.linsolv, randomize=bool(args.randomize),
+        uvmin=args.uvmin, uvmax=args.uvmax, whiten=bool(args.whiten),
+        channel_avg_per_band=args.nsolbw,
+        per_channel_bfgs=bool(args.per_channel),
+        simulation=SimulationMode(args.simulation),
+        ignore_clusters_file=args.ignore_clusters,
+        correct_cluster=args.correct_cluster, beam_mode=BeamMode(args.beam),
+        n_epochs=args.epochs, n_minibatches=args.minibatches,
+        n_admm=args.admm, n_poly=args.npoly, poly_type=args.polytype,
+        admm_rho=args.rho, rho_file=args.rho_file,
+        max_timeslots=args.max_timeslots, verbose=args.verbose)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    if not cfg.ms or not cfg.sky_model or not cfg.cluster_file:
+        print("need -d dataset, -s sky model, -c cluster file",
+              file=sys.stderr)
+        return 2
+
+    from sagecal_tpu import pipeline
+    if cfg.n_epochs > 0:
+        from sagecal_tpu import stochastic
+        if cfg.n_admm > 1 and cfg.channel_avg_per_band > 1:
+            stochastic.run_minibatch_consensus(cfg)
+        else:
+            stochastic.run_minibatch(cfg)
+    else:
+        pipeline.run(cfg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -163,10 +163,11 @@ def simulate_dataset(sky_arrays, n_stations: int, tilesz: int,
     fdelta_tot = float(freqs[-1] - freqs[0]) + chan_width
     fdelta_chan = fdelta_tot / len(freqs)
 
+    from sagecal_tpu.utils import to_np_complex
     coh = rime_predict.coherencies(
         sky_arrays, jnp.asarray(us), jnp.asarray(vs), jnp.asarray(ws),
         jnp.asarray(freqs), fdelta_chan, per_channel_flux=True)
-    coh = np.asarray(coh)  # [M, B, F, 2, 2]
+    coh = to_np_complex(coh)  # [M, B, F, 2, 2]
 
     M = coh.shape[0]
     if nchunk is None:
@@ -175,9 +176,10 @@ def simulate_dataset(sky_arrays, n_stations: int, tilesz: int,
         cidx = rime_predict.chunk_indices(tilesz, nbase, nchunk)
         vis = np.zeros(coh.shape[1:], coh.dtype)
         for m in range(M):
-            vis += np.asarray(rime_predict.apply_jones(
-                jnp.asarray(coh[m]), jnp.asarray(jones[m]),
-                jnp.asarray(sta1), jnp.asarray(sta2), jnp.asarray(cidx[m])))
+            # host-side einsum: complex arrays cannot cross to device here
+            Jp = jones[m][cidx[m], sta1]
+            Jq = jones[m][cidx[m], sta2]
+            vis += np.einsum("bij,bfjk,blk->bfil", Jp, coh[m], Jq.conj())
     else:
         vis = coh.sum(axis=0)
 

@@ -1,0 +1,281 @@
+"""End-to-end calibration pipelines (the application layer).
+
+Capability parity with reference ``src/MS/fullbatch_mode.cpp``
+(``run_fullbatch_calibration``:38): stream solve intervals (tiles) from the
+dataset, predict solve-path coherencies, run SAGE-EM, compute/write
+residuals and solutions, with the reference's convergence heuristics:
+
+- first-tile iteration boost: 4x EM iterations for arrays <= LMCUT (=40)
+  stations, 6x otherwise (fullbatch_mode.cpp:397);
+- LMCUT solver downgrade: RTR/NSD modes fall back to ordered-subsets LM
+  for small arrays (fullbatch_mode.cpp:397,431; sagecalmain.h:24);
+- divergence reset: residual 0 / non-finite / > 5x best resets solutions
+  to the initial values and re-arms the first-tile boost
+  (fullbatch_mode.cpp:605-621, res_ratio fullbatch_mode.cpp:239);
+- simulation modes -a 1/2/3 with optional solutions replay + ignore list
+  (fullbatch_mode.cpp:524-578).
+
+Device policy: one jitted solve program reused across tiles (shapes are
+static per dataset); host streams tiles and writes residuals back.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from sagecal_tpu import skymodel, utils
+from sagecal_tpu.config import RunConfig, SimulationMode, SolverMode
+from sagecal_tpu.solvers import normal_eq as ne
+from sagecal_tpu.io import dataset as ds
+from sagecal_tpu.io import solutions as sol
+from sagecal_tpu.rime import predict as rp
+from sagecal_tpu.rime import residual as rr
+from sagecal_tpu.solvers import lm as lm_mod
+from sagecal_tpu.solvers import sage
+
+LMCUT = 40      # sagecalmain.h:24
+RES_RATIO = 5.0  # fullbatch_mode.cpp:239
+
+
+def effective_solver_mode(mode: int, n_stations: int) -> int:
+    """LMCUT downgrade (fullbatch_mode.cpp:397)."""
+    if n_stations <= LMCUT and mode == int(SolverMode.RTR_OSLM_LBFGS):
+        return int(SolverMode.OSLM_LBFGS)
+    if n_stations <= LMCUT and mode in (int(SolverMode.RTR_OSRLM_RLBFGS),
+                                        int(SolverMode.NSD_RLBFGS)):
+        return int(SolverMode.OSLM_OSRLM_RLBFGS)
+    return mode
+
+
+def first_tile_boost(n_stations: int) -> int:
+    return 4 if n_stations <= LMCUT else 6
+
+
+def _to_x8(xa: np.ndarray) -> np.ndarray:
+    f = xa.reshape(-1, 4)
+    return np.stack([f.real, f.imag], -1).reshape(-1, 8)
+
+
+class FullBatchPipeline:
+    """Reusable jitted solve over a SimMS-like dataset."""
+
+    def __init__(self, cfg: RunConfig, ms: ds.SimMS, sky: skymodel.ClusterSky,
+                 real_dtype=None):
+        self.cfg = cfg
+        self.ms = ms
+        self.sky = sky
+        platform = jax.devices()[0].platform
+        if real_dtype is None:
+            real_dtype = jnp.float64 if (
+                platform == "cpu" and jax.config.read("jax_enable_x64")
+            ) else jnp.float32
+        self.rdt = real_dtype
+        self.dsky = rp.sky_to_device(sky, real_dtype)
+        meta = ms.meta
+        self.kmax = int(sky.nchunk.max())
+        self.cmask = np.arange(self.kmax)[None, :] < sky.nchunk[:, None]
+        self.cidx = rp.chunk_indices(meta["tilesz"], meta["nbase"],
+                                     sky.nchunk)
+        self.n = meta["n_stations"]
+        mode = effective_solver_mode(int(cfg.solver_mode), self.n)
+        self.base_cfg = sage.SageConfig(
+            max_emiter=cfg.max_em_iter, max_iter=cfg.max_iter,
+            max_lbfgs=0 if cfg.per_channel_bfgs else cfg.max_lbfgs,
+            lbfgs_m=cfg.lbfgs_m, solver_mode=mode, nulow=cfg.robust_nulow,
+            nuhigh=cfg.robust_nuhigh, randomize=cfg.randomize,
+            linsolv=cfg.linsolv)
+        self.boost = first_tile_boost(self.n)
+
+        self._solve_first = self._build_solver(self.boost)
+        self._solve_rest = self._build_solver(1)
+        self._residual_fn = jax.jit(self._residuals)
+
+    # NOTE on jit boundaries: complex arrays cannot cross host<->device on
+    # the axon TPU runtime, so solvers take/return Jones as [.., N, 8]
+    # reals and visibilities as stacked [..., 2] real pairs (utils.c2r).
+
+    def _build_solver(self, emiter_mult: int):
+        scfg = self.base_cfg._replace(
+            max_emiter=self.base_cfg.max_emiter * emiter_mult)
+        meta = self.ms.meta
+        freq0 = meta["freq0"]
+        fdelta = meta["fdelta"]
+        cidx = jnp.asarray(self.cidx)
+        cmask = jnp.asarray(self.cmask)
+
+        def solve(x8, u, v, w, sta1, sta2, wt, J0_r8):
+            coh = rp.coherencies(self.dsky, u, v, w,
+                                 jnp.asarray([freq0], x8.dtype),
+                                 fdelta)[:, :, 0]
+            J0 = ne.jones_r2c(J0_r8)
+            J, info = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask, J0,
+                                   self.n, wt, config=scfg)
+            return ne.jones_c2r(J), info
+        return jax.jit(solve)
+
+    def _residuals(self, J_r8, x_r, u, v, w, sta1, sta2):
+        meta = self.ms.meta
+        freqs = jnp.asarray(meta["freqs"], self.rdt)
+        sub = jnp.asarray(self.sky.subtract_mask())
+        correct_idx = None
+        if self.cfg.correct_cluster is not None:
+            matches = np.where(self.sky.cluster_ids
+                               == self.cfg.correct_cluster)[0]
+            if len(matches):
+                correct_idx = int(matches[0])
+        J = ne.jones_r2c(J_r8)
+        x = utils.r2c(x_r)
+        res = rr.calculate_residuals_multifreq(
+            self.dsky, J, x, u, v, w, freqs,
+            meta["fdelta"] / len(meta["freqs"]), sta1, sta2,
+            jnp.asarray(self.cidx), sub, correct_idx=correct_idx)
+        return utils.c2r(res)
+
+    def initial_jones(self) -> np.ndarray:
+        M = self.sky.n_clusters
+        J0 = np.tile(np.eye(2, dtype=np.complex128),
+                     (M, self.kmax, self.n, 1, 1))
+        if self.cfg.init_solutions:
+            _, blocks = sol.read_solutions(self.cfg.init_solutions,
+                                           self.sky.nchunk)
+            if blocks:
+                J0 = blocks[-1]
+        return J0
+
+    def run(self, write_residuals: bool = True, solution_path=None,
+            max_tiles=None, log=print):
+        cfg, ms, sky = self.cfg, self.ms, self.sky
+        meta = ms.meta
+        cdt = jnp.complex64 if self.rdt == jnp.float32 else jnp.complex128
+
+        pinit = self.initial_jones()
+        J = pinit.copy()
+        writer = None
+        if solution_path:
+            writer = sol.SolutionWriter(
+                solution_path, meta["freq0"], meta["fdelta"],
+                meta["tilesz"] * meta["tdelta"] / 60.0, self.n,
+                sky.n_clusters, sky.n_eff_clusters)
+
+        res_prev = None
+        first = True
+        history = []
+        for ti, tile in ms.tiles():
+            if max_tiles is not None and ti >= max_tiles:
+                break
+            t0 = time.time()
+            u = jnp.asarray(tile.u, self.rdt)
+            v = jnp.asarray(tile.v, self.rdt)
+            w = jnp.asarray(tile.w, self.rdt)
+            flags = rp.uvcut_flags(jnp.asarray(tile.flags, jnp.int32),
+                                   u, v, jnp.asarray(tile.freqs, self.rdt),
+                                   cfg.uvmin, cfg.uvmax)
+            xa = tile.averaged()
+            x8 = jnp.asarray(_to_x8(xa), self.rdt)
+            wt = lm_mod.make_weights(flags, self.rdt)
+            sta1 = jnp.asarray(tile.sta1)
+            sta2 = jnp.asarray(tile.sta2)
+
+            solver = self._solve_first if first else self._solve_rest
+            J_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
+            Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8)
+            first = False
+            res_0 = float(info["res_0"])
+            res_1 = float(info["res_1"])
+            mean_nu = float(info["mean_nu"])
+            J = utils.jones_r2c_np(np.asarray(Jd_r8))
+
+            # divergence reset (fullbatch_mode.cpp:605-621)
+            if res_1 == 0.0 or not np.isfinite(res_1) or (
+                    res_prev is not None and res_1 > RES_RATIO * res_prev):
+                log(f"tile {ti}: Resetting Solution")
+                J = pinit.copy()
+                first = True
+                res_prev = res_1 if np.isfinite(res_1) else None
+            else:
+                res_prev = res_1 if res_prev is None else min(res_prev, res_1)
+
+            if writer:
+                writer.write_interval(J, sky.nchunk)
+
+            if write_residuals:
+                res_r = self._residual_fn(
+                    jnp.asarray(utils.jones_c2r_np(J), self.rdt),
+                    jnp.asarray(utils.c2r(tile.x), self.rdt),
+                    u, v, w, sta1, sta2)
+                tile.x = utils.r2c(np.asarray(res_r)).astype(np.complex128)
+                ms.write_tile(ti, tile)
+
+            dt = (time.time() - t0) / 60.0
+            log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
+                f"final={res_1:.6g}, Time spent={dt:.3g} minutes, "
+                f"nu={mean_nu:.2f}")
+            history.append({"tile": ti, "res_0": res_0, "res_1": res_1,
+                            "mean_nu": mean_nu, "minutes": dt})
+
+        if writer:
+            writer.close()
+        return history
+
+    def run_simulation(self, log=print):
+        """Simulation modes -a 1/2/3 (fullbatch_mode.cpp:524-578)."""
+        cfg, ms, sky = self.cfg, self.ms, self.sky
+        meta = ms.meta
+        J = None
+        blocks_iter = None
+        ignore_mask = None
+        if cfg.solutions_file:
+            _, blocks = sol.read_solutions(cfg.solutions_file, sky.nchunk)
+            blocks_iter = blocks
+            if cfg.ignore_clusters_file:
+                ignore = skymodel.read_ignore_list(cfg.ignore_clusters_file)
+                ignore_mask = np.array(
+                    [int(cid) not in ignore for cid in sky.cluster_ids])
+
+        def sim_fn(x_r, u, v, w, sta1, sta2, J_r8):
+            J = ne.jones_r2c(J_r8) if J_r8 is not None else None
+            out = rr.simulate_visibilities(
+                self.dsky, utils.r2c(x_r), u, v, w,
+                jnp.asarray(meta["freqs"], self.rdt),
+                meta["fdelta"] / len(meta["freqs"]), sta1, sta2,
+                mode=int(cfg.simulation), J=J,
+                chunk_idx=jnp.asarray(self.cidx), ignore_mask=ignore_mask)
+            return utils.c2r(out)
+
+        sim_jit = jax.jit(sim_fn)
+        for ti, tile in ms.tiles():
+            J_r8 = None
+            if blocks_iter:
+                J_r8 = jnp.asarray(utils.jones_c2r_np(
+                    blocks_iter[min(ti, len(blocks_iter) - 1)]), self.rdt)
+            out_r = sim_jit(
+                jnp.asarray(utils.c2r(tile.x), self.rdt),
+                jnp.asarray(tile.u, self.rdt), jnp.asarray(tile.v, self.rdt),
+                jnp.asarray(tile.w, self.rdt),
+                jnp.asarray(tile.sta1), jnp.asarray(tile.sta2), J_r8)
+            tile.x = utils.r2c(np.asarray(out_r)).astype(np.complex128)
+            ms.write_tile(ti, tile)
+            log(f"Timeslot: {ti} simulated (mode={int(cfg.simulation)})")
+
+
+def run(cfg: RunConfig, log=print):
+    """Open dataset + sky model, dispatch fullbatch or simulation.
+
+    The three run modes of the reference main.cpp:288-299 (fullbatch /
+    stochastic / stochastic-consensus) dispatch here; stochastic modes live
+    in sagecal_tpu.stochastic.
+    """
+    ms = ds.SimMS(cfg.ms)
+    meta = ms.meta
+    sky = skymodel.read_sky_cluster(cfg.sky_model, cfg.cluster_file,
+                                    meta["ra0"], meta["dec0"], meta["freq0"],
+                                    cfg.format_3)
+    pipe = FullBatchPipeline(cfg, ms, sky)
+    if cfg.simulation != SimulationMode.OFF:
+        return pipe.run_simulation(log=log)
+    return pipe.run(solution_path=cfg.solutions_file,
+                    max_tiles=cfg.max_timeslots or None, log=log)

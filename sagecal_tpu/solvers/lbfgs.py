@@ -120,8 +120,6 @@ class _IterState(NamedTuple):
 
 def _lbfgs_loop(cost_func, grad_func, x0, mem0: LBFGSMemory, itmax: int,
                 stochastic: bool):
-    gradnrm0 = None
-
     g0 = grad_func(x0)
 
     def cond(s: _IterState):

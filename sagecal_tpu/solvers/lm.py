@@ -37,6 +37,8 @@ class LMConfig(NamedTuple):
 
 class LMState(NamedTuple):
     p: jax.Array        # [K, 8N] real parameters
+    JTJ: jax.Array      # [K, 8N, 8N] normal matrix at p
+    JTe: jax.Array      # [K, 8N] gradient at p
     mu: jax.Array       # [K]
     nu: jax.Array       # [K]
     cost: jax.Array     # [K] current weighted cost
@@ -94,15 +96,14 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         return (s.k < itmax) & jnp.any(~s.stop & chunk_mask)
 
     def body(s: LMState):
-        JTJ, JTe, cost = nrm_eq(s.p)
-        dp, ok = _solve_damped(JTJ, JTe, s.mu, config.jitter)
+        dp, ok = _solve_damped(s.JTJ, s.JTe, s.mu, config.jitter)
         pnew = s.p + dp
         cost_new = ne.weighted_cost(
             x8, ne.jones_r2c(pnew.reshape(kmax, n_stations, 8)),
             coh, sta1, sta2, chunk_id, wt, kmax)
         # gain ratio: dL = dp^T (mu dp + JTe)
-        dL = jnp.sum(dp * (s.mu[:, None] * dp + JTe), axis=-1)
-        dF = cost - cost_new
+        dL = jnp.sum(dp * (s.mu[:, None] * dp + s.JTe), axis=-1)
+        dF = s.cost - cost_new
         accept = ok & (dF > 0) & (dL > 0) & ~s.stop & chunk_mask
         rho = dF / jnp.maximum(dL, 1e-30)
         mu_acc = s.mu * jnp.maximum(1.0 / 3.0,
@@ -110,16 +111,24 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         mu = jnp.where(accept, mu_acc, s.mu * s.nu)
         nu = jnp.where(accept, 2.0, s.nu * 2.0)
         p = jnp.where(accept[:, None], pnew, s.p)
-        cost = jnp.where(accept, cost_new, cost)
+        cost = jnp.where(accept, cost_new, s.cost)
+        # rebuild the normal equations only when some chunk moved; on an
+        # all-reject iteration just re-damp (clmfit.c retry loop semantics)
+        JTJ, JTe = jax.lax.cond(
+            jnp.any(accept),
+            lambda: nrm_eq(p)[:2],
+            lambda: (s.JTJ, s.JTe))
         # convergence tests (levmar-style)
         small_grad = jnp.max(jnp.abs(JTe), axis=-1) <= config.eps1
         small_dp = (jnp.linalg.norm(dp, axis=-1)
                     <= config.eps2 * (jnp.linalg.norm(s.p, axis=-1) + 1e-30))
         small_cost = cost <= config.eps3
         stop = s.stop | small_grad | (accept & small_dp) | small_cost
-        return LMState(p=p, mu=mu, nu=nu, cost=cost, stop=stop, k=s.k + 1)
+        return LMState(p=p, JTJ=JTJ, JTe=JTe, mu=mu, nu=nu, cost=cost,
+                       stop=stop, k=s.k + 1)
 
-    init = LMState(p=p0, mu=mu0, nu=jnp.full((kmax,), 2.0, dtype),
+    init = LMState(p=p0, JTJ=JTJ0, JTe=JTe0, mu=mu0,
+                   nu=jnp.full((kmax,), 2.0, dtype),
                    cost=cost0, stop=jnp.zeros((kmax,), bool),
                    k=jnp.zeros((), jnp.int32))
     final = jax.lax.while_loop(cond, body, init)
@@ -129,7 +138,7 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
                "iters": final.k}
 
 
-def make_weights(flags, nrows: int, dtype=jnp.float32, extra=None):
+def make_weights(flags, dtype=jnp.float32, extra=None):
     """[B, 8] sqrt-weights from row flags: only flag==0 rows enter the solve
     (flag 2 = uv-cut rows are subtracted later but not solved on,
     SURVEY.md data model)."""

@@ -73,19 +73,6 @@ def full_model8(J, coh, sta1, sta2, chunk_idx):
     return out
 
 
-def robust_cost(p_flat, x8, coh, sta1, sta2, chunk_idx, wt, nu, shape):
-    """Student's-t joint cost sum log(1 + e^2/nu) (robust_lbfgs.c:94)."""
-    J = ne.jones_r2c(p_flat.reshape(shape))
-    r = (x8 - full_model8(J, coh, sta1, sta2, chunk_idx)) * wt
-    return jnp.sum(jnp.log1p(r * r / nu))
-
-
-def gaussian_cost(p_flat, x8, coh, sta1, sta2, chunk_idx, wt, shape):
-    J = ne.jones_r2c(p_flat.reshape(shape))
-    r = (x8 - full_model8(J, coh, sta1, sta2, chunk_idx)) * wt
-    return jnp.sum(r * r)
-
-
 def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
             wt_base, nu0=None, config: SageConfig = SageConfig()):
     """One solve interval of SAGE-EM calibration.
@@ -114,7 +101,7 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
     res_0 = jnp.linalg.norm(xres0 * wt_base) / n
 
     total_iter = M * config.max_iter
-    iter_bar = int(jnp.ceil(0.8 / M * total_iter))
+    iter_bar = int(-(-0.8 * total_iter // M))  # ceil(0.8/M * total), host-side
 
     def em_iter(ci, carry):
         J, xres, nerr, nuM = carry

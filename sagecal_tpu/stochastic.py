@@ -1,0 +1,24 @@
+"""Stochastic (minibatch) calibration modes.
+
+Parity targets: ``src/MS/minibatch_mode.cpp:47`` (epochs x minibatches with
+persistent LBFGS state per band) and ``minibatch_consensus_mode.cpp:47``
+(single-node consensus across frequency mini-bands). Implementation lands
+with the stochastic milestone; the CLI dispatch (main.cpp:288-299) already
+routes here.
+"""
+
+from __future__ import annotations
+
+from sagecal_tpu.config import RunConfig
+
+
+def run_minibatch(cfg: RunConfig, log=print):
+    raise NotImplementedError(
+        "stochastic minibatch mode is under construction "
+        "(minibatch_mode.cpp parity)")
+
+
+def run_minibatch_consensus(cfg: RunConfig, log=print):
+    raise NotImplementedError(
+        "stochastic consensus mode is under construction "
+        "(minibatch_consensus_mode.cpp parity)")

@@ -1,0 +1,43 @@
+"""Small shared utilities.
+
+Complex transfer shims: the axon TPU runtime cannot move complex arrays
+across the host<->device boundary in either direction (UNIMPLEMENTED), so
+every jit boundary in this framework passes complex quantities as stacked
+real pairs [..., 2] and forms/splits them on device. Complex math *on*
+device works fine.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def c2r(x):
+    """Complex [...,] -> real [..., 2] (device or host)."""
+    if isinstance(x, np.ndarray):
+        return np.stack([x.real, x.imag], axis=-1)
+    return jnp.stack([x.real, x.imag], axis=-1)
+
+
+def r2c(x):
+    """Real [..., 2] -> complex [...] (device or host)."""
+    return x[..., 0] + 1j * x[..., 1]
+
+
+def to_np_complex(x) -> np.ndarray:
+    """Device complex array -> host numpy complex via two real transfers."""
+    return np.asarray(x.real) + 1j * np.asarray(x.imag)
+
+
+def jones_c2r_np(J: np.ndarray) -> np.ndarray:
+    """Host [..., 2, 2] complex Jones -> [..., 8] reals (pure numpy)."""
+    flat = J.reshape(J.shape[:-2] + (4,))
+    return np.stack([flat.real, flat.imag], axis=-1).reshape(
+        J.shape[:-2] + (8,))
+
+
+def jones_r2c_np(p: np.ndarray) -> np.ndarray:
+    """Host [..., 8] reals -> [..., 2, 2] complex Jones (pure numpy)."""
+    pr = p.reshape(p.shape[:-1] + (4, 2))
+    return (pr[..., 0] + 1j * pr[..., 1]).reshape(p.shape[:-1] + (2, 2))

@@ -60,8 +60,7 @@ def test_jacobian_matches_autodiff():
 def test_lm_recovers_jones_noiseless():
     x8, coh, sta1, sta2, chunk_id, Jtrue = _toy_problem(N=8, T=4, K=1, seed=2)
     J0 = jnp.eye(2, dtype=jnp.complex128)[None, None].repeat(1, 0).repeat(8, 1)
-    wt = lm_mod.make_weights(jnp.zeros(x8.shape[0], jnp.int32), x8.shape[0],
-                             x8.dtype)
+    wt = lm_mod.make_weights(jnp.zeros(x8.shape[0], jnp.int32), x8.dtype)
     J, info = lm_mod.lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, 8,
                               config=lm_mod.LMConfig(itmax=50))
     # cost should collapse to ~0
@@ -79,8 +78,7 @@ def test_lm_multichunk():
     x8, coh, sta1, sta2, chunk_id, Jtrue = _toy_problem(N=6, T=4, K=2, seed=3)
     assert set(np.asarray(chunk_id)) == {0, 1}
     J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex128), (2, 6, 1, 1))
-    wt = lm_mod.make_weights(jnp.zeros(x8.shape[0], jnp.int32), x8.shape[0],
-                             x8.dtype)
+    wt = lm_mod.make_weights(jnp.zeros(x8.shape[0], jnp.int32), x8.dtype)
     J, info = lm_mod.lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, 6,
                               config=lm_mod.LMConfig(itmax=60))
     assert np.all(np.asarray(info["final_cost"])
@@ -94,7 +92,7 @@ def test_flagged_rows_do_not_bias():
     flags = np.zeros(B, np.int32)
     flags[: B // 2] = 1
     x8 = x8.at[: B // 2].set(999.0)
-    wt = lm_mod.make_weights(jnp.asarray(flags), B, x8.dtype)
+    wt = lm_mod.make_weights(jnp.asarray(flags), x8.dtype)
     J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex128), (1, 8, 1, 1))
     J, info = lm_mod.lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, 8,
                               config=lm_mod.LMConfig(itmax=50))
@@ -108,7 +106,7 @@ def test_robust_lm_downweights_outliers():
     # 10% gross outliers, unflagged
     out = rng.choice(B, B // 10, replace=False)
     x8 = x8.at[out].add(jnp.asarray(rng.normal(size=(len(out), 8)) * 20))
-    wt = lm_mod.make_weights(jnp.zeros(B, jnp.int32), B, x8.dtype)
+    wt = lm_mod.make_weights(jnp.zeros(B, jnp.int32), x8.dtype)
     J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex128), (1, 8, 1, 1))
 
     Jp, info_plain = lm_mod.lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, 8,

@@ -59,7 +59,7 @@ def _solve(sky, dsky, tile, solver_mode, max_emiter=3, max_iter=12,
     J0 = np.tile(np.eye(2, dtype=complex), (sky.n_clusters, kmax,
                                             tile.n_stations, 1, 1))
     wt = lm_mod.make_weights(jnp.asarray(tile.flags, jnp.int32),
-                             tile.nrows, jnp.float64)
+                             jnp.float64)
     cfg = sage.SageConfig(max_emiter=max_emiter, max_iter=max_iter,
                           max_lbfgs=max_lbfgs, solver_mode=int(solver_mode))
     J, info = sage.sagefit(jnp.asarray(x8), coh, jnp.asarray(tile.sta1),
@@ -118,7 +118,7 @@ def test_sage_warm_start_is_fixed_point():
     kmax = int(sky.nchunk.max())
     cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
     wt = lm_mod.make_weights(jnp.asarray(tile.flags, jnp.int32),
-                             tile.nrows, jnp.float64)
+                             jnp.float64)
     cfg = sage.SageConfig(max_emiter=2, max_iter=10, max_lbfgs=5,
                           solver_mode=int(SolverMode.LM_LBFGS))
     J, info = sage.sagefit(jnp.asarray(x8), coh, jnp.asarray(tile.sta1),

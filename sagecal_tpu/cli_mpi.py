@@ -1,0 +1,239 @@
+"""``sagecal-tpu-mpi``: distributed consensus calibration across subbands.
+
+Capability parity with the reference ``sagecal-mpi`` binary
+(``src/MPI/main.cpp``): one invocation calibrates F frequency-subband
+datasets jointly with consensus ADMM and a smooth polynomial-in-frequency
+prior. Where the reference spreads ranks over hosts with mpirun and a tag
+protocol (SURVEY.md section 3.3), this runs ONE SPMD program over the JAX
+device mesh — multi-host TPU pods get the same program via jax.distributed
+initialization, subbands riding the "freq" mesh axis over ICI/DCN.
+
+MPI-specific flags keep their reference meaning: -A ADMM iterations,
+-P polynomial terms, -Q type, -r rho, -G per-cluster rho file, -C adaptive
+rho, -T/-K timeslot limits, -U global-solution residuals, -V verbose.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globmod
+import sys
+
+import numpy as np
+
+from sagecal_tpu import skymodel, utils
+from sagecal_tpu.config import SolverMode
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sagecal-tpu-mpi",
+        description="distributed consensus-ADMM calibration over subbands")
+    a = p.add_argument
+    a("-f", "--ms-pattern", required=True,
+      help="glob pattern or file listing the subband datasets")
+    a("-s", "--sky-model", required=True)
+    a("-c", "--cluster-file", required=True)
+    a("-p", "--solutions-file", help="global Z solution file")
+    a("-F", "--format", type=int, default=0)
+    a("-t", "--tile-size", type=int, default=120)
+    a("-e", "--max-em-iter", type=int, default=3)
+    a("-l", "--max-iter", type=int, default=10)
+    a("-m", "--max-lbfgs", type=int, default=10)
+    a("-x", "--lbfgs-m", type=int, default=7)
+    a("-j", "--solver-mode", type=int, default=5)
+    a("-L", "--nulow", type=float, default=2.0)
+    a("-H", "--nuhigh", type=float, default=30.0)
+    a("-A", "--admm", type=int, default=10)
+    a("-P", "--npoly", type=int, default=2)
+    a("-Q", "--polytype", type=int, default=2)
+    a("-r", "--rho", type=float, default=5.0)
+    a("-G", "--rho-file", default=None)
+    a("-C", "--adaptive-rho", type=int, default=0)
+    a("-T", "--max-timeslots", type=int, default=0)
+    a("-K", "--skip-timeslots", type=int, default=0)
+    a("-U", "--use-global-solution", type=int, default=0)
+    a("-V", "--verbose", action="store_true")
+    return p
+
+
+def discover_datasets(pattern: str) -> list:
+    """Glob pattern or list file -> sorted dataset paths (master :61-221)."""
+    import os
+    if os.path.isfile(pattern):
+        with open(pattern) as f:
+            paths = [ln.strip() for ln in f if ln.strip()]
+    else:
+        paths = sorted(globmod.glob(pattern))
+    if not paths:
+        raise FileNotFoundError(f"no datasets match {pattern!r}")
+    return paths
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from sagecal_tpu.consensus import admm as cadmm
+    from sagecal_tpu.consensus import poly as cpoly
+    from sagecal_tpu.io import dataset as ds, solutions as sol
+    from sagecal_tpu.rime import predict as rp
+    from sagecal_tpu.rime import residual as rr
+    from sagecal_tpu.solvers import lm as lm_mod, normal_eq as nesolver, sage
+
+    paths = discover_datasets(args.ms_pattern)
+    mss = [ds.SimMS(p) for p in paths]
+    nf = len(mss)
+    meta0 = mss[0].meta
+    # metadata consistency check (master :239-284)
+    for msx in mss[1:]:
+        for key in ("n_stations", "nbase", "tilesz"):
+            if msx.meta[key] != meta0[key]:
+                raise ValueError(
+                    f"dataset {msx.path}: {key} mismatch "
+                    f"({msx.meta[key]} != {meta0[key]})")
+    freqs = np.array([m.meta["freq0"] for m in mss])
+    order = np.argsort(freqs)
+    mss = [mss[i] for i in order]
+    freqs = freqs[order]
+
+    platform = jax.devices()[0].platform
+    rdt = jnp.float64 if (platform == "cpu"
+                          and jax.config.read("jax_enable_x64")) else jnp.float32
+
+    sky = skymodel.read_sky_cluster(
+        args.sky_model, args.cluster_file, meta0["ra0"], meta0["dec0"],
+        float(freqs.mean()), bool(args.format))
+    dsky = rp.sky_to_device(sky, rdt)
+    n = meta0["n_stations"]
+    kmax = int(sky.nchunk.max())
+    cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
+    cidx = rp.chunk_indices(meta0["tilesz"], meta0["nbase"], sky.nchunk)
+
+    # mesh: largest device count dividing Nf
+    ndev_avail = len(jax.devices())
+    ndev = max(d for d in range(1, min(ndev_avail, nf) + 1) if nf % d == 0)
+    mesh = Mesh(np.array(jax.devices()[:ndev]), ("freq",))
+    print(f"Subbands: {nf} over {ndev} device(s); stations {n}, "
+          f"clusters {sky.n_clusters} (Mt={sky.n_eff_clusters})")
+
+    rho0 = args.rho
+    if args.rho_file:
+        # per-cluster regularization (readsky.c:780): passed through as an
+        # [M] array; admm.py broadcasts it per subband
+        rho0 = skymodel.read_cluster_rho(args.rho_file, sky.cluster_ids,
+                                         default_rho=args.rho)
+
+    Bpoly = cpoly.setup_polynomials(freqs, float(freqs.mean()),
+                                    args.npoly, args.polytype)
+    cfg = cadmm.ADMMConfig(
+        n_admm=args.admm, npoly=args.npoly, poly_type=args.polytype,
+        rho=rho0, adaptive_rho=bool(args.adaptive_rho),
+        sage=sage.SageConfig(
+            max_emiter=args.max_em_iter, max_iter=args.max_iter,
+            max_lbfgs=args.max_lbfgs, lbfgs_m=args.lbfgs_m,
+            solver_mode=int(SolverMode(args.solver_mode)),
+            nulow=args.nulow, nuhigh=args.nuhigh))
+
+    t0 = mss[0].read_tile(0)
+    runner = cadmm.make_admm_runner(dsky, t0.sta1, t0.sta2, cidx, cmask, n,
+                                    meta0["fdelta"], Bpoly, cfg, mesh, nf)
+
+    # residual program (per subband, local J)
+    def residual_fn(J_r8, x_r, u, v, w, freq):
+        J = nesolver.jones_r2c(J_r8)
+        x = utils.r2c(x_r)
+        res = rr.calculate_residuals_multifreq(
+            dsky, J, x, u, v, w, freq[None], meta0["fdelta"],
+            jnp.asarray(t0.sta1), jnp.asarray(t0.sta2), jnp.asarray(cidx),
+            jnp.asarray(sky.subtract_mask()))
+        return utils.c2r(res)
+
+    res_jit = jax.jit(jax.vmap(residual_fn))
+
+    writer = None
+    if args.solutions_file:
+        writer = sol.SolutionWriter(
+            args.solutions_file, float(freqs.mean()),
+            float(freqs.max() - freqs.min()),
+            meta0["tilesz"] * meta0["tdelta"] / 60.0, n, sky.n_clusters,
+            sky.n_eff_clusters * args.npoly)
+
+    sh = NamedSharding(mesh, P("freq"))
+    n_tiles = mss[0].n_tiles
+    start = args.skip_timeslots
+    stop = n_tiles if not args.max_timeslots else min(
+        n_tiles, start + args.max_timeslots)
+
+    Jinit = utils.jones_c2r_np(np.tile(
+        np.eye(2, dtype=complex), (nf, sky.n_clusters, kmax, n, 1, 1)))
+    J0 = Jinit.copy()
+
+    for ti in range(start, stop):
+        tiles = [m.read_tile(ti) for m in mss]
+        x8F = np.stack([utils.vis_to_x8(t.averaged()) for t in tiles])
+        uF = np.stack([t.u for t in tiles])
+        vF = np.stack([t.v for t in tiles])
+        wF = np.stack([t.w for t in tiles])
+        wtF = np.stack([np.asarray(lm_mod.make_weights(
+            jnp.asarray(t.flags, jnp.int32), rdt)) for t in tiles])
+        # rho scaled by unflagged fraction (master :646-650)
+        fratioF = np.array([1.0 - t.flag_ratio for t in tiles])
+
+        args_dev = [jax.device_put(jnp.asarray(a, rdt), sh) for a in
+                    (x8F, uF, vF, wF, freqs, wtF, fratioF, J0)]
+        JF_r8, Z, rhoF, res0, res1, r1s, duals = runner(*args_dev)
+
+        res0 = np.asarray(res0)
+        res1 = np.asarray(r1s)[-1] if cfg.n_admm > 1 else np.asarray(res1)
+        duals = np.asarray(duals)
+
+        # warm-start the next interval; per-subband divergence reset
+        # (slave :680-683 res_ratio check; fullbatch warm-start analogue)
+        J_new = np.asarray(JF_r8)
+        bad = (~np.isfinite(res1)) | (res1 == 0.0) | (res1 > 5.0 * res0)
+        for f in range(nf):
+            J0[f] = Jinit[f] if bad[f] else J_new[f]
+            if bad[f]:
+                print(f"  subband {f}: diverged; Resetting Solution")
+        print(f"Timeslot:{ti} ADMM:{cfg.n_admm} "
+              f"residual initial={res0.mean():.6g} final={res1.mean():.6g} "
+              f"dual={duals[-1] if len(duals) else 0:.3g}")
+        if args.verbose:
+            for f in range(nf):
+                print(f"  subband {f}: {res0[f]:.6g} -> {res1[f]:.6g}")
+
+        # residuals + write back (slave :832-869)
+        if args.use_global_solution:
+            # evaluate BZ at each subband: smooth consensus solutions
+            BZ = np.einsum("fp,mpknr->fmknr", Bpoly, np.asarray(Z))
+            J_res = BZ.reshape(nf, sky.n_clusters, kmax, n, 8)
+        else:
+            J_res = np.asarray(JF_r8).reshape(nf, sky.n_clusters, kmax, n, 8)
+        xF_r = np.stack([utils.c2r(t.x) for t in tiles])
+        res_r = res_jit(jnp.asarray(J_res, rdt), jnp.asarray(xF_r, rdt),
+                        jnp.asarray(uF, rdt), jnp.asarray(vF, rdt),
+                        jnp.asarray(wF, rdt), jnp.asarray(freqs, rdt))
+        res_np = utils.r2c(np.asarray(res_r))
+        for f, (msx, t) in enumerate(zip(mss, tiles)):
+            t.x = res_np[f].astype(np.complex128)
+            msx.write_tile(ti, t)
+
+        if writer:
+            # Z coefficient columns: [M, P, K, N, 8] -> Jones-like blocks
+            Zr = np.asarray(Z)
+            Zj = utils.jones_r2c_np(
+                Zr.transpose(0, 2, 1, 3, 4).reshape(
+                    sky.n_clusters, kmax * args.npoly, n, 8))
+            nchunk_poly = sky.nchunk * args.npoly
+            writer.write_interval(Zj, nchunk_poly)
+
+    if writer:
+        writer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,2 @@
+from sagecal_tpu.consensus import manifold as manifold
+from sagecal_tpu.consensus import poly as poly

@@ -1,0 +1,224 @@
+"""Distributed consensus-ADMM across frequency subbands on a device mesh.
+
+Capability parity with the reference's MPI master/slave per-timeslot loop
+(``src/MPI/sagecal_master.cpp:621-890`` + ``sagecal_slave.cpp:488-930``,
+SURVEY.md section 3.3), re-architected as ONE SPMD program over a
+``jax.sharding.Mesh`` with a "freq" axis (SURVEY.md P9/P10/C1):
+
+- the hub-and-spoke MPI tag protocol disappears: J/Y updates run
+  shard-local per subband; the master's gather(Y) + Z-solve + broadcast(BZ)
+  becomes ``psum`` over the subband axis + a replicated small solve;
+- ADMM iteration 0: plain SAGE solve, dual seed Y = rho*J, then manifold
+  averaging of Y across frequency (master :739-751) — here a psum-based
+  Procrustes averaging (consensus/manifold.py);
+- iterations k>0: augmented-Lagrangian SAGE solve (admm_solve.c:221
+  semantics via solvers.sage with the admm term), Y += rho*J, z-sum via
+  psum, Z = Bii z, Y -= rho*BZ (slave :686-770);
+- optional Barzilai-Borwein adaptive rho per (subband, cluster)
+  (slave :782-786, consensus_poly.c:923);
+- rho is scaled by each subband's unflagged-data fraction
+  (master :646-650).
+
+Data multiplexing (Scurrent rotation, master :883-889) is unnecessary when
+every subband owns a shard; when F exceeds the mesh size, multiple subbands
+ride one shard via the local leading axis — same effect, no rotation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sagecal_tpu.consensus import manifold as mf
+from sagecal_tpu.consensus import poly as cpoly
+from sagecal_tpu.solvers import normal_eq as ne
+from sagecal_tpu.solvers import sage
+
+
+class ADMMConfig(NamedTuple):
+    n_admm: int = 10
+    npoly: int = 2
+    poly_type: int = 2
+    rho: float = 5.0             # scalar, or [M] per-cluster array (-G file)
+    adaptive_rho: bool = False
+    manifold_iters: int = 20     # master :740 Niter
+    sage: sage.SageConfig = sage.SageConfig()
+
+
+def _blocks(J_r8):
+    """[.., M, K, N, 8] real Jones -> [.., M*K, 2N, 2] complex blocks."""
+    J = ne.jones_r2c(J_r8)
+    shp = J.shape
+    J = J.reshape(shp[:-5] + (shp[-5] * shp[-4], shp[-3], 2, 2))
+    return mf.jones_to_blocks(J)
+
+
+def _unblocks(X, m, k, n):
+    J = mf.blocks_to_jones(X)
+    J = J.reshape(J.shape[:-4] + (m, k, n, 2, 2))
+    return ne.jones_c2r(J)
+
+
+def manifold_average_mesh(Y_r8, axis_name: str, nf_total: int, m: int,
+                          k: int, n: int, niter: int = 20):
+    """Mesh version of calculate_manifold_average over the freq axis.
+
+    Y_r8: [Fl, M, K, N, 8] local shard (Fl subbands per device). Each
+    (m, k) block is rotated by ONE unitary toward the cross-frequency
+    average; the reference block is the globally-first subband.
+    """
+    X0 = _blocks(Y_r8)                      # [Fl, MK, 2N, 2] complex
+    # broadcast only the globally-first subband's block as the reference
+    # (cheaper than all_gathering the whole array to read one element)
+    is_first = (jax.lax.axis_index(axis_name) == 0)
+    ref = jax.lax.psum(jnp.where(is_first, X0[0], jnp.zeros_like(X0[0])),
+                       axis_name)
+
+    Xp = jax.vmap(lambda Xf: mf.procrustes_project(ref, Xf))(X0)
+
+    def body(Xp, _):
+        mean = jax.lax.psum(jnp.sum(Xp, axis=0), axis_name) / nf_total
+        Xp = jax.vmap(lambda Xf: mf.procrustes_project(mean, Xf))(Xp)
+        return Xp, None
+
+    Xp, _ = jax.lax.scan(body, Xp, None, length=niter)
+    mean = jax.lax.psum(jnp.sum(Xp, axis=0), axis_name) / nf_total
+    Xout = jax.vmap(lambda Xf: mf.procrustes_project(mean, Xf))(X0)
+    return _unblocks(Xout, m, k, n)
+
+
+def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
+                     fdelta: float, B_poly: np.ndarray, cfg: ADMMConfig,
+                     mesh: Mesh, nf_total: int, with_shapelets: bool = False):
+    """Build the jitted per-timeslot consensus-ADMM program.
+
+    Returns ``run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F_r8)`` operating
+    on [F, ...] arrays sharded over the mesh "freq" axis; gives back
+    (JF_r8, Z, rhoF, info).
+
+    B_poly: [F, P] polynomial basis (host numpy, replicated).
+    """
+    from sagecal_tpu.rime import predict as rp
+
+    M = int(np.asarray(cmask).shape[0])
+    K = int(np.asarray(cmask).shape[1])
+    N = n_stations
+    Ppoly = B_poly.shape[1]
+    Bfull = jnp.asarray(B_poly)            # [F, P] replicated
+
+    cidx_j = jnp.asarray(cidx)
+    cmask_j = jnp.asarray(cmask)
+    sta1_j = jnp.asarray(sta1)
+    sta2_j = jnp.asarray(sta2)
+
+    def coh_for(u, v, w, freq):
+        return rp.coherencies(dsky, u, v, w, freq[None], fdelta,
+                              with_shapelets=with_shapelets)[:, :, 0]
+
+    def local_solve_plain(x8, u, v, w, wt, J_r8, freq):
+        coh = coh_for(u, v, w, freq)
+        J, info = sage.sagefit(x8, coh, sta1_j, sta2_j, cidx_j, cmask_j,
+                               ne.jones_r2c(J_r8), N, wt, config=cfg.sage)
+        return ne.jones_c2r(J), info["res_0"], info["res_1"]
+
+    def local_solve_admm(x8, u, v, w, wt, J_r8, freq, Y_r8, BZ_r8, rho_m):
+        coh = coh_for(u, v, w, freq)
+        scfg = cfg.sage._replace(max_lbfgs=0)
+        J, info = sage.sagefit(x8, coh, sta1_j, sta2_j, cidx_j, cmask_j,
+                               ne.jones_r2c(J_r8), N, wt, config=scfg,
+                               admm=(Y_r8, BZ_r8, rho_m))
+        return ne.jones_c2r(J), info["res_0"], info["res_1"]
+
+    axis = "freq"
+
+    def admm_program(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
+        # shapes here are the LOCAL shard: [Fl, ...]
+        Fl = x8F.shape[0]
+        # per-subband basis rows: gather local rows from the replicated Bfull
+        # via the global subband index of each local row
+        dev_idx = jax.lax.axis_index(axis)
+        local_ids = dev_idx * Fl + jnp.arange(Fl)
+        Brow = Bfull[local_ids]                  # [Fl, P]
+
+        # per-(subband, cluster) rho scaled by unflagged fraction; cfg.rho
+        # may be a scalar or an [M] per-cluster array (readsky.c:780 -G)
+        rho_m = jnp.broadcast_to(jnp.asarray(cfg.rho, x8F.dtype), (M,))
+        rhoF = rho_m[None, :] * fratioF[:, None] * jnp.ones((Fl, M),
+                                                            x8F.dtype)
+        rho_upper = rhoF
+
+        # --- ADMM iteration 0: plain solve + dual seed + manifold average
+        JF, res0, res1 = jax.vmap(local_solve_plain)(
+            x8F, uF, vF, wF, wtF, J0F, freqF)
+        YF = rhoF[..., None, None, None] * JF.reshape(Fl, M, K, N, 8)
+        YF = manifold_average_mesh(YF, axis, nf_total, M, K, N,
+                                   cfg.manifold_iters)
+
+        # rho for ALL subbands (for Bii): [M, F]
+        def all_rho(rhoF):
+            g = jax.lax.all_gather(rhoF, axis)       # [ndev, Fl, M]
+            return g.reshape(-1, M).T                # [M, F]
+
+        def z_update(YF, rhoF):
+            """z = sum_f B_f Y_f where YF already holds Y + rho J as sent
+            to the master (slave :686-700); Z = Bii z (master :755-779)."""
+            zsum_local = jnp.einsum("fp,fmknr->mpknr", Brow, YF)
+            zsum = jax.lax.psum(zsum_local, axis)
+            Bii = cpoly.find_prod_inverse(
+                Bfull, all_rho(rhoF).astype(x8F.dtype))
+            return cpoly.z_from_contributions(zsum, Bii)
+
+        # iteration 0 Z update: Y currently = rho*J (manifold-aligned)
+        Z = z_update(YF, rhoF)
+        BZ = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
+        YF = YF - rhoF[..., None, None, None] * BZ   # dual update (slave :750)
+
+        Yhat_prev = YF
+        Jprev = JF.reshape(Fl, M, K, N, 8)
+
+        def body(carry, _):
+            JF, YF, Z, rhoF, Yhat_prev, Jprev = carry
+            BZ = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
+            Jr, r0, r1 = jax.vmap(local_solve_admm)(
+                x8F, uF, vF, wF, wtF, JF, freqF,
+                YF, BZ, rhoF)
+            J5 = Jr.reshape(Fl, M, K, N, 8)
+            YF = YF + rhoF[..., None, None, None] * J5   # Y <- Y + rho J
+            Zold = Z
+            Z = z_update(YF, rhoF)
+            BZn = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
+            # Yhat for BB rho uses BZ_old (slave :724-732, TAG_CONSENSUS_OLD)
+            Yhat = YF - rhoF[..., None, None, None] * jnp.einsum(
+                "fp,mpknr->fmknr", Brow, Zold)
+            YF = YF - rhoF[..., None, None, None] * BZn   # complete dual
+
+            if cfg.adaptive_rho:
+                rhoF = jax.vmap(
+                    lambda r, ru, dy, dj: cpoly.update_rho_bb(
+                        r, ru, dy, dj, axes=(1, 2, 3))
+                )(rhoF, rho_upper, Yhat - Yhat_prev, J5 - Jprev)
+
+            dual = jnp.linalg.norm(Z - Zold) / np.sqrt(Z.size)
+            return (Jr, YF, Z, rhoF, Yhat, J5), (r0, r1, dual)
+
+        (JF, YF, Z, rhoF, _, _), (r0s, r1s, duals) = jax.lax.scan(
+            body, (JF, YF, Z, rhoF, Yhat_prev, Jprev), None,
+            length=max(cfg.n_admm - 1, 0))
+
+        return JF, Z, rhoF, res0, res1, r1s, duals
+
+    from jax import shard_map
+    spec_f = P(axis)
+    spec_r = P()
+    prog = shard_map(
+        admm_program, mesh=mesh,
+        in_specs=(spec_f,) * 8,
+        out_specs=(spec_f, spec_r, spec_f, spec_f, spec_f,
+                   P(None, axis), spec_r),
+        check_vma=False)
+    return jax.jit(prog)

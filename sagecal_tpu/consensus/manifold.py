@@ -1,0 +1,110 @@
+"""Manifold averaging: resolving the per-frequency unitary ambiguity.
+
+Capability parity with reference ``src/lib/Dirac/manifold_average.c``
+(``calculate_manifold_average``:204, ``project_procrustes[_block]``:266/346):
+per direction, each frequency's solution block (viewed as a 2N x 2 complex
+matrix) is defined only up to a right 2x2 unitary; averaging across
+frequency first rotates every block onto a reference block (Procrustes),
+then iterates {mean -> project each block onto the mean}, and finally
+applies exactly ONE unitary to each original block (manifold_average.c:
+147-177) so solutions are modified only by a phase/unitary factor.
+
+TPU re-architecture: the 2x2 complex SVD-based Procrustes factor
+U V^H = polar(A) is computed with a closed-form 2x2 polar decomposition
+(no LAPACK), fully batched over (direction, frequency) — and on the mesh
+the frequency mean is a ``psum`` (SURVEY.md P10 "manifold averaging at
+iter 0").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _herm_invsqrt_2x2(H, eps=1e-12):
+    """Inverse square root of a 2x2 Hermitian PSD matrix, closed form.
+
+    sqrt(H) = (H + sqrt(det) I) / sqrt(trace + 2 sqrt(det));
+    inv via adjugate. Batched over leading axes.
+    """
+    t = H[..., 0, 0] + H[..., 1, 1]
+    d = H[..., 0, 0] * H[..., 1, 1] - H[..., 0, 1] * H[..., 1, 0]
+    sd = jnp.sqrt(jnp.maximum(d.real, 0.0)).astype(H.dtype)
+    denom = jnp.sqrt(jnp.maximum((t + 2 * sd).real, eps)).astype(H.dtype)
+    sq = (H + sd[..., None, None] * jnp.eye(2, dtype=H.dtype)) \
+        / denom[..., None, None]
+    det_sq = sq[..., 0, 0] * sq[..., 1, 1] - sq[..., 0, 1] * sq[..., 1, 0]
+    det_sq = jnp.where(jnp.abs(det_sq) < eps, eps, det_sq)
+    adj = jnp.stack([
+        jnp.stack([sq[..., 1, 1], -sq[..., 0, 1]], -1),
+        jnp.stack([-sq[..., 1, 0], sq[..., 0, 0]], -1),
+    ], -2)
+    return adj / det_sq[..., None, None]
+
+
+def polar_unitary_2x2(A):
+    """U V^H of the SVD of a 2x2 complex A == its polar unitary factor:
+    A (A^H A)^(-1/2). Batched."""
+    AH_A = jnp.einsum("...ji,...jk->...ik", jnp.conj(A), A)
+    return A @ _herm_invsqrt_2x2(AH_A)
+
+
+def procrustes_project(X, Y):
+    """Rotate Y onto X: Y <- Y U, U = argmin ||X - Y U||_F over unitaries.
+
+    X, Y: [..., 2N, 2] complex stacked solution blocks
+    (project_procrustes_block, manifold_average.c:346). U = polar(Y^H X).
+    """
+    A = jnp.einsum("...ji,...jk->...ik", jnp.conj(Y), X)  # [..., 2, 2]
+    return Y @ polar_unitary_2x2(A)
+
+
+def jones_to_blocks(J):
+    """[..., N, 2, 2] Jones -> [..., 2N, 2] stacked blocks X = [J_1; J_2; ...].
+
+    With this stacking the per-frequency gauge freedom of the unpolarized
+    calibration problem (J_p -> J_p U, same 2x2 unitary U for every
+    station; V = J_p C J_q^H invariant when C is diagonal-dominated) is a
+    RIGHT multiplication X -> X U, exactly what the Procrustes projection
+    removes (the role of the reference's 2N x 2 J-format blocks,
+    manifold_average.c:86-96).
+    """
+    return J.reshape(J.shape[:-3] + (2 * J.shape[-3], 2))
+
+
+def blocks_to_jones(X):
+    """Inverse of :func:`jones_to_blocks`."""
+    n = X.shape[-2] // 2
+    return X.reshape(X.shape[:-2] + (n, 2, 2))
+
+
+def manifold_average(J, niter: int = 3, ref_index: int = 0):
+    """Frequency-average solutions up to unitary ambiguity.
+
+    J: [Nf, M, N, 2, 2] complex per-frequency per-direction Jones.
+    Returns J with each (f, m) block replaced by the original block rotated
+    by one unitary toward the cross-frequency average
+    (calculate_manifold_average semantics; ``ref_index`` stands in for the
+    reference's random initial block).
+
+    Note: this host-mesh-agnostic version computes means over axis 0;
+    in the distributed ADMM the same math runs with a psum.
+    """
+    X0 = jones_to_blocks(J)                        # [Nf, M, 2N, 2]
+    nf = X0.shape[0]
+
+    # initial alignment to the reference frequency's block
+    ref = X0[ref_index]
+    X = procrustes_project(ref[None], X0)
+
+    # iterate mean -> project
+    def body(X, _):
+        mean = jnp.mean(X, axis=0, keepdims=True)
+        return procrustes_project(mean, X), None
+    X, _ = jax.lax.scan(body, X, None, length=niter)
+
+    # final: ONE unitary applied to the original blocks, toward the mean
+    mean = jnp.mean(X, axis=0, keepdims=True)
+    Xout = procrustes_project(mean, X0)
+    return blocks_to_jones(Xout)

@@ -1,0 +1,126 @@
+"""Frequency-polynomial consensus: basis, Z-update, adaptive rho.
+
+Capability parity with reference ``src/lib/Dirac/consensus_poly.c``:
+- ``setup_polynomials`` (:39): type 0/1 monomials in (f-f0)/f0 (type 1
+  row-normalized), type 2 Bernstein on [fmin, fmax], type 3 alternating
+  (f-f0)/f0 and (f0/f-1) powers;
+- ``find_prod_inverse_full[_fed]`` (:460, :560): per-cluster pseudo-inverse
+  of sum_f rho[k,f] B_f B_f^T (+ alpha I federated variant) via SVD;
+- ``update_global_z_multi`` (:773): per-cluster Z = (sum_f B_f x z_f) Bi;
+- ``soft_threshold_z`` (:1039);
+- Barzilai-Borwein spectral rho adaptation ``update_rho_bb`` (:923) with
+  the correlation/step heuristics of Xu et al.
+
+All operations are batched dense linear algebra — on the mesh, the sum
+over frequencies is a ``psum`` over the subband axis (SURVEY.md P10).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def setup_polynomials(freqs, freq0, npoly: int, ptype: int = 2) -> np.ndarray:
+    """[Nf, Npoly] real basis matrix B (host-side, numpy)."""
+    freqs = np.asarray(freqs, np.float64)
+    nf = len(freqs)
+    B = np.zeros((nf, npoly))
+    if ptype in (0, 1):
+        frat = (freqs - freq0) / freq0
+        B[:, 0] = 1.0
+        for p in range(1, npoly):
+            B[:, p] = B[:, p - 1] * frat
+        if ptype == 1:
+            nrm = np.sqrt((B ** 2).sum(axis=0))
+            B = B / np.where(nrm > 0, nrm, 1.0)
+    elif ptype == 2:
+        fmax, fmin = freqs.max(), freqs.min()
+        x = (freqs - fmin) / max(fmax - fmin, 1e-30)
+        from math import comb
+        for p in range(npoly):
+            B[:, p] = comb(npoly - 1, p) * x ** p * (1 - x) ** (npoly - 1 - p)
+    elif ptype == 3:
+        B[:, 0] = 1.0
+        frat = (freqs - freq0) / freq0
+        last = frat.copy()
+        for p in range(1, npoly, 2):
+            B[:, p] = last
+            last = last * frat
+        grat = freq0 / freqs - 1.0
+        last = grat.copy()
+        for p in range(2, npoly, 2):
+            B[:, p] = last
+            last = last * grat
+    else:
+        raise ValueError(f"undefined polynomial type {ptype}")
+    return B
+
+
+def find_prod_inverse(B, rho, alpha=None):
+    """Per-cluster pinv(sum_f rho[k,f] B_f B_f^T [+ alpha_k I]) -> [M, P, P].
+
+    B: [Nf, P]; rho: [M, Nf] (per-cluster per-freq regularization);
+    alpha: optional [M] federated penalty (find_prod_inverse_full_fed).
+    """
+    B = jnp.asarray(B)
+    outer = jnp.einsum("fp,fq->fpq", B, B)               # [Nf, P, P]
+    S = jnp.einsum("mf,fpq->mpq", jnp.asarray(rho), outer)
+    if alpha is not None:
+        S = S + jnp.asarray(alpha)[:, None, None] * jnp.eye(B.shape[1])
+    # SVD pseudo-inverse (sum_inv_threadfn, consensus_poly.c:301)
+    U, s, Vt = jnp.linalg.svd(S)
+    sinv = jnp.where(s > 1e-12 * s.max(axis=-1, keepdims=True), 1.0 / s, 0.0)
+    return jnp.einsum("mqp,mq,mrq->mpr", Vt, sinv, U)
+
+
+def z_from_contributions(zsum, Bi):
+    """Global Z update: Z[k] = Bi[k] @ zsum[k] (update_global_z_multi).
+
+    zsum: [M, P, ...] = sum_f B[f, p] * (Y_f + rho_f J_f)[...] — on a mesh
+    this sum arrives via psum over the subband axis. Bi: [M, P, P].
+    Returns Z [M, P, ...].
+    """
+    lead = zsum.shape[2:]
+    flat = zsum.reshape(zsum.shape[0], zsum.shape[1], -1)
+    Z = jnp.einsum("mpq,mqx->mpx", Bi, flat)
+    return Z.reshape(zsum.shape[0], zsum.shape[1], *lead)
+
+
+def bz(Z, Brow):
+    """Evaluate the consensus polynomial at one frequency: sum_p B[f,p] Z_p.
+
+    Z: [M, P, ...]; Brow: [P]. Returns [M, ...].
+    """
+    return jnp.tensordot(jnp.asarray(Brow), Z, axes=(0, 1))
+
+
+def soft_threshold(Z, lam):
+    """Elementwise soft threshold (consensus_poly.c:1039)."""
+    return jnp.sign(Z) * jnp.maximum(jnp.abs(Z) - lam, 0.0)
+
+
+def update_rho_bb(rho, rho_upper, dY, dJ, axes):
+    """Barzilai-Borwein spectral rho (consensus_poly.c:923, Xu et al.).
+
+    rho, rho_upper: [M]; dY = Yhat - Yhat_old, dJ = J - J_old with per-
+    cluster parameter blocks; ``axes`` are the axes of dY/dJ to reduce over
+    (everything except the cluster axis 0).
+
+    Heuristics preserved: update only when correlation > 0.2 and
+    0.001 < alphahat < rho_upper; alphahat = alphaMG if 2 alphaMG > alphaSD
+    else alphaSD - alphaMG/2.
+    """
+    ip12 = jnp.sum(dY * dJ, axis=axes)
+    ip11 = jnp.sum(dY * dY, axis=axes)
+    ip22 = jnp.sum(dJ * dJ, axis=axes)
+    eps = 1e-12
+    corr = ip12 / jnp.sqrt(jnp.maximum(ip11 * ip22, eps))
+    alpha_sd = ip11 / jnp.maximum(ip12, eps)
+    alpha_mg = ip12 / jnp.maximum(ip22, eps)
+    alphahat = jnp.where(2.0 * alpha_mg > alpha_sd, alpha_mg,
+                         alpha_sd - 0.5 * alpha_mg)
+    ok = ((ip12 > eps) & (ip11 > eps) & (ip22 > eps) & (corr > 0.2)
+          & (alphahat > 0.001) & (alphahat < rho_upper))
+    return jnp.where(ok, alphahat, rho)

@@ -55,11 +55,6 @@ def first_tile_boost(n_stations: int) -> int:
     return 4 if n_stations <= LMCUT else 6
 
 
-def _to_x8(xa: np.ndarray) -> np.ndarray:
-    f = xa.reshape(-1, 4)
-    return np.stack([f.real, f.imag], -1).reshape(-1, 8)
-
-
 class FullBatchPipeline:
     """Reusable jitted solve over a SimMS-like dataset."""
 
@@ -175,7 +170,7 @@ class FullBatchPipeline:
                                    u, v, jnp.asarray(tile.freqs, self.rdt),
                                    cfg.uvmin, cfg.uvmax)
             xa = tile.averaged()
-            x8 = jnp.asarray(_to_x8(xa), self.rdt)
+            x8 = jnp.asarray(utils.vis_to_x8(xa), self.rdt)
             wt = lm_mod.make_weights(flags, self.rdt)
             sta1 = jnp.asarray(tile.sta1)
             sta2 = jnp.asarray(tile.sta2)

@@ -58,7 +58,7 @@ def _solve_damped(JTJ, JTe, mu, jitter):
 
 def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
              chunk_mask=None, config: LMConfig = LMConfig(),
-             itmax_dynamic=None):
+             itmax_dynamic=None, admm=None):
     """Levenberg-Marquardt solve of all chunks of one cluster.
 
     Args:
@@ -70,6 +70,12 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
       chunk_mask: [K] bool for live chunks (padded chunk slots frozen).
       itmax_dynamic: optional traced iteration cap <= config.itmax, for the
         SAGE driver's weighted iteration allocation (lmfit.c:859-882).
+      admm: optional (y, bz, rho): consensus-ADMM augmentation with
+        y, bz [K, 8N] real vectors and scalar rho. The solve objective
+        becomes 1/2||w r||^2 + y^T(theta - bz) + rho/2 ||theta - bz||^2
+        (the augmented Lagrangian of rtr_solve_robust_admm.c:199-215 /
+        robust_batchmode_lbfgs.c Dirac.h:314-338, with the Gauss-Newton
+        data term).
 
     Returns (J [K,N,2,2], info dict with init_cost/final_cost [K]).
     """
@@ -79,10 +85,30 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     if chunk_mask is None:
         chunk_mask = jnp.ones((kmax,), bool)
 
+    if admm is not None:
+        admm_y, admm_bz, admm_rho = admm
+        admm_y = admm_y.reshape(kmax, -1).astype(dtype)
+        admm_bz = admm_bz.reshape(kmax, -1).astype(dtype)
+
+    def aug_cost(p, cost_data):
+        """Add 2*(y^T d + rho/2 ||d||^2), consistent with the un-halved
+        data cost convention used for the gain ratio."""
+        if admm is None:
+            return cost_data
+        d = p - admm_bz
+        return cost_data + 2.0 * jnp.sum(admm_y * d, axis=-1) \
+            + admm_rho * jnp.sum(d * d, axis=-1)
+
     def nrm_eq(p):
         J = ne.jones_r2c(p.reshape(kmax, n_stations, 8))
-        return ne.normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt,
-                                   n_stations, kmax)
+        JTJ, JTe, cost = ne.normal_equations(x8, J, coh, sta1, sta2,
+                                             chunk_id, wt, n_stations, kmax)
+        if admm is not None:
+            d = p - admm_bz
+            JTe = JTe - admm_y - admm_rho * d
+            JTJ = JTJ + admm_rho * jnp.eye(JTJ.shape[-1], dtype=JTJ.dtype)
+            cost = aug_cost(p, cost)
+        return JTJ, JTe, cost
 
     JTJ0, JTe0, cost0 = nrm_eq(p0)
     diag_max = jnp.max(jnp.abs(jnp.diagonal(JTJ0, axis1=-2, axis2=-1)),
@@ -98,9 +124,9 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     def body(s: LMState):
         dp, ok = _solve_damped(s.JTJ, s.JTe, s.mu, config.jitter)
         pnew = s.p + dp
-        cost_new = ne.weighted_cost(
+        cost_new = aug_cost(pnew, ne.weighted_cost(
             x8, ne.jones_r2c(pnew.reshape(kmax, n_stations, 8)),
-            coh, sta1, sta2, chunk_id, wt, kmax)
+            coh, sta1, sta2, chunk_id, wt, kmax))
         # gain ratio: dL = dp^T (mu dp + JTe)
         dL = jnp.sum(dp * (s.mu[:, None] * dp + s.JTe), axis=-1)
         dF = s.cost - cost_new
@@ -122,7 +148,10 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         small_grad = jnp.max(jnp.abs(JTe), axis=-1) <= config.eps1
         small_dp = (jnp.linalg.norm(dp, axis=-1)
                     <= config.eps2 * (jnp.linalg.norm(s.p, axis=-1) + 1e-30))
-        small_cost = cost <= config.eps3
+        # eps3 applies to the (nonnegative) data cost only: the augmented-
+        # Lagrangian cost is signed, so a small/negative value there does
+        # not mean convergence
+        small_cost = (cost <= config.eps3) if admm is None else jnp.zeros_like(s.stop)
         stop = s.stop | small_grad | (accept & small_dp) | small_cost
         return LMState(p=p, JTJ=JTJ, JTe=JTe, mu=mu, nu=nu, cost=cost,
                        stop=stop, k=s.k + 1)

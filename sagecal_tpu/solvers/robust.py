@@ -57,7 +57,7 @@ def update_nu_aecm(logsumw, nu_old, p: int = 8, nulow=2.0, nuhigh=30.0,
 def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
                     n_stations: int, nu0=2.0, nulow=2.0, nuhigh=30.0,
                     chunk_mask=None, config=lm_mod.LMConfig(),
-                    wt_rounds: int = 3, itmax_dynamic=None):
+                    wt_rounds: int = 3, itmax_dynamic=None, admm=None):
     """Student's-t IRLS-LM: parity with rlevmar_der_single_nocuda
     (robustlm.c:2008).
 
@@ -76,7 +76,7 @@ def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         wt = wt_base * jnp.sqrt(w)
         Jn, info = lm_mod.lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J,
                                    n_stations, chunk_mask, config,
-                                   itmax_dynamic=itmax_dynamic)
+                                   itmax_dynamic=itmax_dynamic, admm=admm)
         # ML nu update from post-solve residuals
         e2 = ne.residual8(x8, Jn, coh, sta1, sta2, chunk_id)
         w2 = update_weights(e2, nu)

@@ -74,7 +74,8 @@ def full_model8(J, coh, sta1, sta2, chunk_idx):
 
 
 def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
-            wt_base, nu0=None, config: SageConfig = SageConfig()):
+            wt_base, nu0=None, config: SageConfig = SageConfig(),
+            admm=None):
     """One solve interval of SAGE-EM calibration.
 
     Args:
@@ -85,6 +86,13 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
       J0: [M, Kmax, N, 2, 2] initial Jones.
       wt_base: [B, 8] sqrt-weights (0 = excluded from solve).
       nu0: initial robust nu (defaults to config.nulow, lmfit.c:827).
+      admm: optional (Y, BZ, rho) consensus augmentation with Y, BZ
+        [M, Kmax, N, 8] real Jones and rho [M] per-cluster regularization.
+        Each cluster solve then minimizes the augmented Lagrangian
+        (sagefit_visibilities_admm, admm_solve.c:221: same EM loop with
+        ADMM-regularized per-cluster solves; the joint LBFGS refine is
+        disabled in this mode, matching the reference's max_lbfgs=0 call
+        sites sagecal_slave.cpp:644-667).
 
     Returns (J, info) with res_0/res_1 = ||residual||_2 / n (lmfit.c:869,
     1043) and mean_nu.
@@ -118,6 +126,12 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
                 (0.2 * jnp.take(nerr, cj) * total_iter).astype(jnp.int32)
                 + iter_bar,
                 config.max_iter)
+            admm_m = None
+            if admm is not None:
+                Y_all, BZ_all, rho_all = admm
+                admm_m = (jnp.take(Y_all, cj, axis=0),
+                          jnp.take(BZ_all, cj, axis=0),
+                          jnp.take(rho_all, cj))
 
             xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
 
@@ -130,13 +144,13 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
                     xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
                     n_stations, nu0=jnp.take(nuM, cj), nulow=config.nulow,
                     nuhigh=config.nuhigh, chunk_mask=cmask_m, config=lm_cfg,
-                    wt_rounds=2, itmax_dynamic=itermax)
+                    wt_rounds=2, itmax_dynamic=itermax, admm=admm_m)
                 nuM = nuM.at[cj].set(nu_new)
             else:
                 Jn, info = lm_mod.lm_solve(
                     xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
                     n_stations, chunk_mask=cmask_m, config=lm_cfg,
-                    itmax_dynamic=itermax)
+                    itmax_dynamic=itermax, admm=admm_m)
 
             init_res = jnp.sum(info["init_cost"])
             final_res = jnp.sum(info["final_cost"])
@@ -161,8 +175,9 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
 
     mean_nu = jnp.clip(jnp.mean(nuM), config.nulow, config.nuhigh)
 
-    # joint LBFGS refine over all parameters (lmfit.c:1019-1037)
-    if config.max_lbfgs > 0:
+    # joint LBFGS refine over all parameters (lmfit.c:1019-1037);
+    # skipped in ADMM mode (sagecal_slave.cpp passes max_lbfgs=0)
+    if config.max_lbfgs > 0 and admm is None:
         shape = (M * kmax, n_stations, 8)
         Jflat = J.reshape(M * kmax, n_stations, 2, 2)
         p0 = ne.jones_c2r(Jflat).reshape(-1).astype(dtype)

@@ -30,6 +30,13 @@ def to_np_complex(x) -> np.ndarray:
     return np.asarray(x.real) + 1j * np.asarray(x.imag)
 
 
+def vis_to_x8(xa: np.ndarray) -> np.ndarray:
+    """[B, 2, 2] complex visibilities -> [B, 8] reals in data order
+    (XX re, im, XY, YX, YY — Dirac.h:1541-1546)."""
+    f = xa.reshape(-1, 4)
+    return np.stack([f.real, f.imag], -1).reshape(-1, 8)
+
+
 def jones_c2r_np(J: np.ndarray) -> np.ndarray:
     """Host [..., 2, 2] complex Jones -> [..., 8] reals (pure numpy)."""
     flat = J.reshape(J.shape[:-2] + (4,))

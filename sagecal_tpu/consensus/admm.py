@@ -99,7 +99,7 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
 
     Returns ``run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F_r8)`` operating
     on [F, ...] arrays sharded over the mesh "freq" axis; gives back
-    (JF_r8, Z, rhoF, info).
+    (JF_r8, Z, rhoF, res0, res1, r1_per_admm, dual_per_admm).
 
     B_poly: [F, P] polynomial basis (host numpy, replicated).
     """

@@ -33,6 +33,7 @@ from sagecal_tpu.solvers import lbfgs as lbfgs_mod
 from sagecal_tpu.solvers import lm as lm_mod
 from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.solvers import robust as rb
+from sagecal_tpu.solvers import rtr as rtr_mod
 
 
 class SageConfig(NamedTuple):
@@ -136,10 +137,33 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
             xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
 
             # static cap for the while loop; dynamic weighted budget inside
-            lm_cfg = lm_mod.LMConfig(itmax=int(config.max_iter) + iter_bar)
-            if robust:
-                # RTR/NSD modes currently solve via robust IRLS-LM; RTR
-                # proper lands in solvers/rtr.py and is dispatched there.
+            itcap = int(config.max_iter) + iter_bar
+            mode = int(config.solver_mode)
+            if mode == int(SolverMode.RTR_OSLM_LBFGS):
+                rtr_cfg = rtr_mod.RTRConfig(itmax=itcap)
+                Jn, info = rtr_mod.rtr_solve(
+                    xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
+                    n_stations, chunk_mask=cmask_m, config=rtr_cfg,
+                    itmax_dynamic=itermax, admm=admm_m)
+            elif mode == int(SolverMode.RTR_OSRLM_RLBFGS):
+                rtr_cfg = rtr_mod.RTRConfig(itmax=itcap)
+                Jn, nu_new, info = rtr_mod.rtr_solve_robust(
+                    xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
+                    n_stations, nu0=jnp.take(nuM, cj), nulow=config.nulow,
+                    nuhigh=config.nuhigh, chunk_mask=cmask_m,
+                    config=rtr_cfg, wt_rounds=2, itmax_dynamic=itermax,
+                    admm=admm_m)
+                nuM = nuM.at[cj].set(nu_new)
+            elif mode == int(SolverMode.NSD_RLBFGS):
+                nsd_cfg = rtr_mod.NSDConfig(itmax=2 * itcap)
+                Jn, nu_new, info = rtr_mod.nsd_solve_robust(
+                    xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
+                    n_stations, nu0=jnp.take(nuM, cj), nulow=config.nulow,
+                    nuhigh=config.nuhigh, chunk_mask=cmask_m,
+                    config=nsd_cfg, itmax_dynamic=2 * itermax, admm=admm_m)
+                nuM = nuM.at[cj].set(nu_new)
+            elif robust:
+                lm_cfg = lm_mod.LMConfig(itmax=itcap)
                 Jn, nu_new, info = rb.robust_lm_solve(
                     xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
                     n_stations, nu0=jnp.take(nuM, cj), nulow=config.nulow,
@@ -147,6 +171,7 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
                     wt_rounds=2, itmax_dynamic=itermax, admm=admm_m)
                 nuM = nuM.at[cj].set(nu_new)
             else:
+                lm_cfg = lm_mod.LMConfig(itmax=itcap)
                 Jn, info = lm_mod.lm_solve(
                     xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
                     n_stations, chunk_mask=cmask_m, config=lm_cfg,

@@ -75,22 +75,43 @@ class SolutionWriter:
 
     def __init__(self, path: str, freq0_hz: float, bandwidth_hz: float,
                  interval_min: float, n_stations: int, n_clusters: int,
-                 n_eff_clusters: int):
+                 n_eff_clusters: int, nchan: int | None = None,
+                 nsolbw: int | None = None):
+        """With ``nchan``/``nsolbw`` set, writes the stochastic multi-band
+        header variant (minibatch_mode.cpp:276-278): columns then repeat
+        per mini-band in each row (:500-514)."""
         self.f = open(path, "w")
         self.n_stations = n_stations
         self.f.write("# solution file (sagecal-tpu) commands:\n")
-        self.f.write("# freq(MHz) bandwidth(MHz) time_interval(min) "
-                     "stations clusters effective_clusters\n")
-        self.f.write(f"{freq0_hz * 1e-6:f} {bandwidth_hz * 1e-6:f} "
-                     f"{interval_min:f} {n_stations} {n_clusters} "
-                     f"{n_eff_clusters}\n")
+        if nsolbw is not None:
+            self.f.write("# freq(MHz) bandwidth(MHz) channels mini-bands "
+                         "time_interval(min) stations clusters "
+                         "effective_clusters\n")
+            self.f.write(f"{freq0_hz * 1e-6:f} {bandwidth_hz * 1e-6:f} "
+                         f"{nchan} {nsolbw} {interval_min:f} {n_stations} "
+                         f"{n_clusters} {n_eff_clusters}\n")
+        else:
+            self.f.write("# freq(MHz) bandwidth(MHz) time_interval(min) "
+                         "stations clusters effective_clusters\n")
+            self.f.write(f"{freq0_hz * 1e-6:f} {bandwidth_hz * 1e-6:f} "
+                         f"{interval_min:f} {n_stations} {n_clusters} "
+                         f"{n_eff_clusters}\n")
 
-    def write_interval(self, J: np.ndarray, nchunk: np.ndarray) -> None:
-        cols = jones_to_columns(np.asarray(J), nchunk)
+    def _write_cols(self, cols: np.ndarray) -> None:
         for r in range(cols.shape[0]):
             vals = " ".join(f"{x:e}" for x in cols[r])
             self.f.write(f"{r} {vals}\n")
         self.f.flush()
+
+    def write_interval(self, J: np.ndarray, nchunk: np.ndarray) -> None:
+        self._write_cols(jones_to_columns(np.asarray(J), nchunk))
+
+    def write_interval_multiband(self, J_bands, nchunk: np.ndarray) -> None:
+        """One row block with columns repeating per mini-band
+        (minibatch_mode.cpp:500-514)."""
+        cols = np.hstack([jones_to_columns(np.asarray(J), nchunk)
+                          for J in J_bands])
+        self._write_cols(cols)
 
     def close(self):
         self.f.close()
@@ -117,17 +138,36 @@ def read_solutions(path: str, nchunk: np.ndarray):
                 continue
             tok = line.split()
             if header is None:
-                header = {
-                    "freq_mhz": float(tok[0]), "bandwidth_mhz": float(tok[1]),
-                    "interval_min": float(tok[2]), "n_stations": int(tok[3]),
-                    "n_clusters": int(tok[4]), "n_eff_clusters": int(tok[5]),
-                }
+                if len(tok) >= 8:   # stochastic multi-band header variant
+                    header = {
+                        "freq_mhz": float(tok[0]),
+                        "bandwidth_mhz": float(tok[1]),
+                        "nchan": int(tok[2]), "nsolbw": int(tok[3]),
+                        "interval_min": float(tok[4]),
+                        "n_stations": int(tok[5]), "n_clusters": int(tok[6]),
+                        "n_eff_clusters": int(tok[7]),
+                    }
+                else:
+                    header = {
+                        "freq_mhz": float(tok[0]),
+                        "bandwidth_mhz": float(tok[1]),
+                        "interval_min": float(tok[2]),
+                        "n_stations": int(tok[3]), "n_clusters": int(tok[4]),
+                        "n_eff_clusters": int(tok[5]), "nsolbw": 1,
+                    }
                 n8 = 8 * header["n_stations"]
                 continue
             rows.append([float(x) for x in tok[1:]])
             if len(rows) == n8:
-                blocks.append(columns_to_jones(np.asarray(rows).reshape(n8, -1),
-                                               nchunk))
+                cols = np.asarray(rows).reshape(n8, -1)
+                nb = header.get("nsolbw", 1)
+                if nb > 1:
+                    mt = cols.shape[1] // nb
+                    blocks.append([columns_to_jones(
+                        cols[:, b * mt:(b + 1) * mt], nchunk)
+                        for b in range(nb)])
+                else:
+                    blocks.append(columns_to_jones(cols, nchunk))
                 rows = []
     if rows:
         # fail loudly on a truncated interval, like the reference reader's

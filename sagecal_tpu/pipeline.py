@@ -138,7 +138,9 @@ class FullBatchPipeline:
             _, blocks = sol.read_solutions(self.cfg.init_solutions,
                                            self.sky.nchunk)
             if blocks:
-                J0 = blocks[-1]
+                last = blocks[-1]
+                # a stochastic multi-band file warm-starts from band 0
+                J0 = last[0] if isinstance(last, list) else last
         return J0
 
     def run(self, write_residuals: bool = True, solution_path=None,

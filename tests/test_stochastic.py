@@ -1,0 +1,155 @@
+"""Stochastic (minibatch) calibration mode tests.
+
+Oracle is the simulation round trip (SURVEY.md section 4): predict with
+known Jones + noise, calibrate stochastically, require the residual to
+shrink toward the noise floor. Mirrors the reference smoke configs
+(minibatch_mode.cpp / minibatch_consensus_mode.cpp run shapes).
+"""
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sagecal_tpu import cli, skymodel, stochastic
+from sagecal_tpu.io import dataset as ds, solutions as sol
+from sagecal_tpu.rime import predict as rp
+
+SKY = """\
+P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6
+P1A 1 20 0 38 0 0 2.5 0 0 0 0 0 0 0 0 150e6
+"""
+
+CLUSTER = """\
+0 1 P0A
+1 1 P1A
+"""
+
+
+@pytest.fixture
+def simdir(tmp_path):
+    sky_path = tmp_path / "sky.txt"
+    sky_path.write_text(SKY)
+    clus_path = tmp_path / "sky.txt.cluster"
+    clus_path.write_text(CLUSTER)
+
+    ra0 = (0 + 41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(sky_path), ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(str(clus_path)))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jtrue = ds.random_jones(sky.n_clusters, sky.nchunk, 8, seed=2, scale=0.15)
+    tiles = [ds.simulate_dataset(dsky, n_stations=8, tilesz=4,
+                                 freqs=[148e6, 150e6, 152e6, 154e6],
+                                 ra0=ra0, dec0=dec0,
+                                 jones=Jtrue, nchunk=sky.nchunk,
+                                 noise_sigma=0.01, seed=3)]
+    msdir = tmp_path / "sim.ms"
+    ds.SimMS.create(str(msdir), tiles)
+    return tmp_path, str(msdir), str(sky_path), str(clus_path), Jtrue
+
+
+def test_band_plan():
+    cs, nc, pad = stochastic.band_plan(10, 4)
+    assert list(cs) == [0, 3, 6, 9]
+    assert list(nc) == [3, 3, 3, 1]
+    assert pad == 3
+    cs, nc, pad = stochastic.band_plan(4, 8)   # clamp nsolbw to Nchan
+    assert len(cs) == 4 and all(n == 1 for n in nc)
+
+
+def test_band_plan_drops_empty_bands():
+    # Nchan=4, nsolbw=3 -> nchanpersol=2 covers the band in 2 bands; the
+    # reference tolerates a zero-width third band, we drop it
+    cs, nc, _ = stochastic.band_plan(4, 3)
+    assert list(nc) == [2, 2]
+    assert list(cs) == [0, 2]
+
+
+def test_minibatch_rows():
+    r0, nts, tpm = stochastic.minibatch_rows(10, 5, 3)
+    assert tpm == 4
+    assert list(r0) == [0, 20, 40]
+    assert list(nts) == [4, 4, 2]
+
+
+def test_minibatch_rows_clamps_to_tilesz():
+    # minibatches > tilesz must not create empty minibatches (whose zero
+    # residual would trigger the global reset every tile)
+    r0, nts, tpm = stochastic.minibatch_rows(4, 5, 9)
+    assert len(r0) == 4
+    assert all(n == 1 for n in nts)
+
+
+def test_run_minibatch_reduces_residual(simdir):
+    tmp, msdir, sky_path, clus_path, Jtrue = simdir
+    solpath = str(tmp / "msol.txt")
+    args = cli.build_parser().parse_args([
+        "-d", msdir, "-s", sky_path, "-c", clus_path, "-p", solpath,
+        "-N", "2", "-M", "2", "-m", "8", "-w", "2", "-t", "4"])
+    cfg = cli.config_from_args(args)
+    hist = stochastic.run_minibatch(cfg, log=lambda *a: None)
+    assert len(hist) == 1
+    assert hist[0]["res_1"] < hist[0]["res_0"]
+    assert np.isfinite(hist[0]["res_1"])
+
+    # multiband solution file round-trips
+    sky = skymodel.read_sky_cluster(sky_path, clus_path, 0.0, 0.7, 150e6)
+    header, blocks = sol.read_solutions(solpath, sky.nchunk)
+    assert header["nsolbw"] == 2
+    assert len(blocks) == 1 and len(blocks[0]) == 2
+    assert blocks[0][0].shape == (2, 1, 8, 2, 2)
+
+    # residuals were written back and are smaller than the data
+    ms = ds.SimMS(msdir)
+    tile = ms.read_tile(0)
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    orig = ds.simulate_dataset(dsky, n_stations=8, tilesz=4,
+                               freqs=[148e6, 150e6, 152e6, 154e6],
+                               ra0=tile.ra0, dec0=tile.dec0, jones=Jtrue,
+                               nchunk=sky.nchunk, noise_sigma=0.01, seed=3)
+    assert np.linalg.norm(tile.x) < 0.9 * np.linalg.norm(orig.x)
+
+
+def test_run_minibatch_consensus(simdir):
+    tmp, msdir, sky_path, clus_path, Jtrue = simdir
+    args = cli.build_parser().parse_args([
+        "-d", msdir, "-s", sky_path, "-c", clus_path,
+        "-N", "1", "-M", "2", "-m", "6", "-w", "2",
+        "-A", "3", "-P", "2", "-Q", "2", "-r", "0.5", "-t", "4"])
+    cfg = cli.config_from_args(args)
+    hist = stochastic.run_minibatch_consensus(cfg, log=lambda *a: None)
+    assert len(hist) == 1
+    assert np.isfinite(hist[0]["res_1"])
+    assert hist[0]["res_1"] < hist[0]["res_0"]
+
+
+def test_warm_start_from_multiband_file(simdir):
+    tmp, msdir, sky_path, clus_path, _ = simdir
+    solpath = str(tmp / "warm.txt")
+    base = ["-d", msdir, "-s", sky_path, "-c", clus_path,
+            "-N", "1", "-M", "2", "-m", "4", "-w", "2", "-t", "4"]
+    cfg = cli.config_from_args(cli.build_parser().parse_args(
+        base + ["-p", solpath]))
+    stochastic.run_minibatch(cfg, log=lambda *a: None)
+    # re-run warm-started from the multiband file (crashed before fix)
+    cfg2 = cli.config_from_args(cli.build_parser().parse_args(
+        base + ["-q", solpath]))
+    hist = stochastic.run_minibatch(cfg2, log=lambda *a: None)
+    assert np.isfinite(hist[0]["res_1"])
+
+
+def test_cli_dispatch_stochastic(simdir, monkeypatch):
+    tmp, msdir, sky_path, clus_path, _ = simdir
+    called = {}
+    monkeypatch.setattr(stochastic, "run_minibatch",
+                        lambda cfg, log=print: called.setdefault("mb", cfg))
+    monkeypatch.setattr(stochastic, "run_minibatch_consensus",
+                        lambda cfg, log=print: called.setdefault("mbc", cfg))
+    cli.main(["-d", msdir, "-s", sky_path, "-c", clus_path, "-N", "1"])
+    assert "mb" in called
+    cli.main(["-d", msdir, "-s", sky_path, "-c", clus_path, "-N", "1",
+              "-A", "2", "-w", "2"])
+    assert "mbc" in called

@@ -31,8 +31,8 @@ class BeamMode(enum.IntEnum):
 
     NONE = 0
     ARRAY = 1          # array (station) beam only
-    ELEMENT = 2        # element beam only
-    FULL = 3           # array * element
+    FULL = 2           # array * element (DOBEAM_FULL, Dirac_common.h:105)
+    ELEMENT = 3        # element beam only (DOBEAM_ELEMENT, :108)
 
 
 class SimulationMode(enum.IntEnum):

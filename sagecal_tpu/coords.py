@@ -10,6 +10,7 @@ inside jitted beam computations.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 ASEC2RAD = 4.848136811095359935899141e-6  # arcseconds -> radians
 _J2000_JD = 2451545.0
@@ -49,6 +50,19 @@ def jd2gmst(time_jd):
         (876600.0 * 3600.0 + 8640184.812866) + t * (0.093104 - (6.2e-5) * t)
     )
     theta = jnp.where(theta < 0, -(jnp.abs(theta) % 86400.0), theta % 86400.0)
+    return (theta / 240.0) % 360.0
+
+
+def jd2gmst_np(time_jd):
+    """Host-side float64 GMST (degrees). JD magnitudes (~2.45e6 days) lose
+    whole hours of sidereal angle in float32, so this must never route
+    through a default-precision device computation."""
+    time_jd = np.asarray(time_jd, np.float64)
+    t = (time_jd - _J2000_JD) / 36525.0
+    theta = 67310.54841 + t * (
+        (876600.0 * 3600.0 + 8640184.812866) + t * (0.093104 - (6.2e-5) * t)
+    )
+    theta = np.where(theta < 0, -(np.abs(theta) % 86400.0), theta % 86400.0)
     return (theta / 240.0) % 360.0
 
 

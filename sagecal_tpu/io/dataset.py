@@ -67,6 +67,19 @@ class VisTile:
         """Fraction of flagged rows (data.cpp:659-663 ``fratio``)."""
         return float(np.mean(self.flags == 1))
 
+    @property
+    def time_jd(self) -> np.ndarray:
+        """Per-timeslot Julian date in days (predict_model.cu:1372
+        ``kernel_convert_time``: MS TIME is MJD seconds)."""
+        if self.time_mjd is None:
+            return np.full(self.tilesz, 2451545.0)  # J2000 placeholder
+        return np.asarray(self.time_mjd) / 86400.0 + 2400000.5
+
+    @property
+    def tslot(self) -> np.ndarray:
+        """[nrows] row -> timeslot index (rows ordered [tilesz, nbase])."""
+        return row_tslot(self.nrows, self.nbase)
+
     def averaged(self):
         """Channel-average data -> [B, 2, 2]; flagged rows zeroed.
 
@@ -76,6 +89,11 @@ class VisTile:
         xa = self.x.mean(axis=1)
         xa[self.flags == 1] = 0.0
         return xa
+
+
+def row_tslot(nrows: int, nbase: int) -> np.ndarray:
+    """[nrows] row -> timeslot index for [tilesz, nbase]-ordered rows."""
+    return (np.arange(nrows) // nbase).astype(np.int32)
 
 
 def generate_baselines(n_stations: int):
@@ -135,7 +153,9 @@ def simulate_dataset(sky_arrays, n_stations: int, tilesz: int,
                      noise_sigma: float = 0.0, seed: int = 11,
                      extent_m: float = 3000.0,
                      flag_fraction: float = 0.0,
-                     chan_width: float | None = None) -> VisTile:
+                     chan_width: float | None = None,
+                     beam=None, dobeam: int = 0,
+                     start_mjd_s: float = 4.93e9) -> VisTile:
     """Synthesize a corrupted dataset from a device sky model.
 
     This is the test oracle (SURVEY.md section 4): model visibilities are
@@ -163,10 +183,22 @@ def simulate_dataset(sky_arrays, n_stations: int, tilesz: int,
     fdelta_tot = float(freqs[-1] - freqs[0]) + chan_width
     fdelta_chan = fdelta_tot / len(freqs)
 
+    time_mjd = start_mjd_s + tdelta * (np.arange(tilesz) + 0.5)
+
     from sagecal_tpu.utils import to_np_complex
+    beam_kw = {}
+    if beam is not None and dobeam:
+        if beam.gmst.shape[0] != tilesz:
+            raise ValueError(
+                f"beam staged with {beam.gmst.shape[0]} timeslots but "
+                f"tilesz={tilesz}; out-of-range tslot gathers would "
+                f"silently clamp under jit")
+        beam_kw = dict(beam=beam, dobeam=dobeam,
+                       tslot=jnp.asarray(row_tslot(us.shape[0], nbase)),
+                       sta1=jnp.asarray(sta1), sta2=jnp.asarray(sta2))
     coh = rime_predict.coherencies(
         sky_arrays, jnp.asarray(us), jnp.asarray(vs), jnp.asarray(ws),
-        jnp.asarray(freqs), fdelta_chan, per_channel_flux=True)
+        jnp.asarray(freqs), fdelta_chan, per_channel_flux=True, **beam_kw)
     coh = to_np_complex(coh)  # [M, B, F, 2, 2]
 
     M = coh.shape[0]
@@ -197,7 +229,8 @@ def simulate_dataset(sky_arrays, n_stations: int, tilesz: int,
         u=us, v=vs, w=ws, x=vis.astype(np.complex128), flags=flags,
         sta1=sta1, sta2=sta2, freqs=freqs, freq0=float(freqs.mean()),
         fdelta=fdelta_tot, tdelta=tdelta, dec0=dec0, ra0=ra0,
-        n_stations=n_stations, nbase=nbase, tilesz=tilesz)
+        n_stations=n_stations, nbase=nbase, tilesz=tilesz,
+        time_mjd=time_mjd)
 
 
 # ---------------------------------------------------------------------------
@@ -221,7 +254,8 @@ class SimMS:
             self.meta = json.load(f)
 
     @classmethod
-    def create(cls, path: str, tiles: list[VisTile]) -> "SimMS":
+    def create(cls, path: str, tiles: list[VisTile],
+               beam_info=None) -> "SimMS":
         os.makedirs(path, exist_ok=True)
         t0 = tiles[0]
         meta = {
@@ -233,11 +267,21 @@ class SimMS:
         }
         with open(os.path.join(path, cls.META), "w") as f:
             json.dump(meta, f, indent=1)
+        ms = cls(path)
         for i, t in enumerate(tiles):
-            np.savez(os.path.join(path, f"tile{i:05d}.npz"),
-                     u=t.u, v=t.v, w=t.w, x=t.x, flags=t.flags,
-                     sta1=t.sta1, sta2=t.sta2)
-        return cls(path)
+            ms.write_tile(i, t)
+        if beam_info is not None:
+            from sagecal_tpu.rime import beam as bm
+            bm.save_beaminfo(os.path.join(path, "beam.npz"), beam_info)
+        return ms
+
+    def beam_info(self):
+        """Stored beam metadata (LOFAR_ANTENNA_FIELD analogue) or None."""
+        p = os.path.join(self.path, "beam.npz")
+        if not os.path.exists(p):
+            return None
+        from sagecal_tpu.rime import beam as bm
+        return bm.load_beaminfo(p)
 
     @property
     def n_tiles(self) -> int:
@@ -252,12 +296,14 @@ class SimMS:
             freqs=np.asarray(m["freqs"]), freq0=m["freq0"],
             fdelta=m["fdelta"], tdelta=m["tdelta"], dec0=m["dec0"],
             ra0=m["ra0"], n_stations=m["n_stations"], nbase=m["nbase"],
-            tilesz=m["tilesz"])
+            tilesz=m["tilesz"],
+            time_mjd=z["time_mjd"] if "time_mjd" in z.files else None)
 
     def write_tile(self, i: int, tile: VisTile) -> None:
+        kw = {} if tile.time_mjd is None else {"time_mjd": tile.time_mjd}
         np.savez(os.path.join(self.path, f"tile{i:05d}.npz"),
                  u=tile.u, v=tile.v, w=tile.w, x=tile.x, flags=tile.flags,
-                 sta1=tile.sta1, sta2=tile.sta2)
+                 sta1=tile.sta1, sta2=tile.sta2, **kw)
 
     def tiles(self):
         for i in range(self.n_tiles):

@@ -32,6 +32,7 @@ from sagecal_tpu.config import RunConfig, SimulationMode, SolverMode
 from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.io import dataset as ds
 from sagecal_tpu.io import solutions as sol
+from sagecal_tpu.rime import beam as bm
 from sagecal_tpu.rime import predict as rp
 from sagecal_tpu.rime import residual as rr
 from sagecal_tpu.solvers import lm as lm_mod
@@ -76,6 +77,13 @@ class FullBatchPipeline:
         self.cidx = rp.chunk_indices(meta["tilesz"], meta["nbase"],
                                      sky.nchunk)
         self.n = meta["n_stations"]
+        self.tslot = ds.row_tslot(meta["tilesz"] * meta["nbase"],
+                                  meta["nbase"])
+        # beam (-B): stored metadata, else synthetic (set_elementcoeffs +
+        # readAuxData-with-beam analogue; fullbatch_mode.cpp:56-70)
+        self.dobeam = int(cfg.beam_mode)
+        self.beam_info = bm.resolve_beaminfo(self.dobeam, ms, meta)
+        self._warned_no_times = False
         mode = effective_solver_mode(int(cfg.solver_mode), self.n)
         self.base_cfg = sage.SageConfig(
             max_emiter=cfg.max_em_iter, max_iter=cfg.max_iter,
@@ -102,17 +110,31 @@ class FullBatchPipeline:
         cidx = jnp.asarray(self.cidx)
         cmask = jnp.asarray(self.cmask)
 
-        def solve(x8, u, v, w, sta1, sta2, wt, J0_r8):
+        tslot = jnp.asarray(self.tslot)
+
+        def solve(x8, u, v, w, sta1, sta2, wt, J0_r8, beam):
             coh = rp.coherencies(self.dsky, u, v, w,
                                  jnp.asarray([freq0], x8.dtype),
-                                 fdelta)[:, :, 0]
+                                 fdelta, beam=beam, dobeam=self.dobeam,
+                                 tslot=tslot, sta1=sta1, sta2=sta2)[:, :, 0]
             J0 = ne.jones_r2c(J0_r8)
             J, info = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask, J0,
                                    self.n, wt, config=scfg)
             return ne.jones_c2r(J), info
         return jax.jit(solve)
 
-    def _residuals(self, J_r8, x_r, u, v, w, sta1, sta2):
+    def _tile_beam(self, tile):
+        """Per-tile device beam tables (times change per tile)."""
+        if not self.dobeam:
+            return None
+        if tile.time_mjd is None and not self._warned_no_times:
+            print("WARNING: dataset tiles carry no timestamps; beam az/el "
+                  "will be evaluated at the J2000 placeholder epoch")
+            self._warned_no_times = True
+        return bm.beam_to_device(self.beam_info, self.ms.meta["freq0"],
+                                 self.rdt, time_jd=tile.time_jd)
+
+    def _residuals(self, J_r8, x_r, u, v, w, sta1, sta2, beam=None):
         meta = self.ms.meta
         freqs = jnp.asarray(meta["freqs"], self.rdt)
         sub = jnp.asarray(self.sky.subtract_mask())
@@ -127,7 +149,8 @@ class FullBatchPipeline:
         res = rr.calculate_residuals_multifreq(
             self.dsky, J, x, u, v, w, freqs,
             meta["fdelta"] / len(meta["freqs"]), sta1, sta2,
-            jnp.asarray(self.cidx), sub, correct_idx=correct_idx)
+            jnp.asarray(self.cidx), sub, correct_idx=correct_idx,
+            beam=beam, dobeam=self.dobeam, tslot=jnp.asarray(self.tslot))
         return utils.c2r(res)
 
     def initial_jones(self) -> np.ndarray:
@@ -179,7 +202,9 @@ class FullBatchPipeline:
 
             solver = self._solve_first if first else self._solve_rest
             J_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
-            Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8)
+            tile_beam = self._tile_beam(tile)
+            Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8,
+                                 tile_beam)
             first = False
             res_0 = float(info["res_0"])
             res_1 = float(info["res_1"])
@@ -203,7 +228,7 @@ class FullBatchPipeline:
                 res_r = self._residual_fn(
                     jnp.asarray(utils.jones_c2r_np(J), self.rdt),
                     jnp.asarray(utils.c2r(tile.x), self.rdt),
-                    u, v, w, sta1, sta2)
+                    u, v, w, sta1, sta2, tile_beam)
                 tile.x = utils.r2c(np.asarray(res_r)).astype(np.complex128)
                 ms.write_tile(ti, tile)
 
@@ -233,14 +258,16 @@ class FullBatchPipeline:
                 ignore_mask = np.array(
                     [int(cid) not in ignore for cid in sky.cluster_ids])
 
-        def sim_fn(x_r, u, v, w, sta1, sta2, J_r8):
+        def sim_fn(x_r, u, v, w, sta1, sta2, J_r8, beam):
             J = ne.jones_r2c(J_r8) if J_r8 is not None else None
             out = rr.simulate_visibilities(
                 self.dsky, utils.r2c(x_r), u, v, w,
                 jnp.asarray(meta["freqs"], self.rdt),
                 meta["fdelta"] / len(meta["freqs"]), sta1, sta2,
                 mode=int(cfg.simulation), J=J,
-                chunk_idx=jnp.asarray(self.cidx), ignore_mask=ignore_mask)
+                chunk_idx=jnp.asarray(self.cidx), ignore_mask=ignore_mask,
+                beam=beam, dobeam=self.dobeam,
+                tslot=jnp.asarray(self.tslot))
             return utils.c2r(out)
 
         sim_jit = jax.jit(sim_fn)
@@ -253,7 +280,8 @@ class FullBatchPipeline:
                 jnp.asarray(utils.c2r(tile.x), self.rdt),
                 jnp.asarray(tile.u, self.rdt), jnp.asarray(tile.v, self.rdt),
                 jnp.asarray(tile.w, self.rdt),
-                jnp.asarray(tile.sta1), jnp.asarray(tile.sta2), J_r8)
+                jnp.asarray(tile.sta1), jnp.asarray(tile.sta2), J_r8,
+                self._tile_beam(tile))
             tile.x = utils.r2c(np.asarray(out_r)).astype(np.complex128)
             ms.write_tile(ti, tile)
             log(f"Timeslot: {ti} simulated (mode={int(cfg.simulation)})")

@@ -42,6 +42,8 @@ class SkyArrays(NamedTuple):
     ll: jax.Array
     mm: jax.Array
     nn: jax.Array
+    ra: jax.Array
+    dec: jax.Array
     sI: jax.Array
     sQ: jax.Array
     sU: jax.Array
@@ -73,6 +75,7 @@ def sky_to_device(sky: ClusterSky, real_dtype=jnp.float32) -> SkyArrays:
     f = lambda a: jnp.asarray(a, real_dtype)
     return SkyArrays(
         ll=f(sky.ll), mm=f(sky.mm), nn=f(sky.nn),
+        ra=f(sky.ra), dec=f(sky.dec),
         sI=f(sky.sI), sQ=f(sky.sQ), sU=f(sky.sU), sV=f(sky.sV),
         sI0=f(sky.sI0), sQ0=f(sky.sQ0), sU0=f(sky.sU0), sV0=f(sky.sV0),
         spec_idx=f(sky.spec_idx), spec_idx1=f(sky.spec_idx1),
@@ -98,18 +101,27 @@ def _spectral_flux(s0, spec_idx, spec_idx1, spec_idx2, f0, freq):
 
 
 def _cluster_coherency(csky, u, v, w, freqs, fdelta, per_channel_flux: bool,
-                       n0max: int, with_shapelets: bool):
+                       n0max: int, with_shapelets: bool,
+                       af=None, E=None, tslot=None, sta1=None, sta2=None):
     """Coherencies of ONE cluster: [B, F, 2, 2] complex.
 
     ``csky`` is a SkyArrays row (arrays [S]); u,v,w [B] seconds; freqs [F].
+    Beam (predict_withbeam.c:139-187): ``af`` [F, S, T, N] array-factor
+    gains multiply each source's amplitude by af_p*af_q; ``E`` [S, T, N,
+    2, 2] element E-Jones sandwich each source's brightness E_p B E_q^H.
+    ``tslot``/``sta1``/``sta2`` [B] map data rows to (time, antennas).
     """
     cdtype = jnp.complex64 if u.dtype == jnp.float32 else jnp.complex128
     # G [B, S]: frequency-independent phase term (seconds)
     G = 2.0 * jnp.pi * (u[:, None] * csky.ll[None, :]
                         + v[:, None] * csky.mm[None, :]
                         + w[:, None] * csky.nn[None, :])
+    if E is not None:
+        Et = jnp.moveaxis(E, (0, 1, 2), (2, 0, 1))      # [T, N, S, 2, 2]
+        E1 = Et[tslot, sta1]                            # [B, S, 2, 2]
+        E2 = Et[tslot, sta2]
 
-    def one_channel(freq):
+    def one_channel(freq, af_f=None):
         # f32 fringe phases match the reference's float GPU predict path
         # (predict_model.cu); pass f64 u,v,w for reference-CPU precision.
         phase = G * freq
@@ -127,6 +139,10 @@ def _cluster_coherency(csky, u, v, w, freqs, fdelta, per_channel_flux: bool,
             csky.sphi[None, :], csky.use_projection[None, :],
             csky.sh_beta[None, :], csky.sh_modes[None, :, :],
             csky.sh_n0[None, :], n0max, with_shapelets)
+        if af_f is not None:
+            aft = jnp.moveaxis(af_f, 0, -1)             # [T, N, S]
+            phasor = phasor * (aft[tslot, sta1]
+                               * aft[tslot, sta2]).astype(cdtype)
         if per_channel_flux:
             sI = _spectral_flux(csky.sI0, csky.spec_idx, csky.spec_idx1,
                                 csky.spec_idx2, csky.f0, freq)
@@ -140,23 +156,42 @@ def _cluster_coherency(csky, u, v, w, freqs, fdelta, per_channel_flux: bool,
             sI, sQ, sU, sV = csky.sI, csky.sQ, csky.sU, csky.sV
         live = csky.smask
         phasor = jnp.where(live[None, :], phasor, 0.0)
-        xx = jnp.sum(phasor * (sI + sQ)[None, :], axis=1)
-        xy = jnp.sum(phasor * (sU + 1j * sV.astype(cdtype))[None, :], axis=1)
-        yx = jnp.sum(phasor * (sU - 1j * sV.astype(cdtype))[None, :], axis=1)
-        yy = jnp.sum(phasor * (sI - sQ)[None, :], axis=1)
-        return jnp.stack([jnp.stack([xx, xy], -1),
-                          jnp.stack([yx, yy], -1)], -2)  # [B, 2, 2]
+        b00 = (sI + sQ).astype(cdtype)
+        b01 = (sU + 1j * sV).astype(cdtype)
+        b10 = (sU - 1j * sV).astype(cdtype)
+        b11 = (sI - sQ).astype(cdtype)
+        if E is None:
+            xx = jnp.sum(phasor * b00[None, :], axis=1)
+            xy = jnp.sum(phasor * b01[None, :], axis=1)
+            yx = jnp.sum(phasor * b10[None, :], axis=1)
+            yy = jnp.sum(phasor * b11[None, :], axis=1)
+            return jnp.stack([jnp.stack([xx, xy], -1),
+                              jnp.stack([yx, yy], -1)], -2)  # [B, 2, 2]
+        # element beam: per-source 2x2 sandwich, then sum over sources
+        Bm = jnp.stack([jnp.stack([b00, b01], -1),
+                        jnp.stack([b10, b11], -1)], -2)      # [S, 2, 2]
+        Bm = phasor[..., None, None] * Bm[None]              # [B, S, 2, 2]
+        return jnp.einsum("bsij,bsjk,bslk->bil", E1, Bm, jnp.conj(E2))
 
-    out = jax.vmap(one_channel, out_axes=1)(freqs)  # [B, F, 2, 2]
-    return out
+    if af is None:
+        out = jax.vmap(lambda f: one_channel(f), out_axes=1)(freqs)
+    else:
+        out = jax.vmap(one_channel, out_axes=1)(freqs, af)
+    return out  # [B, F, 2, 2]
 
 
 def coherencies(sky: SkyArrays, u, v, w, freqs, fdelta,
                 per_channel_flux: bool = False,
-                with_shapelets: bool | None = None):
+                with_shapelets: bool | None = None,
+                beam=None, dobeam: int = 0,
+                tslot=None, sta1=None, sta2=None):
     """All-cluster coherencies [M, B, F, 2, 2] (no Jones applied).
 
-    Equivalent of precalculate_coherencies[_multifreq] (predict.c:653/:890).
+    Equivalent of precalculate_coherencies[_multifreq] (predict.c:653/:890);
+    with ``beam`` (a :class:`sagecal_tpu.rime.beam.BeamArrays`) and
+    ``dobeam`` != 0 this is precalculate_coherencies[_multifreq]_withbeam
+    (predict_withbeam.c:522/:690) — beam tables are computed per cluster
+    and folded into the source sum.
     ``fdelta`` is the smearing bandwidth PER CHANNEL (callers pass total
     bandwidth for channel-averaged single-freq solves, total/Nchan for
     multifreq, matching predict.c:943).
@@ -169,10 +204,21 @@ def coherencies(sky: SkyArrays, u, v, w, freqs, fdelta,
         else:
             with_shapelets = bool(np.any(np.asarray(sky.sh_n0) > 0))
     n0max = int(np.sqrt(sky.sh_modes.shape[-1]).round())
+    if beam is not None and dobeam:
+        from sagecal_tpu.rime import beam as beam_mod
 
-    def per_cluster(csky):
-        return _cluster_coherency(csky, u, v, w, freqs, fdelta,
-                                  per_channel_flux, n0max, with_shapelets)
+        def per_cluster(csky):
+            af, E = beam_mod.cluster_beam(beam, csky.ra, csky.dec,
+                                          jnp.atleast_1d(freqs), dobeam)
+            return _cluster_coherency(csky, u, v, w, freqs, fdelta,
+                                      per_channel_flux, n0max,
+                                      with_shapelets, af=af, E=E,
+                                      tslot=tslot, sta1=sta1, sta2=sta2)
+    else:
+        def per_cluster(csky):
+            return _cluster_coherency(csky, u, v, w, freqs, fdelta,
+                                      per_channel_flux, n0max,
+                                      with_shapelets)
 
     return jax.lax.map(per_cluster, sky)
 
@@ -233,11 +279,15 @@ def predict_model(coh, J, sta1, sta2, chunk_idx, cluster_mask=None):
 
 def predict_visibilities(sky: SkyArrays, u, v, w, freqs, fdelta,
                          per_channel_flux: bool = True,
-                         cluster_mask=None):
+                         cluster_mask=None, beam=None, dobeam: int = 0,
+                         tslot=None, sta1=None, sta2=None):
     """Uncorrupted model visibilities summed over clusters [B, F, 2, 2]
-    (predict.c:417 / residual.c:1242 simulation path)."""
+    (predict.c:417 / residual.c:1242 simulation path; with beam:
+    predict_visibilities_multifreq_withbeam, predict_withbeam.c:1155)."""
     coh = coherencies(sky, u, v, w, freqs, fdelta,
-                      per_channel_flux=per_channel_flux)
+                      per_channel_flux=per_channel_flux,
+                      beam=beam, dobeam=dobeam,
+                      tslot=tslot, sta1=sta1, sta2=sta2)
     if cluster_mask is not None:
         coh = jnp.where(cluster_mask[:, None, None, None, None], coh, 0.0)
     return jnp.sum(coh, axis=0)

@@ -46,7 +46,8 @@ def correct_by_cluster(res, J_m, sta1, sta2, chunk_idx_m, rho):
 def calculate_residuals_multifreq(sky: rp.SkyArrays, J, x, u, v, w, freqs,
                                   fdelta_chan, sta1, sta2, chunk_idx,
                                   subtract_mask, correct_idx: int | None = None,
-                                  rho: float = 1e-9):
+                                  rho: float = 1e-9,
+                                  beam=None, dobeam: int = 0, tslot=None):
     """Residual x - sum_m J_p C_m(f) J_q^H over subtractable clusters.
 
     x: [B, F, 2, 2]; J: [M, Kmax, N, 2, 2]; chunk_idx: [M, B];
@@ -54,10 +55,12 @@ def calculate_residuals_multifreq(sky: rp.SkyArrays, J, x, u, v, w, freqs,
     the cluster whose solutions correct the residual (host code resolves
     the user-facing ``-k`` cluster id to an index).
 
-    Returns [B, F, 2, 2] residuals.
+    With ``beam``/``dobeam`` this is calculate_residuals_multifreq_withbeam
+    (predict_withbeam.c:1895). Returns [B, F, 2, 2] residuals.
     """
     coh = rp.coherencies(sky, u, v, w, freqs, fdelta_chan,
-                         per_channel_flux=True)
+                         per_channel_flux=True, beam=beam, dobeam=dobeam,
+                         tslot=tslot, sta1=sta1, sta2=sta2)
     model = rp.predict_model(coh, J, sta1, sta2, chunk_idx,
                              cluster_mask=subtract_mask)
     res = x - model
@@ -70,16 +73,20 @@ def calculate_residuals_multifreq(sky: rp.SkyArrays, J, x, u, v, w, freqs,
 def simulate_visibilities(sky: rp.SkyArrays, x, u, v, w, freqs, fdelta_chan,
                           sta1, sta2, mode: int, J=None, chunk_idx=None,
                           ignore_mask=None, correct_idx: int | None = None,
-                          rho: float = 1e-9):
+                          rho: float = 1e-9,
+                          beam=None, dobeam: int = 0, tslot=None):
     """Simulation modes (-a 1/2/3): replace/add/subtract the model
-    (residual.c:1242 predict_visibilities_multifreq, :1601 _withsol).
+    (residual.c:1242 predict_visibilities_multifreq, :1601 _withsol;
+    with beam: predict_visibilities_multifreq_with[sol_with]beam_gpu
+    semantics, Radio.h:400-446).
 
     ``J`` (optional) corrupts the model with solutions; ``ignore_mask`` [M]
     True = keep cluster in the simulated model (reference ignorelist holds
     clusters to skip).
     """
     coh = rp.coherencies(sky, u, v, w, freqs, fdelta_chan,
-                         per_channel_flux=True)
+                         per_channel_flux=True, beam=beam, dobeam=dobeam,
+                         tslot=tslot, sta1=sta1, sta2=sta2)
     M, B = coh.shape[0], coh.shape[1]
     mask = (jnp.ones((M,), bool) if ignore_mask is None
             else jnp.asarray(ignore_mask))

@@ -82,6 +82,8 @@ class ClusterSky:
     ll: np.ndarray                 # [M, Smax]
     mm: np.ndarray
     nn: np.ndarray                 # carries the -1
+    ra: np.ndarray                 # [M, Smax] rad (for beam evaluation)
+    dec: np.ndarray
     sI: np.ndarray                 # [M, Smax] Stokes at data ref freq
     sQ: np.ndarray
     sU: np.ndarray
@@ -285,7 +287,7 @@ def build_cluster_sky(sources: dict, clusters: list,
     c = ClusterSky(
         cluster_ids=np.zeros(M, np.int32), nchunk=np.ones(M, np.int32),
         names=[],
-        ll=zeros(), mm=zeros(), nn=zeros(),
+        ll=zeros(), mm=zeros(), nn=zeros(), ra=zeros(), dec=zeros(),
         sI=zeros(), sQ=zeros(), sU=zeros(), sV=zeros(),
         sI0=zeros(), sQ0=zeros(), sU0=zeros(), sV0=zeros(),
         spec_idx=zeros(), spec_idx1=zeros(), spec_idx2=zeros(),
@@ -309,6 +311,7 @@ def build_cluster_sky(sources: dict, clusters: list,
                 raise KeyError(f"cluster {cid}: source {nm!r} not in sky model")
             s = sources[nm]
             c.ll[ci, sj], c.mm[ci, sj], c.nn[ci, sj] = s.ll, s.mm, s.nn
+            c.ra[ci, sj], c.dec[ci, sj] = s.ra, s.dec
             c.sI[ci, sj], c.sQ[ci, sj] = s.sI, s.sQ
             c.sU[ci, sj], c.sV[ci, sj] = s.sU, s.sV
             c.sI0[ci, sj], c.sQ0[ci, sj] = s.sI0, s.sQ0

@@ -51,6 +51,7 @@ from sagecal_tpu.config import RunConfig
 from sagecal_tpu.consensus import poly as cpoly
 from sagecal_tpu.io import dataset as ds
 from sagecal_tpu.io import solutions as sol
+from sagecal_tpu.rime import beam as bm
 from sagecal_tpu.rime import predict as rp
 from sagecal_tpu.rime import residual as rr
 from sagecal_tpu.solvers import lbfgs as lbfgs_mod
@@ -138,7 +139,7 @@ class BandSolverOutputs(NamedTuple):
 
 def make_band_solver(dsky, n_stations: int, chunk_idx, chunk_mask,
                      fdelta_chan: float, nu: float, max_lbfgs: int,
-                     consensus: bool):
+                     consensus: bool, dobeam: int = 0):
     """Build the jitted per-(band, minibatch) robust LBFGS solve.
 
     Parity: ``bfgsfit_minibatch_visibilities`` (plain) /
@@ -153,11 +154,13 @@ def make_band_solver(dsky, n_stations: int, chunk_idx, chunk_mask,
     cidx = jnp.asarray(chunk_idx)
     cmask3 = jnp.asarray(chunk_mask)[..., None, None]     # [M, K, 1, 1]
 
-    def solve(x8F, u, v, w, sta1, sta2, wtF, freqsF, p0, mem,
-              Y=None, BZ=None, rho=None):
+    def solve(x8F, u, v, w, sta1, sta2, wtF, freqsF, tslot, p0, mem,
+              Y=None, BZ=None, rho=None, beam=None):
         # x8F/wtF: [B, Fp, 8]; freqsF: [Fp]; p0: [M, K, N, 8] reals
         coh = rp.coherencies(dsky, u, v, w, freqsF, fdelta_chan,
-                             per_channel_flux=True)      # [M, B, Fp, 2, 2]
+                             per_channel_flux=True, beam=beam,
+                             dobeam=dobeam, tslot=tslot,
+                             sta1=sta1, sta2=sta2)       # [M, B, Fp, 2, 2]
         nreal = jnp.maximum(jnp.sum(wtF > 0), 1).astype(x8F.dtype)
 
         def cost_fn(pflat):
@@ -227,6 +230,14 @@ class _StochasticRunner:
             f"{(self.nchan_total + self.nsolbw - 1) // self.nsolbw} "
             f"channels wide")
 
+        # beam (-B): the reference's stochastic loaders carry the same
+        # beam chain as fullbatch (minibatch_mode.cpp uses the _withbeam
+        # precalculate/residual variants when doBeam is set)
+        self.dobeam = int(cfg.beam_mode)
+        self.beam_info = bm.resolve_beaminfo(self.dobeam, ms, meta, log=log)
+        self.tile_beam = None
+        self._warned_no_times = False
+
         self.nparam = self.M * self.kmax * self.n * 8
         self._tile_inputs = None
         self._tile_inputs_id = None
@@ -263,6 +274,15 @@ class _StochasticRunner:
         """Pad + upload every (minibatch, band) slice once per tile."""
         self._tile_inputs = {}
         rdt = self.rdt
+        if self.dobeam:
+            if tile.time_mjd is None and not self._warned_no_times:
+                self.log("WARNING: dataset tiles carry no timestamps; beam "
+                         "az/el will be evaluated at the J2000 placeholder "
+                         "epoch")
+                self._warned_no_times = True
+            self.tile_beam = bm.beam_to_device(
+                self.beam_info, self.meta["freq0"], rdt,
+                time_jd=tile.time_jd)
         for nmb in range(self.minibatches):
             r0 = self.row0[nmb]
             nrow = self.nts[nmb] * self.nbase
@@ -279,6 +299,11 @@ class _StochasticRunner:
             uj, vj, wj = (jnp.asarray(u, rdt), jnp.asarray(v, rdt),
                           jnp.asarray(w, rdt))
             s1j, s2j = jnp.asarray(sta1), jnp.asarray(sta2)
+            # GLOBAL tile timeslot per row (for beam gathers); padded rows
+            # clamp to the last valid slot of this minibatch
+            tsg = np.minimum((r0 + np.arange(self.bmb)) // self.nbase,
+                             self.tilesz - 1).astype(np.int32)
+            tsj = jnp.asarray(tsg)
             for b in range(self.nsolbw):
                 c0, nc = self.chanstart[b], self.nchan[b]
                 x = np.zeros((self.bmb, self.fpad, 2, 2), np.complex128)
@@ -293,7 +318,7 @@ class _StochasticRunner:
                 freqsF[:nc] = self.freqs[c0:c0 + nc]
                 self._tile_inputs[(nmb, b)] = (
                     jnp.asarray(x8F, rdt), uj, vj, wj, s1j, s2j,
-                    jnp.asarray(wtF, rdt), jnp.asarray(freqsF, rdt))
+                    jnp.asarray(wtF, rdt), jnp.asarray(freqsF, rdt), tsj)
 
     def band_inputs(self, nmb: int, band: int):
         return self._tile_inputs[(nmb, band)]
@@ -313,11 +338,12 @@ class _StochasticRunner:
             if len(matches):
                 correct_idx = int(matches[0])
 
-        def resid(x8F, u, v, w, sta1, sta2, freqsF, J_r8):
+        def resid(x8F, u, v, w, sta1, sta2, freqsF, tslot, J_r8, beam):
             res = rr.calculate_residuals_multifreq(
                 self.dsky, ne.jones_r2c(J_r8), _x8f_to_complex(x8F),
                 u, v, w, freqsF, self.fdelta_chan, sta1, sta2, cidx, sub,
-                correct_idx=correct_idx)
+                correct_idx=correct_idx, beam=beam, dobeam=self.dobeam,
+                tslot=tslot)
             B, F = x8F.shape[0], x8F.shape[1]
             return utils.c2r(res.reshape(B, F, 4)).reshape(B, F, 8)
 
@@ -332,10 +358,11 @@ class _StochasticRunner:
             nrow = self.nts[nmb] * self.nbase
             for b in range(self.nsolbw):
                 c0, nc = self.chanstart[b], self.nchan[b]
-                x8F, u, v, w, s1, s2, _, freqsF = self.band_inputs(nmb, b)
+                x8F, u, v, w, s1, s2, _, freqsF, tsj = \
+                    self.band_inputs(nmb, b)
                 out = np.asarray(self._resid_jit(
-                    x8F, u, v, w, s1, s2, freqsF,
-                    jnp.asarray(pfreq[b], self.rdt)))
+                    x8F, u, v, w, s1, s2, freqsF, tsj,
+                    jnp.asarray(pfreq[b], self.rdt), self.tile_beam))
                 res = utils.r2c(out.reshape(self.bmb, self.fpad, 4, 2))
                 xout[r0:r0 + nrow, c0:c0 + nc] = res.reshape(
                     self.bmb, self.fpad, 2, 2)[:nrow, :nc]
@@ -407,7 +434,8 @@ def run_minibatch(cfg: RunConfig, log=print):
 
     solver = make_band_solver(
         rn.dsky, rn.n, rn.cidx, rn.cmask, rn.fdelta_chan,
-        nu=cfg.robust_nulow, max_lbfgs=cfg.max_lbfgs, consensus=False)
+        nu=cfg.robust_nulow, max_lbfgs=cfg.max_lbfgs, consensus=False,
+        dobeam=rn.dobeam)
 
     pinit, pfreq = rn.initial_p()
     mems = [lbfgs_mod.lbfgs_memory_init(rn.nparam, cfg.lbfgs_m)
@@ -429,7 +457,7 @@ def run_minibatch(cfg: RunConfig, log=print):
                 for b in range(rn.nsolbw):
                     args = rn.band_inputs(nmb, b)
                     out = solver(*args, jnp.asarray(pfreq[b], rn.rdt),
-                                 mems[b])
+                                 mems[b], beam=rn.tile_beam)
                     pfreq[b] = np.asarray(out.p)
                     mems[b] = out.mem
                     r00, r01 = float(out.res_0), float(out.res_1)
@@ -475,7 +503,8 @@ def run_minibatch_consensus(cfg: RunConfig, log=print):
 
     solver = make_band_solver(
         rn.dsky, rn.n, rn.cidx, rn.cmask, rn.fdelta_chan,
-        nu=cfg.robust_nulow, max_lbfgs=cfg.max_lbfgs, consensus=True)
+        nu=cfg.robust_nulow, max_lbfgs=cfg.max_lbfgs, consensus=True,
+        dobeam=rn.dobeam)
 
     pinit, pfreq = rn.initial_p()
     mems = [lbfgs_mod.lbfgs_memory_init(rn.nparam, cfg.lbfgs_m)
@@ -506,7 +535,8 @@ def run_minibatch_consensus(cfg: RunConfig, log=print):
                                      mems[b],
                                      Y=jnp.asarray(Y[b], rn.rdt),
                                      BZ=jnp.asarray(BZ, rn.rdt),
-                                     rho=jnp.asarray(rhok[b], rn.rdt))
+                                     rho=jnp.asarray(rhok[b], rn.rdt),
+                                     beam=rn.tile_beam)
                         pfreq[b] = np.asarray(out.p)
                         mems[b] = out.mem
                         r00, r01 = float(out.res_0), float(out.res_1)

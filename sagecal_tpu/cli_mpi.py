@@ -53,6 +53,17 @@ def build_parser() -> argparse.ArgumentParser:
     a("-T", "--max-timeslots", type=int, default=0)
     a("-K", "--skip-timeslots", type=int, default=0)
     a("-U", "--use-global-solution", type=int, default=0)
+    a("-M", "--mdl", action="store_true",
+      help="report MDL/AIC consensus-polynomial model order (mdl.c:42)")
+    a("-N", "--epochs", type=int, default=0,
+      help=">0: stochastic federated mode (sagecal_stochastic_*.cpp)")
+    a("--minibatches", type=int, default=1)
+    a("-w", "--bands", type=int, default=1,
+      help="channels per mini-band in stochastic mode")
+    a("-u", "--federated-alpha", type=float, default=0.0,
+      help="federated/spatial prior strength (-u)")
+    a("-X", "--spatialreg", default=None,
+      help="spatial regularization: l2,l1,order,fista_iters,cadence")
     a("-V", "--verbose", action="store_true")
     return p
 
@@ -84,6 +95,30 @@ def main(argv=None) -> int:
     from sagecal_tpu.solvers import lm as lm_mod, normal_eq as nesolver, sage
 
     paths = discover_datasets(args.ms_pattern)
+
+    if args.epochs > 0:
+        # stochastic federated mode (reference main.cpp:330-342 dispatch)
+        from sagecal_tpu import federated
+        from sagecal_tpu.config import RunConfig
+        cfg = RunConfig(
+            ms=paths[0], sky_model=args.sky_model,
+            cluster_file=args.cluster_file,
+            solutions_file=args.solutions_file,
+            format_3=bool(args.format),
+            n_epochs=args.epochs, n_minibatches=args.minibatches,
+            channel_avg_per_band=args.bands,
+            n_admm=args.admm, n_poly=args.npoly, poly_type=args.polytype,
+            admm_rho=args.rho, rho_file=args.rho_file,
+            federated_alpha=args.federated_alpha,
+            use_global_solution=bool(args.use_global_solution),
+            max_timeslots=args.max_timeslots,
+            skip_timeslots=args.skip_timeslots,
+            max_lbfgs=args.max_lbfgs, lbfgs_m=args.lbfgs_m,
+            robust_nulow=args.nulow, robust_nuhigh=args.nuhigh,
+            verbose=args.verbose)
+        federated.run_federated(cfg, paths)
+        return 0
+
     mss = [ds.SimMS(p) for p in paths]
     nf = len(mss)
     meta0 = mss[0].meta
@@ -128,9 +163,25 @@ def main(argv=None) -> int:
 
     Bpoly = cpoly.setup_polynomials(freqs, float(freqs.mean()),
                                     args.npoly, args.polytype)
+    spatialreg = None
+    spatial_coords = None
+    if args.spatialreg:
+        from sagecal_tpu.consensus import spatial as csp
+        vals = [float(x) for x in args.spatialreg.split(",")]
+        if len(vals) != 5:
+            raise ValueError("-X needs l2,l1,order,fista_iters,cadence")
+        if args.federated_alpha <= 0.0:
+            raise ValueError(
+                "-X spatial regularization couples into the consensus Z "
+                "only through the -u prior strength; give -u > 0 "
+                "(master :768-775 adds alpha*Zbar - X to the Z update)")
+        spatialreg = (vals[0], vals[1], int(vals[2]), int(vals[3]),
+                      max(int(vals[4]), 1))
+        spatial_coords = csp.cluster_polar_coords(sky)
     cfg = cadmm.ADMMConfig(
         n_admm=args.admm, npoly=args.npoly, poly_type=args.polytype,
         rho=rho0, adaptive_rho=bool(args.adaptive_rho),
+        spatialreg=spatialreg, federated_alpha=args.federated_alpha,
         sage=sage.SageConfig(
             max_emiter=args.max_em_iter, max_iter=args.max_iter,
             max_lbfgs=args.max_lbfgs, lbfgs_m=args.lbfgs_m,
@@ -139,7 +190,8 @@ def main(argv=None) -> int:
 
     t0 = mss[0].read_tile(0)
     runner = cadmm.make_admm_runner(dsky, t0.sta1, t0.sta2, cidx, cmask, n,
-                                    meta0["fdelta"], Bpoly, cfg, mesh, nf)
+                                    meta0["fdelta"], Bpoly, cfg, mesh, nf,
+                                    spatial_coords=spatial_coords)
 
     # residual program (per subband, local J)
     def residual_fn(J_r8, x_r, u, v, w, freq):
@@ -184,7 +236,17 @@ def main(argv=None) -> int:
 
         args_dev = [jax.device_put(jnp.asarray(a, rdt), sh) for a in
                     (x8F, uF, vF, wF, freqs, wtF, fratioF, J0)]
-        JF_r8, Z, rhoF, res0, res1, r1s, duals = runner(*args_dev)
+        JF_r8, Z, rhoF, res0, res1, r1s, duals, Y0F = runner(*args_dev)
+
+        if args.mdl and ti == start:
+            # model-order report from iteration-0 rho*J (master :815-822)
+            from sagecal_tpu.consensus import mdl as mdlmod
+            res = mdlmod.minimum_description_length(
+                np.asarray(Y0F), np.broadcast_to(
+                    np.asarray(rho0, float), (sky.n_clusters,)),
+                freqs, float(freqs.mean()), weight=fratioF,
+                polytype=args.polytype, kstart=1, kfinish=args.npoly)
+            mdlmod.report(res)
 
         res0 = np.asarray(res0)
         res1 = np.asarray(r1s)[-1] if cfg.n_admm > 1 else np.asarray(res1)

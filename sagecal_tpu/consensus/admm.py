@@ -48,6 +48,9 @@ class ADMMConfig(NamedTuple):
     adaptive_rho: bool = False
     manifold_iters: int = 20     # master :740 Niter
     sage: sage.SageConfig = sage.SageConfig()
+    # -X l2,l1,order,fista_iters,cadence (README.md:160-166); None = off
+    spatialreg: tuple | None = None
+    federated_alpha: float = 0.0  # -u : alpha of the spatial/federated prior
 
 
 def _blocks(J_r8):
@@ -94,15 +97,22 @@ def manifold_average_mesh(Y_r8, axis_name: str, nf_total: int, m: int,
 
 def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                      fdelta: float, B_poly: np.ndarray, cfg: ADMMConfig,
-                     mesh: Mesh, nf_total: int, with_shapelets: bool = False):
+                     mesh: Mesh, nf_total: int, with_shapelets: bool = False,
+                     spatial_coords=None):
     """Build the jitted per-timeslot consensus-ADMM program.
 
     Returns ``run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F_r8)`` operating
     on [F, ...] arrays sharded over the mesh "freq" axis; gives back
-    (JF_r8, Z, rhoF, res0, res1, r1_per_admm, dual_per_admm).
+    (JF_r8, Z, rhoF, res0, res1, r1_per_admm, dual_per_admm, Y0F_r8)
+    where Y0F is the manifold-projected rho*J of iteration 0 (the MDL
+    input, master :815-822).
 
     B_poly: [F, P] polynomial basis (host numpy, replicated).
+    spatial_coords: ([Mt] r, [Mt] theta) per-effective-cluster polar
+    centroids (spatial.cluster_polar_coords) — required when
+    cfg.spatialreg is set.
     """
+    from sagecal_tpu.consensus import spatial as sp
     from sagecal_tpu.rime import predict as rp
 
     M = int(np.asarray(cmask).shape[0])
@@ -110,6 +120,34 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
     N = n_stations
     Ppoly = B_poly.shape[1]
     Bfull = jnp.asarray(B_poly)            # [F, P] replicated
+
+    # --- spatial regularization setup (master :294-397), host-side once.
+    # Phi blocks live on the padded (m, k) grid: padded chunk slots get
+    # zero blocks so they never contribute to Phikk or the Z update.
+    spat = None
+    if cfg.spatialreg is not None:
+        sh_l2, sh_mu, sh_n0, fista_iters, cadence = cfg.spatialreg
+        rr_c, tt_c = spatial_coords
+        G = int(sh_n0) * int(sh_n0)
+        cm_np = np.asarray(cmask)
+        r_pad = np.zeros((M, K))
+        t_pad = np.zeros((M, K))
+        idx = 0
+        for m in range(M):
+            for k in range(K):
+                if cm_np[m, k]:
+                    r_pad[m, k] = rr_c[idx]
+                    t_pad[m, k] = tt_c[idx]
+                    idx += 1
+        Phi, Phikk = sp.build_phi(int(sh_n0), r_pad.ravel(), t_pad.ravel(),
+                                  float(sh_l2))
+        Phi = Phi * cm_np.reshape(-1)[:, None, None]   # zero padded blocks
+        # stage complex as re/im pairs (no complex host<->device transfer)
+        spat = dict(
+            Phi_ri=jnp.asarray(np.stack([Phi.real, Phi.imag], -1)),
+            Phikk_ri=jnp.asarray(np.stack([Phikk.real, Phikk.imag], -1)),
+            mu=float(sh_mu), iters=int(fista_iters), cadence=int(cadence),
+            G=G)
 
     cidx_j = jnp.asarray(cidx)
     cmask_j = jnp.asarray(cmask)
@@ -164,25 +202,63 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
             g = jax.lax.all_gather(rhoF, axis)       # [ndev, Fl, M]
             return g.reshape(-1, M).T                # [M, F]
 
-        def z_update(YF, rhoF):
+        alpha_vec = None
+        if spat is not None and cfg.federated_alpha > 0.0:
+            alpha_vec = jnp.full((M,), cfg.federated_alpha, x8F.dtype)
+
+        def z_update(YF, rhoF, Zbar=None, Xd=None):
             """z = sum_f B_f Y_f where YF already holds Y + rho J as sent
-            to the master (slave :686-700); Z = Bii z (master :755-779)."""
+            to the master (slave :686-700); Z = Bii z (master :755-779).
+            With spatial reg the prior pulls in: z += alpha Zbar - X and
+            Bii gains the federated +alpha I (master :668-673,:768-775)."""
             zsum_local = jnp.einsum("fp,fmknr->mpknr", Brow, YF)
             zsum = jax.lax.psum(zsum_local, axis)
+            if Zbar is not None:
+                zsum = zsum + cfg.federated_alpha * Zbar - Xd
             Bii = cpoly.find_prod_inverse(
-                Bfull, all_rho(rhoF).astype(x8F.dtype))
+                Bfull, all_rho(rhoF).astype(x8F.dtype), alpha=alpha_vec)
             return cpoly.z_from_contributions(zsum, Bii)
+
+        def spatial_step(Z, Zbar, Xd):
+            """FISTA prox + Zbar/X refresh (master :789-814):
+            Zbar <- Zspat Phi from the FISTA solve on Z; X += alpha(Z-Zbar).
+            All replicated ops."""
+            from sagecal_tpu.consensus import spatial as sp
+            Phi = jax.lax.complex(spat["Phi_ri"][..., 0],
+                                  spat["Phi_ri"][..., 1])
+            Phikk = jax.lax.complex(spat["Phikk_ri"][..., 0],
+                                    spat["Phikk_ri"][..., 1])
+            cdt = jnp.complex64 if x8F.dtype == jnp.float32 \
+                else jnp.complex128
+            Zb = sp.z_r8_to_blocks(Z).astype(cdt)       # [MK, 2PN, 2]
+            Zspat = sp.fista_spatialreg(Zb, Phikk.astype(cdt),
+                                        Phi.astype(cdt), spat["mu"],
+                                        spat["iters"])
+            Zbar_new = sp.blocks_to_z_r8(
+                sp.spatial_predict(Zspat, Phi.astype(cdt)),
+                M, Ppoly, K, N).astype(Z.dtype)
+            Xd_new = Xd + cfg.federated_alpha * (Z - Zbar_new)
+            return Zbar_new, Xd_new
+
+        Y0F = YF     # manifold-projected rho*J: the MDL input (:815-822)
+
+        # spatial-reg state (replicated); zeros when disabled
+        Zbar = jnp.zeros((M, Ppoly, K, N, 8), x8F.dtype)
+        Xd = jnp.zeros_like(Zbar)
 
         # iteration 0 Z update: Y currently = rho*J (manifold-aligned)
         Z = z_update(YF, rhoF)
+        if spat is not None:
+            # admm==0 matches !(admm % cadence) (master :789)
+            Zbar, Xd = spatial_step(Z, Zbar, Xd)
         BZ = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
         YF = YF - rhoF[..., None, None, None] * BZ   # dual update (slave :750)
 
         Yhat_prev = YF
         Jprev = JF.reshape(Fl, M, K, N, 8)
 
-        def body(carry, _):
-            JF, YF, Z, rhoF, Yhat_prev, Jprev = carry
+        def body(carry, it):
+            JF, YF, Z, rhoF, Yhat_prev, Jprev, Zbar, Xd = carry
             BZ = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
             Jr, r0, r1 = jax.vmap(local_solve_admm)(
                 x8F, uF, vF, wF, wtF, JF, freqF,
@@ -190,7 +266,15 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
             J5 = Jr.reshape(Fl, M, K, N, 8)
             YF = YF + rhoF[..., None, None, None] * J5   # Y <- Y + rho J
             Zold = Z
-            Z = z_update(YF, rhoF)
+            if spat is None:
+                Z = z_update(YF, rhoF)
+            else:
+                Z = z_update(YF, rhoF, Zbar, Xd)
+                Zbar, Xd = jax.lax.cond(
+                    it % spat["cadence"] == 0,
+                    lambda z, zb, xd: spatial_step(z, zb, xd),
+                    lambda z, zb, xd: (zb, xd),
+                    Z, Zbar, Xd)
             BZn = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
             # Yhat for BB rho uses BZ_old (slave :724-732, TAG_CONSENSUS_OLD)
             Yhat = YF - rhoF[..., None, None, None] * jnp.einsum(
@@ -204,13 +288,13 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                 )(rhoF, rho_upper, Yhat - Yhat_prev, J5 - Jprev)
 
             dual = jnp.linalg.norm(Z - Zold) / np.sqrt(Z.size)
-            return (Jr, YF, Z, rhoF, Yhat, J5), (r0, r1, dual)
+            return (Jr, YF, Z, rhoF, Yhat, J5, Zbar, Xd), (r0, r1, dual)
 
-        (JF, YF, Z, rhoF, _, _), (r0s, r1s, duals) = jax.lax.scan(
-            body, (JF, YF, Z, rhoF, Yhat_prev, Jprev), None,
-            length=max(cfg.n_admm - 1, 0))
+        (JF, YF, Z, rhoF, _, _, Zbar, Xd), (r0s, r1s, duals) = jax.lax.scan(
+            body, (JF, YF, Z, rhoF, Yhat_prev, Jprev, Zbar, Xd),
+            jnp.arange(1, max(cfg.n_admm, 1)))
 
-        return JF, Z, rhoF, res0, res1, r1s, duals
+        return JF, Z, rhoF, res0, res1, r1s, duals, Y0F
 
     from jax import shard_map
     spec_f = P(axis)
@@ -219,6 +303,6 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         admm_program, mesh=mesh,
         in_specs=(spec_f,) * 8,
         out_specs=(spec_f, spec_r, spec_f, spec_f, spec_f,
-                   P(None, axis), spec_r),
+                   P(None, axis), spec_r, spec_f),
         check_vma=False)
     return jax.jit(prog)

@@ -1,0 +1,183 @@
+"""Distributed stochastic calibration with federated averaging.
+
+Capability parity with the reference's stochastic sagecal-mpi mode
+(``sagecal-mpi -N > 0``; ``src/MPI/sagecal_stochastic_master.cpp`` +
+``sagecal_stochastic_slave.cpp``): every "slave" (here: one subband
+dataset; reference: one MPI rank with its MS list) runs minibatch
+consensus calibration over its own frequency mini-bands with a LOCAL
+polynomial consensus Z, and the slaves are coupled by FEDERATED
+AVERAGING of their Z's:
+
+- local Z update (slave :780-825): z = sum_b B_b Y_b (+ alpha Zavg - X
+  after the first outer iteration), Z = Bii_fed z where Bii_fed is the
+  inverse of (sum_b rho_b B_b B_b^T + alpha I)
+  (``find_prod_inverse_full_fed``, consensus_poly.c);
+- global Zavg = mean over slaves (stochastic master :329-351) — on a
+  device mesh this is ``lax.pmean`` (SURVEY.md P11); host-looped slaves
+  here compute the same mean directly;
+- federated dual X += alpha (Z - Zavg) per cluster (slave :867-875);
+- per-(slave, band) J updates are the stochastic consensus LBFGS solver
+  (``bfgsfit_minibatch_consensus``), with diverged bands flagged out of
+  the Z update exactly as the single-node mode does.
+
+The J-update math runs jitted on the device per (slave, band,
+minibatch); the Z/Zavg/X exchange is tiny (8 N Mt Npoly doubles per
+slave) and stays on host, mirroring the reference's MPI exchange.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import jax.numpy as jnp
+
+from sagecal_tpu import skymodel, utils
+from sagecal_tpu.config import RunConfig
+from sagecal_tpu.consensus import poly as cpoly
+from sagecal_tpu.io import dataset as ds
+from sagecal_tpu.solvers import lbfgs as lbfgs_mod
+from sagecal_tpu import stochastic as st
+
+RES_RATIO = st.RES_RATIO
+
+
+def run_federated(cfg: RunConfig, paths: list, log=print):
+    """One invocation over several subband datasets (the slaves)."""
+    mss = [ds.SimMS(p) for p in paths]
+    meta0 = mss[0].meta
+    sky = skymodel.read_sky_cluster(
+        cfg.sky_model, cfg.cluster_file, meta0["ra0"], meta0["dec0"],
+        float(np.mean([m.meta["freq0"] for m in mss])), cfg.format_3)
+    nslaves = len(mss)
+    runners = [st._StochasticRunner(cfg, m, sky, log=(lambda *a: None))
+               for m in mss]
+    rn0 = runners[0]
+    log(f"Federated stochastic calibration: {nslaves} slave datasets, "
+        f"{cfg.n_epochs} epochs x {rn0.minibatches} minibatches, "
+        f"{rn0.nsolbw} mini-bands each, {cfg.n_admm} outer iterations")
+
+    solver = st.make_band_solver(
+        rn0.dsky, rn0.n, rn0.cidx, rn0.cmask, rn0.fdelta_chan,
+        nu=cfg.robust_nulow, max_lbfgs=cfg.max_lbfgs, consensus=True,
+        dobeam=rn0.dobeam)
+
+    P = cfg.n_poly
+    M, kmax, n = rn0.M, rn0.kmax, rn0.n
+    ref_f = float(np.mean([m.meta["freq0"] for m in mss]))
+    alpha = np.full(M, cfg.federated_alpha)
+
+    # per-slave polynomial basis at that slave's band-center freqs
+    Bs, Biis, rhoks = [], [], []
+    for rn in runners:
+        fcen = np.array([rn.freqs[c0:c0 + nc].mean()
+                         for c0, nc in zip(rn.chanstart, rn.nchan)])
+        B = cpoly.setup_polynomials(fcen, ref_f, P, cfg.poly_type)
+        arho = np.full(M, cfg.admm_rho)
+        if cfg.rho_file:
+            arho = skymodel.read_cluster_rho(cfg.rho_file, sky.cluster_ids,
+                                             cfg.admm_rho)
+        rhok = np.tile(arho[None, :], (rn.nsolbw, 1))       # [nb, M]
+        # federated inverse: +alpha I (find_prod_inverse_full_fed)
+        Bii = np.asarray(cpoly.find_prod_inverse(
+            jnp.asarray(B), jnp.asarray(rhok.T), alpha=jnp.asarray(alpha)))
+        Bs.append(B)
+        Biis.append(Bii)
+        rhoks.append(rhok)
+
+    pshape = (M, kmax, n, 8)
+    states = []
+    for rn in runners:
+        pinit, pfreq = rn.initial_p()
+        mems = [lbfgs_mod.lbfgs_memory_init(rn.nparam, cfg.lbfgs_m)
+                for _ in range(rn.nsolbw)]
+        states.append({"pfreq": pfreq, "mems": mems, "pinit": pinit,
+                       "res_prev": None})
+
+    writer = rn0.solution_writer()
+    n_tiles = min(m.n_tiles for m in mss)
+    start = cfg.skip_timeslots           # -K (CTRL_SKIP, master :623-634)
+    stop = n_tiles if not cfg.max_timeslots else min(
+        n_tiles, start + cfg.max_timeslots)
+    history = []
+    for ti in range(start, stop):
+        t0 = time.time()
+        tiles = [m.read_tile(ti) for m in mss]
+        for rn, tile in zip(runners, tiles):
+            rn.prepare_tile(tile)
+        Zavg = np.zeros((M, P, kmax, n, 8))
+        Zs = [np.zeros_like(Zavg) for _ in range(nslaves)]
+        Xs = [np.zeros_like(Zavg) for _ in range(nslaves)]
+        Ys = [np.zeros((rn.nsolbw,) + pshape) for rn in runners]
+        resband = [np.zeros(rn.nsolbw) for rn in runners]
+        res_0 = res_1 = 0.0
+        for nadmm in range(cfg.n_admm):
+            r0all, r1all = [], []
+            for s, rn in enumerate(runners):
+                B, Bii, rhok = Bs[s], Biis[s], rhoks[s]
+                Y, Z, X = Ys[s], Zs[s], Xs[s]
+                pfreq, mems = states[s]["pfreq"], states[s]["mems"]
+                for nepch in range(cfg.n_epochs):
+                    for nmb in range(rn.minibatches):
+                        r0s, r1s = [], []
+                        for b in range(rn.nsolbw):
+                            BZ = np.einsum("p,mpkns->mkns", B[b], Z)
+                            args = rn.band_inputs(nmb, b)
+                            out = solver(
+                                *args, jnp.asarray(pfreq[b], rn.rdt),
+                                mems[b], Y=jnp.asarray(Y[b], rn.rdt),
+                                BZ=jnp.asarray(BZ, rn.rdt),
+                                rho=jnp.asarray(rhok[b], rn.rdt),
+                                beam=rn.tile_beam)
+                            pfreq[b] = np.asarray(out.p)
+                            mems[b] = out.mem
+                            r00, r01 = float(out.res_0), float(out.res_1)
+                            resband[s][b] = r01 if (r00 > 0 and r01 > 0) \
+                                else np.inf
+                            r0s.append(r00)
+                            r1s.append(r01)
+                        rmean = float(np.mean(r1s))
+                        fband = resband[s] > RES_RATIO * rmean
+                        good = ~fband
+                        # local ADMM update (slave :780-825)
+                        for b in np.where(good)[0]:
+                            Y[b] += (rhok[b][:, None, None, None]
+                                     * pfreq[b])
+                        zsum = np.einsum("b,bp,bmkns->mpkns",
+                                         good.astype(float), B, Y)
+                        if nadmm > 0:
+                            zsum += (alpha[:, None, None, None, None]
+                                     * Zavg - X)
+                        Z = np.einsum("mpq,mqkns->mpkns", Bii, zsum)
+                        for b in np.where(good)[0]:
+                            BZb = np.einsum("p,mpkns->mkns", B[b], Z)
+                            Y[b] -= rhok[b][:, None, None, None] * BZb
+                        r0all.extend(r0s)
+                        r1all.extend(r1s)
+                Zs[s] = Z
+            # federated averaging (stochastic master :329-351; pmean on a
+            # mesh) + dual update X += alpha (Z - Zavg) (slave :867-875)
+            Zavg = np.mean(Zs, axis=0)
+            feda = 0.0
+            for s in range(nslaves):
+                d = Zs[s] - Zavg
+                Xs[s] += alpha[:, None, None, None, None] * d
+                feda += float(np.linalg.norm(d)) ** 2
+            if cfg.verbose:
+                log(f"FEDA: {nadmm} dual residual="
+                    f"{np.sqrt(feda / max(Zavg.size * nslaves, 1)):.6f}")
+            res_0 = float(np.mean(r0all))
+            res_1 = float(np.mean(r1all))
+
+        for s, rn in enumerate(runners):
+            pfreq = states[s]["pfreq"]
+            if cfg.use_global_solution:
+                for b in range(rn.nsolbw):
+                    pfreq[b] = np.einsum("p,mpkns->mkns", Bs[s][b],
+                                         Zs[s]).astype(np.float32)
+            rn.end_of_tile(tiles[s], ti, states[s], resband[s], res_0,
+                           res_1, t0, writer if s == 0 else None,
+                           history if s == 0 else [])
+    if writer:
+        writer.close()
+    return history

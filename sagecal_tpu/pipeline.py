@@ -60,10 +60,11 @@ class FullBatchPipeline:
     """Reusable jitted solve over a SimMS-like dataset."""
 
     def __init__(self, cfg: RunConfig, ms: ds.SimMS, sky: skymodel.ClusterSky,
-                 real_dtype=None):
+                 real_dtype=None, log=print):
         self.cfg = cfg
         self.ms = ms
         self.sky = sky
+        self.log = log
         platform = jax.devices()[0].platform
         if real_dtype is None:
             real_dtype = jnp.float64 if (
@@ -82,7 +83,7 @@ class FullBatchPipeline:
         # beam (-B): stored metadata, else synthetic (set_elementcoeffs +
         # readAuxData-with-beam analogue; fullbatch_mode.cpp:56-70)
         self.dobeam = int(cfg.beam_mode)
-        self.beam_info = bm.resolve_beaminfo(self.dobeam, ms, meta)
+        self.beam_info = bm.resolve_beaminfo(self.dobeam, ms, meta, log=log)
         self._warned_no_times = False
         mode = effective_solver_mode(int(cfg.solver_mode), self.n)
         self.base_cfg = sage.SageConfig(
@@ -128,8 +129,8 @@ class FullBatchPipeline:
         if not self.dobeam:
             return None
         if tile.time_mjd is None and not self._warned_no_times:
-            print("WARNING: dataset tiles carry no timestamps; beam az/el "
-                  "will be evaluated at the J2000 placeholder epoch")
+            self.log("WARNING: dataset tiles carry no timestamps; beam "
+                     "az/el will be evaluated at the J2000 placeholder epoch")
             self._warned_no_times = True
         return bm.beam_to_device(self.beam_info, self.ms.meta["freq0"],
                                  self.rdt, time_jd=tile.time_jd)
@@ -196,6 +197,11 @@ class FullBatchPipeline:
                                    cfg.uvmin, cfg.uvmax)
             xa = tile.averaged()
             x8 = jnp.asarray(utils.vis_to_x8(xa), self.rdt)
+            if cfg.whiten:
+                # -W: uv-density whitening of the solve input only
+                # (fullbatch_mode.cpp applies whiten_data to the averaged x)
+                from sagecal_tpu.solvers import robust as rb
+                x8 = rb.whiten_data(x8, u, v, meta["freq0"])
             wt = lm_mod.make_weights(flags, self.rdt)
             sta1 = jnp.asarray(tile.sta1)
             sta2 = jnp.asarray(tile.sta2)
@@ -299,7 +305,7 @@ def run(cfg: RunConfig, log=print):
     sky = skymodel.read_sky_cluster(cfg.sky_model, cfg.cluster_file,
                                     meta["ra0"], meta["dec0"], meta["freq0"],
                                     cfg.format_3)
-    pipe = FullBatchPipeline(cfg, ms, sky)
+    pipe = FullBatchPipeline(cfg, ms, sky, log=log)
     if cfg.simulation != SimulationMode.OFF:
         return pipe.run_simulation(log=log)
     return pipe.run(solution_path=cfg.solutions_file,

@@ -89,3 +89,25 @@ def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         None, length=wt_rounds)
     info = {"init_cost": costs[0][0], "final_cost": costs[1][-1]}
     return J, nu, info
+
+
+def ncp_weight(uvdist):
+    """Inverse uv-density taper 1/(1 + 1.8 exp(-0.05 d)), flat for
+    d > 400 wavelengths (updatenu.c:343-350)."""
+    import jax.numpy as jnp
+    w = 1.0 / (1.0 + 1.8 * jnp.exp(-0.05 * uvdist))
+    return jnp.where(uvdist > 400.0, 1.0, w)
+
+
+def whiten_data(x, u, v, freq0):
+    """uv-density whitening of visibilities (-W flag; updatenu.c:386
+    ``whiten_data``): every correlation of baseline row b is scaled by
+    ``ncp_weight(|uv_b|)`` in wavelengths at ``freq0``. u, v in seconds.
+
+    x: [B, ...] complex or real visibility rows.
+    """
+    import jax.numpy as jnp
+    uu = u * freq0
+    vv = v * freq0
+    a = ncp_weight(jnp.sqrt(uu * uu + vv * vv))
+    return x * a.reshape((-1,) + (1,) * (x.ndim - 1)).astype(x.real.dtype)

@@ -1,0 +1,2 @@
+"""Image-domain companion tools: buildsky (image -> sky model) and
+restore (sky model -> image). Reference: src/buildsky/, src/restore/."""

@@ -1,0 +1,199 @@
+"""Minimal self-contained FITS image reader/writer.
+
+The reference tools use cfitsio + wcslib (src/buildsky/buildsky.c
+``read_fits_file``:242, src/restore/restore.c). This image has neither
+cfitsio python bindings nor astropy, and the subset of FITS needed for
+buildsky/restore is small: single-HDU images, BITPIX -32/-64/16/32,
+NAXIS 2-4 (degenerate freq/stokes axes), linear or SIN-projected celestial
+WCS, and the restoring-beam keywords BMAJ/BMIN/BPA. This module implements
+exactly that subset over numpy big-endian buffers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+BLOCK = 2880
+
+
+@dataclasses.dataclass
+class FitsImage:
+    """A 2D image plane + the WCS/beam metadata the tools need."""
+
+    data: np.ndarray            # [ny, nx] (row y, column x)
+    ra0: float                  # reference RA (rad) at crpix
+    dec0: float                 # reference Dec (rad)
+    crpix1: float               # 1-based reference pixel (x)
+    crpix2: float
+    cdelt1: float               # rad/pixel (RA axis, usually negative)
+    cdelt2: float
+    bmaj: float = 0.0           # restoring beam major axis (rad)
+    bmin: float = 0.0
+    bpa: float = 0.0            # position angle (rad)
+    freq: float = 0.0           # Hz (from a degenerate FREQ axis)
+    header_cards: list = dataclasses.field(default_factory=list)
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    # --- WCS: SIN (orthographic) projection, the interferometric standard
+    def pixel_to_lm(self, x, y):
+        """0-based pixel -> direction cosines (l, m) about the reference
+        direction. For SIN projection the tangent-plane offsets ARE l, m."""
+        l = (np.asarray(x, float) - (self.crpix1 - 1.0)) * self.cdelt1
+        m = (np.asarray(y, float) - (self.crpix2 - 1.0)) * self.cdelt2
+        return l, m
+
+    def lm_to_pixel(self, l, m):
+        x = np.asarray(l, float) / self.cdelt1 + (self.crpix1 - 1.0)
+        y = np.asarray(m, float) / self.cdelt2 + (self.crpix2 - 1.0)
+        return x, y
+
+    def lm_to_radec(self, l, m):
+        """Inverse SIN projection about (ra0, dec0)."""
+        l = np.asarray(l, float)
+        m = np.asarray(m, float)
+        n = np.sqrt(np.maximum(1.0 - l * l - m * m, 0.0))
+        sd, cd = math.sin(self.dec0), math.cos(self.dec0)
+        dec = np.arcsin(m * cd + n * sd)
+        ra = self.ra0 + np.arctan2(l, n * cd - m * sd)
+        return ra, dec
+
+    def radec_to_lm(self, ra, dec):
+        ra = np.asarray(ra, float)
+        dec = np.asarray(dec, float)
+        sd, cd = math.sin(self.dec0), math.cos(self.dec0)
+        l = np.cos(dec) * np.sin(ra - self.ra0)
+        m = np.sin(dec) * cd - np.cos(dec) * sd * np.cos(ra - self.ra0)
+        return l, m
+
+
+def _parse_card(card: bytes):
+    key = card[:8].decode("ascii", "replace").strip()
+    rest = card[8:].decode("ascii", "replace")
+    if not rest.startswith("="):
+        return key, None
+    val = rest[1:].split("/")[0].strip()
+    if val.startswith("'"):
+        return key, val.strip("'").strip()
+    if val in ("T", "F"):
+        return key, val == "T"
+    try:
+        return key, int(val)
+    except ValueError:
+        pass
+    try:
+        return key, float(val)
+    except ValueError:
+        return key, val
+
+
+def read_fits(path: str) -> FitsImage:
+    with open(path, "rb") as f:
+        raw = f.read()
+    hdr = {}
+    cards = []
+    pos = 0
+    done = False
+    while not done:
+        block = raw[pos:pos + BLOCK]
+        if len(block) < BLOCK:
+            raise ValueError(f"{path}: truncated FITS header")
+        for i in range(0, BLOCK, 80):
+            card = block[i:i + 80]
+            k, v = _parse_card(card)
+            if k == "END":
+                done = True
+                break
+            if k:
+                hdr[k] = v
+                cards.append(card)
+        pos += BLOCK
+
+    bitpix = int(hdr["BITPIX"])
+    naxis = int(hdr["NAXIS"])
+    dims = [int(hdr[f"NAXIS{i+1}"]) for i in range(naxis)]
+    count = int(np.prod(dims)) if dims else 0
+    dt = {-64: ">f8", -32: ">f4", 16: ">i2", 32: ">i4", 8: ">u1"}[bitpix]
+    need = count * np.dtype(dt).itemsize
+    arr = np.frombuffer(raw[pos:pos + need], dtype=dt).astype(np.float64)
+    if "BSCALE" in hdr or "BZERO" in hdr:
+        arr = arr * float(hdr.get("BSCALE", 1.0)) + float(hdr.get("BZERO",
+                                                                 0.0))
+    # FITS is Fortran order: NAXIS1 fastest
+    arr = arr.reshape(dims[::-1])
+    # collapse degenerate leading (stokes/freq) axes to the 2D sky plane
+    while arr.ndim > 2:
+        arr = arr[0]
+
+    # celestial + freq axes
+    d2r = math.pi / 180.0
+    ra0 = dec0 = 0.0
+    crpix1 = crpix2 = 1.0
+    cdelt1 = cdelt2 = 1.0 * d2r
+    freq = 0.0
+    for i in range(naxis):
+        ctype = str(hdr.get(f"CTYPE{i+1}", ""))
+        crval = float(hdr.get(f"CRVAL{i+1}", 0.0))
+        cdelt = float(hdr.get(f"CDELT{i+1}", 1.0))
+        crpix = float(hdr.get(f"CRPIX{i+1}", 1.0))
+        if ctype.startswith("RA"):
+            ra0, cdelt1, crpix1 = crval * d2r, cdelt * d2r, crpix
+        elif ctype.startswith("DEC"):
+            dec0, cdelt2, crpix2 = crval * d2r, cdelt * d2r, crpix
+        elif ctype.startswith("FREQ"):
+            freq = crval
+    return FitsImage(
+        data=arr, ra0=ra0, dec0=dec0, crpix1=crpix1, crpix2=crpix2,
+        cdelt1=cdelt1, cdelt2=cdelt2,
+        bmaj=float(hdr.get("BMAJ", 0.0)) * d2r,
+        bmin=float(hdr.get("BMIN", 0.0)) * d2r,
+        bpa=float(hdr.get("BPA", 0.0)) * d2r,
+        freq=freq, header_cards=cards)
+
+
+def _card(key: str, value, comment: str = "") -> bytes:
+    if isinstance(value, bool):
+        v = "T" if value else "F"
+        s = f"{key:<8}= {v:>20}"
+    elif isinstance(value, str):
+        s = f"{key:<8}= '{value:<8}'"
+    elif isinstance(value, int):
+        s = f"{key:<8}= {value:>20}"
+    else:
+        s = f"{key:<8}= {value:>20.12E}"
+    if comment:
+        s += f" / {comment}"
+    return s[:80].ljust(80).encode("ascii")
+
+
+def write_fits(path: str, img: FitsImage) -> None:
+    """Write a 2D (degenerate 4-axis) float32 image with SIN WCS."""
+    ny, nx = img.data.shape
+    r2d = 180.0 / math.pi
+    cards = [
+        _card("SIMPLE", True), _card("BITPIX", -32), _card("NAXIS", 4),
+        _card("NAXIS1", nx), _card("NAXIS2", ny),
+        _card("NAXIS3", 1), _card("NAXIS4", 1),
+        _card("CTYPE1", "RA---SIN"), _card("CRVAL1", img.ra0 * r2d),
+        _card("CDELT1", img.cdelt1 * r2d), _card("CRPIX1", img.crpix1),
+        _card("CTYPE2", "DEC--SIN"), _card("CRVAL2", img.dec0 * r2d),
+        _card("CDELT2", img.cdelt2 * r2d), _card("CRPIX2", img.crpix2),
+        _card("CTYPE3", "FREQ"), _card("CRVAL3", img.freq),
+        _card("CDELT3", 1.0), _card("CRPIX3", 1.0),
+        _card("CTYPE4", "STOKES"), _card("CRVAL4", 1.0),
+        _card("CDELT4", 1.0), _card("CRPIX4", 1.0),
+        _card("BMAJ", img.bmaj * r2d), _card("BMIN", img.bmin * r2d),
+        _card("BPA", img.bpa * r2d), _card("BUNIT", "JY/BEAM"),
+    ]
+    cards.append("END".ljust(80).encode("ascii"))
+    hdr = b"".join(cards)
+    hdr += b" " * ((-len(hdr)) % BLOCK)
+    payload = img.data[None, None].astype(">f4").tobytes()
+    payload += b"\x00" * ((-len(payload)) % BLOCK)
+    with open(path, "wb") as f:
+        f.write(hdr + payload)

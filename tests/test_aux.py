@@ -1,0 +1,207 @@
+"""Tests for auxiliary algorithms: whitening, MDL model order, spatial
+regularization (spherical harmonics + FISTA), federated averaging."""
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sagecal_tpu import skymodel
+from sagecal_tpu.consensus import mdl as mdlmod
+from sagecal_tpu.consensus import poly as cpoly
+from sagecal_tpu.consensus import spatial as sp
+from sagecal_tpu.io import dataset as ds
+from sagecal_tpu.rime import predict as rp
+from sagecal_tpu.solvers import robust as rb
+
+
+# --- whitening -------------------------------------------------------------
+
+def test_ncp_weight_long_baseline_flat():
+    d = jnp.array([0.0, 10.0, 100.0, 401.0, 1e5])
+    w = np.asarray(rb.ncp_weight(d))
+    assert w[-1] == 1.0 and w[-2] == 1.0
+    assert np.all(np.diff(w) >= 0)          # monotone taper
+    assert w[0] == pytest.approx(1 / 2.8)   # 1/(1+1.8) at d=0
+
+
+def test_whiten_data_scales_rows():
+    rng = np.random.default_rng(0)
+    B = 16
+    x = rng.normal(size=(B, 8))
+    u = rng.normal(0, 1e-6, B)
+    v = rng.normal(0, 1e-6, B)
+    out = np.asarray(rb.whiten_data(jnp.asarray(x), jnp.asarray(u),
+                                    jnp.asarray(v), 150e6))
+    d = np.sqrt((u * 150e6) ** 2 + (v * 150e6) ** 2)
+    a = np.where(d > 400, 1.0, 1.0 / (1.0 + 1.8 * np.exp(-0.05 * d)))
+    np.testing.assert_allclose(out, x * a[:, None], rtol=1e-6)
+
+
+# --- MDL -------------------------------------------------------------------
+
+def test_mdl_recovers_polynomial_order():
+    """Solutions generated from an order-2 frequency polynomial + noise:
+    MDL/AIC must pick order 2 over 1..4."""
+    rng = np.random.default_rng(3)
+    F, M, rest = 8, 3, 24
+    k_true = 2
+    freqs = np.linspace(120e6, 168e6, F)
+    freq0 = float(freqs.mean())
+    B = cpoly.setup_polynomials(freqs, freq0, k_true, 2)     # [F, 2]
+    Z = rng.normal(size=(M, k_true, rest))
+    rho = np.array([2.0, 5.0, 1.0])
+    J = np.einsum("fp,mpr->fmr", B, Z) * rho[None, :, None]
+    J += 0.001 * rng.normal(size=J.shape)
+    res = mdlmod.minimum_description_length(
+        J.reshape(F, M, 4, 6), rho, freqs, freq0, polytype=2,
+        kstart=1, kfinish=4)
+    assert res["best_mdl"] == k_true
+    assert res["best_aic"] == k_true
+
+
+# --- spherical harmonics + FISTA ------------------------------------------
+
+def test_sharmonic_y00_and_count():
+    th = jnp.array([0.1, 0.7, 1.2])
+    ph = jnp.array([0.0, 2.0, 4.0])
+    Y = np.asarray(sp.sharmonic_basis(3, th, ph))
+    assert Y.shape == (3, 9)
+    np.testing.assert_allclose(Y[:, 0], 1.0 / math.sqrt(4 * math.pi),
+                               atol=1e-12)
+    # Y_1,-1 = conj(Y_1,1) * (-1): modes ordered l=0; l=1 m=-1,0,1
+    np.testing.assert_allclose(Y[:, 1], -np.conj(Y[:, 3]), atol=1e-12)
+
+
+def test_sharmonic_orthonormality():
+    """Numerical quadrature of Y_lm Y_l'm'^* over the sphere ~ identity."""
+    nth, nph = 64, 64
+    th = np.linspace(0, np.pi, nth + 1)[:-1] + np.pi / (2 * nth)
+    ph = np.linspace(0, 2 * np.pi, nph, endpoint=False)
+    T, Pg = np.meshgrid(th, ph, indexing="ij")
+    Y = np.asarray(sp.sharmonic_basis(3, jnp.asarray(T.ravel()),
+                                      jnp.asarray(Pg.ravel())))
+    w = (np.sin(T.ravel()) * (np.pi / nth) * (2 * np.pi / nph))
+    G = (Y.conj().T * w) @ Y
+    np.testing.assert_allclose(G, np.eye(9), atol=5e-3)
+
+
+def test_fista_ridge_limit():
+    """With mu=0 FISTA converges to the ridge solution rhs @ inv(Phikk)."""
+    rng = np.random.default_rng(1)
+    Mt, D, G2 = 5, 8, 6
+    # modest scale keeps the reference's conservative Lipschitz estimate
+    # (L = ||Phikk||_F^2, fista.c:44) from making steps microscopic
+    Phi = 0.4 * (rng.normal(size=(Mt, G2, 2))
+                 + 1j * rng.normal(size=(Mt, G2, 2)))
+    Zbar = rng.normal(size=(Mt, D, 2)) + 1j * rng.normal(size=(Mt, D, 2))
+    Phikk = np.einsum("kgi,khi->gh", Phi, Phi.conj()) + 0.5 * np.eye(G2)
+    Z = np.asarray(sp.fista_spatialreg(jnp.asarray(Zbar),
+                                       jnp.asarray(Phikk),
+                                       jnp.asarray(Phi), 0.0, 20000))
+    rhs = np.einsum("kdi,kgi->dg", Zbar, Phi.conj())
+    want = rhs @ np.linalg.inv(Phikk)
+    np.testing.assert_allclose(Z, want, atol=1e-5)
+
+
+def test_fista_l1_shrinks_but_not_to_zero():
+    """With moderate mu the elastic-net solution is shrunk vs the ridge
+    solution but must NOT be annihilated (the reference's t*mu prox
+    threshold zeroes everything; we use the correct mu/L scaling)."""
+    rng = np.random.default_rng(4)
+    Mt, D, G2 = 5, 8, 6
+    Phi = 0.4 * (rng.normal(size=(Mt, G2, 2))
+                 + 1j * rng.normal(size=(Mt, G2, 2)))
+    Zbar = rng.normal(size=(Mt, D, 2)) + 1j * rng.normal(size=(Mt, D, 2))
+    Phikk = np.einsum("kgi,khi->gh", Phi, Phi.conj()) + 0.5 * np.eye(G2)
+    Z_l1 = np.asarray(sp.fista_spatialreg(jnp.asarray(Zbar),
+                                          jnp.asarray(Phikk),
+                                          jnp.asarray(Phi), 0.05, 5000))
+    Z_0 = np.asarray(sp.fista_spatialreg(jnp.asarray(Zbar),
+                                         jnp.asarray(Phikk),
+                                         jnp.asarray(Phi), 0.0, 5000))
+    n1, n0 = np.linalg.norm(Z_l1), np.linalg.norm(Z_0)
+    assert n1 > 0.25 * n0          # not annihilated
+    assert n1 < n0                 # but shrunk
+
+
+def test_z_block_roundtrip():
+    rng = np.random.default_rng(2)
+    M, P, K, N = 3, 2, 2, 4
+    Z = rng.normal(size=(M, P, K, N, 8))
+    X = sp.z_r8_to_blocks(jnp.asarray(Z))
+    assert X.shape == (M * K, 2 * P * N, 2)
+    back = np.asarray(sp.blocks_to_z_r8(X, M, P, K, N))
+    np.testing.assert_allclose(back, Z, atol=1e-12)
+
+
+def test_cluster_polar_coords():
+    srcs = {}
+    for i, (ll, mm) in enumerate([(0.01, 0.0), (0.0, 0.02)]):
+        nm = f"P{i}"
+        srcs[nm] = skymodel.Source(
+            name=nm, ra=0, dec=0, ll=ll, mm=mm,
+            nn=math.sqrt(1 - ll * ll - mm * mm) - 1, sI=2.0, sQ=0, sU=0,
+            sV=0, sI0=2.0, sQ0=0, sU0=0, sV0=0, spec_idx=0, spec_idx1=0,
+            spec_idx2=0, f0=150e6)
+    sky = skymodel.build_cluster_sky(srcs, [(0, 2, ["P0"]), (1, 1, ["P1"])])
+    r, t = sp.cluster_polar_coords(sky)
+    assert len(r) == 3               # nchunk 2 + 1
+    assert r[0] == r[1]              # chunk replication
+    np.testing.assert_allclose(r[0], 0.01 * np.pi / 2, rtol=1e-12)
+    np.testing.assert_allclose(t[2], np.pi / 2, rtol=1e-9)  # atan2(m, 0)
+
+
+# --- federated + spatial-reg end-to-end ------------------------------------
+
+def _make_subband_datasets(tmp_path, nf=2, n_sta=6, tilesz=2, nchan=2):
+    sky_txt = "P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6\n"
+    (tmp_path / "sky.txt").write_text(sky_txt)
+    (tmp_path / "sky.txt.cluster").write_text("0 1 P0A\n")
+    ra0 = (41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(tmp_path / "sky.txt"),
+                                    ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(str(tmp_path / "sky.txt.cluster")))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jtrue = ds.random_jones(1, sky.nchunk, n_sta, seed=5, scale=0.15)
+    paths = []
+    for f in range(nf):
+        fc = 140e6 + 10e6 * f
+        freqs = np.linspace(fc - 1e6, fc + 1e6, nchan)
+        tile = ds.simulate_dataset(dsky, n_stations=n_sta, tilesz=tilesz,
+                                   freqs=freqs, ra0=ra0, dec0=dec0,
+                                   jones=Jtrue, nchunk=sky.nchunk,
+                                   noise_sigma=0.01, seed=7 + f)
+        p = tmp_path / f"band{f}.ms"
+        ds.SimMS.create(str(p), [tile])
+        paths.append(str(p))
+    return paths, sky
+
+
+def test_federated_stochastic(tmp_path):
+    from sagecal_tpu import cli_mpi
+    paths, sky = _make_subband_datasets(tmp_path)
+    lst = tmp_path / "mslist.txt"
+    lst.write_text("\n".join(paths) + "\n")
+    rc = cli_mpi.main([
+        "-f", str(lst), "-s", str(tmp_path / "sky.txt"),
+        "-c", str(tmp_path / "sky.txt.cluster"),
+        "-N", "2", "--minibatches", "1", "-A", "3", "-P", "2",
+        "-r", "1.0", "-u", "0.5", "-m", "10", "-l", "5"])
+    assert rc == 0
+
+
+def test_admm_spatialreg_runs(tmp_path):
+    from sagecal_tpu import cli_mpi
+    paths, sky = _make_subband_datasets(tmp_path)
+    rc = cli_mpi.main([
+        "-f", str(tmp_path / "band*.ms"),
+        "-s", str(tmp_path / "sky.txt"),
+        "-c", str(tmp_path / "sky.txt.cluster"),
+        "-A", "4", "-P", "2", "-r", "1.0", "-j", "2", "-e", "2",
+        "-l", "4", "-m", "4", "-M",
+        "-u", "0.1", "-X", "0.01,0.001,2,20,2"])
+    assert rc == 0

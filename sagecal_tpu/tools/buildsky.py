@@ -1,0 +1,567 @@
+"""buildsky: FITS image + island mask -> LSM sky model + cluster file.
+
+Capability parity with the reference ``buildsky`` tool
+(``src/buildsky/``): per-island multi-point-source fits against the
+restoring beam with AIC model-order selection (fitpixels.c:57-560,
+buildsky.c:1286-1390 ``process_pixels``), EM component refinement,
+sidelobe detection (``filter_pixels``, buildsky.c:1435), component
+merging, flux rescaling, weighted k-means / hierarchical clustering of
+sources into directions (cluster.c, create_clusters.py), and LSM/BBS
+output with ds9 annotations (annotate.py).
+
+Multi-FITS spectral mode (``-d`` directory; buildmultisky.c): positions
+are fitted on the channel-mean image, per-channel fluxes solved linearly,
+and up-to-3rd-order spectral indices fitted in log-log space
+(``sI = exp(log I0 + sP log(f/f0) + sP1 log^2 + sP2 log^3)``).
+
+Conventions follow the reference exactly:
+- internal beam widths are HALF the FWHM in radians (main.c:210
+  ``bmaj = (arcsec/3600)/360*pi``; buildsky.c:272 ``fits_bmaj/360*pi``),
+  and the component model is ``sI * exp(-(u^2+v^2))`` with u, v the
+  pa-rotated offsets scaled by those half-widths (fitpixels.c:90-95);
+- AIC = 2*(3k) + 2*n*ln(SSE) (fitpixels.c:101-103 "AIC=2*k+N*ln(err)");
+- beam area in pixels = pi*bmaj*bmin/(|cdelt1*cdelt2|) (buildsky.c:288).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import math
+import os
+import sys
+
+import numpy as np
+
+from sagecal_tpu.tools import fits as fitsio
+
+
+# ---------------------------------------------------------------------------
+# island extraction
+# ---------------------------------------------------------------------------
+
+def label_islands(mask: np.ndarray) -> dict:
+    """Island id -> (ys, xs) pixel indices. A Duchamp-style mask already
+    carries distinct island numbers; a binary mask gets connected-component
+    labels (4-connectivity, iterative flood fill)."""
+    mask = np.asarray(mask)
+    ids = np.unique(mask[mask > 0].astype(np.int64))
+    if len(ids) > 1:
+        return {int(i): np.nonzero(mask == i) for i in ids}
+    # binary mask: label components
+    lab = np.zeros(mask.shape, np.int64)
+    cur = 0
+    todo = list(zip(*np.nonzero(mask > 0)))
+    seen = set()
+    out = {}
+    for seed in todo:
+        if seed in seen:
+            continue
+        cur += 1
+        stack = [seed]
+        pix = []
+        while stack:
+            y, x = stack.pop()
+            if (y, x) in seen or not (0 <= y < mask.shape[0]
+                                      and 0 <= x < mask.shape[1]):
+                continue
+            if mask[y, x] <= 0:
+                continue
+            seen.add((y, x))
+            lab[y, x] = cur
+            pix.append((y, x))
+            stack.extend([(y + 1, x), (y - 1, x), (y, x + 1), (y, x - 1)])
+        ys = np.array([p[0] for p in pix])
+        xs = np.array([p[1] for p in pix])
+        out[cur] = (ys, xs)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-island fitting (fitpixels.c)
+# ---------------------------------------------------------------------------
+
+def _model_and_jac(p, l, m, sb, cb, bmaj, bmin, jac=True):
+    """Sum of k PSF-shaped components + analytic Jacobian.
+
+    p: [3k] = (l0, m0, sI0, l1, ...); returns (model [n], J [n, 3k]).
+    u = (-dl*sb + dm*cb)/bmaj, v = (-dl*cb - dm*sb)/bmin,
+    model += sI*exp(-(u^2+v^2)) (fitpixels.c:90-95).
+    """
+    k = len(p) // 3
+    n = len(l)
+    mod = np.zeros(n)
+    J = np.zeros((n, 3 * k)) if jac else None
+    for i in range(k):
+        lk, mk, sk = p[3 * i], p[3 * i + 1], p[3 * i + 2]
+        dl = l - lk
+        dm = m - mk
+        u = (-dl * sb + dm * cb) / bmaj
+        v = (-dl * cb - dm * sb) / bmin
+        E = np.exp(-(u * u + v * v))
+        mod += sk * E
+        if jac:
+            # du/dlk = sb/bmaj, dv/dlk = cb/bmin
+            J[:, 3 * i] = sk * E * (-2.0) * (u * sb / bmaj + v * cb / bmin)
+            # du/dmk = -cb/bmaj, dv/dmk = sb/bmin
+            J[:, 3 * i + 1] = sk * E * (-2.0) * (-u * cb / bmaj
+                                                 + v * sb / bmin)
+            J[:, 3 * i + 2] = E
+    return mod, J
+
+
+def _lm_refine(p0, l, m, x, sb, cb, bmaj, bmin, maxiter: int):
+    """Damped LM on the k-component model (clmfit_nocuda.c equivalent)."""
+    p = np.asarray(p0, float).copy()
+    mod, J = _model_and_jac(p, l, m, sb, cb, bmaj, bmin)
+    r = x - mod
+    cost = r @ r
+    mu = 1e-3 * max(np.max(np.abs(J.T @ J)), 1e-12)
+    for _ in range(maxiter):
+        JTJ = J.T @ J
+        g = J.T @ r
+        try:
+            dp = np.linalg.solve(JTJ + mu * np.eye(len(p)), g)
+        except np.linalg.LinAlgError:
+            mu *= 10
+            continue
+        p_new = p + dp
+        mod_new, J_new = _model_and_jac(p_new, l, m, sb, cb, bmaj, bmin)
+        r_new = x - mod_new
+        cost_new = r_new @ r_new
+        if cost_new < cost:
+            p, mod, J, r, cost = p_new, mod_new, J_new, r_new, cost_new
+            mu = max(mu / 3, 1e-15)
+            if np.linalg.norm(dp) < 1e-12:
+                break
+        else:
+            mu *= 2.5
+            if mu > 1e12:
+                break
+    return p, cost
+
+
+def fit_island(l, m, x, bmaj, bmin, bpa, maxfits: int = 10,
+               maxiter: int = 100, maxemiter: int = 4, use_em: bool = True):
+    """AIC model-order scan: 1..maxfits components (process_pixels,
+    buildsky.c:1286-1390). Returns (ll, mm, sI) of the best fit."""
+    n = len(x)
+    sb, cb = math.sin(bpa), math.cos(bpa)
+    nfits = max(min(maxfits, n // 3), 1)
+    best = None
+    best_aic = np.inf
+    for k in range(1, nfits + 1):
+        if k == 1:
+            # moment init (fit_single_point0, fitpixels.c:57) + LM refine
+            # (fit_single_point, fitpixels.c:295)
+            sumI = x.sum()
+            if abs(sumI) < 1e-300:
+                continue
+            ll0 = float((x * l).sum() / sumI)
+            mm0 = float((x * m).sum() / sumI)
+            peak = x[np.argmax(np.abs(x))]
+            p, sse = _lm_refine(np.array([ll0, mm0, peak]), l, m, x,
+                                sb, cb, bmaj, bmin, maxiter)
+            sse = float(sse)
+        else:
+            # greedy peak-subtract init (fit_N_point_em, fitpixels.c:478-)
+            xd = x.copy()
+            p = np.zeros(3 * k)
+            for i in range(k):
+                j = int(np.argmax(np.abs(xd)))
+                p[3 * i:3 * i + 3] = (l[j], m[j], xd[j])
+                mod, _ = _model_and_jac(p[3 * i:3 * i + 3], l, m, sb, cb,
+                                        bmaj, bmin, jac=False)
+                xd = xd - mod
+            if use_em:
+                # EM: cycle components, refit each against its residual
+                for _ in range(maxemiter):
+                    for i in range(k):
+                        others = np.concatenate(
+                            [p[:3 * i], p[3 * i + 3:]])
+                        mod_o, _ = _model_and_jac(others, l, m, sb, cb,
+                                                  bmaj, bmin, jac=False) \
+                            if len(others) else (np.zeros(n), None)
+                        pi, _ = _lm_refine(p[3 * i:3 * i + 3], l, m,
+                                           x - mod_o, sb, cb, bmaj, bmin,
+                                           max(maxiter // maxemiter, 5))
+                        p[3 * i:3 * i + 3] = pi
+            p, sse = _lm_refine(p, l, m, x, sb, cb, bmaj, bmin, maxiter)
+            sse = float(sse)
+        # keep components inside the island bounding box (hull penalty,
+        # fitpixels.c:528-543)
+        ok = True
+        for i in range(k if k > 1 else 1):
+            li, mi = p[3 * i], p[3 * i + 1]
+            if not (l.min() - 2 * bmaj <= li <= l.max() + 2 * bmaj
+                    and m.min() - 2 * bmaj <= mi <= m.max() + 2 * bmaj):
+                ok = False
+        aic = 2.0 * 3 * k + 2.0 * n * math.log(max(sse, 1e-300))
+        if ok and aic < best_aic:
+            best_aic = aic
+            best = p.copy()
+    if best is None:
+        return np.array([]), np.array([]), np.array([])
+    k = len(best) // 3
+    return best[0::3][:k], best[1::3][:k], best[2::3][:k]
+
+
+# ---------------------------------------------------------------------------
+# post-processing
+# ---------------------------------------------------------------------------
+
+def sidelobe_score(l, m, x):
+    """Eigen-ratio sidelobe statistic (filter_pixels, buildsky.c:1460-1536):
+    W0/(W1*peak*mean) — large for elongated faint islands."""
+    lc = l - l.mean()
+    mc = m - m.mean()
+    a00 = (lc * lc).sum()
+    a01 = (lc * mc).sum()
+    a11 = (mc * mc).sum()
+    T = a00 + a11
+    D = a00 * a11 - a01 * a01
+    s = math.sqrt(max(T * T * 0.25 - D, 0.0))
+    w0, w1 = T * 0.5 + s, T * 0.5 - s
+    peak = float(np.max(np.abs(x)))
+    mean = float(np.abs(x.sum()) / len(x))
+    denom = w1 * peak * mean
+    return w0 / denom if denom > 0 else np.inf
+
+
+def merge_components(ll, mm, sI, rd: float, bmaj: float, bmin: float):
+    """Merge components closer than rd*(bmaj+bmin)/2 into flux-weighted
+    centroids (-c; main.c:41)."""
+    ll = list(map(float, ll))
+    mm = list(map(float, mm))
+    sI = list(map(float, sI))
+    lim = rd * (bmaj + bmin) / 2
+    merged = True
+    while merged and len(ll) > 1:
+        merged = False
+        for i in range(len(ll)):
+            for j in range(i + 1, len(ll)):
+                if math.hypot(ll[i] - ll[j], mm[i] - mm[j]) < lim:
+                    w = abs(sI[i]) + abs(sI[j])
+                    if w > 0:
+                        ll[i] = (abs(sI[i]) * ll[i] + abs(sI[j]) * ll[j]) / w
+                        mm[i] = (abs(sI[i]) * mm[i] + abs(sI[j]) * mm[j]) / w
+                    sI[i] = sI[i] + sI[j]
+                    del ll[j], mm[j], sI[j]
+                    merged = True
+                    break
+            if merged:
+                break
+    return np.array(ll), np.array(mm), np.array(sI)
+
+
+def cluster_sources(ll, mm, sI, k: int, seed: int = 0, iters: int = 50):
+    """Cluster source directions: k>0 flux-weighted k-means
+    (create_clusters.py); k<0 hierarchical agglomeration to |k| clusters
+    (cluster.c). Returns [S] cluster labels 0..nc-1."""
+    S = len(ll)
+    pts = np.stack([ll, mm], 1)
+    w = np.abs(sI) + 1e-12
+    if k == 0 or S == 0:
+        return np.zeros(S, int)
+    nc = min(abs(k), S)
+    if k > 0:
+        rng = np.random.default_rng(seed)
+        # weighted init: brightest sources
+        order = np.argsort(-w)
+        cent = pts[order[:nc]].copy()
+        lab = np.zeros(S, int)
+        for _ in range(iters):
+            d = ((pts[:, None] - cent[None]) ** 2).sum(-1)
+            lab = np.argmin(d, 1)
+            for c in range(nc):
+                sel = lab == c
+                if sel.any():
+                    cent[c] = (w[sel, None] * pts[sel]).sum(0) / w[sel].sum()
+                else:
+                    cent[c] = pts[rng.integers(S)]
+        return lab
+    # hierarchical: start singleton, merge closest centroid pair
+    groups = [[i] for i in range(S)]
+    cent = [pts[i].copy() for i in range(S)]
+    while len(groups) > nc:
+        best, bi, bj = np.inf, 0, 1
+        for i in range(len(groups)):
+            for j in range(i + 1, len(groups)):
+                d = ((cent[i] - cent[j]) ** 2).sum()
+                if d < best:
+                    best, bi, bj = d, i, j
+        gi, gj = groups[bi], groups[bj]
+        wi = w[gi].sum()
+        wj = w[gj].sum()
+        cent[bi] = (cent[bi] * wi + cent[bj] * wj) / (wi + wj)
+        groups[bi] = gi + gj
+        del groups[bj], cent[bj]
+    lab = np.zeros(S, int)
+    for c, g in enumerate(groups):
+        lab[np.array(g)] = c
+    return lab
+
+
+# ---------------------------------------------------------------------------
+# output (LSM format3 / BBS; cluster file; annotations)
+# ---------------------------------------------------------------------------
+
+def _radec_sexagesimal(ra, dec):
+    ra = ra % (2 * math.pi)
+    h = ra * 12.0 / math.pi
+    rah = int(h)
+    ram = int((h - rah) * 60)
+    ras = ((h - rah) * 60 - ram) * 60
+    neg = dec < 0
+    d = abs(dec) * 180.0 / math.pi
+    decd = int(d)
+    decm = int((d - decd) * 60)
+    decs = ((d - decd) * 60 - decm) * 60
+    return rah, ram, ras, ("-" if neg else "") + str(decd), decm, decs
+
+
+class SkySource:
+    def __init__(self, name, ra, dec, l, m, sI, sP=0.0, sP1=0.0, sP2=0.0,
+                 f0=1e9, isl=0):
+        self.name = name
+        self.ra, self.dec = ra, dec
+        self.l, self.m = l, m
+        self.sI, self.sP, self.sP1, self.sP2 = sI, sP, sP1, sP2
+        self.f0 = f0
+        self.isl = isl
+
+
+def write_lsm(path, sources, fmt: int = 1):
+    """fmt 0: BBS, 1: LSM with 3rd-order spectral indices (-o)."""
+    with open(path, "w") as f:
+        if fmt == 0:
+            f.write("# (Name, Type, Ra, Dec, I, Q, U, V,"
+                    " ReferenceFrequency, SpectralIndex) = format\n")
+            for s in sources:
+                rah, ram, ras, dd, dm_, dsx = _radec_sexagesimal(s.ra, s.dec)
+                f.write(f"{s.name}, POINT, {rah}:{ram:02d}:{ras:06.3f}, "
+                        f"{dd}.{dm_:02d}.{dsx:06.3f}, {s.sI:.6f}, 0, 0, 0, "
+                        f"{s.f0:.1f}, [{s.sP:.4f}]\n")
+        else:
+            f.write("## LSM file (buildsky)\n"
+                    "# name h m s d m s I Q U V spectral_index0 "
+                    "spectral_index1 spectral_index2 RM eX eY eP "
+                    "freq0\n")
+            for s in sources:
+                rah, ram, ras, dd, dm_, dsx = _radec_sexagesimal(s.ra, s.dec)
+                f.write(f"{s.name} {rah} {ram} {ras:.4f} {dd} {dm_} "
+                        f"{dsx:.4f} {s.sI:.6g} 0 0 0 {s.sP:.6g} "
+                        f"{s.sP1:.6g} {s.sP2:.6g} 0 0 0 0 {s.f0:.6g}\n")
+
+
+def write_cluster_file(path, sources, labels, nchunk: int = 1):
+    """Cluster file rows: id chunks name...; brightest cluster first."""
+    nc = labels.max() + 1 if len(labels) else 0
+    flux = [sum(abs(s.sI) for s, c in zip(sources, labels) if c == ci)
+            for ci in range(nc)]
+    order = np.argsort(flux)[::-1]
+    with open(path, "w") as f:
+        f.write("# cluster_id chunks source_names\n")
+        for new_id, ci in enumerate(order):
+            names = " ".join(s.name for s, c in zip(sources, labels)
+                             if c == ci)
+            f.write(f"{new_id} {nchunk} {names}\n")
+
+
+def write_ds9_regions(path, sources):
+    """annotate.py equivalent: ds9 region file."""
+    with open(path, "w") as f:
+        f.write("# Region file format: DS9\nfk5\n")
+        for s in sources:
+            f.write(f'circle({math.degrees(s.ra):.6f},'
+                    f'{math.degrees(s.dec):.6f},30") # text={{{s.name}}}\n')
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+def build_sky_single(img: fitsio.FitsImage, mask: np.ndarray,
+                     threshold: float = 0.0, maxiter: int = 100,
+                     maxemiter: int = 4, use_em: bool = True,
+                     maxfits: int = 10, wcutoff: float = 0.0,
+                     merge_rd: float = 0.0, unique: str = "",
+                     ignore: set | None = None, donegative: bool = False,
+                     scaleflux: bool = False, log=print):
+    """Single-image buildsky: returns (sources, sidelobe_ids)."""
+    islands = label_islands(mask)
+    bmaj = img.bmaj / 2 if img.bmaj else 0.001     # internal half-FWHM
+    bmin = img.bmin / 2 if img.bmin else 0.001
+    beam_pix = math.pi * bmaj * bmin / abs(img.cdelt1 * img.cdelt2)
+    sources = []
+    sidelobes = []
+    for isl, (ys, xs) in sorted(islands.items()):
+        if ignore and isl in ignore:
+            continue
+        l, m = img.pixel_to_lm(xs, ys)
+        x = img.data[ys, xs].astype(float)
+        if donegative:
+            x = -x
+        if threshold:
+            x = np.where(np.abs(x) < threshold, 0.0, x)
+        if not np.any(x):
+            continue
+        if wcutoff > 0 and len(x) > 2:
+            if sidelobe_score(l, m, x) > wcutoff:
+                sidelobes.append(isl)
+        ll, mm, sI = fit_island(l, m, x, bmaj, bmin, img.bpa,
+                                maxfits=maxfits, maxiter=maxiter,
+                                maxemiter=maxemiter, use_em=use_em)
+        if merge_rd > 0 and len(ll) > 1:
+            ll, mm, sI = merge_components(ll, mm, sI, merge_rd, bmaj, bmin)
+        if scaleflux and len(sI):
+            tot_island = x.sum() / beam_pix
+            tot_model = sI.sum()
+            if abs(tot_model) > 0:
+                sI = sI * (tot_island / tot_model)
+        ra, dec = img.lm_to_radec(ll, mm)
+        for ci in range(len(ll)):
+            name = f"P{isl}C{ci}{unique}"
+            if donegative:
+                sI_out = -sI[ci]
+            else:
+                sI_out = sI[ci]
+            sources.append(SkySource(name, float(ra[ci]), float(dec[ci]),
+                                     float(ll[ci]), float(mm[ci]),
+                                     float(sI_out), f0=img.freq or 1e9,
+                                     isl=int(isl)))
+    log(f"buildsky: {len(islands)} islands -> {len(sources)} sources")
+    if sidelobes:
+        log(f"probable sidelobe islands ({wcutoff}): "
+            + " ".join(map(str, sidelobes)))
+    return sources, sidelobes
+
+
+def build_sky_multifreq(imgs: list, mask: np.ndarray, log=print, **kw):
+    """Multi-FITS spectral mode (buildmultisky.c): positions from the
+    channel-mean image, per-channel fluxes, log-log polynomial spectra."""
+    freqs = np.array([im.freq for im in imgs])
+    if np.any(freqs <= 0.0):
+        raise ValueError(
+            "spectral mode needs a FREQ axis in every FITS image "
+            "(got freq<=0); add CTYPE/CRVAL FREQ cards")
+    ref = imgs[0]
+    mean_img = fitsio.FitsImage(
+        data=np.mean([im.data for im in imgs], axis=0), ra0=ref.ra0,
+        dec0=ref.dec0, crpix1=ref.crpix1, crpix2=ref.crpix2,
+        cdelt1=ref.cdelt1, cdelt2=ref.cdelt2, bmaj=ref.bmaj,
+        bmin=ref.bmin, bpa=ref.bpa, freq=float(freqs.mean()))
+    sources, sidelobes = build_sky_single(mean_img, mask, log=log, **kw)
+    f0 = float(freqs.mean())
+    bmaj, bmin = mean_img.bmaj / 2 or 0.001, mean_img.bmin / 2 or 0.001
+    sb, cb = math.sin(mean_img.bpa), math.cos(mean_img.bpa)
+    # restrict the flux solve to pixels of islands that actually produced
+    # sources (ignored/failed islands would otherwise bias the lstsq)
+    islands = label_islands(mask)
+    used = {s.isl for s in sources}
+    keep = [isl for isl in sorted(islands) if isl in used]
+    ys = np.concatenate([islands[i][0] for i in keep])
+    xs = np.concatenate([islands[i][1] for i in keep])
+    l, m = mean_img.pixel_to_lm(xs, ys)
+    # linear per-channel flux solve with fixed positions
+    A = np.stack([_model_and_jac(
+        np.array([s.l, s.m, 1.0]), l, m, sb, cb, bmaj, bmin,
+        jac=False)[0] for s in sources], axis=1)       # [npix, S]
+    lo = np.log(freqs / f0)
+    fluxes = []
+    for im in imgs:
+        x = im.data[ys, xs].astype(float)
+        sol, *_ = np.linalg.lstsq(A, x, rcond=None)
+        fluxes.append(sol)
+    fluxes = np.stack(fluxes)                          # [F, S]
+    for si, s in enumerate(sources):
+        fI = fluxes[:, si]
+        pos = np.abs(fI) > 1e-12
+        if pos.sum() >= 2:
+            order = min(3, pos.sum() - 1)
+            coeff = np.polyfit(lo[pos], np.log(np.abs(fI[pos])), order)
+            coeff = coeff[::-1]       # ascending
+            s.sI = math.copysign(math.exp(coeff[0]), np.median(fI))
+            s.sP = float(coeff[1]) if order >= 1 else 0.0
+            s.sP1 = float(coeff[2]) if order >= 2 else 0.0
+            s.sP2 = float(coeff[3]) if order >= 3 else 0.0
+        s.f0 = f0
+    return sources, sidelobes
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="sagecal-tpu-buildsky",
+        description="FITS image + mask -> LSM sky model + cluster file")
+    a = p.add_argument
+    a("-f", "--image", help="FITS image")
+    a("-d", "--fits-dir", help="directory of FITS images (spectral mode)")
+    a("-m", "--mask", required=True, help="island mask FITS")
+    a("-t", "--threshold", type=float, default=0.0)
+    a("-i", "--maxiter", type=int, default=100)
+    a("-e", "--maxemiter", type=int, default=4)
+    a("-n", "--no-em", action="store_true")
+    a("-a", "--bmaj", type=float, default=0.0, help="PSF major (arcsec)")
+    a("-b", "--bmin", type=float, default=0.0)
+    a("-p", "--bpa", type=float, default=0.0, help="PSF pa (deg)")
+    a("-o", "--format", type=int, default=1,
+      help="0 BBS, 1 LSM 3rd-order spectra (upstream buildsky numbering;"
+           " note restore calls the 3rd-order format -o 2)")
+    a("-g", "--ignorelist", default=None)
+    a("-w", "--wcutoff", type=float, default=0.0)
+    a("-c", "--merge", type=float, default=0.0)
+    a("-l", "--maxfits", type=int, default=10)
+    a("-k", "--clusters", type=int, default=0)
+    a("-s", "--unique", default="")
+    a("-N", "--negative", action="store_true")
+    a("-q", "--scaleflux", type=int, default=0)
+    a("-O", "--output", default=None, help="output basename")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.image and not args.fits_dir:
+        print("need -f image.fits or -d fits_dir", file=sys.stderr)
+        return 1
+    maskimg = fitsio.read_fits(args.mask)
+    ignore = set()
+    if args.ignorelist:
+        with open(args.ignorelist) as f:
+            ignore = {int(t) for line in f for t in line.split()}
+    kw = dict(threshold=args.threshold, maxiter=args.maxiter,
+              maxemiter=args.maxemiter, use_em=not args.no_em,
+              maxfits=args.maxfits, wcutoff=args.wcutoff,
+              merge_rd=args.merge, unique=args.unique, ignore=ignore,
+              donegative=args.negative, scaleflux=bool(args.scaleflux))
+
+    def override_beam(img):
+        if args.bmaj:
+            img.bmaj = math.radians(args.bmaj / 3600.0)
+            img.bmin = math.radians(args.bmin / 3600.0)
+            img.bpa = math.radians(args.bpa)
+        return img
+
+    if args.fits_dir:
+        paths = sorted(glob.glob(os.path.join(args.fits_dir, "*.fits")))
+        imgs = [override_beam(fitsio.read_fits(p)) for p in paths]
+        sources, _ = build_sky_multifreq(imgs, maskimg.data, **kw)
+        base = args.output or (paths[0] + ".sky.txt")
+    else:
+        img = override_beam(fitsio.read_fits(args.image))
+        sources, _ = build_sky_single(img, maskimg.data, **kw)
+        base = args.output or (args.image + ".sky.txt")
+
+    write_lsm(base, sources, fmt=args.format)
+    labels = cluster_sources(
+        np.array([s.l for s in sources]), np.array([s.m for s in sources]),
+        np.array([s.sI for s in sources]), args.clusters)
+    write_cluster_file(base + ".cluster", sources, labels)
+    write_ds9_regions(base + ".reg", sources)
+    print(f"wrote {base} (+.cluster, +.reg): {len(sources)} sources, "
+          f"{labels.max() + 1 if len(labels) else 0} clusters")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,301 @@
+"""restore: render an LSM sky model (+ optional solutions) into a FITS
+image, convolved with the restoring PSF.
+
+Capability parity with the reference ``restore`` tool
+(``src/restore/restore.c:862-880``): replace/add/subtract (-a/-s) the
+rendered model in an existing FITS image; point sources rendered
+analytically under the elliptical-Gaussian PSF; extended sources
+(Gaussian/disk/ring/shapelet, by leading name letter) rendered in the
+image domain (shapelet_lm.c Hermite basis) and FFT-convolved with the
+PSF (fft.c); with ``-l solutions -c clusterfile`` each cluster's fluxes
+are scaled by the mean apparent gain of its solutions
+(readsky.c:460 ``read_sky_model_withgain``:
+``sum(J_i)^H sum(J_i) - sum(J_i^H J_i)`` = sum_{p != q} J_p^H J_q over
+station pairs, traced, averaged over timeslots; ``-g`` drops listed
+stations). Solution application assumes an unpolarized model, as
+upstream documents.
+
+Beam-width convention matches buildsky: internal widths are HALF the
+FWHM in radians and the PSF is ``exp(-(u^2+v^2))`` on pa-rotated
+coordinates scaled by those half-widths.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+
+import numpy as np
+
+from sagecal_tpu import skymodel
+from sagecal_tpu.io import solutions as solio
+from sagecal_tpu.tools import fits as fitsio
+
+
+def parse_bbs_sky(path: str, f0_default: float = 150e6) -> dict:
+    """Minimal BBS catalog parser (-o 0; readsky.c:186
+    ``read_bbs_skyline``): 'Name, Type, hh:mm:ss.s, dd.mm.ss.s, I, Q, U,
+    V, RefFreq, [spectral_index]' lines -> {name: Source}."""
+    out = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#") or line.startswith("//"):
+                continue
+            tok = [t.strip() for t in line.split(",")]
+            if len(tok) < 5 or ":" not in tok[2]:
+                continue
+            name = tok[0]
+            hh, mm, ss = tok[2].split(":")
+            ra = (float(hh) + float(mm) / 60 + float(ss) / 3600) \
+                * math.pi / 12
+            dparts = tok[3].split(".")
+            dd = float(dparts[0])
+            dmn = float(dparts[1]) if len(dparts) > 1 else 0.0
+            dsc = float(".".join(dparts[2:])) if len(dparts) > 2 else 0.0
+            sgn = -1.0 if tok[3].lstrip().startswith("-") else 1.0
+            dec = sgn * (abs(dd) + dmn / 60 + dsc / 3600) * math.pi / 180
+            sI = float(tok[4]) if len(tok) > 4 else 0.0
+            f0 = float(tok[8]) if len(tok) > 8 and tok[8] else f0_default
+            si = 0.0
+            if len(tok) > 9:
+                si_s = tok[9].strip("[]")
+                si = float(si_s) if si_s else 0.0
+            out[name] = skymodel.Source(
+                name=name, ra=ra, dec=dec, ll=0.0, mm=0.0, nn=0.0,
+                sI=sI, sQ=0.0, sU=0.0, sV=0.0, sI0=sI, sQ0=0.0, sU0=0.0,
+                sV0=0.0, spec_idx=si, spec_idx1=0.0, spec_idx2=0.0, f0=f0)
+    return out
+
+
+def cluster_gains(solfile: str, cluster_path: str,
+                  ignore_stations: set | None = None):
+    """Per-cluster apparent-gain factors from a solutions file.
+
+    factor_m = mean over (interval, chunk) of
+      Re tr( sum_{p != q} J_p^H J_q ) / (2 N (N-1))
+    (readsky.c:720-810) — the imaged Stokes-I scaling of an unpolarized
+    source observed through per-station gains.
+    Returns {cluster_id: factor}.
+    """
+    clusters = skymodel.parse_cluster_file(cluster_path)
+    nchunk = np.array([max(1, nch) for _, nch, _ in clusters], np.int32)
+    hdr, blocks = solio.read_solutions(solfile, nchunk)
+    out = {}
+    for mi, (cid, _, _) in enumerate(clusters):
+        acc = 0.0
+        cnt = 0
+        for blk in blocks:
+            J = blk[0] if isinstance(blk, list) else blk   # [M, K, N, 2, 2]
+            for k in range(nchunk[mi]):
+                Jk = J[mi, k]                              # [N, 2, 2]
+                if ignore_stations:
+                    keep = [p for p in range(Jk.shape[0])
+                            if p not in ignore_stations]
+                    Jk = Jk[keep]
+                N = Jk.shape[0]
+                if N < 2:
+                    continue
+                A = Jk.sum(axis=0)                         # sum_p J_p
+                S2 = np.einsum("pij,pik->jk", Jk.conj(), Jk)
+                cross = A.conj().T @ A - S2                # sum_{p!=q}
+                acc += float(np.trace(cross).real) / (2.0 * N * (N - 1))
+                cnt += 1
+        out[int(cid)] = acc / cnt if cnt else 1.0
+    return out
+
+
+def _psf_kernel(img: fitsio.FitsImage, bmaj, bmin, bpa):
+    """PSF image on the pixel grid, centered, for FFT convolution."""
+    ny, nx = img.data.shape
+    ys, xs = np.mgrid[0:ny, 0:nx]
+    l, m = img.pixel_to_lm(xs, ys)
+    lc, mc = img.pixel_to_lm(nx // 2, ny // 2)
+    dl, dm = l - lc, m - mc
+    sb, cb = math.sin(bpa), math.cos(bpa)
+    u = (-dl * sb + dm * cb) / bmaj
+    v = (-dl * cb - dm * sb) / bmin
+    return np.exp(-(u * u + v * v))
+
+
+def _hermite_1d(x, n0: int):
+    """Normalized Hermite functions H_n(x) exp(-x^2/2) (hermite.c:
+    recursion; shapelet_lm.c basis)."""
+    H = [np.ones_like(x), 2.0 * x]
+    for n in range(2, n0):
+        H.append(2.0 * x * H[-1] - 2.0 * (n - 1) * H[-2])
+    ex = np.exp(-0.5 * x * x)
+    out = []
+    for n in range(n0):
+        norm = 1.0 / math.sqrt((2.0 ** n) * math.factorial(n)
+                               * math.sqrt(math.pi))
+        out.append(H[n] * ex * norm)
+    return out
+
+
+def render_source(img: fitsio.FitsImage, s, bmaj, bmin, bpa, l, m):
+    """One source's contribution on the pixel grid (l, m precomputed by
+    the caller). Points fold the PSF analytically; extended profiles are
+    returned UNconvolved (the caller FFT-convolves the accumulated
+    extended plane once)."""
+    ls, ms = img.radec_to_lm(s.ra, s.dec)
+    dl, dm = l - ls, m - ms
+    stype = getattr(s, "stype", skymodel.STYPE_POINT)
+    if stype == skymodel.STYPE_POINT:
+        sb, cb = math.sin(bpa), math.cos(bpa)
+        u = (-dl * sb + dm * cb) / bmaj
+        v = (-dl * cb - dm * sb) / bmin
+        return s.sI * np.exp(-(u * u + v * v)), True
+    # rotate into the source frame (position angle from sky model)
+    cxi, sxi = s.cxi, -s.sxi
+    xr = dl * cxi - dm * sxi
+    yr = dl * sxi + dm * cxi
+    # Extended profiles carry total flux sI, normalized by the ANALYTIC
+    # profile integral (in pixels) so that partially-off-grid sources keep
+    # only the flux that actually lands on the grid.
+    pix_area = abs(img.cdelt1 * img.cdelt2)
+    if stype == skymodel.STYPE_GAUSSIAN:
+        # eX/eY carry the doubled readsky convention; use as 1/e widths
+        eX, eY = max(s.eX, 1e-12), max(s.eY, 1e-12)
+        prof = np.exp(-((xr / eX) ** 2 + (yr / eY) ** 2))
+        prof *= s.sI / (math.pi * eX * eY / pix_area)
+        return prof, False
+    if stype == skymodel.STYPE_DISK:
+        prof = ((xr ** 2 + yr ** 2) <= s.eX ** 2).astype(float)
+        prof *= s.sI / max(math.pi * s.eX ** 2 / pix_area, 1.0)
+        return prof, False
+    if stype == skymodel.STYPE_RING:
+        r = np.sqrt(xr ** 2 + yr ** 2)
+        width = 1.5 * max(abs(img.cdelt2), 1e-12)
+        prof = (np.abs(r - s.eX) < width).astype(float)
+        prof *= s.sI / max(2 * math.pi * s.eX * 2 * width / pix_area, 1.0)
+        return prof, False
+    if stype == skymodel.STYPE_SHAPELET:
+        # parse_sky_model already loaded the mode file onto the Source
+        n0, beta = s.sh_n0, s.sh_beta
+        hx = _hermite_1d(xr / beta, n0)
+        hy = _hermite_1d(yr / beta, n0)
+        prof = np.zeros_like(xr)
+        mgrid = np.asarray(s.sh_modes).reshape(n0, n0)
+        for n2 in range(n0):
+            for n1 in range(n0):
+                prof += mgrid[n2, n1] * hy[n2] * hx[n1]
+        prof = prof / beta
+        tot = prof.sum()
+        if abs(tot) > 1e-300:
+            prof *= s.sI / tot
+        return prof, False
+    return np.zeros_like(dl), True
+
+
+def restore_image(img: fitsio.FitsImage, sources: dict, mode: str = "replace",
+                  bmaj=None, bmin=None, bpa=None, gains=None,
+                  source_cluster=None, log=print):
+    """Render all sources into ``img.data`` (in place).
+
+    mode: replace | add | subtract (-a / -s); gains: {cluster_id: factor}
+    with ``source_cluster`` {name: cluster_id}.
+    """
+    bmaj = (bmaj if bmaj else img.bmaj) / 2 or 0.001
+    bmin = (bmin if bmin else img.bmin) / 2 or 0.001
+    bpa = bpa if bpa is not None else img.bpa
+    ny, nx = img.data.shape
+    ys, xs = np.mgrid[0:ny, 0:nx]
+    l, m = img.pixel_to_lm(xs, ys)
+    model = np.zeros_like(img.data)
+    extended = np.zeros_like(img.data)
+    n_ext = 0
+    for s in sources.values():
+        factor = 1.0
+        if gains is not None and source_cluster is not None:
+            factor = gains.get(source_cluster.get(s.name, None), 1.0)
+        plane, convolved = render_source(img, s, bmaj, bmin, bpa, l, m)
+        if convolved:
+            model += factor * plane
+        else:
+            extended += factor * plane
+            n_ext += 1
+    if n_ext:
+        # LINEAR convolution with the PSF: zero-pad to 2x so flux near an
+        # edge falls off the grid instead of wrapping around (circular
+        # FFT conv); "same" crop about the kernel center
+        psf = _psf_kernel(img, bmaj, bmin, bpa)   # centered at (ny//2, nx//2)
+        S = (2 * ny, 2 * nx)
+        full = np.fft.irfft2(np.fft.rfft2(extended, s=S)
+                             * np.fft.rfft2(psf, s=S), s=S)
+        model += full[ny // 2:ny // 2 + ny, nx // 2:nx // 2 + nx]
+    if mode == "add":
+        img.data = img.data + model
+    elif mode == "subtract":
+        img.data = img.data - model
+    else:
+        img.data = model
+    log(f"restore: {len(sources)} sources ({n_ext} extended), mode={mode}")
+    return img
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="sagecal-tpu-restore",
+        description="render LSM (+solutions) into a FITS image")
+    a = p.add_argument
+    a("-f", "--fits", required=True)
+    a("-i", "--sky-model", required=True)
+    a("-o", "--format", type=int, default=2,
+      help="0 BBS, 1 LSM, 2 LSM 3rd-order spectra (default)")
+    a("-a", "--add", action="store_true")
+    a("-s", "--subtract", action="store_true")
+    a("-c", "--cluster-file", default=None)
+    a("-l", "--solutions-file", default=None)
+    a("-g", "--ignore-stations", default=None,
+      help="file of station numbers to ignore")
+    a("-m", "--bmaj", type=float, default=0.0, help="PSF major (arcsec)")
+    a("-n", "--bmin", type=float, default=0.0)
+    a("-p", "--bpa", type=float, default=0.0, help="PSF pa (deg)")
+    a("-O", "--output", default=None, help="output FITS (default in-place)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    img = fitsio.read_fits(args.fits)
+    if args.format == 0:
+        sources = parse_bbs_sky(args.sky_model, img.freq or 150e6)
+    else:
+        sources = skymodel.parse_sky_model(
+            args.sky_model, img.ra0, img.dec0,
+            img.freq or 150e6, format_3=(args.format == 2))
+    if not sources:
+        print(f"no sources parsed from {args.sky_model} with -o "
+              f"{args.format}; refusing to overwrite the image",
+              file=sys.stderr)
+        return 1
+    gains = None
+    source_cluster = None
+    if args.solutions_file and args.cluster_file:
+        ignore = set()
+        if args.ignore_stations:
+            with open(args.ignore_stations) as f:
+                ignore = {int(t) for ln in f for t in ln.split()}
+        gains = cluster_gains(args.solutions_file, args.cluster_file,
+                              ignore)
+        source_cluster = {}
+        for cid, _, names in skymodel.parse_cluster_file(args.cluster_file):
+            for nm in names:
+                source_cluster[nm] = int(cid)
+    mode = "add" if args.add else ("subtract" if args.subtract
+                                   else "replace")
+    kw = {}
+    if args.bmaj:
+        kw = dict(bmaj=math.radians(args.bmaj / 3600.0),
+                  bmin=math.radians(args.bmin / 3600.0),
+                  bpa=math.radians(args.bpa))
+    restore_image(img, sources, mode=mode, gains=gains,
+                  source_cluster=source_cluster, **kw)
+    fitsio.write_fits(args.output or args.fits, img)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

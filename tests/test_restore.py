@@ -1,0 +1,159 @@
+"""restore tests: point rendering, add/subtract round trip, solution
+gain application, extended-source convolution."""
+
+import math
+
+import numpy as np
+
+from sagecal_tpu import skymodel
+from sagecal_tpu.io import solutions as solio
+from sagecal_tpu.tools import fits as fitsio
+from sagecal_tpu.tools import restore as rst
+
+RA0, DEC0 = 1.2, 0.8
+CD = math.radians(2.0 / 3600)
+BMAJ = math.radians(12.0 / 3600)
+NPIX = 96
+
+
+def blank_image():
+    return fitsio.FitsImage(
+        data=np.zeros((NPIX, NPIX)), ra0=RA0, dec0=DEC0,
+        crpix1=NPIX / 2, crpix2=NPIX / 2, cdelt1=-CD, cdelt2=CD,
+        bmaj=BMAJ, bmin=BMAJ, bpa=0.0, freq=150e6)
+
+
+def write_sky(tmp_path, lines):
+    p = tmp_path / "sky.txt"
+    p.write_text("".join(lines))
+    return str(p)
+
+
+def lsm_line(name, ra, dec, sI, eX=0.0, eY=0.0):
+    h = (ra % (2 * math.pi)) * 12 / math.pi
+    rah, rem = int(h), (h - int(h)) * 60
+    ram, ras = int(rem), (rem - int(rem)) * 60
+    d = abs(dec) * 180 / math.pi
+    dd, dmr = int(d), (d - int(d)) * 60
+    dm, dsx = int(dmr), (dmr - int(dmr)) * 60
+    sign = "-" if dec < 0 else ""
+    return (f"{name} {rah} {ram} {ras:.6f} {sign}{dd} {dm} {dsx:.6f} "
+            f"{sI} 0 0 0 0 0 0 0 {eX} {eY} 0 150e6\n")
+
+
+def test_point_restore_peak(tmp_path):
+    img = blank_image()
+    ra, dec = img.lm_to_radec(5 * CD, -3 * CD)
+    sky = write_sky(tmp_path, [lsm_line("P0", float(ra), float(dec), 2.5)])
+    srcs = skymodel.parse_sky_model(sky, RA0, DEC0, 150e6, format_3=True)
+    rst.restore_image(img, srcs, log=lambda *a: None)
+    x, y = img.lm_to_pixel(5 * CD, -3 * CD)
+    peak = img.data[int(round(float(y))), int(round(float(x)))]
+    np.testing.assert_allclose(peak, 2.5, rtol=0.02)
+
+
+def test_add_subtract_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    img = blank_image()
+    img.data = rng.normal(size=img.data.shape)
+    orig = img.data.copy()
+    ra, dec = img.lm_to_radec(0.0, 0.0)
+    sky = write_sky(tmp_path, [lsm_line("P0", float(ra), float(dec), 3.0)])
+    srcs = skymodel.parse_sky_model(sky, RA0, DEC0, 150e6, format_3=True)
+    rst.restore_image(img, srcs, mode="add", log=lambda *a: None)
+    assert np.abs(img.data - orig).max() > 1.0
+    rst.restore_image(img, srcs, mode="subtract", log=lambda *a: None)
+    np.testing.assert_allclose(img.data, orig, atol=1e-10)
+
+
+def test_gaussian_flux_conserved(tmp_path):
+    img = blank_image()
+    ra, dec = img.lm_to_radec(0.0, 0.0)
+    sky = write_sky(tmp_path, [lsm_line("GS0", float(ra), float(dec), 2.0,
+                                        eX=4 * CD, eY=2 * CD)])
+    srcs = skymodel.parse_sky_model(sky, RA0, DEC0, 150e6, format_3=True)
+    assert list(srcs.values())[0].stype == skymodel.STYPE_GAUSSIAN
+    rst.restore_image(img, srcs, log=lambda *a: None)
+    # total flux = sI * PSF pixel sum (same as a point source would give)
+    imgp = blank_image()
+    skyp = write_sky(tmp_path, [lsm_line("P0", float(ra), float(dec), 2.0)])
+    srcp = skymodel.parse_sky_model(skyp, RA0, DEC0, 150e6, format_3=True)
+    rst.restore_image(imgp, srcp, log=lambda *a: None)
+    np.testing.assert_allclose(img.data.sum(), imgp.data.sum(), rtol=0.02)
+    # extended: lower peak than the point source
+    assert img.data.max() < 0.9 * imgp.data.max()
+
+
+def test_cluster_gains_scalar_identity(tmp_path):
+    """J = g*I for every station -> apparent gain factor g^2."""
+    g = 1.3
+    M, N, K = 2, 5, 1
+    nchunk = np.ones(M, np.int32)
+    J = np.tile((g * np.eye(2, dtype=complex))[None, None, None],
+                (M, K, N, 1, 1))
+    solpath = str(tmp_path / "sols.txt")
+    with solio.SolutionWriter(solpath, 150e6, 4e6, 1.0, N, M, M) as w:
+        w.write_interval(J, nchunk)
+    cpath = tmp_path / "sky.cluster"
+    cpath.write_text("0 1 A\n1 1 B\n")
+    gains = rst.cluster_gains(solpath, str(cpath))
+    np.testing.assert_allclose(gains[0], g * g, rtol=1e-6)
+    np.testing.assert_allclose(gains[1], g * g, rtol=1e-6)
+
+
+def test_restore_cli(tmp_path):
+    img = blank_image()
+    fp = str(tmp_path / "im.fits")
+    fitsio.write_fits(fp, img)
+    ra, dec = img.lm_to_radec(2 * CD, 2 * CD)
+    sky = write_sky(tmp_path, [lsm_line("P0", float(ra), float(dec), 1.5)])
+    out = str(tmp_path / "out.fits")
+    rc = rst.main(["-f", fp, "-i", sky, "-O", out])
+    assert rc == 0
+    res = fitsio.read_fits(out)
+    np.testing.assert_allclose(res.data.max(), 1.5, rtol=0.03)
+
+
+def test_bbs_roundtrip(tmp_path):
+    """buildsky BBS output (-o 0) parses through restore's BBS reader."""
+    from sagecal_tpu.tools import buildsky as bs
+    src = bs.SkySource("P0C0", 1.21, 0.79, 0.0, 0.0, 2.0, sP=-0.6,
+                       f0=150e6)
+    p = str(tmp_path / "sky.bbs")
+    bs.write_lsm(p, [src], fmt=0)
+    parsed = rst.parse_bbs_sky(p)
+    assert "P0C0" in parsed
+    s = parsed["P0C0"]
+    np.testing.assert_allclose(s.sI, 2.0)
+    np.testing.assert_allclose(s.ra, 1.21, atol=1e-6)
+    np.testing.assert_allclose(s.dec, 0.79, atol=1e-6)
+    np.testing.assert_allclose(s.spec_idx, -0.6, atol=1e-4)
+
+
+def test_extended_edge_no_wraparound(tmp_path):
+    """A Gaussian near the left edge must not wrap flux onto the right
+    edge (linear, not circular, PSF convolution)."""
+    img = blank_image()
+    # left edge at x=0 -> l = +crpix*CD (cdelt1 negative)
+    l_edge, _ = img.pixel_to_lm(1, NPIX // 2)
+    ra, dec = img.lm_to_radec(float(l_edge), 0.0)
+    sky = write_sky(tmp_path, [lsm_line("GS0", float(ra), float(dec), 5.0,
+                                        eX=5 * CD, eY=5 * CD)])
+    srcs = skymodel.parse_sky_model(sky, RA0, DEC0, 150e6, format_3=True)
+    rst.restore_image(img, srcs, log=lambda *a: None)
+    assert img.data[:, :8].max() > 0.01      # flux present at left edge
+    assert np.abs(img.data[:, -8:]).max() < 1e-6 * img.data.max()
+
+
+def test_restore_bbs_refuses_empty(tmp_path):
+    """-o mismatch (unparseable sky) must NOT overwrite the image."""
+    img = blank_image()
+    img.data[:] = 7.0
+    fp = str(tmp_path / "im.fits")
+    fitsio.write_fits(fp, img)
+    bad = tmp_path / "bad.txt"
+    bad.write_text("not a sky model\n")
+    rc = rst.main(["-f", fp, "-i", str(bad)])
+    assert rc == 1
+    back = fitsio.read_fits(fp)
+    np.testing.assert_allclose(back.data, 7.0, atol=1e-4)

@@ -3,7 +3,7 @@
 Toward parity with reference ``src/MS/data.cpp`` semantics (loadData:522:
 TIME/ANT sort, autocorrelation drop, channel averaging, flag ratio),
 re-expressed over an abstract dataset. Per-channel flags and the
->=half-unflagged channel-averaging rule (data.cpp:594-610) belong to the
+more-than-half-unflagged channel-averaging rule (data.cpp:601) belong to the
 casacore MS backend and are not represented here yet — VisTile flags are
 per-row:
 
@@ -57,6 +57,7 @@ class VisTile:
     nbase: int               # baselines per timeslot
     tilesz: int              # timeslots in this tile
     time_mjd: np.ndarray | None = None   # [tilesz] time centroid (s, MJD)
+    cflags: np.ndarray | None = None     # [B, F] per-channel flags (u8)
 
     @property
     def nrows(self) -> int:
@@ -84,11 +85,33 @@ class VisTile:
         """Channel-average data -> [B, 2, 2]; flagged rows zeroed.
 
         Mirrors loadData's averaging into ``x`` while ``xo`` keeps channels
-        (data.cpp:594-610). Weighting is a plain mean over channels.
+        (data.cpp:594-610). Weighting is a plain mean over channels; with
+        per-channel flags use :meth:`pack` instead.
         """
         xa = self.x.mean(axis=1)
         xa[self.flags == 1] = 0.0
         return xa
+
+    def pack(self, uvmin_m: float = 0.0, uvmax_m: float = 1e30,
+             uvtaper_m: float = 0.0):
+        """Full loadData-semantics packing via the native kernel
+        (src/native/tile_pack.cc; data.cpp:552-664): per-channel-flag
+        averaging (strictly-more-than-half channels rule), uv-cut/partial
+        rows flag=2,
+        short-baseline taper, fratio. u/v are stored in seconds ->
+        meters via c. Returns (x8 [B, 8] f64, rowflags [B] u8, fratio);
+        rows already flagged in ``self.flags`` stay flagged.
+        """
+        from sagecal_tpu.io import native
+        cf = self.cflags
+        if cf is None:
+            cf = np.zeros((self.nrows, len(self.freqs)), np.uint8)
+        cf = cf | (self.flags == 1)[:, None]
+        x8, rowflags, fratio = native.pack_tile(
+            self.x, cf, self.u * C_M_S, self.v * C_M_S, self.nrows,
+            uvmin=uvmin_m, uvmax=uvmax_m, uvtaper_m=uvtaper_m,
+            freq0=self.freq0)
+        return x8, rowflags, fratio
 
 
 def row_tslot(nrows: int, nbase: int) -> np.ndarray:
@@ -153,6 +176,7 @@ def simulate_dataset(sky_arrays, n_stations: int, tilesz: int,
                      noise_sigma: float = 0.0, seed: int = 11,
                      extent_m: float = 3000.0,
                      flag_fraction: float = 0.0,
+                     chan_flag_fraction: float = 0.0,
                      chan_width: float | None = None,
                      beam=None, dobeam: int = 0,
                      start_mjd_s: float = 4.93e9) -> VisTile:
@@ -224,13 +248,17 @@ def simulate_dataset(sky_arrays, n_stations: int, tilesz: int,
     if flag_fraction > 0:
         nf = int(flag_fraction * len(flags))
         flags[rng.choice(len(flags), nf, replace=False)] = 1
+    cflags = None
+    if chan_flag_fraction > 0:
+        cflags = (rng.random((us.shape[0], len(freqs)))
+                  < chan_flag_fraction).astype(np.uint8)
 
     return VisTile(
         u=us, v=vs, w=ws, x=vis.astype(np.complex128), flags=flags,
         sta1=sta1, sta2=sta2, freqs=freqs, freq0=float(freqs.mean()),
         fdelta=fdelta_tot, tdelta=tdelta, dec0=dec0, ra0=ra0,
         n_stations=n_stations, nbase=nbase, tilesz=tilesz,
-        time_mjd=time_mjd)
+        time_mjd=time_mjd, cflags=cflags)
 
 
 # ---------------------------------------------------------------------------
@@ -297,10 +325,15 @@ class SimMS:
             fdelta=m["fdelta"], tdelta=m["tdelta"], dec0=m["dec0"],
             ra0=m["ra0"], n_stations=m["n_stations"], nbase=m["nbase"],
             tilesz=m["tilesz"],
-            time_mjd=z["time_mjd"] if "time_mjd" in z.files else None)
+            time_mjd=z["time_mjd"] if "time_mjd" in z.files else None,
+            cflags=z["cflags"] if "cflags" in z.files else None)
 
     def write_tile(self, i: int, tile: VisTile) -> None:
-        kw = {} if tile.time_mjd is None else {"time_mjd": tile.time_mjd}
+        kw = {}
+        if tile.time_mjd is not None:
+            kw["time_mjd"] = tile.time_mjd
+        if tile.cflags is not None:
+            kw["cflags"] = tile.cflags
         np.savez(os.path.join(self.path, f"tile{i:05d}.npz"),
                  u=tile.u, v=tile.v, w=tile.w, x=tile.x, flags=tile.flags,
                  sta1=tile.sta1, sta2=tile.sta2, **kw)
@@ -308,3 +341,55 @@ class SimMS:
     def tiles(self):
         for i in range(self.n_tiles):
             yield i, self.read_tile(i)
+
+    def tiles_prefetch(self, depth: int = 2):
+        """Tile iterator with background read-ahead: the host overlaps
+        disk I/O with the device solve of the previous tile (the
+        streaming analogue of the reference's synchronous per-tile MSIter
+        loop; SURVEY.md section 5 'host streaming')."""
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+        stop = object()
+        cancel = threading.Event()
+
+        def _put(item) -> bool:
+            while not cancel.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def reader():
+            try:
+                for i in range(self.n_tiles):
+                    if cancel.is_set():
+                        return
+                    if not _put((i, self.read_tile(i))):
+                        return
+            except Exception as e:          # surface in the consumer
+                _put((stop, e))
+                return
+            _put((stop, None))
+
+        th = threading.Thread(target=reader, daemon=True)
+        th.start()
+        try:
+            while True:
+                item = q.get()
+                if item[0] is stop:
+                    if item[1] is not None:
+                        raise item[1]
+                    break
+                yield item
+        finally:
+            cancel.set()
+            while not q.empty():            # unblock a full queue
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            th.join(timeout=5.0)

@@ -185,18 +185,26 @@ class FullBatchPipeline:
         res_prev = None
         first = True
         history = []
-        for ti, tile in ms.tiles():
+        for ti, tile in ms.tiles_prefetch():
             if max_tiles is not None and ti >= max_tiles:
                 break
             t0 = time.time()
             u = jnp.asarray(tile.u, self.rdt)
             v = jnp.asarray(tile.v, self.rdt)
             w = jnp.asarray(tile.w, self.rdt)
-            flags = rp.uvcut_flags(jnp.asarray(tile.flags, jnp.int32),
-                                   u, v, jnp.asarray(tile.freqs, self.rdt),
+            if tile.cflags is not None or cfg.uvtaper > 0:
+                # native loadData-semantics packing (per-channel flags,
+                # more-than-half-channels rule, taper; src/native/tile_pack.cc)
+                x8_np, rowflags, _fr = tile.pack(uvtaper_m=cfg.uvtaper)
+                base_flags = jnp.asarray(rowflags, jnp.int32)
+                x8 = jnp.asarray(x8_np, self.rdt)
+            else:
+                base_flags = jnp.asarray(tile.flags, jnp.int32)
+                x8 = jnp.asarray(utils.vis_to_x8(tile.averaged()),
+                                 self.rdt)
+            flags = rp.uvcut_flags(base_flags, u, v,
+                                   jnp.asarray(tile.freqs, self.rdt),
                                    cfg.uvmin, cfg.uvmax)
-            xa = tile.averaged()
-            x8 = jnp.asarray(utils.vis_to_x8(xa), self.rdt)
             if cfg.whiten:
                 # -W: uv-density whitening of the solve input only
                 # (fullbatch_mode.cpp applies whiten_data to the averaged x)

@@ -19,7 +19,9 @@ Conventions follow the reference exactly:
   ``bmaj = (arcsec/3600)/360*pi``; buildsky.c:272 ``fits_bmaj/360*pi``),
   and the component model is ``sI * exp(-(u^2+v^2))`` with u, v the
   pa-rotated offsets scaled by those half-widths (fitpixels.c:90-95);
-- AIC = 2*(3k) + 2*n*ln(SSE) (fitpixels.c:101-103 "AIC=2*k+N*ln(err)");
+- AIC = 2*(3k) + 2*n*ln(SSE) — matching the reference CODE
+  (fitpixels.c:103 ``2*3+npix*log(sumI)*2.0``; its comment says
+  "2*k+N*ln" but the implementation doubles the data term);
 - beam area in pixels = pi*bmaj*bmin/(|cdelt1*cdelt2|) (buildsky.c:288).
 """
 
@@ -452,6 +454,8 @@ def build_sky_multifreq(imgs: list, mask: np.ndarray, log=print, **kw):
         cdelt1=ref.cdelt1, cdelt2=ref.cdelt2, bmaj=ref.bmaj,
         bmin=ref.bmin, bpa=ref.bpa, freq=float(freqs.mean()))
     sources, sidelobes = build_sky_single(mean_img, mask, log=log, **kw)
+    if not sources:
+        return sources, sidelobes
     f0 = float(freqs.mean())
     bmaj, bmin = mean_img.bmaj / 2 or 0.001, mean_img.bmin / 2 or 0.001
     sb, cb = math.sin(mean_img.bpa), math.cos(mean_img.bpa)
@@ -538,7 +542,8 @@ def main(argv=None) -> int:
     def override_beam(img):
         if args.bmaj:
             img.bmaj = math.radians(args.bmaj / 3600.0)
-            img.bmin = math.radians(args.bmin / 3600.0)
+            # -a without -b: circular beam, not a zero/garbage minor axis
+            img.bmin = math.radians((args.bmin or args.bmaj) / 3600.0)
             img.bpa = math.radians(args.bpa)
         return img
 

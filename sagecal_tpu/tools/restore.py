@@ -148,9 +148,10 @@ def render_source(img: fitsio.FitsImage, s, bmaj, bmin, bpa, l, m):
         v = (-dl * cb - dm * sb) / bmin
         return s.sI * np.exp(-(u * u + v * v)), True
     # rotate into the source frame (position angle from sky model)
-    cxi, sxi = s.cxi, -s.sxi
-    xr = dl * cxi - dm * sxi
-    yr = dl * sxi + dm * cxi
+    # rotate into the source frame by its catalogued position angle eP
+    ce, se = math.cos(getattr(s, "eP", 0.0)), math.sin(getattr(s, "eP", 0.0))
+    xr = dl * ce + dm * se
+    yr = -dl * se + dm * ce
     # Extended profiles carry total flux sI, normalized by the ANALYTIC
     # profile integral (in pixels) so that partially-off-grid sources keep
     # only the flux that actually lands on the grid.
@@ -289,7 +290,7 @@ def main(argv=None) -> int:
     kw = {}
     if args.bmaj:
         kw = dict(bmaj=math.radians(args.bmaj / 3600.0),
-                  bmin=math.radians(args.bmin / 3600.0),
+                  bmin=math.radians((args.bmin or args.bmaj) / 3600.0),
                   bpa=math.radians(args.bpa))
     restore_image(img, sources, mode=mode, gains=gains,
                   source_cluster=source_cluster, **kw)

@@ -1,0 +1,1 @@
+"""Custom TPU kernels (Pallas) for the hot ops."""

@@ -85,6 +85,27 @@ class FullBatchPipeline:
         self.dobeam = int(cfg.beam_mode)
         self.beam_info = bm.resolve_beaminfo(self.dobeam, ms, meta, log=log)
         self._warned_no_times = False
+        # Pallas coherency kernel: point-only f32 models on a real TPU.
+        # The probe runs the PRODUCTION block configuration (same block_b
+        # and real source count) so VMEM/compile failures surface here,
+        # where we can fall back, not inside the jitted solve.
+        self.use_pallas = False
+        if (platform not in ("cpu",) and not self.dobeam
+                and self.rdt == jnp.float32):
+            from sagecal_tpu.ops import coh_pallas
+            if coh_pallas.supported(sky):
+                try:
+                    probe_b = min(1024, meta["tilesz"] * meta["nbase"])
+                    z = jnp.zeros(probe_b, jnp.float32)
+                    coh_pallas.coherencies(
+                        self.dsky, z, z, z,
+                        jnp.asarray([meta["freq0"]], jnp.float32),
+                        meta["fdelta"]).block_until_ready()
+                    self.use_pallas = True
+                    log("Pallas coherency kernel enabled")
+                except Exception as e:      # pragma: no cover - hw path
+                    log(f"Pallas kernel unavailable ({type(e).__name__}); "
+                        "using the XLA path")
         mode = effective_solver_mode(int(cfg.solver_mode), self.n)
         self.base_cfg = sage.SageConfig(
             max_emiter=cfg.max_em_iter, max_iter=cfg.max_iter,
@@ -117,7 +138,8 @@ class FullBatchPipeline:
             coh = rp.coherencies(self.dsky, u, v, w,
                                  jnp.asarray([freq0], x8.dtype),
                                  fdelta, beam=beam, dobeam=self.dobeam,
-                                 tslot=tslot, sta1=sta1, sta2=sta2)[:, :, 0]
+                                 tslot=tslot, sta1=sta1, sta2=sta2,
+                                 use_pallas=self.use_pallas)[:, :, 0]
             J0 = ne.jones_r2c(J0_r8)
             J, info = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask, J0,
                                    self.n, wt, config=scfg)

@@ -184,7 +184,8 @@ def coherencies(sky: SkyArrays, u, v, w, freqs, fdelta,
                 per_channel_flux: bool = False,
                 with_shapelets: bool | None = None,
                 beam=None, dobeam: int = 0,
-                tslot=None, sta1=None, sta2=None):
+                tslot=None, sta1=None, sta2=None,
+                use_pallas: bool = False):
     """All-cluster coherencies [M, B, F, 2, 2] (no Jones applied).
 
     Equivalent of precalculate_coherencies[_multifreq] (predict.c:653/:890);
@@ -197,6 +198,12 @@ def coherencies(sky: SkyArrays, u, v, w, freqs, fdelta,
     multifreq, matching predict.c:943).
     ``with_shapelets`` defaults to auto-detect (static) from the model.
     """
+    if use_pallas and not dobeam:
+        # point-source fused TPU kernel (caller guarantees the model is
+        # point-only via ops.coh_pallas.supported)
+        from sagecal_tpu.ops import coh_pallas
+        return coh_pallas.coherencies(sky, u, v, w, freqs, fdelta,
+                                      per_channel_flux=per_channel_flux)
     if with_shapelets is None:
         if isinstance(sky.sh_n0, jax.core.Tracer):
             # under jit we cannot inspect values; keep the general path

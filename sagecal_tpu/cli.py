@@ -54,6 +54,8 @@ def build_parser() -> argparse.ArgumentParser:
     a("-z", "--ignore-clusters", help="file of cluster ids to ignore")
     a("-k", "--correct-cluster", type=int, default=None,
       help="cluster id whose solutions correct the residual")
+    a("-J", "--phase-only", type=int, default=0,
+      help=">0: phase-only correction (joint-diagonalized phases)")
     a("-B", "--beam", type=int, default=0)
     a("-N", "--epochs", type=int, default=0,
       help=">0 enables stochastic (minibatch) calibration")
@@ -84,7 +86,8 @@ def config_from_args(args) -> RunConfig:
         per_channel_bfgs=bool(args.per_channel),
         simulation=SimulationMode(args.simulation),
         ignore_clusters_file=args.ignore_clusters,
-        correct_cluster=args.correct_cluster, beam_mode=BeamMode(args.beam),
+        correct_cluster=args.correct_cluster,
+        phase_only=bool(args.phase_only), beam_mode=BeamMode(args.beam),
         n_epochs=args.epochs, n_minibatches=args.minibatches,
         n_admm=args.admm, n_poly=args.npoly, poly_type=args.polytype,
         admm_rho=args.rho, rho_file=args.rho_file,

@@ -103,6 +103,7 @@ class RunConfig:
     simulation: SimulationMode = SimulationMode.OFF  # -a
     ignore_clusters_file: str | None = None          # -z
     correct_cluster: int | None = None               # -k : cluster id to correct residual by
+    phase_only: bool = False                         # -J : phase-only correction
 
     # --- beam
     beam_mode: BeamMode = BeamMode.NONE              # -B

@@ -79,6 +79,61 @@ def blocks_to_jones(X):
     return X.reshape(X.shape[:-2] + (n, 2, 2))
 
 
+def _givens_from_eigvec(Z):
+    """Unit eigenvector of the 3x3 rotation objective -> Givens (c, s)
+    (manifold_average.c:497-506, with the sign-flip branch)."""
+    pos = Z[0] >= 0.0
+    Zs = jnp.where(pos, Z, -Z)
+    c = jnp.sqrt(0.5 + 0.5 * Zs[0]).astype(jnp.result_type(Z, 1j))
+    s = 0.5 * (Zs[1] - 1j * Zs[2]) / c
+    return c, s
+
+
+def extract_phases(J, niter: int = 10):
+    """Phase-only diagonal Jones by joint diagonalization
+    (``extract_phases``, manifold_average.c:400): iteratively rotate all
+    stations' 2x2 blocks by a common Givens unitary (one sweep targets
+    element (1,2), the next (2,1)) chosen as the top eigenvector of the
+    accumulated 3x3 quadratic form; finally keep only unit-modulus
+    diagonal entries.
+
+    J: [N, 2, 2] complex -> [N, 2, 2] complex (diag(e^{i th0}, e^{i th1})).
+    """
+    cdt = J.dtype
+
+    def h_vec(Jc, flip: bool):
+        a00, a01 = Jc[:, 0, 0], Jc[:, 0, 1]
+        a10, a11 = Jc[:, 1, 0], Jc[:, 1, 1]
+        if not flip:
+            h = jnp.stack([a00 - a11, a01 + a10, 1j * (a10 - a01)], -1)
+        else:
+            h = jnp.stack([a11 - a00, a10 + a01, 1j * (a01 - a10)], -1)
+        return jnp.conj(h)                    # [N, 3]
+
+    def sweep(Jc, flip: bool):
+        h = h_vec(Jc, flip)
+        H = jnp.einsum("ni,nj->ij", h, jnp.conj(h)).real   # 3x3 symmetric
+        _, V = jnp.linalg.eigh(H)
+        c, s = _givens_from_eigvec(V[:, -1])
+        G = jnp.stack([jnp.stack([c, -s]),
+                       jnp.stack([jnp.conj(s), jnp.conj(c)])]).astype(cdt)
+        return jnp.einsum("nij,kj->nik", Jc, jnp.conj(G))  # J G^H
+
+    def body(_, Jc):
+        Jc = sweep(Jc, False)
+        Jc = sweep(Jc, True)
+        return Jc
+
+    Jr = jax.lax.fori_loop(0, niter, body, J)
+    d0 = Jr[:, 0, 0]
+    d1 = Jr[:, 1, 1]
+    d0 = d0 / jnp.maximum(jnp.abs(d0), 1e-30)
+    d1 = d1 / jnp.maximum(jnp.abs(d1), 1e-30)
+    zero = jnp.zeros_like(d0)
+    return jnp.stack([jnp.stack([d0, zero], -1),
+                      jnp.stack([zero, d1], -1)], -2)
+
+
 def manifold_average(J, niter: int = 3, ref_index: int = 0):
     """Frequency-average solutions up to unitary ambiguity.
 

@@ -118,6 +118,11 @@ class FullBatchPipeline:
         self._solve_first = self._build_solver(self.boost)
         self._solve_rest = self._build_solver(1)
         self._residual_fn = jax.jit(self._residuals)
+        self._chan_solver = None
+        self._chan_residual_fn = None
+        if cfg.per_channel_bfgs:
+            self._chan_solver = self._build_chan_solver()
+            self._chan_residual_fn = jax.jit(self._chan_residual)
 
     # NOTE on jit boundaries: complex arrays cannot cross host<->device on
     # the axon TPU runtime, so solvers take/return Jones as [.., N, 8]
@@ -157,24 +162,56 @@ class FullBatchPipeline:
         return bm.beam_to_device(self.beam_info, self.ms.meta["freq0"],
                                  self.rdt, time_jd=tile.time_jd)
 
-    def _residuals(self, J_r8, x_r, u, v, w, sta1, sta2, beam=None):
+    def _correct_idx(self):
+        """-k cluster id -> padded-array index (or None)."""
+        if self.cfg.correct_cluster is None:
+            return None
+        matches = np.where(self.sky.cluster_ids
+                           == self.cfg.correct_cluster)[0]
+        return int(matches[0]) if len(matches) else None
+
+    def _residuals(self, J_r8, x_r, u, v, w, sta1, sta2, beam=None,
+                   freqs=None):
+        """Residuals over ``freqs`` (default: all channels; a single
+        [1] freq gives the per-channel -b 1 path, fullbatch_mode.cpp:483)."""
         meta = self.ms.meta
-        freqs = jnp.asarray(meta["freqs"], self.rdt)
+        if freqs is None:
+            freqs = jnp.asarray(meta["freqs"], self.rdt)
         sub = jnp.asarray(self.sky.subtract_mask())
-        correct_idx = None
-        if self.cfg.correct_cluster is not None:
-            matches = np.where(self.sky.cluster_ids
-                               == self.cfg.correct_cluster)[0]
-            if len(matches):
-                correct_idx = int(matches[0])
-        J = ne.jones_r2c(J_r8)
-        x = utils.r2c(x_r)
         res = rr.calculate_residuals_multifreq(
-            self.dsky, J, x, u, v, w, freqs,
+            self.dsky, ne.jones_r2c(J_r8), utils.r2c(x_r), u, v, w, freqs,
             meta["fdelta"] / len(meta["freqs"]), sta1, sta2,
-            jnp.asarray(self.cidx), sub, correct_idx=correct_idx,
-            beam=beam, dobeam=self.dobeam, tslot=jnp.asarray(self.tslot))
+            jnp.asarray(self.cidx), sub, correct_idx=self._correct_idx(),
+            beam=beam, dobeam=self.dobeam, tslot=jnp.asarray(self.tslot),
+            phase_only=self.cfg.phase_only)
         return utils.c2r(res)
+
+    def _chan_residual(self, J_r8, x_r, u, v, w, sta1, sta2, freq, beam):
+        return self._residuals(J_r8, x_r, u, v, w, sta1, sta2, beam,
+                               freqs=freq[None])
+
+    def _build_chan_solver(self):
+        """Per-channel bandpass solve (-b 1, fullbatch_mode.cpp:442-488):
+        LBFGS-only joint fit at ONE channel, warm-started from the joint
+        solution; used per channel with its own residual."""
+        meta = self.ms.meta
+        fdelta_chan = meta["fdelta"] / len(meta["freqs"])
+        cidx = jnp.asarray(self.cidx)
+        cmask = jnp.asarray(self.cmask)
+        scfg = self.base_cfg._replace(max_lbfgs=self.cfg.max_lbfgs)
+
+        def solve(x8, u, v, w, sta1, sta2, wt, J0_r8, freq, beam):
+            coh = rp.coherencies(self.dsky, u, v, w, freq[None],
+                                 fdelta_chan, per_channel_flux=True,
+                                 beam=beam, dobeam=self.dobeam,
+                                 tslot=jnp.asarray(self.tslot),
+                                 sta1=sta1, sta2=sta2,
+                                 use_pallas=self.use_pallas)[:, :, 0]
+            J, info = sage.bfgsfit(x8, coh, sta1, sta2, cidx,
+                                   ne.jones_r2c(J0_r8), self.n, wt,
+                                   config=scfg, nu=self.cfg.robust_nulow)
+            return ne.jones_c2r(J), info["res_0"], info["res_1"]
+        return jax.jit(solve)
 
     def initial_jones(self) -> np.ndarray:
         M = self.sky.n_clusters
@@ -257,16 +294,58 @@ class FullBatchPipeline:
             else:
                 res_prev = res_1 if res_prev is None else min(res_prev, res_1)
 
-            if writer:
-                writer.write_interval(J, sky.nchunk)
+            if cfg.per_channel_bfgs:
+                # -b 1: per-channel LBFGS re-solve + per-channel residual
+                # (fullbatch_mode.cpp:442-488); the last channel's
+                # solutions become the carried/written solutions
+                xout = np.array(tile.x)
+                J0c_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
+                flags_np = np.asarray(flags)
+                for ci_ch, fch in enumerate(tile.freqs):
+                    xc = np.array(tile.x[:, ci_ch])
+                    # apply per-channel flags (same data the joint pack
+                    # path zeroes) + row flags
+                    bad = flags_np == 1
+                    if tile.cflags is not None:
+                        bad = bad | (tile.cflags[:, ci_ch] != 0)
+                    xc[bad] = 0.0
+                    x8c = jnp.asarray(utils.vis_to_x8(xc), self.rdt)
+                    if cfg.whiten:
+                        from sagecal_tpu.solvers import robust as rb
+                        x8c = rb.whiten_data(x8c, u, v, meta["freq0"])
+                    # channel-flagged rows carry zero weight in THIS
+                    # channel's solve (zeroed data must not pull the fit)
+                    wt_c = wt * jnp.asarray(~bad, self.rdt)[:, None]
+                    Jc_r8, _, _ = self._chan_solver(
+                        x8c, u, v, w, sta1, sta2, wt_c, J0c_r8,
+                        jnp.asarray(fch, self.rdt), tile_beam)
+                    if write_residuals:
+                        res_c = self._chan_residual_fn(
+                            Jc_r8,
+                            jnp.asarray(utils.c2r(xc[:, None]), self.rdt),
+                            u, v, w, sta1, sta2,
+                            jnp.asarray(fch, self.rdt), tile_beam)
+                        xout[:, ci_ch] = utils.r2c(
+                            np.asarray(res_c))[:, 0]
+                    J_last = Jc_r8
+                J = utils.jones_r2c_np(np.asarray(J_last))
+                if write_residuals:
+                    tile.x = xout.astype(np.complex128)
+                    ms.write_tile(ti, tile)
+                if writer:
+                    writer.write_interval(J, sky.nchunk)
+            else:
+                if writer:
+                    writer.write_interval(J, sky.nchunk)
 
-            if write_residuals:
-                res_r = self._residual_fn(
-                    jnp.asarray(utils.jones_c2r_np(J), self.rdt),
-                    jnp.asarray(utils.c2r(tile.x), self.rdt),
-                    u, v, w, sta1, sta2, tile_beam)
-                tile.x = utils.r2c(np.asarray(res_r)).astype(np.complex128)
-                ms.write_tile(ti, tile)
+                if write_residuals:
+                    res_r = self._residual_fn(
+                        jnp.asarray(utils.jones_c2r_np(J), self.rdt),
+                        jnp.asarray(utils.c2r(tile.x), self.rdt),
+                        u, v, w, sta1, sta2, tile_beam)
+                    tile.x = utils.r2c(np.asarray(res_r)).astype(
+                        np.complex128)
+                    ms.write_tile(ti, tile)
 
             dt = (time.time() - t0) / 60.0
             log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "

@@ -33,9 +33,16 @@ def mmse_inverse(J, rho):
     return inv / det[..., None, None]
 
 
-def correct_by_cluster(res, J_m, sta1, sta2, chunk_idx_m, rho):
+def correct_by_cluster(res, J_m, sta1, sta2, chunk_idx_m, rho,
+                       phase_only: bool = False):
     """Apply inv(J_p) res inv(J_q)^H using cluster ``m``'s solutions
-    (residual.c:945-1030 correction path). res: [B, F, 2, 2]."""
+    (residual.c:945-1030 correction path). With ``phase_only`` (-J flag)
+    each chunk's solutions are first reduced to unit-modulus diagonal
+    phases by joint diagonalization (residual.c:965-980 +
+    extract_phases). res: [B, F, 2, 2]."""
+    if phase_only:
+        from sagecal_tpu.consensus import manifold as mf
+        J_m = jax.vmap(mf.extract_phases)(J_m)        # per chunk [K,N,2,2]
     Jinv = mmse_inverse(J_m, jnp.asarray(rho, J_m.real.dtype))  # [K,N,2,2]
     Gp = Jinv[chunk_idx_m, sta1]
     Gq = Jinv[chunk_idx_m, sta2]
@@ -47,7 +54,8 @@ def calculate_residuals_multifreq(sky: rp.SkyArrays, J, x, u, v, w, freqs,
                                   fdelta_chan, sta1, sta2, chunk_idx,
                                   subtract_mask, correct_idx: int | None = None,
                                   rho: float = 1e-9,
-                                  beam=None, dobeam: int = 0, tslot=None):
+                                  beam=None, dobeam: int = 0, tslot=None,
+                                  phase_only: bool = False):
     """Residual x - sum_m J_p C_m(f) J_q^H over subtractable clusters.
 
     x: [B, F, 2, 2]; J: [M, Kmax, N, 2, 2]; chunk_idx: [M, B];
@@ -66,6 +74,29 @@ def calculate_residuals_multifreq(sky: rp.SkyArrays, J, x, u, v, w, freqs,
     res = x - model
     if correct_idx is not None:
         res = correct_by_cluster(res, J[correct_idx], sta1, sta2,
+                                 chunk_idx[correct_idx], rho,
+                                 phase_only=phase_only)
+    return res
+
+
+def calculate_residuals_interp(sky: rp.SkyArrays, J_old, J_new, x, u, v, w,
+                               freqs, fdelta_chan, sta1, sta2, chunk_idx,
+                               subtract_mask, correct_idx: int | None = None,
+                               rho: float = 1e-9):
+    """Residuals with OLD-solution correction (``calculate_residuals_interp``,
+    residual.c:201): subtract the model corrupted by the NEW solutions,
+    correct the residual with the inverse of the OLD solutions' cluster
+    ``correct_idx``. (The reference's time interpolation between the two
+    is disabled upstream — residual.c:288 'interpolation is disabled for
+    the moment' — so this matches its actual behavior.)
+    """
+    coh = rp.coherencies(sky, u, v, w, freqs, fdelta_chan,
+                         per_channel_flux=True)
+    model = rp.predict_model(coh, J_new, sta1, sta2, chunk_idx,
+                             cluster_mask=subtract_mask)
+    res = x - model
+    if correct_idx is not None:
+        res = correct_by_cluster(res, J_old[correct_idx], sta1, sta2,
                                  chunk_idx[correct_idx], rho)
     return res
 

@@ -228,3 +228,37 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
     res_1 = jnp.linalg.norm(xres_f * wt_base) / n
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
                "nerr": nerr}
+
+
+def bfgsfit(x8, coh, sta1, sta2, chunk_idx, J0, n_stations: int,
+            wt_base, config: SageConfig = SageConfig(), nu: float = 2.0):
+    """LBFGS-only joint solve over all clusters (``bfgsfit_visibilities``,
+    lmfit.c:1127) — the per-channel bandpass solver (-b 1,
+    fullbatch_mode.cpp:442-488). Warm-started from ``J0``; robust
+    Student's-t cost when the solver mode is robust. Residual figures
+    use the same B*8 normalization as :func:`sagefit`.
+    """
+    dtype = x8.dtype
+    M, kmax = J0.shape[0], J0.shape[1]
+    n = x8.shape[0] * 8
+    robust = _is_robust(config.solver_mode)
+    shape = (M * kmax, n_stations, 8)
+    p0 = ne.jones_c2r(J0.reshape(M * kmax, n_stations, 2, 2)) \
+        .reshape(-1).astype(dtype)
+
+    def cost_fn(p):
+        Jr = ne.jones_r2c(p.reshape(shape)).reshape(
+            M, kmax, n_stations, 2, 2)
+        r = (x8 - full_model8(Jr, coh, sta1, sta2, chunk_idx)) * wt_base
+        if robust:
+            return jnp.sum(jnp.log1p(r * r / nu))
+        return jnp.sum(r * r)
+
+    res_0 = jnp.linalg.norm(
+        (x8 - full_model8(J0, coh, sta1, sta2, chunk_idx)) * wt_base) / n
+    p1 = lbfgs_mod.lbfgs_fit(cost_fn, jax.grad(cost_fn), p0,
+                             itmax=config.max_lbfgs, M=config.lbfgs_m)
+    J = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations, 2, 2)
+    res_1 = jnp.linalg.norm(
+        (x8 - full_model8(J, coh, sta1, sta2, chunk_idx)) * wt_base) / n
+    return J, {"res_0": res_0, "res_1": res_1}

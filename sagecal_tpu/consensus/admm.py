@@ -203,8 +203,12 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
             return g.reshape(-1, M).T                # [M, F]
 
         alpha_vec = None
-        if spat is not None and cfg.federated_alpha > 0.0:
-            alpha_vec = jnp.full((M,), cfg.federated_alpha, x8F.dtype)
+        if spat is not None:
+            # per-cluster alpha scaled by initial rho, =alpha at max rho
+            # (sagecal_master.cpp:577-579; matters with a -G rho file)
+            alpha_vec = (cfg.federated_alpha * rho_m
+                         / jnp.maximum(jnp.max(rho_m), 1e-30)
+                         ).astype(x8F.dtype)
 
         def z_update(YF, rhoF, Zbar=None, Xd=None):
             """z = sum_f B_f Y_f where YF already holds Y + rho J as sent
@@ -214,7 +218,9 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
             zsum_local = jnp.einsum("fp,fmknr->mpknr", Brow, YF)
             zsum = jax.lax.psum(zsum_local, axis)
             if Zbar is not None:
-                zsum = zsum + cfg.federated_alpha * Zbar - Xd
+                # alphak[cm] Zbar - X (master :768-775)
+                zsum = zsum + alpha_vec[:, None, None, None, None] * Zbar \
+                    - Xd
             Bii = cpoly.find_prod_inverse(
                 Bfull, all_rho(rhoF).astype(x8F.dtype), alpha=alpha_vec)
             return cpoly.z_from_contributions(zsum, Bii)

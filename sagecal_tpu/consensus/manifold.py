@@ -115,8 +115,11 @@ def extract_phases(J, niter: int = 10):
         H = jnp.einsum("ni,nj->ij", h, jnp.conj(h)).real   # 3x3 symmetric
         _, V = jnp.linalg.eigh(H)
         c, s = _givens_from_eigvec(V[:, -1])
-        G = jnp.stack([jnp.stack([c, -s]),
-                       jnp.stack([jnp.conj(s), jnp.conj(c)])]).astype(cdt)
+        # row-major G = [[c, conj(s)], [-s, conj(c)]] — the reference
+        # stores the same matrix column-major (manifold_average.c:505-509:
+        # G[0]=c, G[1]=-s, G[2]=conj(s), G[3]=conj(c))
+        G = jnp.stack([jnp.stack([c, jnp.conj(s)]),
+                       jnp.stack([-s, jnp.conj(c)])]).astype(cdt)
         return jnp.einsum("nij,kj->nik", Jc, jnp.conj(G))  # J G^H
 
     def body(_, Jc):

@@ -174,7 +174,7 @@ def run_federated(cfg: RunConfig, paths: list, log=print):
             if cfg.use_global_solution:
                 for b in range(rn.nsolbw):
                     pfreq[b] = np.einsum("p,mpkns->mkns", Bs[s][b],
-                                         Zs[s]).astype(np.float32)
+                                         Zs[s]).astype(pfreq[b].dtype)
             rn.end_of_tile(tiles[s], ti, states[s], resband[s], res_0,
                            res_1, t0, writer if s == 0 else None,
                            history if s == 0 else [])

@@ -138,18 +138,29 @@ class FullBatchPipeline:
         cmask = jnp.asarray(self.cmask)
 
         tslot = jnp.asarray(self.tslot)
+        # ordered-subsets partition for solver modes 1/2/3 (P4,
+        # clmfit.c:1074); harmless to pass for other modes
+        os_info = lm_mod.os_subset_ids(meta["tilesz"], meta["nbase"])
 
-        def solve(x8, u, v, w, sta1, sta2, wt, J0_r8, beam):
-            coh = rp.coherencies(self.dsky, u, v, w,
-                                 jnp.asarray([freq0], x8.dtype),
-                                 fdelta, beam=beam, dobeam=self.dobeam,
-                                 tslot=tslot, sta1=sta1, sta2=sta2,
-                                 use_pallas=self.use_pallas)[:, :, 0]
-            J0 = ne.jones_r2c(J0_r8)
-            J, info = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask, J0,
-                                   self.n, wt, config=scfg)
+        coh_fn = jax.jit(lambda u, v, w, sta1, sta2, beam: (
+            rp.coherencies(self.dsky, u, v, w,
+                           jnp.asarray([freq0], self.rdt),
+                           fdelta, beam=beam, dobeam=self.dobeam,
+                           tslot=tslot, sta1=sta1, sta2=sta2,
+                           use_pallas=self.use_pallas)[:, :, 0]))
+
+        def solve(x8, u, v, w, sta1, sta2, wt, J0_r8, beam, tile_idx=0):
+            # host-driven EM: one bounded device execution per cluster
+            # solve (the tunneled chip kills single executions over ~60 s)
+            coh = coh_fn(u, v, w, sta1, sta2, beam)
+            J0 = ne.jones_r2c(jnp.asarray(J0_r8, self.rdt))
+            # fresh subset draws + cluster permutations per tile
+            key = jax.random.fold_in(jax.random.PRNGKey(199), tile_idx)
+            J, info = sage.sagefit_host(
+                jnp.asarray(x8, self.rdt), coh, sta1, sta2, cidx, cmask,
+                J0, self.n, wt, config=scfg, os_id=os_info, key=key)
             return ne.jones_c2r(J), info
-        return jax.jit(solve)
+        return solve
 
     def _tile_beam(self, tile):
         """Per-tile device beam tables (times change per tile)."""
@@ -277,7 +288,7 @@ class FullBatchPipeline:
             J_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
             tile_beam = self._tile_beam(tile)
             Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8,
-                                 tile_beam)
+                                 tile_beam, tile_idx=ti)
             first = False
             res_0 = float(info["res_0"])
             res_1 = float(info["res_1"])

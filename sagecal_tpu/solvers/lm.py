@@ -46,6 +46,33 @@ class LMState(NamedTuple):
     k: jax.Array        # iteration counter
 
 
+class OSConfig(NamedTuple):
+    """Ordered-subsets acceleration (clmfit.c:1074 oslevmar semantics):
+    each LM iteration builds the normal equations from ONE contiguous
+    time-tile subset; acceptance still tests the FULL-data cost
+    (clmfit.c:1404 computes pDp_eL2 over all N rows)."""
+
+    os_id: jax.Array       # [B] subset id per data row (os_subset_ids)
+    n_subsets: int         # static subset count (<= 10, reference default)
+    key: jax.Array         # PRNG key for subset randomization
+    randomize: bool = True  # False -> deterministic (k % n_subsets) rotation
+
+
+def os_subset_ids(tilesz: int, nbase: int, n_subsets: int = 10):
+    """[tilesz*nbase] contiguous-time subset ids + actual subset count.
+
+    Mirrors the reference partition (clmfit.c:1311-1358): Nsubsets =
+    min(10, tilesz) contiguous blocks of ceil(tilesz/Nsubsets) timeslots;
+    the tail block is short. Rows are ordered [tilesz, nbase].
+    """
+    import numpy as np
+    ns = min(n_subsets, tilesz)
+    ntper = -(-tilesz // ns)              # ceil
+    tslot = np.arange(tilesz * nbase) // nbase
+    os_id = (tslot // ntper).astype(np.int32)
+    return os_id, int(os_id.max()) + 1
+
+
 def _solve_damped(JTJ, JTe, mu, jitter):
     """Solve (JTJ + mu I) dp = JTe batched over chunks; returns dp, ok."""
     k8n = JTJ.shape[-1]
@@ -58,7 +85,7 @@ def _solve_damped(JTJ, JTe, mu, jitter):
 
 def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
              chunk_mask=None, config: LMConfig = LMConfig(),
-             itmax_dynamic=None, admm=None):
+             itmax_dynamic=None, admm=None, os: OSConfig | None = None):
     """Levenberg-Marquardt solve of all chunks of one cluster.
 
     Args:
@@ -76,6 +103,12 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         (the augmented Lagrangian of rtr_solve_robust_admm.c:199-215 /
         robust_batchmode_lbfgs.c Dirac.h:314-338, with the Gauss-Newton
         data term).
+      os: optional ordered-subsets acceleration (clmfit.c:1074): each
+        iteration's JTJ/JTe come from one random (or rotating) time-tile
+        subset while acceptance tests the full cost. One behavioral
+        difference vs the reference is documented on OSConfig: a rejected
+        step moves on to the next subset with increased damping instead
+        of retrying the same subset.
 
     Returns (J [K,N,2,2], info dict with init_cost/final_cost [K]).
     """
@@ -99,10 +132,12 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         return cost_data + 2.0 * jnp.sum(admm_y * d, axis=-1) \
             + admm_rho * jnp.sum(d * d, axis=-1)
 
-    def nrm_eq(p):
+    def nrm_eq(p, w=None):
         J = ne.jones_r2c(p.reshape(kmax, n_stations, 8))
         JTJ, JTe, cost = ne.normal_equations(x8, J, coh, sta1, sta2,
-                                             chunk_id, wt, n_stations, kmax)
+                                             chunk_id,
+                                             wt if w is None else w,
+                                             n_stations, kmax)
         if admm is not None:
             d = p - admm_bz
             JTe = JTe - admm_y - admm_rho * d
@@ -110,7 +145,27 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
             cost = aug_cost(p, cost)
         return JTJ, JTe, cost
 
-    JTJ0, JTe0, cost0 = nrm_eq(p0)
+    if os is not None:
+        n_sub = int(os.n_subsets)
+
+        def subset_for(k):
+            if os.randomize:
+                # fresh uniform subset per iteration: the first entry of
+                # the reference's per-iteration random permutation
+                # (clmfit.c:1378) is exactly a uniform draw
+                return jax.random.randint(jax.random.fold_in(os.key, k),
+                                          (), 0, n_sub)
+            return jnp.mod(k, n_sub)           # clmfit.c:1388 (k+ositer)%Ns
+
+        def os_wt(l):
+            return wt * (os.os_id == l).astype(wt.dtype)[:, None]
+
+        JTJ0, JTe0, _ = nrm_eq(p0, os_wt(subset_for(jnp.zeros((), jnp.int32))))
+        cost0 = aug_cost(p0, ne.weighted_cost(
+            x8, ne.jones_r2c(p0.reshape(kmax, n_stations, 8)),
+            coh, sta1, sta2, chunk_id, wt, kmax))
+    else:
+        JTJ0, JTe0, cost0 = nrm_eq(p0)
     diag_max = jnp.max(jnp.abs(jnp.diagonal(JTJ0, axis1=-2, axis2=-1)),
                        axis=-1)
     mu0 = config.tau * jnp.maximum(diag_max, 1e-30)
@@ -138,14 +193,25 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         nu = jnp.where(accept, 2.0, s.nu * 2.0)
         p = jnp.where(accept[:, None], pnew, s.p)
         cost = jnp.where(accept, cost_new, s.cost)
-        # rebuild the normal equations only when some chunk moved; on an
-        # all-reject iteration just re-damp (clmfit.c retry loop semantics)
-        JTJ, JTe = jax.lax.cond(
-            jnp.any(accept),
-            lambda: nrm_eq(p)[:2],
-            lambda: (s.JTJ, s.JTe))
+        if os is not None:
+            # OS: next iteration always sees a fresh subset's normal
+            # equations at the (possibly unchanged) parameters
+            wt_next = os_wt(subset_for(s.k + 1))
+            JTJ, JTe = nrm_eq(p, wt_next)[:2]
+            # an all-flagged subset has JTe == 0; that is not convergence
+            sub_live = jnp.any(wt_next > 0)
+        else:
+            # rebuild the normal equations only when some chunk moved; on an
+            # all-reject iteration just re-damp (clmfit.c retry loop
+            # semantics)
+            JTJ, JTe = jax.lax.cond(
+                jnp.any(accept),
+                lambda: nrm_eq(p)[:2],
+                lambda: (s.JTJ, s.JTe))
         # convergence tests (levmar-style)
         small_grad = jnp.max(jnp.abs(JTe), axis=-1) <= config.eps1
+        if os is not None:
+            small_grad = small_grad & sub_live
         small_dp = (jnp.linalg.norm(dp, axis=-1)
                     <= config.eps2 * (jnp.linalg.norm(s.p, axis=-1) + 1e-30))
         # eps3 applies to the (nonnegative) data cost only: the augmented-

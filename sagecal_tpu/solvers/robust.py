@@ -57,9 +57,13 @@ def update_nu_aecm(logsumw, nu_old, p: int = 8, nulow=2.0, nuhigh=30.0,
 def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
                     n_stations: int, nu0=2.0, nulow=2.0, nuhigh=30.0,
                     chunk_mask=None, config=lm_mod.LMConfig(),
-                    wt_rounds: int = 3, itmax_dynamic=None, admm=None):
+                    wt_rounds: int = 3, itmax_dynamic=None, admm=None,
+                    os=None):
     """Student's-t IRLS-LM: parity with rlevmar_der_single_nocuda
-    (robustlm.c:2008).
+    (robustlm.c:2008); with ``os`` set it is the ordered-subsets variant
+    osrlevmar_der_single_nocuda (robustlm.c:2607) — the weighted inner LM
+    sees random tile subsets while the E-step weight/nu updates stay
+    full-data.
 
     ``wt_base`` [B, 8]: 0/1 row mask weights. Robust sqrt(w) multiplies it.
     Returns (J, nu, info). nu is a scalar (all chunks share one nu, like the
@@ -68,15 +72,19 @@ def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
     kmax = J0.shape[0]
     mask = wt_base > 0
 
-    def round_body(carry, _):
+    def round_body(carry, rs):
         J, nu, first = carry
         e = ne.residual8(x8, J, coh, sta1, sta2, chunk_id)
         w = update_weights(e, nu)
         w = jnp.where(first, jnp.ones_like(w), w)
         wt = wt_base * jnp.sqrt(w)
+        # distinct subset draws per IRLS round
+        os_r = (os._replace(key=jax.random.fold_in(os.key, 7919 + rs))
+                if os is not None else None)
         Jn, info = lm_mod.lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J,
                                    n_stations, chunk_mask, config,
-                                   itmax_dynamic=itmax_dynamic, admm=admm)
+                                   itmax_dynamic=itmax_dynamic, admm=admm,
+                                   os=os_r)
         # ML nu update from post-solve residuals
         e2 = ne.residual8(x8, Jn, coh, sta1, sta2, chunk_id)
         w2 = update_weights(e2, nu)
@@ -86,7 +94,7 @@ def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
 
     (J, nu, _), costs = jax.lax.scan(
         round_body, (J0, jnp.asarray(nu0, x8.dtype), jnp.ones((), bool)),
-        None, length=wt_rounds)
+        jnp.arange(wt_rounds))
     info = {"init_cost": costs[0][0], "final_cost": costs[1][-1]}
     return J, nu, info
 

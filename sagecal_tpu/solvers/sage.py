@@ -8,6 +8,14 @@ cluster's cost reduction (lmfit.c:859-882: 80% evenly, 20% by share), robust
 nu is averaged over clusters (lmfit.c:1002-1017), and a final joint LBFGS
 refine polishes all 8*N*Mt parameters (lmfit.c:1019-1037).
 
+Solver-mode dispatch follows lmfit.c:906-962 exactly: modes 1/2/3 run
+ordered-subsets LM on every EM iteration except the last, which switches to
+plain LM / OS-robust-LM / robust-LM respectively; modes 4/5 run (robust)
+RTR throughout; mode 6 NSD. Cluster visiting order is randomly permuted per
+EM iteration under ``randomize`` (random_permutation, lmfit.c:1085 — used
+by the ADMM/CUDA drivers admm_solve.c:740, lmfit_cuda.c:734: random when
+unweighted, sorted by cost reduction when weighted).
+
 TPU re-architecture:
 - the cluster loop is a ``lax.fori_loop`` over the padded [M, ...] axis
   (sequencing is algorithmic — SAGE needs it, SURVEY.md P2);
@@ -17,14 +25,24 @@ TPU re-architecture:
   (or Gaussian) objective instead of hand-written kernels
   (robust_lbfgs.c:94-155).
 
+Two drivers share the same per-cluster update:
+- :func:`sagefit` — fully traced (one XLA program), used inside the mesh
+  consensus-ADMM program and anywhere the whole solve must stay jittable;
+- :func:`sagefit_host` — EM/cluster loops on the host, one bounded jit call
+  per cluster solve. The tunneled single-chip runtime enforces a wall-clock
+  limit (~60 s) per device execution, so long solves MUST be chunked; this
+  is also the natural streaming structure for very large M.
+
 The dual-GPU pipeline machinery of lmfit_cuda.c (P5) is intentionally
 absent: XLA's async dispatch over a sharded mesh replaces it.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import NamedTuple
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -46,6 +64,11 @@ class SageConfig(NamedTuple):
     nuhigh: float = 30.0
     randomize: bool = True
     linsolv: int = 1
+
+
+_OS_MODES = (int(SolverMode.OSLM_LBFGS),
+             int(SolverMode.OSLM_OSRLM_RLBFGS),
+             int(SolverMode.RLM_RLBFGS))
 
 
 def _is_robust(mode: int) -> bool:
@@ -74,10 +97,165 @@ def full_model8(J, coh, sta1, sta2, chunk_idx):
     return out
 
 
+def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
+                   wt_base, J_m, n_stations: int, nu_cj, config: SageConfig,
+                   itermax, itcap: int, admm_m, os_cfg, last):
+    """One cluster's per-chunk solve by solver mode (lmfit.c:906-962).
+
+    ``last`` (traced bool) is the is-last-EM-iteration switch; ``os_cfg``
+    is an lm.OSConfig or None (static). Returns
+    (Jn [K,N,2,2], nu_new scalar, init_cost [K], final_cost [K]).
+    """
+    lm_cfg = lm_mod.LMConfig(itmax=itcap)
+
+    def plain_lm(os=None):
+        Jn, info = lm_mod.lm_solve(
+            xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
+            chunk_mask=cmask_m, config=lm_cfg, itmax_dynamic=itermax,
+            admm=admm_m, os=os)
+        return Jn, nu_cj, info["init_cost"], info["final_cost"]
+
+    def robust_lm(os=None):
+        Jn, nu_new, info = rb.robust_lm_solve(
+            xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
+            nu0=nu_cj, nulow=config.nulow, nuhigh=config.nuhigh,
+            chunk_mask=cmask_m, config=lm_cfg, wt_rounds=3,  # wt_itmax=3,
+            itmax_dynamic=itermax, admm=admm_m, os=os)       # robustlm.c:103
+        return Jn, nu_new, info["init_cost"], info["final_cost"]
+
+    if mode == int(SolverMode.RTR_OSLM_LBFGS):
+        rtr_cfg = rtr_mod.RTRConfig(itmax=itcap)
+        Jn, info = rtr_mod.rtr_solve(
+            xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
+            chunk_mask=cmask_m, config=rtr_cfg, itmax_dynamic=itermax,
+            admm=admm_m)
+        return Jn, nu_cj, info["init_cost"], info["final_cost"]
+
+    if mode == int(SolverMode.RTR_OSRLM_RLBFGS):
+        rtr_cfg = rtr_mod.RTRConfig(itmax=itcap)
+        Jn, nu_new, info = rtr_mod.rtr_solve_robust(
+            xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
+            nu0=nu_cj, nulow=config.nulow, nuhigh=config.nuhigh,
+            # 2 rounds/call: the reference robust RTR updates weights once
+            # before and once after the TR loop (rtr_solve_robust.c:1625,
+            # :1842), not the LM path's wt_itmax=3
+            chunk_mask=cmask_m, config=rtr_cfg, wt_rounds=2,
+            itmax_dynamic=itermax, admm=admm_m)
+        return Jn, nu_new, info["init_cost"], info["final_cost"]
+
+    if mode == int(SolverMode.NSD_RLBFGS):
+        nsd_cfg = rtr_mod.NSDConfig(itmax=2 * itcap)
+        Jn, nu_new, info = rtr_mod.nsd_solve_robust(
+            xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
+            nu0=nu_cj, nulow=config.nulow, nuhigh=config.nuhigh,
+            chunk_mask=cmask_m, config=nsd_cfg, itmax_dynamic=2 * itermax,
+            admm=admm_m)
+        return Jn, nu_new, info["init_cost"], info["final_cost"]
+
+    if mode == int(SolverMode.LM_LBFGS) or os_cfg is None:
+        # without OS machinery, modes 1/3 degrade to plain/robust LM and
+        # mode 2 to robust LM (the pre-OS behavior)
+        if _is_robust(mode):
+            return robust_lm()
+        return plain_lm()
+
+    # OS modes (lmfit.c:907-933): OS-LM on every EM iteration but the
+    # last, which switches per mode
+    if mode == int(SolverMode.OSLM_LBFGS):
+        return jax.lax.cond(last, lambda: plain_lm(),
+                            lambda: plain_lm(os_cfg))
+    if mode == int(SolverMode.RLM_RLBFGS):
+        return jax.lax.cond(last, lambda: robust_lm(),
+                            lambda: plain_lm(os_cfg))
+    # SM_OSLM_OSRLM_RLBFGS
+    return jax.lax.cond(last, lambda: robust_lm(os_cfg),
+                        lambda: plain_lm(os_cfg))
+
+
+def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
+                    wt_base, n_stations: int, config: SageConfig,
+                    nerr_prev, weighted, last, key, admm, os_id,
+                    total_iter: int, iter_bar: int):
+    """Visit one cluster: add model back to residual, solve, re-subtract
+    (lmfit.c:890-981). ``state`` = (J, xres, nerr_acc, nuM)."""
+    J, xres, nerr_acc, nuM = state
+    mode = int(config.solver_mode)
+    coh_m = jnp.take(coh, cj, axis=0)
+    cidx_m = jnp.take(chunk_idx, cj, axis=0)
+    cmask_m = jnp.take(chunk_mask, cj, axis=0)
+    J_m = jnp.take(J, cj, axis=0)
+    itermax = jnp.where(
+        weighted,
+        (0.2 * jnp.take(nerr_prev, cj) * total_iter).astype(jnp.int32)
+        + iter_bar,
+        config.max_iter)
+    admm_m = None
+    if admm is not None:
+        Y_all, BZ_all, rho_all = admm
+        admm_m = (jnp.take(Y_all, cj, axis=0),
+                  jnp.take(BZ_all, cj, axis=0),
+                  jnp.take(rho_all, cj))
+    os_cfg = None
+    if os_id is not None and mode in _OS_MODES:
+        ids, n_sub = os_id              # the (ids, count) pair from
+        os_cfg = lm_mod.OSConfig(       # lm.os_subset_ids — count stays
+            os_id=ids, n_subsets=int(n_sub),   # bound to the partition
+            key=jax.random.fold_in(key, cj), randomize=config.randomize)
+
+    xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
+
+    itcap = int(config.max_iter) + iter_bar  # static while-loop cap
+    Jn, nu_new, init_cost, final_cost = _cluster_solve(
+        mode, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m, wt_base, J_m,
+        n_stations, jnp.take(nuM, cj), config, itermax, itcap, admm_m,
+        os_cfg, last)
+    nuM = nuM.at[cj].set(nu_new)
+
+    init_res = jnp.sum(init_cost)
+    final_res = jnp.sum(final_cost)
+    dcost = jnp.where(init_res > 0,
+                      jnp.maximum((init_res - final_res) / init_res, 0.0),
+                      0.0)
+    nerr_acc = nerr_acc.at[cj].set(dcost)
+    xres = xdummy - _model8(Jn, coh_m, sta1, sta2, cidx_m)
+    J = J.at[cj].set(Jn)
+    return J, xres, nerr_acc, nuM
+
+
+def _cluster_perm(ci, nerr_prev, weighted, key, M: int,
+                  config: SageConfig):
+    """Cluster visiting order for EM iteration ``ci`` (random_permutation,
+    lmfit.c:1085 via admm_solve.c:740): random when unweighted, sorted by
+    descending cost reduction when weighted."""
+    if not config.randomize or M <= 1 or key is None:
+        return None
+    perm_rand = jax.random.permutation(jax.random.fold_in(key, 104729 + ci),
+                                       M)
+    perm_sort = jnp.argsort(-nerr_prev)
+    return jnp.where(weighted, perm_sort, perm_rand).astype(jnp.int32)
+
+
+def _refine_cost_fn(x8, coh, sta1, sta2, chunk_idx, wt_base, shape, M, kmax,
+                    n_stations, robust: bool, mean_nu):
+    if robust:
+        def cost_fn(p):
+            Jr = ne.jones_r2c(p.reshape(shape)).reshape(
+                M, kmax, n_stations, 2, 2)
+            r = (x8 - full_model8(Jr, coh, sta1, sta2, chunk_idx)) * wt_base
+            return jnp.sum(jnp.log1p(r * r / mean_nu))
+    else:
+        def cost_fn(p):
+            Jr = ne.jones_r2c(p.reshape(shape)).reshape(
+                M, kmax, n_stations, 2, 2)
+            r = (x8 - full_model8(Jr, coh, sta1, sta2, chunk_idx)) * wt_base
+            return jnp.sum(r * r)
+    return cost_fn
+
+
 def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
             wt_base, nu0=None, config: SageConfig = SageConfig(),
-            admm=None):
-    """One solve interval of SAGE-EM calibration.
+            admm=None, os_id=None, key=None):
+    """One solve interval of SAGE-EM calibration (fully traced).
 
     Args:
       x8: [B, 8] channel-averaged data (flagged rows zeroed).
@@ -94,6 +272,11 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
         ADMM-regularized per-cluster solves; the joint LBFGS refine is
         disabled in this mode, matching the reference's max_lbfgs=0 call
         sites sagecal_slave.cpp:644-667).
+      os_id: optional (ids [B], n_subsets) pair as returned by
+        lm.os_subset_ids — enables the ordered-subsets path for solver
+        modes 1/2/3 (P4 acceleration).
+      key: PRNG key for OS subset draws + cluster-order permutation;
+        a fixed default keeps runs reproducible.
 
     Returns (J, info) with res_0/res_1 = ||residual||_2 / n (lmfit.c:869,
     1043) and mean_nu.
@@ -105,6 +288,8 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
     robust = _is_robust(config.solver_mode)
     if nu0 is None:
         nu0 = config.nulow
+    if key is None:
+        key = jax.random.PRNGKey(42)
 
     xres0 = x8 - full_model8(J0, coh, sta1, sta2, chunk_idx)
     res_0 = jnp.linalg.norm(xres0 * wt_base) / n
@@ -114,78 +299,18 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
 
     def em_iter(ci, carry):
         J, xres, nerr, nuM = carry
-        weighted = (ci % 2 == 1) if config.randomize else False
+        weighted = (ci % 2 == 1) if config.randomize else jnp.asarray(False)
+        last = ci == config.max_emiter - 1
+        perm = _cluster_perm(ci, nerr, weighted, key, M, config)
+        kci = jax.random.fold_in(key, ci)
 
         def cluster_step(cj, inner):
-            J, xres, nerr_new, nuM = inner
-            coh_m = jnp.take(coh, cj, axis=0)
-            cidx_m = jnp.take(chunk_idx, cj, axis=0)
-            cmask_m = jnp.take(chunk_mask, cj, axis=0)
-            J_m = jnp.take(J, cj, axis=0)
-            itermax = jnp.where(
-                weighted,
-                (0.2 * jnp.take(nerr, cj) * total_iter).astype(jnp.int32)
-                + iter_bar,
-                config.max_iter)
-            admm_m = None
-            if admm is not None:
-                Y_all, BZ_all, rho_all = admm
-                admm_m = (jnp.take(Y_all, cj, axis=0),
-                          jnp.take(BZ_all, cj, axis=0),
-                          jnp.take(rho_all, cj))
-
-            xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
-
-            # static cap for the while loop; dynamic weighted budget inside
-            itcap = int(config.max_iter) + iter_bar
-            mode = int(config.solver_mode)
-            if mode == int(SolverMode.RTR_OSLM_LBFGS):
-                rtr_cfg = rtr_mod.RTRConfig(itmax=itcap)
-                Jn, info = rtr_mod.rtr_solve(
-                    xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
-                    n_stations, chunk_mask=cmask_m, config=rtr_cfg,
-                    itmax_dynamic=itermax, admm=admm_m)
-            elif mode == int(SolverMode.RTR_OSRLM_RLBFGS):
-                rtr_cfg = rtr_mod.RTRConfig(itmax=itcap)
-                Jn, nu_new, info = rtr_mod.rtr_solve_robust(
-                    xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
-                    n_stations, nu0=jnp.take(nuM, cj), nulow=config.nulow,
-                    nuhigh=config.nuhigh, chunk_mask=cmask_m,
-                    config=rtr_cfg, wt_rounds=2, itmax_dynamic=itermax,
-                    admm=admm_m)
-                nuM = nuM.at[cj].set(nu_new)
-            elif mode == int(SolverMode.NSD_RLBFGS):
-                nsd_cfg = rtr_mod.NSDConfig(itmax=2 * itcap)
-                Jn, nu_new, info = rtr_mod.nsd_solve_robust(
-                    xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
-                    n_stations, nu0=jnp.take(nuM, cj), nulow=config.nulow,
-                    nuhigh=config.nuhigh, chunk_mask=cmask_m,
-                    config=nsd_cfg, itmax_dynamic=2 * itermax, admm=admm_m)
-                nuM = nuM.at[cj].set(nu_new)
-            elif robust:
-                lm_cfg = lm_mod.LMConfig(itmax=itcap)
-                Jn, nu_new, info = rb.robust_lm_solve(
-                    xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
-                    n_stations, nu0=jnp.take(nuM, cj), nulow=config.nulow,
-                    nuhigh=config.nuhigh, chunk_mask=cmask_m, config=lm_cfg,
-                    wt_rounds=2, itmax_dynamic=itermax, admm=admm_m)
-                nuM = nuM.at[cj].set(nu_new)
-            else:
-                lm_cfg = lm_mod.LMConfig(itmax=itcap)
-                Jn, info = lm_mod.lm_solve(
-                    xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m,
-                    n_stations, chunk_mask=cmask_m, config=lm_cfg,
-                    itmax_dynamic=itermax, admm=admm_m)
-
-            init_res = jnp.sum(info["init_cost"])
-            final_res = jnp.sum(info["final_cost"])
-            dcost = jnp.where(init_res > 0,
-                              jnp.maximum((init_res - final_res) / init_res,
-                                          0.0), 0.0)
-            nerr_new = nerr_new.at[cj].set(dcost)
-            xres = xdummy - _model8(Jn, coh_m, sta1, sta2, cidx_m)
-            J = J.at[cj].set(Jn)
-            return J, xres, nerr_new, nuM
+            cj_eff = cj if perm is None else jnp.take(perm, cj)
+            J, xres, nerr_acc, nuM = _cluster_update(
+                cj_eff, inner, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
+                wt_base, n_stations, config, nerr, weighted, last, kci,
+                admm, os_id, total_iter, iter_bar)
+            return J, xres, nerr_acc, nuM
 
         J, xres, nerr_new, nuM = jax.lax.fori_loop(
             0, M, cluster_step, (J, xres, jnp.zeros((M,), dtype), nuM))
@@ -206,19 +331,9 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
         shape = (M * kmax, n_stations, 8)
         Jflat = J.reshape(M * kmax, n_stations, 2, 2)
         p0 = ne.jones_c2r(Jflat).reshape(-1).astype(dtype)
-
-        if robust:
-            def cost_fn(p):
-                Jr = ne.jones_r2c(p.reshape(shape)).reshape(
-                    M, kmax, n_stations, 2, 2)
-                r = (x8 - full_model8(Jr, coh, sta1, sta2, chunk_idx)) * wt_base
-                return jnp.sum(jnp.log1p(r * r / mean_nu))
-        else:
-            def cost_fn(p):
-                Jr = ne.jones_r2c(p.reshape(shape)).reshape(
-                    M, kmax, n_stations, 2, 2)
-                r = (x8 - full_model8(Jr, coh, sta1, sta2, chunk_idx)) * wt_base
-                return jnp.sum(r * r)
+        cost_fn = _refine_cost_fn(x8, coh, sta1, sta2, chunk_idx, wt_base,
+                                  shape, M, kmax, n_stations, robust,
+                                  mean_nu)
         grad_fn = jax.grad(cost_fn)
         p1 = lbfgs_mod.lbfgs_fit(cost_fn, grad_fn, p0,
                                  itmax=config.max_lbfgs, M=config.lbfgs_m)
@@ -226,6 +341,121 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
 
     xres_f = x8 - full_model8(J, coh, sta1, sta2, chunk_idx)
     res_1 = jnp.linalg.norm(xres_f * wt_base) / n
+    return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
+               "nerr": nerr}
+
+
+# ---------------------------------------------------------------------------
+# host-driven variant: bounded per-cluster device executions
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_stations", "config", "total_iter",
+                                    "iter_bar", "os_nsub"))
+def _jit_cluster_update(cj, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
+                        chunk_idx, chunk_mask, wt_base, nerr_prev, weighted,
+                        last, key, admm, os_ids, n_stations, config,
+                        total_iter, iter_bar, os_nsub):
+    os_id = None if os_ids is None else (os_ids, os_nsub)
+    return _cluster_update(cj, (J, xres, nerr_acc, nuM), x8, coh, sta1,
+                           sta2, chunk_idx, chunk_mask, wt_base, n_stations,
+                           config, nerr_prev, weighted, last, key, admm,
+                           os_id, total_iter, iter_bar)
+
+
+@jax.jit
+def _jit_prelude(x8, coh, sta1, sta2, chunk_idx, J0, wt_base):
+    xres0 = x8 - full_model8(J0, coh, sta1, sta2, chunk_idx)
+    return xres0, jnp.linalg.norm(xres0 * wt_base) / (x8.shape[0] * 8)
+
+
+@functools.partial(jax.jit, static_argnames=("n_stations", "config",
+                                             "robust"))
+def _jit_refine(x8, coh, sta1, sta2, chunk_idx, J, wt_base, mean_nu,
+                n_stations, config, robust):
+    M, kmax = J.shape[0], J.shape[1]
+    dtype = x8.dtype
+    shape = (M * kmax, n_stations, 8)
+    p0 = ne.jones_c2r(J.reshape(M * kmax, n_stations, 2, 2)) \
+        .reshape(-1).astype(dtype)
+    cost_fn = _refine_cost_fn(x8, coh, sta1, sta2, chunk_idx, wt_base,
+                              shape, M, kmax, n_stations, robust, mean_nu)
+    p1 = lbfgs_mod.lbfgs_fit(cost_fn, jax.grad(cost_fn), p0,
+                             itmax=config.max_lbfgs, M=config.lbfgs_m)
+    Jn = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations, 2, 2)
+    res = jnp.linalg.norm(
+        (x8 - full_model8(Jn, coh, sta1, sta2, chunk_idx)) * wt_base) \
+        / (x8.shape[0] * 8)
+    return Jn, res
+
+
+@jax.jit
+def _jit_res(x8, coh, sta1, sta2, chunk_idx, J, wt_base):
+    return jnp.linalg.norm(
+        (x8 - full_model8(J, coh, sta1, sta2, chunk_idx)) * wt_base) \
+        / (x8.shape[0] * 8)
+
+
+def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
+                 n_stations: int, wt_base, nu0=None,
+                 config: SageConfig = SageConfig(), os_id=None, key=None):
+    """:func:`sagefit` with the EM/cluster loops on the host.
+
+    Identical math; each device execution is one cluster solve (or the
+    joint refine), which keeps every XLA program under the tunneled
+    runtime's per-execution wall-clock limit and scales to large cluster
+    counts without giant compilations. ADMM mode is not offered here — the
+    mesh ADMM program must stay fully traced (use :func:`sagefit`).
+    """
+    M = coh.shape[0]
+    dtype = x8.dtype
+    robust = _is_robust(config.solver_mode)
+    if nu0 is None:
+        nu0 = config.nulow
+    if key is None:
+        key = jax.random.PRNGKey(42)
+
+    total_iter = M * config.max_iter
+    iter_bar = int(-(-0.8 * total_iter // M))
+
+    os_ids, os_nsub = (None, 0) if os_id is None else \
+        (jnp.asarray(os_id[0]), int(os_id[1]))
+    xres, res_0 = _jit_prelude(x8, coh, sta1, sta2, jnp.asarray(chunk_idx),
+                               J0, wt_base)
+    J = J0
+    nerr = jnp.zeros((M,), dtype)
+    nuM = jnp.full((M,), jnp.asarray(nu0, dtype))
+    chunk_idx = jnp.asarray(chunk_idx)
+    chunk_mask = jnp.asarray(chunk_mask)
+
+    for ci in range(config.max_emiter):
+        weighted = config.randomize and (ci % 2 == 1)
+        last = ci == config.max_emiter - 1
+        kci = jax.random.fold_in(key, ci)
+        if config.randomize and M > 1:
+            if weighted:
+                order = np.argsort(-np.asarray(nerr))
+            else:
+                order = np.asarray(jax.random.permutation(
+                    jax.random.fold_in(key, 104729 + ci), M))
+        else:
+            order = np.arange(M)
+        nerr_acc = jnp.zeros((M,), dtype)
+        for cj in order:
+            J, xres, nerr_acc, nuM = _jit_cluster_update(
+                jnp.asarray(int(cj), jnp.int32), J, xres, nerr_acc, nuM,
+                x8, coh, sta1, sta2, chunk_idx, chunk_mask, wt_base, nerr,
+                jnp.asarray(weighted), jnp.asarray(last), kci, None, os_ids,
+                n_stations, config, total_iter, iter_bar, os_nsub)
+        total = float(jnp.sum(nerr_acc))
+        nerr = nerr_acc / total if total > 0 else nerr_acc
+
+    mean_nu = jnp.clip(jnp.mean(nuM), config.nulow, config.nuhigh)
+    if config.max_lbfgs > 0:
+        J, res_1 = _jit_refine(x8, coh, sta1, sta2, chunk_idx, J, wt_base,
+                               mean_nu, n_stations, config, robust)
+    else:
+        res_1 = _jit_res(x8, coh, sta1, sta2, chunk_idx, J, wt_base)
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
                "nerr": nerr}
 

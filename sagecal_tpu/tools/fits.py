@@ -77,9 +77,23 @@ def _parse_card(card: bytes):
     rest = card[8:].decode("ascii", "replace")
     if not rest.startswith("="):
         return key, None
-    val = rest[1:].split("/")[0].strip()
-    if val.startswith("'"):
-        return key, val.strip("'").strip()
+    body = rest[1:]
+    s = body.lstrip()
+    if s.startswith("'"):
+        # quoted string: '' escapes a quote; '/' inside quotes is literal
+        # (e.g. BUNIT 'JY/BEAM'), so find the true closing quote first
+        i, out = 1, []
+        while i < len(s):
+            if s[i] == "'":
+                if i + 1 < len(s) and s[i + 1] == "'":
+                    out.append("'")
+                    i += 2
+                    continue
+                break
+            out.append(s[i])
+            i += 1
+        return key, "".join(out).strip()
+    val = body.split("/")[0].strip()
     if val in ("T", "F"):
         return key, val == "T"
     try:

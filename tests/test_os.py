@@ -1,0 +1,180 @@
+"""Ordered-subsets solvers (P4) + host-driven SAGE driver.
+
+Parity targets: oslevmar_der_single_nocuda (clmfit.c:1074),
+osrlevmar_der_single_nocuda (robustlm.c:2607), solver-mode dispatch
+lmfit.c:906-962 (modes 1/2/3 run OS-LM on non-final EM iterations).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sagecal_tpu import skymodel
+from sagecal_tpu.config import SolverMode
+from sagecal_tpu.io import dataset as ds
+from sagecal_tpu.rime import predict as rp
+from sagecal_tpu.solvers import lm as lm_mod
+from sagecal_tpu.solvers import normal_eq as ne
+from sagecal_tpu.solvers import sage
+
+
+def test_os_subset_ids_partition():
+    # tilesz=10 -> 10 subsets of 1 timeslot (clmfit.c: Nsubsets=min(10,T))
+    ids, ns = lm_mod.os_subset_ids(10, 3)
+    assert ns == 10
+    assert ids.shape == (30,)
+    # rows of timeslot t belong to subset t (contiguous blocks)
+    assert list(ids[:6]) == [0, 0, 0, 1, 1, 1]
+    # tilesz=25 -> ceil(25/10)=3 slots per subset -> 9 subsets
+    ids, ns = lm_mod.os_subset_ids(25, 2)
+    assert ns == 9
+    assert ids.max() == 8
+    # short tiles cap the subset count
+    ids, ns = lm_mod.os_subset_ids(4, 5)
+    assert ns == 4
+
+
+def _problem(n_stations=12, n_clusters=3, tilesz=10, seed=5):
+    rng = np.random.default_rng(seed)
+    srcs, clusters = {}, []
+    for m in range(n_clusters):
+        names = []
+        for s in range(2):
+            nm = f"P{m}_{s}"
+            ll, mm = rng.normal(0, 0.02, 2)
+            nn = np.sqrt(1 - ll * ll - mm * mm)
+            srcs[nm] = skymodel.Source(
+                name=nm, ra=0, dec=0, ll=ll, mm=mm, nn=nn - 1, sI=2.0,
+                sQ=0.0, sU=0.0, sV=0.0, sI0=2.0, sQ0=0, sU0=0, sV0=0,
+                spec_idx=0, spec_idx1=0, spec_idx2=0, f0=150e6)
+            names.append(nm)
+        clusters.append((m, 1, names))
+    sky = skymodel.build_cluster_sky(srcs, clusters)
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jtrue = ds.random_jones(n_clusters, sky.nchunk, n_stations,
+                            seed=seed + 1, scale=0.2)
+    tile = ds.simulate_dataset(dsky, n_stations=n_stations, tilesz=tilesz,
+                               freqs=[150e6], ra0=0.1, dec0=0.9,
+                               jones=Jtrue, nchunk=sky.nchunk,
+                               noise_sigma=0.005, seed=seed + 2)
+    kmax = int(sky.nchunk.max())
+    cidx = jnp.asarray(rp.chunk_indices(tilesz, tile.nbase, sky.nchunk))
+    cmask = jnp.asarray(np.arange(kmax)[None, :] < sky.nchunk[:, None])
+    xa = tile.averaged()
+    x8 = jnp.asarray(np.stack([xa.reshape(-1, 4).real,
+                               xa.reshape(-1, 4).imag], -1).reshape(-1, 8))
+    coh = rp.coherencies(dsky, jnp.asarray(tile.u), jnp.asarray(tile.v),
+                         jnp.asarray(tile.w), jnp.asarray([tile.freq0]),
+                         tile.fdelta)[:, :, 0]
+    wt = lm_mod.make_weights(jnp.asarray(tile.flags, jnp.int32), x8.dtype)
+    J0 = jnp.asarray(np.tile(np.eye(2, dtype=complex),
+                             (n_clusters, kmax, n_stations, 1, 1)))
+    sta1 = jnp.asarray(tile.sta1)
+    sta2 = jnp.asarray(tile.sta2)
+    return sky, tile, x8, coh, sta1, sta2, cidx, cmask, wt, J0
+
+
+def _run(mode, x8, coh, sta1, sta2, cidx, cmask, wt, J0, n, tile,
+         os_on=True, **kw):
+    os_info = lm_mod.os_subset_ids(tile.tilesz, tile.nbase)
+    cfg = sage.SageConfig(max_emiter=2, max_iter=6, max_lbfgs=0,
+                          solver_mode=int(mode), **kw)
+    J, info = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask, J0, n, wt,
+                           config=cfg, os_id=os_info if os_on else None,
+                           key=jax.random.PRNGKey(3))
+    return J, info
+
+
+def test_oslm_no_longer_aliases_plain_lm():
+    """Mode 1 (OSLM) must differ from mode 0 (LM) when OS ids are given,
+    and both must converge."""
+    sky, tile, *arrs = _problem()
+    x8, coh, sta1, sta2, cidx, cmask, wt, J0 = arrs
+    n = tile.n_stations
+    J_os, info_os = _run(SolverMode.OSLM_LBFGS, x8, coh, sta1, sta2, cidx,
+                         cmask, wt, J0, n, tile)
+    J_lm, info_lm = _run(SolverMode.LM_LBFGS, x8, coh, sta1, sta2, cidx,
+                         cmask, wt, J0, n, tile)
+    assert float(info_os["res_1"]) < 0.5 * float(info_os["res_0"])
+    assert float(info_lm["res_1"]) < 0.5 * float(info_lm["res_0"])
+    # different iterates: subsets change the LM trajectory
+    assert not np.allclose(np.asarray(J_os), np.asarray(J_lm))
+
+
+def test_osrlm_no_longer_aliases_rlm():
+    sky, tile, *arrs = _problem()
+    x8, coh, sta1, sta2, cidx, cmask, wt, J0 = arrs
+    n = tile.n_stations
+    J_os, info_os = _run(SolverMode.OSLM_OSRLM_RLBFGS, x8, coh, sta1, sta2,
+                         cidx, cmask, wt, J0, n, tile)
+    J_rlm, info_rlm = _run(SolverMode.RLM_RLBFGS, x8, coh, sta1, sta2,
+                           cidx, cmask, wt, J0, n, tile, os_on=False)
+    assert float(info_os["res_1"]) < 0.5 * float(info_os["res_0"])
+    assert not np.allclose(np.asarray(J_os), np.asarray(J_rlm))
+
+
+def test_os_deterministic_rotation():
+    """randomize=False uses the (k % n_subsets) rotation — reproducible."""
+    sky, tile, *arrs = _problem()
+    x8, coh, sta1, sta2, cidx, cmask, wt, J0 = arrs
+    n = tile.n_stations
+    J1, i1 = _run(SolverMode.OSLM_LBFGS, x8, coh, sta1, sta2, cidx, cmask,
+                  wt, J0, n, tile, randomize=False)
+    J2, i2 = _run(SolverMode.OSLM_LBFGS, x8, coh, sta1, sta2, cidx, cmask,
+                  wt, J0, n, tile, randomize=False)
+    np.testing.assert_array_equal(np.asarray(J1), np.asarray(J2))
+    assert float(i1["res_1"]) < 0.5 * float(i1["res_0"])
+
+
+def test_os_reaches_full_lm_quality():
+    """OS-robust mode 2 must reach (near) the residual of full robust
+    mode 3 — the point of P4 is same quality from cheaper iterations
+    (clmfit.c FIXME notes 0.1 of subsets per iteration suffices)."""
+    sky, tile, *arrs = _problem(n_stations=20, tilesz=10)
+    x8, coh, sta1, sta2, cidx, cmask, wt, J0 = arrs
+    n = tile.n_stations
+    _, info_os = _run(SolverMode.OSLM_OSRLM_RLBFGS, x8, coh, sta1, sta2,
+                      cidx, cmask, wt, J0, n, tile)
+    _, info_full = _run(SolverMode.RLM_RLBFGS, x8, coh, sta1, sta2, cidx,
+                        cmask, wt, J0, n, tile, os_on=False)
+    r_os = float(info_os["res_1"])
+    r_full = float(info_full["res_1"])
+    assert r_os < 2.0 * max(r_full, 1e-6), (r_os, r_full)
+
+
+def test_sagefit_host_matches_traced():
+    """sagefit_host is the same algorithm as sagefit, chunked into
+    bounded device executions; with randomize=False the trajectories are
+    identical up to compilation-boundary roundoff."""
+    sky, tile, *arrs = _problem(n_stations=10, n_clusters=2, tilesz=6)
+    x8, coh, sta1, sta2, cidx, cmask, wt, J0 = arrs
+    n = tile.n_stations
+    cfg = sage.SageConfig(max_emiter=2, max_iter=5, max_lbfgs=4,
+                          solver_mode=int(SolverMode.RLM_RLBFGS),
+                          randomize=False)
+    J_t, info_t = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask, J0, n,
+                               wt, config=cfg)
+    J_h, info_h = sage.sagefit_host(x8, coh, sta1, sta2, cidx, cmask, J0,
+                                    n, wt, config=cfg)
+    np.testing.assert_allclose(float(info_h["res_0"]),
+                               float(info_t["res_0"]), rtol=1e-9)
+    np.testing.assert_allclose(float(info_h["res_1"]),
+                               float(info_t["res_1"]), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(J_h), np.asarray(J_t),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_sagefit_host_randomized_converges():
+    """Randomized cluster permutation + OS subsets still converge through
+    the host driver (the production fullbatch path)."""
+    sky, tile, *arrs = _problem(n_stations=14, n_clusters=3)
+    x8, coh, sta1, sta2, cidx, cmask, wt, J0 = arrs
+    n = tile.n_stations
+    os_info = lm_mod.os_subset_ids(tile.tilesz, tile.nbase)
+    cfg = sage.SageConfig(max_emiter=3, max_iter=6, max_lbfgs=6,
+                          solver_mode=int(SolverMode.OSLM_OSRLM_RLBFGS))
+    J, info = sage.sagefit_host(x8, coh, sta1, sta2, cidx, cmask, J0, n,
+                                wt, config=cfg, os_id=os_info,
+                                key=jax.random.PRNGKey(11))
+    assert float(info["res_1"]) < 0.3 * float(info["res_0"])

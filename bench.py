@@ -56,6 +56,8 @@ def probe_tpu(attempts: int = 3, timeout_s: int = 150,
             out = (r.stdout or "") + (r.stderr or "")
             if r.returncode == 0 and "PLATFORM=tpu" in out:
                 return True
+            if r.returncode == 0 and "PLATFORM=" in out:
+                return False    # clean non-TPU answer: no point retrying
             log(f"# tpu probe {i + 1}/{attempts}: rc={r.returncode} "
                 f"tail={out.strip().splitlines()[-1] if out.strip() else ''}")
         except subprocess.TimeoutExpired:
@@ -189,13 +191,17 @@ def time_sage(device, dtype, sky, dsky, tile, solver_mode, reps=2,
 
     coh_fn = jax.jit(lambda u, v, w: rp.coherencies(
         dsky_d, u, v, w, freq, tile.fdelta)[:, :, 0])
+    # complex<->real conversions must run jitted: eager complex ops are
+    # unimplemented on the axon TPU runtime
+    r2c = jax.jit(ne.jones_r2c)
+    c2r = jax.jit(ne.jones_c2r)
 
     def step(x8, u, v, w, s1, s2, wt, J0):
         coh = coh_fn(u, v, w)
         J, info = sage.sagefit_host(x8, coh, s1, s2, cidx_d, cmask_d,
-                                    ne.jones_r2c(J0), n, wt, config=cfg,
+                                    r2c(J0), n, wt, config=cfg,
                                     os_id=os_d)
-        return ne.jones_c2r(J), info["res_0"], info["res_1"]
+        return c2r(J), info["res_0"], info["res_1"]
 
     args = (inp["x8"], inp["u"], inp["v"], inp["w"], inp["s1"], inp["s2"],
             inp["wt"], inp["J0"])
@@ -312,7 +318,7 @@ def config3_rtr16(device, dtype):
     sky, dsky, tile = build_fullbatch(dtype, n_stations=62, n_clusters=16,
                                       tilesz=10, seed=SEED + 10)
     vps, r0, r1, dt, comp = time_sage(device, dtype, sky, dsky, tile,
-                                      SolverMode.RTR_OSRLM_RLBFGS)
+                                      SolverMode.RTR_OSRLM_RLBFGS, reps=1)
     return dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                 step_s=dt, compile_s=comp,
                 shape="N=62 M=16 tilesz=10 point -j5")
@@ -326,7 +332,7 @@ def config4_extended(device, dtype):
                                       tilesz=10, extended=True,
                                       spectra3=True, seed=SEED + 20)
     vps, r0, r1, dt, comp = time_sage(device, dtype, sky, dsky, tile,
-                                      SolverMode.RTR_OSRLM_RLBFGS)
+                                      SolverMode.RTR_OSRLM_RLBFGS, reps=1)
     return dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                 step_s=dt, compile_s=comp,
                 shape="N=64 M=8 shapelet+gauss -F1 -j5")

@@ -97,8 +97,9 @@ def config_from_args(args) -> RunConfig:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
-    if not cfg.ms or not cfg.sky_model or not cfg.cluster_file:
-        print("need -d dataset, -s sky model, -c cluster file",
+    if (not cfg.ms and not cfg.ms_list) or not cfg.sky_model \
+            or not cfg.cluster_file:
+        print("need -d dataset (or -f list), -s sky model, -c cluster file",
               file=sys.stderr)
         return 2
 

@@ -343,53 +343,178 @@ class SimMS:
             yield i, self.read_tile(i)
 
     def tiles_prefetch(self, depth: int = 2):
-        """Tile iterator with background read-ahead: the host overlaps
-        disk I/O with the device solve of the previous tile (the
-        streaming analogue of the reference's synchronous per-tile MSIter
-        loop; SURVEY.md section 5 'host streaming')."""
-        import queue
-        import threading
+        return _tiles_prefetch_impl(self, depth)
 
-        q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
-        stop = object()
-        cancel = threading.Event()
 
-        def _put(item) -> bool:
-            while not cancel.is_set():
-                try:
-                    q.put(item, timeout=0.2)
-                    return True
-                except queue.Full:
-                    continue
-            return False
+class MultiSimMS:
+    """Several SimMS datasets presented as ONE dataset with the combined
+    channel axis — the ``-f MSlist`` multi-MS joint calibration (P8):
+    ``Data::loadDataList`` (src/MS/data.cpp:835) channel-averages across
+    every MS's channels into one solve vector (the more-than-half rule
+    counts unflagged channels over ALL MSs), and ``writeDataList``
+    (data.cpp:1304) splits residual channels back per MS.
 
-        def reader():
+    All parts must agree on stations/baselines/tile structure — the same
+    consistency requirement the MPI master enforces
+    (sagecal_master.cpp:239-284). Parts are ordered by mean frequency so
+    the combined channel axis is monotone.
+    """
+
+    def __init__(self, paths):
+        if isinstance(paths, str):
+            paths = [paths]
+        if not paths:
+            raise ValueError("MultiSimMS: empty dataset list")
+        parts = [SimMS(p) for p in paths]
+        parts.sort(key=lambda m: float(np.mean(m.meta["freqs"])))
+        m0 = parts[0].meta
+        for mx in parts[1:]:
+            for key in ("n_stations", "nbase", "tilesz", "n_tiles",
+                        "tdelta", "ra0", "dec0"):
+                if mx.meta[key] != m0[key]:
+                    raise ValueError(
+                        f"dataset {mx.path}: {key} mismatch "
+                        f"({mx.meta[key]} vs {m0[key]})")
+        self.parts = parts
+        self.path = ",".join(p.path for p in parts)
+        freqs = np.concatenate([np.asarray(p.meta["freqs"], float)
+                                for p in parts])
+        self._nchan = [len(p.meta["freqs"]) for p in parts]
+        self.meta = dict(m0)
+        self.meta["freqs"] = list(map(float, freqs))
+        # reference freq0 = mean over ALL channels of all MSs
+        # (readAuxDataList data.cpp:487-505 accumulates every channel of
+        # every MS and divides by the total channel count)
+        self.meta["freq0"] = float(freqs.mean())
+        self.meta["fdelta"] = float(sum(p.meta["fdelta"] for p in parts))
+
+    @property
+    def n_tiles(self) -> int:
+        return self.meta["n_tiles"]
+
+    def beam_info(self):
+        return self.parts[0].beam_info()
+
+    def read_tile(self, i: int) -> VisTile:
+        tiles = [p.read_tile(i) for p in self.parts]
+        t0 = tiles[0]
+        x = np.concatenate([t.x for t in tiles], axis=1)
+        flags = np.zeros(t0.nrows, np.int8)
+        # a row is flagged only if flagged in every MS; uv-cut (2) wins
+        # only when nothing is plain-flagged
+        allf = np.stack([t.flags for t in tiles])
+        flags[np.all(allf == 1, axis=0)] = 1
+        uvcut = np.any(allf == 2, axis=0) & (flags == 0)
+        flags[uvcut] = 2
+        # per-channel flags: a row flagged in ONE MS must not leak into
+        # the channel average (loadDataList's nflag counts unflagged
+        # channels across ALL MSs, data.cpp:899-921) — synthesize cflags
+        # from each part's row flags whenever parts disagree or any part
+        # carries channel flags
+        flags_differ = not all(
+            np.array_equal(t.flags, tiles[0].flags) for t in tiles[1:])
+        cfl = None
+        if flags_differ or any(t.cflags is not None for t in tiles):
+            cfl = np.concatenate(
+                [((t.cflags if t.cflags is not None
+                   else np.zeros((t.nrows, len(t.freqs)), np.uint8))
+                  | (t.flags == 1)[:, None].astype(np.uint8))
+                 for t in tiles], axis=1)
+        return VisTile(
+            u=t0.u, v=t0.v, w=t0.w, x=x, flags=flags,
+            sta1=t0.sta1, sta2=t0.sta2,
+            freqs=np.asarray(self.meta["freqs"]),
+            freq0=self.meta["freq0"], fdelta=self.meta["fdelta"],
+            tdelta=t0.tdelta, dec0=t0.dec0, ra0=t0.ra0,
+            n_stations=t0.n_stations, nbase=t0.nbase, tilesz=t0.tilesz,
+            time_mjd=t0.time_mjd, cflags=cfl)
+
+    def write_tile(self, i: int, tile: VisTile) -> None:
+        """Split the combined channel axis back per MS (writeDataList)."""
+        lo = 0
+        for p, nc in zip(self.parts, self._nchan):
+            part_tile = p.read_tile(i)
+            # only residual data is written back; each part keeps its own
+            # flags (writeDataList writes the data column only)
+            part_tile.x = tile.x[:, lo:lo + nc]
+            p.write_tile(i, part_tile)
+            lo += nc
+
+    def tiles(self):
+        for i in range(self.n_tiles):
+            yield i, self.read_tile(i)
+
+    def tiles_prefetch(self, depth: int = 2):
+        return _tiles_prefetch_impl(self, depth)
+
+
+def open_dataset(ms: str | None, ms_list: str | None = None):
+    """Resolve -d/-f into a dataset: a single SimMS, or a MultiSimMS from
+    a glob pattern / list file (fullbatch_mode.cpp:255-262 dispatch)."""
+    if ms_list:
+        import glob as globmod
+        if os.path.isfile(ms_list):
+            with open(ms_list) as f:
+                paths = [ln.strip() for ln in f if ln.strip()
+                         and not ln.startswith("#")]
+        else:
+            paths = sorted(globmod.glob(ms_list))
+        if not paths:
+            raise ValueError(f"-f {ms_list}: no datasets found")
+        if len(paths) == 1:
+            return SimMS(paths[0])
+        return MultiSimMS(paths)
+    return SimMS(ms)
+
+
+def _tiles_prefetch_impl(dataset, depth: int = 2):
+    """Tile iterator with background read-ahead: the host overlaps
+    disk I/O with the device solve of the previous tile (the
+    streaming analogue of the reference's synchronous per-tile MSIter
+    loop; SURVEY.md section 5 'host streaming')."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+    stop = object()
+    cancel = threading.Event()
+
+    def _put(item) -> bool:
+        while not cancel.is_set():
             try:
-                for i in range(self.n_tiles):
-                    if cancel.is_set():
-                        return
-                    if not _put((i, self.read_tile(i))):
-                        return
-            except Exception as e:          # surface in the consumer
-                _put((stop, e))
-                return
-            _put((stop, None))
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
 
-        th = threading.Thread(target=reader, daemon=True)
-        th.start()
+    def reader():
         try:
-            while True:
-                item = q.get()
-                if item[0] is stop:
-                    if item[1] is not None:
-                        raise item[1]
-                    break
-                yield item
-        finally:
-            cancel.set()
-            while not q.empty():            # unblock a full queue
-                try:
-                    q.get_nowait()
-                except queue.Empty:
-                    break
-            th.join(timeout=5.0)
+            for i in range(dataset.n_tiles):
+                if cancel.is_set():
+                    return
+                if not _put((i, dataset.read_tile(i))):
+                    return
+        except Exception as e:          # surface in the consumer
+            _put((stop, e))
+            return
+        _put((stop, None))
+
+    th = threading.Thread(target=reader, daemon=True)
+    th.start()
+    try:
+        while True:
+            item = q.get()
+            if item[0] is stop:
+                if item[1] is not None:
+                    raise item[1]
+                break
+            yield item
+    finally:
+        cancel.set()
+        while not q.empty():            # unblock a full queue
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        th.join(timeout=5.0)

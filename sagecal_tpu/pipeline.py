@@ -38,6 +38,11 @@ from sagecal_tpu.rime import residual as rr
 from sagecal_tpu.solvers import lm as lm_mod
 from sagecal_tpu.solvers import sage
 
+# eager complex arithmetic is unimplemented on the axon TPU runtime; keep
+# the Jones real<->complex reshapes inside jit
+_jones_r2c_j = jax.jit(ne.jones_r2c)
+_jones_c2r_j = jax.jit(ne.jones_c2r)
+
 LMCUT = 40      # sagecalmain.h:24
 RES_RATIO = 5.0  # fullbatch_mode.cpp:239
 
@@ -153,13 +158,15 @@ class FullBatchPipeline:
             # host-driven EM: one bounded device execution per cluster
             # solve (the tunneled chip kills single executions over ~60 s)
             coh = coh_fn(u, v, w, sta1, sta2, beam)
-            J0 = ne.jones_r2c(jnp.asarray(J0_r8, self.rdt))
+            # jitted conversion: eager complex ops are unimplemented on
+            # the axon TPU runtime
+            J0 = _jones_r2c_j(jnp.asarray(J0_r8, self.rdt))
             # fresh subset draws + cluster permutations per tile
             key = jax.random.fold_in(jax.random.PRNGKey(199), tile_idx)
             J, info = sage.sagefit_host(
                 jnp.asarray(x8, self.rdt), coh, sta1, sta2, cidx, cmask,
                 J0, self.n, wt, config=scfg, os_id=os_info, key=key)
-            return ne.jones_c2r(J), info
+            return _jones_c2r_j(J), info
         return solve
 
     def _tile_beam(self, tile):
@@ -420,7 +427,7 @@ def run(cfg: RunConfig, log=print):
     stochastic / stochastic-consensus) dispatch here; stochastic modes live
     in sagecal_tpu.stochastic.
     """
-    ms = ds.SimMS(cfg.ms)
+    ms = ds.open_dataset(cfg.ms, cfg.ms_list)
     meta = ms.meta
     sky = skymodel.read_sky_cluster(cfg.sky_model, cfg.cluster_file,
                                     meta["ra0"], meta["dec0"], meta["freq0"],

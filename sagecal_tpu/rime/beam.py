@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import NamedTuple
 
 import jax
@@ -171,6 +172,31 @@ def synthetic_element_coeffs(band: str = "lba", M: int = BEAM_ELEM_MODES,
                          M=M, beta=beta)
 
 
+_DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+def lofar_element_coeffs(band: str) -> ElementCoeffs:
+    """Measured LOFAR LBA/HBA element characterization tables.
+
+    Converted from the reference's auto-generated coefficient data
+    (elementcoeff.h: 10 LBA / 15 HBA frequencies x 28 modes, M=7,
+    beta=0.5) by tools_dev/convert_elementcoeff.py; frequencies stored in
+    Hz. Selection by band follows the <100 MHz LBA/HBA split of the
+    callers (fullbatch_mode.cpp:71).
+    """
+    return load_element_coeffs(
+        os.path.join(_DATA_DIR, f"lofar_elem_{band}.npz"))
+
+
+def default_element_coeffs(band: str) -> ElementCoeffs:
+    """The LOFAR characterization tables; synthetic dipole fit only if
+    the packaged data files are missing."""
+    try:
+        return lofar_element_coeffs(band)
+    except (FileNotFoundError, OSError):        # pragma: no cover
+        return synthetic_element_coeffs(band)
+
+
 def save_element_coeffs(path: str, ecoeff: ElementCoeffs) -> None:
     np.savez(path, freqs=ecoeff.freqs, theta=ecoeff.theta, phi=ecoeff.phi,
              M=ecoeff.M, beta=ecoeff.beta)
@@ -246,7 +272,7 @@ def beam_to_device(info: BeamInfo, data_freq0: float | None = None,
     stored times (per-tile staging in the streaming pipeline)."""
     f = lambda a: jnp.asarray(a, real_dtype)
     f0ref = data_freq0 or info.freq0
-    ecoeff = info.ecoeff or synthetic_element_coeffs(band_for_freq(f0ref))
+    ecoeff = info.ecoeff or default_element_coeffs(band_for_freq(f0ref))
     th, ph = element_pattern_at(ecoeff, f0ref)
     th = np.stack([th.real, th.imag], axis=-1)
     ph = np.stack([ph.real, ph.imag], axis=-1)
@@ -282,7 +308,7 @@ def synthetic_beam(n_stations: int, time_jd, ra0: float, dec0: float,
                     time_jd=np.atleast_1d(np.asarray(time_jd, float)),
                     ra0=ra0, dec0=dec0, freq0=freq0,
                     elem_xyz=elem, elem_mask=mask,
-                    ecoeff=ecoeff or synthetic_element_coeffs(band))
+                    ecoeff=ecoeff or default_element_coeffs(band))
 
 
 def band_for_freq(freq_hz: float) -> str:
@@ -311,7 +337,7 @@ def resolve_beaminfo(dobeam: int, ms, meta: dict, log=print):
 def save_beaminfo(path: str, info: BeamInfo) -> None:
     """Persist beam metadata next to a dataset (the SimMS analogue of the
     MS's LOFAR_ANTENNA_FIELD subtable, data.cpp:194-300)."""
-    ec = info.ecoeff or synthetic_element_coeffs(band_for_freq(info.freq0))
+    ec = info.ecoeff or default_element_coeffs(band_for_freq(info.freq0))
     np.savez(path, longitude=info.longitude, latitude=info.latitude,
              time_jd=info.time_jd, ra0=info.ra0, dec0=info.dec0,
              freq0=info.freq0, elem_xyz=info.elem_xyz,

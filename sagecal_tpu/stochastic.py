@@ -419,7 +419,7 @@ class _StochasticRunner:
 
 
 def _open(cfg: RunConfig, log):
-    ms = ds.SimMS(cfg.ms)
+    ms = ds.open_dataset(cfg.ms, cfg.ms_list)
     meta = ms.meta
     sky = skymodel.read_sky_cluster(cfg.sky_model, cfg.cluster_file,
                                     meta["ra0"], meta["dec0"], meta["freq0"],

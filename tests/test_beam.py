@@ -279,3 +279,97 @@ def test_stochastic_pipeline_withbeam(tmp_path):
     h = history[0]
     assert np.isfinite(h["res_1"])
     assert h["res_1"] < h["res_0"]
+
+
+# ---------------------------------------------------------------------------
+# real LOFAR element characterization tables (elementcoeff.h conversion)
+# ---------------------------------------------------------------------------
+
+def _ref_eval_elementcoeffs(r, theta, patt_theta, patt_phi, M, beta):
+    """Independent float64 reimplementation of the reference evaluation
+    (elementbeam.c:139-235: preamble, L_g1 recursion, (pi/4+r)^|m|,
+    e^{-j m theta}), used as the oracle for the device path."""
+    import math as _m
+    rb = (r / beta) ** 2
+    ex = np.exp(-0.5 * rb)
+    e_th = 0.0 + 0.0j
+    e_ph = 0.0 + 0.0j
+    idx = 0
+    for n in range(M):
+        for m in range(-n, n + 1, 2):
+            absm = abs(m)
+            p, q = (n - absm) // 2, (n + absm) // 2
+            pre = _m.sqrt(_m.factorial(p) / (_m.pi * _m.factorial(q)))
+            if p % 2:
+                pre = -pre
+            pre *= beta ** (-1.0 - absm)
+            # L_{(n-|m|)/2}^{|m|}(rb) (elementbeam.c:213 L_g1(p, absm, rb))
+            if p == 0:
+                lg = 1.0
+            else:
+                lm2, lm1 = 1.0, 1.0 - rb + absm
+                for i in range(2, p + 1):
+                    inv = 1.0 / i
+                    cur = (2.0 + inv * (absm - 1.0 - rb)) * lm1 \
+                        - (1.0 + inv * (absm - 1)) * lm2
+                    lm2, lm1 = lm1, cur
+                lg = lm1
+            rm = (_m.pi / 4 + r) ** absm
+            pr = rm * lg * ex * pre
+            bf = pr * np.exp(-1j * m * theta)
+            e_th += patt_theta[idx] * bf
+            e_ph += patt_phi[idx] * bf
+            idx += 1
+    return e_th, e_ph
+
+
+def test_lofar_element_tables_load_and_select():
+    lba = bm.lofar_element_coeffs("lba")
+    hba = bm.lofar_element_coeffs("hba")
+    assert lba.M == hba.M == 7 and lba.beta == 0.5
+    assert lba.theta.shape == (10, 28)
+    assert hba.theta.shape == (15, 28)
+    np.testing.assert_allclose(lba.freqs[0], 10e6)
+    np.testing.assert_allclose(hba.freqs[-1], 240e6)
+    # spot values from the characterization data (elementcoeff.h rows)
+    np.testing.assert_allclose(lba.theta[0, 1],
+                               -1.840944e-01 - 2.564009e-01j, rtol=1e-6)
+    # default coefficients ARE the LOFAR tables
+    ec = bm.default_element_coeffs("hba")
+    np.testing.assert_array_equal(ec.theta, hba.theta)
+
+
+def test_element_eval_matches_reference_math_on_real_tables():
+    """Evaluate the device basis against the independent reference-math
+    oracle at sampled (freq, zenith, azimuth) points with the REAL LOFAR
+    tables (f32 tolerance; VERDICT round-1 item 5)."""
+    for band, freq in (("lba", 55e6), ("hba", 151e6)):
+        ec = bm.lofar_element_coeffs(band)
+        th_tab, ph_tab = bm.element_pattern_at(ec, freq)
+        rng = np.random.default_rng(9)
+        zd = rng.uniform(0.0, np.pi / 2, 12)
+        az = rng.uniform(0.0, 2 * np.pi, 12)
+        basis = np.asarray(bm.element_basis(
+            jnp.asarray(zd), jnp.asarray(az), ec.M, ec.beta))
+        got_th = basis @ th_tab
+        got_ph = basis @ ph_tab
+        for i in range(len(zd)):
+            w_th, w_ph = _ref_eval_elementcoeffs(
+                zd[i], az[i], th_tab, ph_tab, ec.M, ec.beta)
+            np.testing.assert_allclose(got_th[i], w_th, rtol=2e-5,
+                                       atol=1e-7)
+            np.testing.assert_allclose(got_ph[i], w_ph, rtol=2e-5,
+                                       atol=1e-7)
+
+
+def test_element_freq_interpolation_matches_reference_rule():
+    """set_elementcoeffs interpolation (elementbeam.c:91-127): linear
+    blend of bracketing rows; clamped outside the table."""
+    ec = bm.lofar_element_coeffs("lba")
+    th, ph = bm.element_pattern_at(ec, 35e6)   # between 30 and 40 MHz
+    expect = 0.5 * (ec.theta[2] + ec.theta[3])
+    np.testing.assert_allclose(th, expect, rtol=1e-12)
+    th_lo, _ = bm.element_pattern_at(ec, 5e6)
+    np.testing.assert_array_equal(th_lo, ec.theta[0])
+    th_hi, _ = bm.element_pattern_at(ec, 500e6)
+    np.testing.assert_array_equal(th_hi, ec.theta[-1])

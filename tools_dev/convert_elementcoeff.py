@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Convert the LOFAR element-beam characterization tables to .npz.
+
+The reference ships the LBA/HBA dual-pol spherical-harmonic coefficient
+tables as C array initializers (src/lib/Radio/elementcoeff.h — measured
+characterization DATA, auto-generated per its own banner comment). This
+script parses those numeric tables into the ElementCoeffs .npz schema of
+``sagecal_tpu.rime.beam`` so beam-mode results can numerically match the
+reference for real LOFAR observations (frequency selection per
+elementbeam.c:68-77; table frequencies are GHz -> stored as Hz).
+
+Usage: python tools_dev/convert_elementcoeff.py [path-to-elementcoeff.h]
+Writes sagecal_tpu/rime/data/lofar_elem_{lba,hba}.npz.
+"""
+
+import os
+import re
+import sys
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+DEFAULT_SRC = "/root/reference/src/lib/Radio/elementcoeff.h"
+
+
+def _parse_complex_block(text: str, name: str, nfreq: int, nmodes: int):
+    """Extract ``const static complex double <name>[nfreq][nmodes]``."""
+    m = re.search(rf"{name}\[\d+\]\[\d+\]\s*=\s*\{{(.*?)\}};", text,
+                  re.DOTALL)
+    if not m:
+        raise ValueError(f"table {name} not found")
+    body = m.group(1)
+    # entries look like: -1.840944e-01+_Complex_I*(-2.564009e-01)
+    pat = re.compile(
+        r"([+-]?\d+\.\d+e[+-]?\d+)\+_Complex_I\*\(([+-]?\d+\.\d+e[+-]?\d+)\)")
+    vals = [complex(float(a), float(b)) for a, b in pat.findall(body)]
+    if len(vals) != nfreq * nmodes:
+        raise ValueError(
+            f"{name}: expected {nfreq * nmodes} entries, got {len(vals)}")
+    return np.asarray(vals, complex).reshape(nfreq, nmodes)
+
+
+def _parse_real_block(text: str, name: str, n: int):
+    m = re.search(rf"{name}\[\d+\]\s*=\s*\{{(.*?)\}};", text, re.DOTALL)
+    if not m:
+        raise ValueError(f"table {name} not found")
+    vals = [float(x) for x in re.findall(r"[-+]?\d*\.\d+|\d+", m.group(1))]
+    if len(vals) != n:
+        raise ValueError(f"{name}: expected {n} entries, got {len(vals)}")
+    return np.asarray(vals)
+
+
+def main():
+    src = sys.argv[1] if len(sys.argv) > 1 else DEFAULT_SRC
+    with open(src) as f:
+        text = f.read()
+
+    modes = int(re.search(r"#define BEAM_ELEM_MODES (\d+)", text).group(1))
+    beta = float(re.search(r"#define BEAM_ELEM_BETA ([\d.]+)", text).group(1))
+    nmodes = modes * (modes + 1) // 2
+    out_dir = os.path.join(REPO, "sagecal_tpu", "rime", "data")
+    os.makedirs(out_dir, exist_ok=True)
+
+    for band, nf_def in (("lba", "LBA_FREQS"), ("hba", "HBA_FREQS")):
+        nf = int(re.search(rf"#define {nf_def} (\d+)", text).group(1))
+        freqs_ghz = _parse_real_block(text, f"{band}_beam_elem_freqs", nf)
+        theta = _parse_complex_block(text, f"{band}_beam_elem_theta", nf,
+                                     nmodes)
+        phi = _parse_complex_block(text, f"{band}_beam_elem_phi", nf, nmodes)
+        path = os.path.join(out_dir, f"lofar_elem_{band}.npz")
+        np.savez(path, freqs=freqs_ghz * 1e9, theta=theta, phi=phi,
+                 M=modes, beta=beta)
+        print(f"{path}: {nf} freqs x {nmodes} modes, M={modes}, beta={beta}")
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
     a("-B", "--beam", type=int, default=0)
     a("-N", "--epochs", type=int, default=0,
       help=">0 enables stochastic (minibatch) calibration")
+    a("--loss", choices=("robust", "huber"), default="robust",
+      help="stochastic minibatch loss (Student's t or Huber)")
     a("-M", "--minibatches", type=int, default=1)
     a("-A", "--admm", type=int, default=1)
     a("-P", "--npoly", type=int, default=2)
@@ -89,6 +91,7 @@ def config_from_args(args) -> RunConfig:
         correct_cluster=args.correct_cluster,
         phase_only=bool(args.phase_only), beam_mode=BeamMode(args.beam),
         n_epochs=args.epochs, n_minibatches=args.minibatches,
+        stochastic_loss=args.loss,
         n_admm=args.admm, n_poly=args.npoly, poly_type=args.polytype,
         admm_rho=args.rho, rho_file=args.rho_file,
         max_timeslots=args.max_timeslots, verbose=args.verbose)

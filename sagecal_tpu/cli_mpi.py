@@ -124,6 +124,12 @@ def main(argv=None) -> int:
     meta0 = mss[0].meta
     # metadata consistency check (master :239-284)
     for msx in mss[1:]:
+        if len(msx.meta["freqs"]) != len(meta0["freqs"]):
+            raise ValueError(
+                f"dataset {msx.path}: channel count mismatch "
+                f'({len(msx.meta["freqs"])} vs {len(meta0["freqs"])}) '
+                "— the mesh program needs a uniform channel count per "
+                "subband")
         for key in ("n_stations", "nbase", "tilesz"):
             if msx.meta[key] != meta0[key]:
                 raise ValueError(
@@ -225,14 +231,24 @@ def main(argv=None) -> int:
 
     for ti in range(start, stop):
         tiles = [m.read_tile(ti) for m in mss]
-        x8F = np.stack([utils.vis_to_x8(t.averaged()) for t in tiles])
+        # shared staging decision (VisTile.solve_input): per-channel
+        # packing when cflags exist, plain mean else; uv-cut rows (flag 2)
+        # stay excluded from the solve; the downweight ratio is the GOOD
+        # fraction (sagecal_slave.cpp:513)
+        x8_l, wt_l, fr_l = [], [], []
+        for t in tiles:
+            x8_t, flags_t, good = t.solve_input()
+            fr_l.append(good)
+            x8_l.append(x8_t)
+            wt_l.append(np.asarray(lm_mod.make_weights(
+                jnp.asarray(flags_t, jnp.int32), rdt)))
+        x8F = np.stack(x8_l)
         uF = np.stack([t.u for t in tiles])
         vF = np.stack([t.v for t in tiles])
         wF = np.stack([t.w for t in tiles])
-        wtF = np.stack([np.asarray(lm_mod.make_weights(
-            jnp.asarray(t.flags, jnp.int32), rdt)) for t in tiles])
+        wtF = np.stack(wt_l)
         # rho scaled by unflagged fraction (master :646-650)
-        fratioF = np.array([1.0 - t.flag_ratio for t in tiles])
+        fratioF = np.array(fr_l)
 
         args_dev = [jax.device_put(jnp.asarray(a, rdt), sh) for a in
                     (x8F, uF, vF, wF, freqs, wtF, fratioF, J0)]

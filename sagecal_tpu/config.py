@@ -111,6 +111,9 @@ class RunConfig:
     # --- stochastic calibration (minibatch)
     n_epochs: int = 0                  # -N : >0 enables stochastic mode
     n_minibatches: int = 1             # -M
+    # robust (Student's t) or huber minibatch loss
+    # (robust_batchmode_lbfgs.c:66 func_huber_th vs :89 func_robust_th)
+    stochastic_loss: str = "robust"
 
     # --- consensus / distributed (reference src/MPI/main.cpp:107-242)
     n_admm: int = 1                    # -A : ADMM iterations

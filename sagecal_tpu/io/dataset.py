@@ -92,6 +92,23 @@ class VisTile:
         xa[self.flags == 1] = 0.0
         return xa
 
+    def solve_input(self, uvtaper_m: float = 0.0):
+        """(x8 [B, 8], rowflags [B], good_fraction) — the channel-averaged
+        solve input with loadData semantics: native per-channel-flag
+        packing (more-than-half rule) when ``cflags`` exist or a taper is
+        requested, else the plain channel mean. Stored uv-cut rows
+        (flag == 2) survive either path; this is the ONE staging decision
+        shared by the fullbatch pipeline and the distributed CLI.
+        """
+        if self.cflags is not None or uvtaper_m > 0.0:
+            x8, rowflags, fr = self.pack(uvtaper_m=uvtaper_m)
+            rowflags = np.where((self.flags == 2) & (rowflags == 0),
+                                np.int8(2), rowflags.astype(np.int8))
+            return x8, rowflags, 1.0 - fr
+        from sagecal_tpu import utils
+        return (utils.vis_to_x8(self.averaged()), self.flags,
+                1.0 - self.flag_ratio)
+
     def pack(self, uvmin_m: float = 0.0, uvmax_m: float = 1e30,
              uvtaper_m: float = 0.0):
         """Full loadData-semantics packing via the native kernel
@@ -455,8 +472,9 @@ def open_dataset(ms: str | None, ms_list: str | None = None):
         import glob as globmod
         if os.path.isfile(ms_list):
             with open(ms_list) as f:
-                paths = [ln.strip() for ln in f if ln.strip()
-                         and not ln.startswith("#")]
+                stripped = (ln.strip() for ln in f)
+                paths = [ln for ln in stripped
+                         if ln and not ln.startswith("#")]
         else:
             paths = sorted(globmod.glob(ms_list))
         if not paths:

@@ -142,3 +142,10 @@ def pack_tile(vis, cflags, u_m, v_m, nrow_total: int,
                   freq0, dptr(x8), bptr(rowflag),
                   ctypes.byref(fratio))
     return x8, rowflag, float(fratio.value)
+
+
+if __name__ == "__main__":
+    import sys
+    if "--build" in sys.argv:
+        path = _build_lib()
+        print(f"native kernel: {path or 'unavailable (g++ missing?)'}")

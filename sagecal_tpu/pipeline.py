@@ -269,16 +269,13 @@ class FullBatchPipeline:
             u = jnp.asarray(tile.u, self.rdt)
             v = jnp.asarray(tile.v, self.rdt)
             w = jnp.asarray(tile.w, self.rdt)
-            if tile.cflags is not None or cfg.uvtaper > 0:
-                # native loadData-semantics packing (per-channel flags,
-                # more-than-half-channels rule, taper; src/native/tile_pack.cc)
-                x8_np, rowflags, _fr = tile.pack(uvtaper_m=cfg.uvtaper)
-                base_flags = jnp.asarray(rowflags, jnp.int32)
-                x8 = jnp.asarray(x8_np, self.rdt)
-            else:
-                base_flags = jnp.asarray(tile.flags, jnp.int32)
-                x8 = jnp.asarray(utils.vis_to_x8(tile.averaged()),
-                                 self.rdt)
+            # shared staging decision (VisTile.solve_input): native
+            # per-channel-flag packing when applicable, plain mean else;
+            # stored uv-cut rows survive either way
+            x8_np, rowflags, _good = tile.solve_input(
+                uvtaper_m=cfg.uvtaper)
+            base_flags = jnp.asarray(rowflags, jnp.int32)
+            x8 = jnp.asarray(x8_np, self.rdt)
             flags = rp.uvcut_flags(base_flags, u, v,
                                    jnp.asarray(tile.freqs, self.rdt),
                                    cfg.uvmin, cfg.uvmax)

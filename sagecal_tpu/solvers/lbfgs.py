@@ -13,10 +13,10 @@ Capability parity with reference ``src/lib/Dirac/lbfgs.c``:
 
 Re-architected for JAX: the persistent state is an immutable pytree carried
 through ``lax.while_loop``; cost/grad are arbitrary jit-traceable closures
-(autodiff supplies gradients where the reference hand-codes kernels). Line
-search is Armijo backtracking for both variants (the reference's full-batch
-cubic/zoom Fletcher search exists for the same purpose; backtracking is the
-variant it uses in production stochastic mode).
+(autodiff supplies gradients where the reference hand-codes kernels). The
+full-batch path uses the Fletcher cubic/zoom line search with the
+reference's parameters; the stochastic path uses Armijo backtracking, the
+variant the reference uses in production minibatch mode.
 """
 
 from __future__ import annotations
@@ -109,6 +109,138 @@ def linesearch_backtrack(cost_func: Callable, xk, pk, gk, alpha0,
     return alpha
 
 
+def linesearch_fletcher(cost_func, grad_func, xk, pk, gk=None,
+                        alpha1: float = 10.0, sigma: float = 0.1,
+                        rho: float = 0.01, t1: float = 9.0, t2: float = 0.1,
+                        t3: float = 0.5):
+    """Fletcher line search with cubic interpolation (lbfgs.c:116-443:
+    ``cubic_interp`` / ``linesearch_zoom`` / ``linesearch``), used by the
+    full-batch path with the reference's parameters (lbfgs.c:572).
+
+    Deviations from the reference: directional derivatives are exact
+    (``grad . pk``) instead of central finite differences, and the cubic
+    minimizer evaluates the trial point at ``z0`` itself (the reference's
+    mixed absolute/fractional use of ``z0`` evaluates at a+z0(b-a) while
+    bounds-checking z0 in alpha units).
+    """
+    dtype = xk.dtype
+    eps = jnp.asarray(1e-30, dtype)
+
+    def phi(a):
+        return cost_func(xk + a * pk)
+
+    def dphi(a):
+        return jnp.dot(grad_func(xk + a * pk), pk)
+
+    phi_0 = phi(jnp.asarray(0.0, dtype))
+    # reuse the caller's gradient at xk when given (saves one full
+    # gradient eval per LBFGS iteration)
+    gphi_0 = jnp.dot(gk, pk) if gk is not None \
+        else dphi(jnp.asarray(0.0, dtype))
+    tol = jnp.minimum(0.01 * phi_0, 1e-6)
+    mu = (tol - phi_0) / (rho * gphi_0)
+
+    def cubic(a, b):
+        """Minimizer of the Hermite cubic through (a, f0, f0d), (b, f1,
+        f1d); falls back to the lower endpoint (cubic_interp:116-189)."""
+        f0, f1 = phi(a), phi(b)
+        f0d, f1d = dphi(a), dphi(b)
+        ba = jnp.where(jnp.abs(b - a) > eps, b - a, eps)
+        aa = 3.0 * (f0 - f1) / ba + (f1d - f0d)
+        disc = aa * aa - f0d * f1d
+        has_root = disc > 0.0
+        cc = jnp.sqrt(jnp.maximum(disc, 0.0))
+        den = f1d - f0d + 2.0 * cc
+        z0 = b - (f1d + cc - aa) * ba / jnp.where(jnp.abs(den) > eps,
+                                                  den, eps)
+        lo, hi = jnp.minimum(a, b), jnp.maximum(a, b)
+        in_bounds = (z0 >= lo) & (z0 <= hi) & jnp.isfinite(z0)
+        fz0 = jnp.where(in_bounds, phi(jnp.where(in_bounds, z0, a)),
+                        f0 + f1)
+        pick_root = jnp.where((f0 < f1) & (f0 < fz0), a,
+                              jnp.where(f1 < fz0, b, z0))
+        return jnp.where(has_root, pick_root, jnp.where(f0 < f1, a, b))
+
+    # --- phase 1: bracketing (linesearch:298-420). state codes:
+    # 0 continue, 1 found alphak, 2 zoom(aj, bj)
+    def p1_cond(s):
+        ci, alphai, alphai1, phi_i1, alphak, code, aj, bj = s
+        return (ci < 10) & (code == 0)
+
+    def p1_body(s):
+        ci, alphai, alphai1, phi_i1, alphak, code, aj, bj = s
+        phi_i = phi(alphai)
+        cond0 = phi_i < tol
+        cond1 = (phi_i > phi_0 + alphai * gphi_0) | ((ci > 1)
+                                                     & (phi_i >= phi_i1))
+        gphi_i = dphi(alphai)
+        cond2 = jnp.abs(gphi_i) <= -sigma * gphi_0
+        cond3 = gphi_i >= 0.0
+
+        i32 = lambda v: jnp.asarray(v, jnp.int32)
+        code_n = jnp.where(cond0, i32(1),
+                           jnp.where(cond1, i32(2),
+                                     jnp.where(cond2, i32(1),
+                                               jnp.where(cond3, i32(2),
+                                                         i32(0)))))
+        alphak_n = jnp.where(cond0 | (~cond1 & cond2), alphai, alphak)
+        aj_n = jnp.where(cond1, alphai1, jnp.where(cond3, alphai, aj))
+        bj_n = jnp.where(cond1, alphai, jnp.where(cond3, alphai1, bj))
+
+        # advance: next alpha by mu or cubic in the extended interval;
+        # cubic costs ~5 cost/grad evals, so only run it when the branch
+        # is live (linesearch:409-416 evaluates it only in the else)
+        take_mu = mu <= (2.0 * alphai - alphai1)
+        lo = 2.0 * alphai - alphai1
+        hi = jnp.minimum(mu, alphai + t1 * (alphai - alphai1))
+        alpha_adv = jax.lax.cond(take_mu | (code_n != 0),
+                                 lambda: mu, lambda: cubic(lo, hi))
+        alphai1_n = jnp.where(code_n == 0, alphai, alphai1)
+        alphai_n = jnp.where(code_n == 0, alpha_adv, alphai)
+        phi_i1_n = jnp.where(code_n == 0, phi_i, phi_i1)
+        return (ci + 1, alphai_n, alphai1_n, phi_i1_n, alphak_n, code_n,
+                aj_n, bj_n)
+
+    z = jnp.asarray(0.0, dtype)
+    ci, alphai, alphai1, phi_i1, alphak, code, aj, bj = jax.lax.while_loop(
+        p1_cond, p1_body,
+        (jnp.asarray(1, jnp.int32), jnp.asarray(alpha1, dtype), z, phi_0,
+         jnp.asarray(1.0, dtype), jnp.asarray(0, jnp.int32), z, z))
+
+    # --- phase 2: zoom (linesearch_zoom:211-284), only when code == 2
+    def p2_cond(s):
+        cj, aj, bj, alphaj, found = s
+        return (cj < 10) & ~found
+
+    def p2_body(s):
+        cj, aj, bj, alphaj, found = s
+        alphaj_n = cubic(aj + t2 * (bj - aj), bj - t3 * (bj - aj))
+        phi_j = phi(alphaj_n)
+        phi_aj = phi(aj)
+        no_suff = (phi_j > phi_0 + rho * alphaj_n * gphi_0) \
+            | (phi_j >= phi_aj)
+        gphi_j = dphi(alphaj_n)
+        term_round = (aj - alphaj_n) * gphi_j <= 1e-9  # Fletcher pp.38
+        term_curv = jnp.abs(gphi_j) <= -sigma * gphi_0
+        found_n = ~no_suff & (term_round | term_curv)
+        # bracket update
+        bj_n = jnp.where(no_suff, alphaj_n,
+                         jnp.where(gphi_j * (bj - aj) >= 0.0, aj, bj))
+        aj_n = jnp.where(no_suff, aj, alphaj_n)
+        return cj + 1, aj_n, bj_n, alphaj_n, found_n
+
+    _, _, _, alphaj, _ = jax.lax.while_loop(
+        p2_cond, p2_body,
+        (jnp.asarray(0, jnp.int32), aj, bj, jnp.asarray(1.0, dtype),
+         code != 2))
+
+    alpha_out = jnp.where(code == 1, alphak,
+                          jnp.where(code == 2, alphaj, alphai))
+    # degenerate slope: hand back mu (caller's bad-alpha check stops the
+    # iteration, matching the reference's !isnormal(mu) early return)
+    return jnp.where(jnp.isfinite(mu) & (jnp.abs(mu) > 0), alpha_out, mu)
+
+
 class _IterState(NamedTuple):
     x: jax.Array
     g: jax.Array
@@ -119,7 +251,7 @@ class _IterState(NamedTuple):
 
 
 def _lbfgs_loop(cost_func, grad_func, x0, mem0: LBFGSMemory, itmax: int,
-                stochastic: bool):
+                stochastic: bool, force_backtrack: bool = False):
     g0 = grad_func(x0)
 
     def cond(s: _IterState):
@@ -147,7 +279,15 @@ def _lbfgs_loop(cost_func, grad_func, x0, mem0: LBFGSMemory, itmax: int,
                 batch_changed, upd, lambda m: (m, s.alphabar), mem)
 
         pk = -mult_hessian(s.g, mem)
-        alphak = linesearch_backtrack(cost_func, s.x, pk, s.g, alphabar)
+        if stochastic or force_backtrack:
+            # production stochastic path uses Armijo backtracking
+            # (lbfgs.c:444 linesearch_backtrack)
+            alphak = linesearch_backtrack(cost_func, s.x, pk, s.g, alphabar)
+        else:
+            # full-batch path uses the Fletcher search with the
+            # reference's parameters (lbfgs.c:572)
+            alphak = linesearch_fletcher(cost_func, grad_func, s.x, pk,
+                                         gk=s.g)
         bad_alpha = ~jnp.isfinite(alphak) | (jnp.abs(alphak) < 1e-12)
         x1 = s.x + alphak * pk
         g1 = grad_func(x1)
@@ -186,11 +326,16 @@ def _lbfgs_loop(cost_func, grad_func, x0, mem0: LBFGSMemory, itmax: int,
     return out.x, out.mem
 
 
-def lbfgs_fit(cost_func, grad_func, p0, itmax: int = 20, M: int = 7):
-    """Full-batch LBFGS (lbfgs_fit, lbfgs.c:933): fresh memory each call."""
+def lbfgs_fit(cost_func, grad_func, p0, itmax: int = 20, M: int = 7,
+              linesearch: str = "fletcher"):
+    """Full-batch LBFGS (lbfgs_fit, lbfgs.c:933): fresh memory each call.
+
+    ``linesearch``: "fletcher" (reference full-batch default) or
+    "backtrack" (Armijo)."""
     mem = lbfgs_memory_init(p0.shape[0], M, p0.dtype)
     x, _ = _lbfgs_loop(cost_func, grad_func, p0, mem, itmax,
-                       stochastic=False)
+                       stochastic=False,
+                       force_backtrack=(linesearch == "backtrack"))
     return x
 
 

@@ -139,7 +139,8 @@ class BandSolverOutputs(NamedTuple):
 
 def make_band_solver(dsky, n_stations: int, chunk_idx, chunk_mask,
                      fdelta_chan: float, nu: float, max_lbfgs: int,
-                     consensus: bool, dobeam: int = 0):
+                     consensus: bool, dobeam: int = 0,
+                     loss: str = "robust"):
     """Build the jitted per-(band, minibatch) robust LBFGS solve.
 
     Parity: ``bfgsfit_minibatch_visibilities`` (plain) /
@@ -167,7 +168,14 @@ def make_band_solver(dsky, n_stations: int, chunk_idx, chunk_mask,
             p = pflat.reshape(M, kmax, n_stations, 8)
             J = ne.jones_r2c(p)
             r = (x8F - model8_multifreq(J, coh, sta1, sta2, cidx)) * wtF
-            c = jnp.sum(jnp.log1p(r * r / nu))
+            if loss == "huber":
+                # Huber threshold-nu loss (func_huber_th,
+                # robust_batchmode_lbfgs.c:66): r^2 inside, linear outside
+                a = jnp.abs(r)
+                c = jnp.sum(jnp.where(a <= nu, r * r,
+                                      2.0 * nu * a - nu * nu))
+            else:
+                c = jnp.sum(jnp.log1p(r * r / nu))
             if consensus:
                 # augmented Lagrangian (robust_batchmode_lbfgs.c:1504):
                 # y^T(p - BZ) + rho/2 ||p - BZ||^2 per effective cluster
@@ -313,7 +321,12 @@ class _StochasticRunner:
                      x.reshape(self.bmb, self.fpad, 4).imag],
                     -1).reshape(self.bmb, self.fpad, 8)
                 wtF = np.zeros((self.bmb, self.fpad, 8), np.float32)
-                wtF[:nrow, :nc] = np.where(good[..., None], 1.0, 0.0)
+                ok = np.broadcast_to(good, (nrow, nc))
+                if tile.cflags is not None:
+                    # per-channel flags (incl. rows flagged in a subset
+                    # of a MultiSimMS merge) zero those channels' weights
+                    ok = ok & (tile.cflags[sel, c0:c0 + nc] == 0)
+                wtF[:nrow, :nc] = np.where(ok[..., None], 1.0, 0.0)
                 freqsF = np.full(self.fpad, self.freqs[c0], np.float64)
                 freqsF[:nc] = self.freqs[c0:c0 + nc]
                 self._tile_inputs[(nmb, b)] = (
@@ -435,7 +448,7 @@ def run_minibatch(cfg: RunConfig, log=print):
     solver = make_band_solver(
         rn.dsky, rn.n, rn.cidx, rn.cmask, rn.fdelta_chan,
         nu=cfg.robust_nulow, max_lbfgs=cfg.max_lbfgs, consensus=False,
-        dobeam=rn.dobeam)
+        dobeam=rn.dobeam, loss=cfg.stochastic_loss)
 
     pinit, pfreq = rn.initial_p()
     mems = [lbfgs_mod.lbfgs_memory_init(rn.nparam, cfg.lbfgs_m)
@@ -504,7 +517,7 @@ def run_minibatch_consensus(cfg: RunConfig, log=print):
     solver = make_band_solver(
         rn.dsky, rn.n, rn.cidx, rn.cmask, rn.fdelta_chan,
         nu=cfg.robust_nulow, max_lbfgs=cfg.max_lbfgs, consensus=True,
-        dobeam=rn.dobeam)
+        dobeam=rn.dobeam, loss=cfg.stochastic_loss)
 
     pinit, pfreq = rn.initial_p()
     mems = [lbfgs_mod.lbfgs_memory_init(rn.nparam, cfg.lbfgs_m)

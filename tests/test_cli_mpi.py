@@ -78,3 +78,39 @@ def test_discover_datasets_glob(tmp_path):
     assert len(got) == 2
     with pytest.raises(FileNotFoundError):
         cli_mpi.discover_datasets(str(tmp_path / "nope*.ms"))
+
+
+def test_mpi_cli_per_channel_flags(tmp_path):
+    """A garbage channel that is per-channel FLAGGED must be excluded
+    from the solve input via the native pack path (VERDICT weak item:
+    cli_mpi previously averaged over flagged channels)."""
+    sky_path, clus_path, paths, sky = make_subbands(tmp_path, nf=2)
+    # widen every subband to 3 channels (the mesh program needs a uniform
+    # channel count); corrupt + per-channel-flag channel 0 of subband 0
+    import json, os
+    for k, p in enumerate(paths):
+        msx = ds.SimMS(p)
+        for i, t in msx.tiles():
+            t.x = np.repeat(t.x, 3, axis=1)
+            t.freqs = np.repeat(t.freqs, 3)
+            if k == 0:
+                t.x[:, 0] = 1e6 * (1 + 1j)    # garbage channel
+                cf = np.zeros((t.nrows, 3), np.uint8)
+                cf[:, 0] = 1                  # ... but flagged
+                t.cflags = cf
+            msx.write_tile(i, t)
+        msx.meta["freqs"] = [msx.meta["freqs"][0]] * 3
+        with open(os.path.join(p, "meta.json"), "w") as f:
+            json.dump(msx.meta, f)
+
+    listfile = tmp_path / "mslist.txt"
+    listfile.write_text("\n".join(paths) + "\n")
+    rc = cli_mpi.main([
+        "-f", str(listfile), "-s", str(sky_path), "-c", str(clus_path),
+        "-A", "3", "-P", "2", "-Q", "2", "-r", "2",
+        "-e", "2", "-l", "6", "-m", "4", "-j", "0", "-t", "3"])
+    assert rc == 0
+    # with the garbage channel excluded the residual must be small;
+    # averaging it in would leave residuals ~ 3e5
+    res = np.abs(ds.SimMS(paths[1]).read_tile(0).x).mean()
+    assert res < 1.0, res

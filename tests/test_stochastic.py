@@ -153,3 +153,49 @@ def test_cli_dispatch_stochastic(simdir, monkeypatch):
     cli.main(["-d", msdir, "-s", sky_path, "-c", clus_path, "-N", "1",
               "-A", "2", "-w", "2"])
     assert "mbc" in called
+
+
+def test_huber_loss_band_solver(simdir):
+    """Huber loss option (func_huber_th, robust_batchmode_lbfgs.c:66):
+    converges on the minibatch problem and differs from the Student's-t
+    trajectory."""
+    from sagecal_tpu import stochastic as st
+    from sagecal_tpu.solvers import lbfgs as lbfgs_mod
+
+    tmp, msdir, sky_path, clus_path, Jt = simdir
+    ms = ds.SimMS(msdir)
+    meta = ms.meta
+    sky = skymodel.read_sky_cluster(sky_path, clus_path, meta["ra0"],
+                                    meta["dec0"], meta["freq0"])
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    tile = ms.read_tile(0)
+    kmax = int(sky.nchunk.max())
+    cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
+    cidx = rp.chunk_indices(tile.tilesz, tile.nbase, sky.nchunk)
+    fdelta_chan = tile.fdelta / len(tile.freqs)
+    nchan = len(tile.freqs)
+    x8F = np.stack([tile.x.reshape(tile.nrows, nchan, 4).real,
+                    tile.x.reshape(tile.nrows, nchan, 4).imag],
+                   -1).reshape(tile.nrows, nchan, 8)
+    wtF = np.broadcast_to((tile.flags == 0)[:, None, None],
+                          x8F.shape).astype(float)
+    tslot = ds.row_tslot(tile.nrows, tile.nbase)
+    nparam = sky.n_clusters * kmax * 8 * 8
+    p0 = np.zeros((sky.n_clusters, kmax, 8, 8))
+    p0[..., 0] = p0[..., 6] = 1.0
+
+    outs = {}
+    for loss in ("robust", "huber"):
+        solver = st.make_band_solver(dsky, 8, cidx, cmask, fdelta_chan,
+                                     nu=2.0, max_lbfgs=12, consensus=False,
+                                     loss=loss)
+        mem = lbfgs_mod.lbfgs_memory_init(nparam, 7, jnp.float64)
+        out = solver(jnp.asarray(x8F), jnp.asarray(tile.u),
+                     jnp.asarray(tile.v), jnp.asarray(tile.w),
+                     jnp.asarray(tile.sta1), jnp.asarray(tile.sta2),
+                     jnp.asarray(wtF), jnp.asarray(tile.freqs),
+                     jnp.asarray(tslot), jnp.asarray(p0), mem)
+        outs[loss] = out
+        assert float(out.res_1) < 0.5 * float(out.res_0), loss
+    assert not np.allclose(np.asarray(outs["robust"].p),
+                           np.asarray(outs["huber"].p))

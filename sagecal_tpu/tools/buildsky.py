@@ -212,6 +212,57 @@ def fit_island(l, m, x, bmaj, bmin, bpa, maxfits: int = 10,
 # post-processing
 # ---------------------------------------------------------------------------
 
+def convex_hull(l, m):
+    """Convex hull of island pixels in (l, m) — Andrew's monotone chain.
+
+    Capability parity with construct_boundary/hull.c (the reference uses a
+    stack-based Graham scan); the hull bounds each island for annotation
+    output and diagnostics.  Returns [H, 2] vertex array in CCW order.
+    """
+    pts = np.unique(np.stack([np.asarray(l, float),
+                              np.asarray(m, float)], axis=1), axis=0)
+    if len(pts) <= 2:
+        return pts
+    pts = pts[np.lexsort((pts[:, 1], pts[:, 0]))]
+
+    def cross(o, a, b):
+        return ((a[0] - o[0]) * (b[1] - o[1])
+                - (a[1] - o[1]) * (b[0] - o[0]))
+
+    lower, upper = [], []
+    for p in pts:
+        while len(lower) >= 2 and cross(lower[-2], lower[-1], p) <= 0:
+            lower.pop()
+        lower.append(p)
+    for p in pts[::-1]:
+        while len(upper) >= 2 and cross(upper[-2], upper[-1], p) <= 0:
+            upper.pop()
+        upper.append(p)
+    return np.asarray(lower[:-1] + upper[:-1])
+
+
+def add_guard_pixels(xs, ys, l, m, x, img, threshold: float = 0.0):
+    """Bounding-grid guard pixels (add_guard_pixels, buildsky.c:972-1260):
+    every (x, y) on the island's x-coords x y-coords grid that is not an
+    island pixel is appended with flux = min(island flux) * threshold
+    (zero with the default threshold), anchoring the fit floor just
+    outside the island. Returns extended (l, m, x)."""
+    xs = np.asarray(xs)
+    ys = np.asarray(ys)
+    ux, uy = np.unique(xs), np.unique(ys)
+    have = set(zip(xs.tolist(), ys.tolist()))
+    gx, gy = np.meshgrid(ux, uy, indexing="ij")
+    gxy = np.stack([gx.ravel(), gy.ravel()], axis=1)
+    new = np.array([p for p in gxy if (int(p[0]), int(p[1])) not in have],
+                   dtype=float)
+    if len(new) == 0:
+        return l, m, x
+    gl, gm = img.pixel_to_lm(new[:, 0], new[:, 1])
+    gflux = np.full(len(new), float(np.min(x)) * threshold)
+    return (np.concatenate([l, gl]), np.concatenate([m, gm]),
+            np.concatenate([x, gflux]))
+
+
 def sidelobe_score(l, m, x):
     """Eigen-ratio sidelobe statistic (filter_pixels, buildsky.c:1460-1536):
     W0/(W1*peak*mean) — large for elongated faint islands."""
@@ -370,13 +421,25 @@ def write_cluster_file(path, sources, labels, nchunk: int = 1):
             f.write(f"{new_id} {nchunk} {names}\n")
 
 
-def write_ds9_regions(path, sources):
-    """annotate.py equivalent: ds9 region file."""
+def write_ds9_regions(path, sources, hulls=None, img=None):
+    """annotate.py equivalent: ds9 region file; island convex-hull
+    boundary polygons when ``hulls`` (isl -> [H, 2] lm vertices) and the
+    image (for lm -> ra/dec) are given (the reference draws hull
+    boundaries in its annotations, buildsky.c:826-850)."""
     with open(path, "w") as f:
         f.write("# Region file format: DS9\nfk5\n")
         for s in sources:
             f.write(f'circle({math.degrees(s.ra):.6f},'
                     f'{math.degrees(s.dec):.6f},30") # text={{{s.name}}}\n')
+        if hulls and img is not None:
+            for isl, hv in sorted(hulls.items()):
+                if len(hv) < 3:
+                    continue
+                ra, dec = img.lm_to_radec(hv[:, 0], hv[:, 1])
+                pts = ",".join(f"{math.degrees(r):.6f},"
+                               f"{math.degrees(d):.6f}"
+                               for r, d in zip(ra, dec))
+                f.write(f"polygon({pts}) # text={{island {isl}}}\n")
 
 
 # ---------------------------------------------------------------------------
@@ -389,7 +452,8 @@ def build_sky_single(img: fitsio.FitsImage, mask: np.ndarray,
                      maxfits: int = 10, wcutoff: float = 0.0,
                      merge_rd: float = 0.0, unique: str = "",
                      ignore: set | None = None, donegative: bool = False,
-                     scaleflux: bool = False, log=print):
+                     scaleflux: bool = False, guard: bool = False,
+                     log=print):
     """Single-image buildsky: returns (sources, sidelobe_ids)."""
     islands = label_islands(mask)
     bmaj = img.bmaj / 2 if img.bmaj else 0.001     # internal half-FWHM
@@ -397,6 +461,7 @@ def build_sky_single(img: fitsio.FitsImage, mask: np.ndarray,
     beam_pix = math.pi * bmaj * bmin / abs(img.cdelt1 * img.cdelt2)
     sources = []
     sidelobes = []
+    hulls = {}
     for isl, (ys, xs) in sorted(islands.items()):
         if ignore and isl in ignore:
             continue
@@ -411,7 +476,18 @@ def build_sky_single(img: fitsio.FitsImage, mask: np.ndarray,
         if wcutoff > 0 and len(x) > 2:
             if sidelobe_score(l, m, x) > wcutoff:
                 sidelobes.append(isl)
-        ll, mm, sI = fit_island(l, m, x, bmaj, bmin, img.bpa,
+        if len(x) > 2:
+            hulls[isl] = convex_hull(l, m)
+        if guard:
+            # zero-floor guard ring on the island bounding grid
+            # (add_guard_pixels, buildsky.c:1325) — opt-in: it anchors
+            # extended-island fits but biases the AIC toward extra
+            # components on compact islands
+            lf, mf, xf = add_guard_pixels(xs, ys, l, m, x, img,
+                                          threshold=threshold)
+        else:
+            lf, mf, xf = l, m, x
+        ll, mm, sI = fit_island(lf, mf, xf, bmaj, bmin, img.bpa,
                                 maxfits=maxfits, maxiter=maxiter,
                                 maxemiter=maxemiter, use_em=use_em)
         if merge_rd > 0 and len(ll) > 1:
@@ -436,7 +512,7 @@ def build_sky_single(img: fitsio.FitsImage, mask: np.ndarray,
     if sidelobes:
         log(f"probable sidelobe islands ({wcutoff}): "
             + " ".join(map(str, sidelobes)))
-    return sources, sidelobes
+    return sources, sidelobes, hulls
 
 
 def build_sky_multifreq(imgs: list, mask: np.ndarray, log=print, **kw):
@@ -453,9 +529,10 @@ def build_sky_multifreq(imgs: list, mask: np.ndarray, log=print, **kw):
         dec0=ref.dec0, crpix1=ref.crpix1, crpix2=ref.crpix2,
         cdelt1=ref.cdelt1, cdelt2=ref.cdelt2, bmaj=ref.bmaj,
         bmin=ref.bmin, bpa=ref.bpa, freq=float(freqs.mean()))
-    sources, sidelobes = build_sky_single(mean_img, mask, log=log, **kw)
+    sources, sidelobes, hulls = build_sky_single(mean_img, mask, log=log,
+                                                 **kw)
     if not sources:
-        return sources, sidelobes
+        return sources, sidelobes, hulls
     f0 = float(freqs.mean())
     bmaj, bmin = mean_img.bmaj / 2 or 0.001, mean_img.bmin / 2 or 0.001
     sb, cb = math.sin(mean_img.bpa), math.cos(mean_img.bpa)
@@ -490,7 +567,7 @@ def build_sky_multifreq(imgs: list, mask: np.ndarray, log=print, **kw):
             s.sP1 = float(coeff[2]) if order >= 2 else 0.0
             s.sP2 = float(coeff[3]) if order >= 3 else 0.0
         s.f0 = f0
-    return sources, sidelobes
+    return sources, sidelobes, hulls
 
 
 def build_parser():
@@ -519,6 +596,9 @@ def build_parser():
     a("-s", "--unique", default="")
     a("-N", "--negative", action="store_true")
     a("-q", "--scaleflux", type=int, default=0)
+    a("-G", "--guard", action="store_true",
+      help="add bounding-grid guard pixels at flux=min*threshold "
+           "(reference add_guard_pixels; biases AIC on compact islands)")
     a("-O", "--output", default=None, help="output basename")
     return p
 
@@ -533,7 +613,8 @@ def main(argv=None) -> int:
     if args.ignorelist:
         with open(args.ignorelist) as f:
             ignore = {int(t) for line in f for t in line.split()}
-    kw = dict(threshold=args.threshold, maxiter=args.maxiter,
+    kw = dict(guard=args.guard,
+              threshold=args.threshold, maxiter=args.maxiter,
               maxemiter=args.maxemiter, use_em=not args.no_em,
               maxfits=args.maxfits, wcutoff=args.wcutoff,
               merge_rd=args.merge, unique=args.unique, ignore=ignore,
@@ -550,11 +631,12 @@ def main(argv=None) -> int:
     if args.fits_dir:
         paths = sorted(glob.glob(os.path.join(args.fits_dir, "*.fits")))
         imgs = [override_beam(fitsio.read_fits(p)) for p in paths]
-        sources, _ = build_sky_multifreq(imgs, maskimg.data, **kw)
+        sources, _, hulls = build_sky_multifreq(imgs, maskimg.data, **kw)
+        img = imgs[0]
         base = args.output or (paths[0] + ".sky.txt")
     else:
         img = override_beam(fitsio.read_fits(args.image))
-        sources, _ = build_sky_single(img, maskimg.data, **kw)
+        sources, _, hulls = build_sky_single(img, maskimg.data, **kw)
         base = args.output or (args.image + ".sky.txt")
 
     write_lsm(base, sources, fmt=args.format)
@@ -562,7 +644,7 @@ def main(argv=None) -> int:
         np.array([s.l for s in sources]), np.array([s.m for s in sources]),
         np.array([s.sI for s in sources]), args.clusters)
     write_cluster_file(base + ".cluster", sources, labels)
-    write_ds9_regions(base + ".reg", sources)
+    write_ds9_regions(base + ".reg", sources, hulls=hulls, img=img)
     print(f"wrote {base} (+.cluster, +.reg): {len(sources)} sources, "
           f"{labels.max() + 1 if len(labels) else 0} clusters")
     return 0

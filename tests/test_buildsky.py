@@ -149,10 +149,56 @@ def test_buildsky_multifreq_spectral(tmp_path):
         flux = 3.0 * (f / 150e6) ** si_true
         imgs.append(make_image([(ls, ms, flux)], freq=f))
     mask = (imgs[1].data > 0.2).astype(float)
-    sources, _ = bs.build_sky_multifreq(imgs, mask)
+    sources, _, _ = bs.build_sky_multifreq(imgs, mask)
     assert len(sources) == 1
     s = sources[0]
     f0 = np.mean(f0s)
     np.testing.assert_allclose(s.sI, 3.0 * (f0 / 150e6) ** si_true,
                                rtol=0.02)
     np.testing.assert_allclose(s.sP, si_true, atol=0.05)
+
+
+def test_convex_hull_and_guard_pixels():
+    """Hull vertices bound the island; guard pixels fill the bounding
+    grid with the zero floor (hull.c / add_guard_pixels parity)."""
+    from sagecal_tpu.tools import buildsky as bs
+
+    # L-shaped island
+    xs = np.array([5, 6, 7, 5, 5])
+    ys = np.array([5, 5, 5, 6, 7])
+    l = xs * 0.01
+    m = ys * 0.01
+    x = np.array([1.0, 2.0, 1.5, 0.8, 0.6])
+    hull = bs.convex_hull(l, m)
+    assert 3 <= len(hull) <= 5
+
+    def inside(p, hull):
+        n = len(hull)
+        sgn = 0
+        for i in range(n):
+            a, b = hull[i], hull[(i + 1) % n]
+            c = ((b[0] - a[0]) * (p[1] - a[1])
+                 - (b[1] - a[1]) * (p[0] - a[0]))
+            if abs(c) < 1e-15:
+                continue
+            if sgn == 0:
+                sgn = 1 if c > 0 else -1
+            elif (c > 0) != (sgn > 0):
+                return False
+        return True
+
+    for p in zip(l, m):
+        assert inside(p, hull)
+
+    class FakeImg:
+        def pixel_to_lm(self, xx, yy):
+            return np.asarray(xx) * 0.01, np.asarray(yy) * 0.01
+
+    lg, mg, xg = bs.add_guard_pixels(xs, ys, l, m, x, FakeImg())
+    # bounding grid is 3x3 = 9 points, island covers 5 -> 4 guards
+    assert len(lg) == 9 and len(xg) == 9
+    assert np.all(xg[5:] == 0.0)      # zero floor at default threshold
+    # guard flux scales with min island flux and threshold
+    lg2, mg2, xg2 = bs.add_guard_pixels(xs, ys, l, m, x, FakeImg(),
+                                        threshold=0.5)
+    assert np.allclose(xg2[5:], 0.5 * x.min())

@@ -443,6 +443,12 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     total_iter = M * config.max_iter
     iter_bar = int(-(-0.8 * total_iter // M))
 
+    # max_emiter drives only THIS host loop; strip it from the static
+    # config handed to the jitted programs so the first-tile EM boost
+    # (pipeline.py) reuses the compiled per-cluster/sweep/refine programs
+    # instead of compiling a second identical set.
+    dev_config = config._replace(max_emiter=0)
+
     os_ids, os_nsub = (None, 0) if os_id is None else \
         (jnp.asarray(os_id[0]), int(os_id[1]))
     xres, res_0 = _jit_prelude(x8, coh, sta1, sta2, jnp.asarray(chunk_idx),
@@ -474,7 +480,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                 J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                 wt_base, nerr, jnp.asarray(weighted), jnp.asarray(last),
                 kci, jnp.asarray(order, jnp.int32), os_ids,
-                n_stations, config, total_iter, iter_bar, os_nsub)
+                n_stations, dev_config, total_iter, iter_bar, os_nsub)
         else:
             t_sweep = time.perf_counter()
             nerr_acc = jnp.zeros((M,), dtype)
@@ -484,7 +490,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                     nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                     wt_base, nerr, jnp.asarray(weighted),
                     jnp.asarray(last), kci, None, os_ids,
-                    n_stations, config, total_iter, iter_bar, os_nsub)
+                    n_stations, dev_config, total_iter, iter_bar, os_nsub)
             jax.block_until_ready(J)
             # the fused program does the same work minus dispatch overhead,
             # so a 25 s per-cluster sweep bounds it well under the ~60 s
@@ -496,7 +502,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     mean_nu = jnp.clip(jnp.mean(nuM), config.nulow, config.nuhigh)
     if config.max_lbfgs > 0:
         J, res_1 = _jit_refine(x8, coh, sta1, sta2, chunk_idx, J, wt_base,
-                               mean_nu, n_stations, config, robust)
+                               mean_nu, n_stations, dev_config, robust)
     else:
         res_1 = _jit_res(x8, coh, sta1, sta2, chunk_idx, J, wt_base)
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,

@@ -98,7 +98,7 @@ def manifold_average_mesh(Y_r8, axis_name: str, nf_total: int, m: int,
 def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                      fdelta: float, B_poly: np.ndarray, cfg: ADMMConfig,
                      mesh: Mesh, nf_total: int, with_shapelets: bool = False,
-                     spatial_coords=None):
+                     spatial_coords=None, host_loop: bool = False):
     """Build the jitted per-timeslot consensus-ADMM program.
 
     Returns ``run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F_r8)`` operating
@@ -111,6 +111,10 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
     spatial_coords: ([Mt] r, [Mt] theta) per-effective-cluster polar
     centroids (spatial.cluster_polar_coords) — required when
     cfg.spatialreg is set.
+    host_loop: run the ADMM iteration loop on the host, one bounded
+    jitted execution per iteration (identical math; required on the
+    tunneled single chip whose runtime kills long executions, and
+    cheaper to compile: the scan body becomes a reusable program).
     """
     from sagecal_tpu.consensus import spatial as sp
     from sagecal_tpu.rime import predict as rp
@@ -174,78 +178,78 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
 
     axis = "freq"
 
-    def admm_program(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
-        # shapes here are the LOCAL shard: [Fl, ...]
-        Fl = x8F.shape[0]
-        # per-subband basis rows: gather local rows from the replicated Bfull
-        # via the global subband index of each local row
+    def _brow(Fl):
+        # per-subband basis rows: gather local rows from the replicated
+        # Bfull via the global subband index of each local row
         dev_idx = jax.lax.axis_index(axis)
         local_ids = dev_idx * Fl + jnp.arange(Fl)
-        Brow = Bfull[local_ids]                  # [Fl, P]
+        return Bfull[local_ids]                  # [Fl, P]
 
+    # rho for ALL subbands (for Bii): [M, F]
+    def all_rho(rhoF):
+        g = jax.lax.all_gather(rhoF, axis)       # [ndev, Fl, M]
+        return g.reshape(-1, M).T                # [M, F]
+
+    def _alpha_vec(rho_m, dtype):
+        if spat is None:
+            return None
+        # per-cluster alpha scaled by initial rho, =alpha at max rho
+        # (sagecal_master.cpp:577-579; matters with a -G rho file)
+        return (cfg.federated_alpha * rho_m
+                / jnp.maximum(jnp.max(rho_m), 1e-30)).astype(dtype)
+
+    def z_update(Brow, YF, rhoF, alpha_vec, Zbar=None, Xd=None):
+        """z = sum_f B_f Y_f where YF already holds Y + rho J as sent
+        to the master (slave :686-700); Z = Bii z (master :755-779).
+        With spatial reg the prior pulls in: z += alpha Zbar - X and
+        Bii gains the federated +alpha I (master :668-673,:768-775)."""
+        zsum_local = jnp.einsum("fp,fmknr->mpknr", Brow, YF)
+        zsum = jax.lax.psum(zsum_local, axis)
+        if Zbar is not None:
+            # alphak[cm] Zbar - X (master :768-775)
+            zsum = zsum + alpha_vec[:, None, None, None, None] * Zbar - Xd
+        Bii = cpoly.find_prod_inverse(
+            Bfull, all_rho(rhoF).astype(YF.dtype), alpha=alpha_vec)
+        return cpoly.z_from_contributions(zsum, Bii)
+
+    def spatial_step(Z, Zbar, Xd, dtype):
+        """FISTA prox + Zbar/X refresh (master :789-814):
+        Zbar <- Zspat Phi from the FISTA solve on Z; X += alpha(Z-Zbar).
+        All replicated ops."""
+        from sagecal_tpu.consensus import spatial as sp
+        Phi = jax.lax.complex(spat["Phi_ri"][..., 0],
+                              spat["Phi_ri"][..., 1])
+        Phikk = jax.lax.complex(spat["Phikk_ri"][..., 0],
+                                spat["Phikk_ri"][..., 1])
+        cdt = jnp.complex64 if dtype == jnp.float32 else jnp.complex128
+        Zb = sp.z_r8_to_blocks(Z).astype(cdt)       # [MK, 2PN, 2]
+        Zspat = sp.fista_spatialreg(Zb, Phikk.astype(cdt),
+                                    Phi.astype(cdt), spat["mu"],
+                                    spat["iters"])
+        Zbar_new = sp.blocks_to_z_r8(
+            sp.spatial_predict(Zspat, Phi.astype(cdt)),
+            M, Ppoly, K, N).astype(Z.dtype)
+        Xd_new = Xd + cfg.federated_alpha * (Z - Zbar_new)
+        return Zbar_new, Xd_new
+
+    def iter0_local(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
+        """ADMM iteration 0 on the LOCAL shard: plain solve + dual seed
+        + manifold average + first Z/dual update. Returns the loop carry
+        plus (res0, res1, Y0F)."""
+        Fl = x8F.shape[0]
+        Brow = _brow(Fl)
         # per-(subband, cluster) rho scaled by unflagged fraction; cfg.rho
         # may be a scalar or an [M] per-cluster array (readsky.c:780 -G)
         rho_m = jnp.broadcast_to(jnp.asarray(cfg.rho, x8F.dtype), (M,))
         rhoF = rho_m[None, :] * fratioF[:, None] * jnp.ones((Fl, M),
                                                             x8F.dtype)
-        rho_upper = rhoF
+        alpha_vec = _alpha_vec(rho_m, x8F.dtype)
 
-        # --- ADMM iteration 0: plain solve + dual seed + manifold average
         JF, res0, res1 = jax.vmap(local_solve_plain)(
             x8F, uF, vF, wF, wtF, J0F, freqF)
         YF = rhoF[..., None, None, None] * JF.reshape(Fl, M, K, N, 8)
         YF = manifold_average_mesh(YF, axis, nf_total, M, K, N,
                                    cfg.manifold_iters)
-
-        # rho for ALL subbands (for Bii): [M, F]
-        def all_rho(rhoF):
-            g = jax.lax.all_gather(rhoF, axis)       # [ndev, Fl, M]
-            return g.reshape(-1, M).T                # [M, F]
-
-        alpha_vec = None
-        if spat is not None:
-            # per-cluster alpha scaled by initial rho, =alpha at max rho
-            # (sagecal_master.cpp:577-579; matters with a -G rho file)
-            alpha_vec = (cfg.federated_alpha * rho_m
-                         / jnp.maximum(jnp.max(rho_m), 1e-30)
-                         ).astype(x8F.dtype)
-
-        def z_update(YF, rhoF, Zbar=None, Xd=None):
-            """z = sum_f B_f Y_f where YF already holds Y + rho J as sent
-            to the master (slave :686-700); Z = Bii z (master :755-779).
-            With spatial reg the prior pulls in: z += alpha Zbar - X and
-            Bii gains the federated +alpha I (master :668-673,:768-775)."""
-            zsum_local = jnp.einsum("fp,fmknr->mpknr", Brow, YF)
-            zsum = jax.lax.psum(zsum_local, axis)
-            if Zbar is not None:
-                # alphak[cm] Zbar - X (master :768-775)
-                zsum = zsum + alpha_vec[:, None, None, None, None] * Zbar \
-                    - Xd
-            Bii = cpoly.find_prod_inverse(
-                Bfull, all_rho(rhoF).astype(x8F.dtype), alpha=alpha_vec)
-            return cpoly.z_from_contributions(zsum, Bii)
-
-        def spatial_step(Z, Zbar, Xd):
-            """FISTA prox + Zbar/X refresh (master :789-814):
-            Zbar <- Zspat Phi from the FISTA solve on Z; X += alpha(Z-Zbar).
-            All replicated ops."""
-            from sagecal_tpu.consensus import spatial as sp
-            Phi = jax.lax.complex(spat["Phi_ri"][..., 0],
-                                  spat["Phi_ri"][..., 1])
-            Phikk = jax.lax.complex(spat["Phikk_ri"][..., 0],
-                                    spat["Phikk_ri"][..., 1])
-            cdt = jnp.complex64 if x8F.dtype == jnp.float32 \
-                else jnp.complex128
-            Zb = sp.z_r8_to_blocks(Z).astype(cdt)       # [MK, 2PN, 2]
-            Zspat = sp.fista_spatialreg(Zb, Phikk.astype(cdt),
-                                        Phi.astype(cdt), spat["mu"],
-                                        spat["iters"])
-            Zbar_new = sp.blocks_to_z_r8(
-                sp.spatial_predict(Zspat, Phi.astype(cdt)),
-                M, Ppoly, K, N).astype(Z.dtype)
-            Xd_new = Xd + cfg.federated_alpha * (Z - Zbar_new)
-            return Zbar_new, Xd_new
-
         Y0F = YF     # manifold-projected rho*J: the MDL input (:815-822)
 
         # spatial-reg state (replicated); zeros when disabled
@@ -253,62 +257,127 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         Xd = jnp.zeros_like(Zbar)
 
         # iteration 0 Z update: Y currently = rho*J (manifold-aligned)
-        Z = z_update(YF, rhoF)
+        Z = z_update(Brow, YF, rhoF, alpha_vec)
         if spat is not None:
             # admm==0 matches !(admm % cadence) (master :789)
-            Zbar, Xd = spatial_step(Z, Zbar, Xd)
+            Zbar, Xd = spatial_step(Z, Zbar, Xd, x8F.dtype)
         BZ = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
-        YF = YF - rhoF[..., None, None, None] * BZ   # dual update (slave :750)
+        YF = YF - rhoF[..., None, None, None] * BZ   # dual (slave :750)
 
-        Yhat_prev = YF
-        Jprev = JF.reshape(Fl, M, K, N, 8)
+        carry = (JF, YF, Z, rhoF, YF, JF.reshape(Fl, M, K, N, 8),
+                 Zbar, Xd, rhoF)
+        return carry, res0, res1, Y0F
+
+    def body_local(x8F, uF, vF, wF, freqF, wtF, carry, it):
+        """One ADMM iteration k>0 on the LOCAL shard (slave :686-770)."""
+        JF, YF, Z, rhoF, Yhat_prev, Jprev, Zbar, Xd, rho_upper = carry
+        Fl = x8F.shape[0]
+        Brow = _brow(Fl)
+        rho_m = jnp.broadcast_to(jnp.asarray(cfg.rho, x8F.dtype), (M,))
+        alpha_vec = _alpha_vec(rho_m, x8F.dtype)
+
+        BZ = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
+        Jr, r0, r1 = jax.vmap(local_solve_admm)(
+            x8F, uF, vF, wF, wtF, JF, freqF, YF, BZ, rhoF)
+        J5 = Jr.reshape(Fl, M, K, N, 8)
+        YF = YF + rhoF[..., None, None, None] * J5   # Y <- Y + rho J
+        Zold = Z
+        if spat is None:
+            Z = z_update(Brow, YF, rhoF, alpha_vec)
+        else:
+            Z = z_update(Brow, YF, rhoF, alpha_vec, Zbar, Xd)
+            Zbar, Xd = jax.lax.cond(
+                it % spat["cadence"] == 0,
+                lambda z, zb, xd: spatial_step(z, zb, xd, x8F.dtype),
+                lambda z, zb, xd: (zb, xd),
+                Z, Zbar, Xd)
+        BZn = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
+        # Yhat for BB rho uses BZ_old (slave :724-732, TAG_CONSENSUS_OLD)
+        Yhat = YF - rhoF[..., None, None, None] * jnp.einsum(
+            "fp,mpknr->fmknr", Brow, Zold)
+        YF = YF - rhoF[..., None, None, None] * BZn   # complete dual
+
+        if cfg.adaptive_rho:
+            rhoF = jax.vmap(
+                lambda r, ru, dy, dj: cpoly.update_rho_bb(
+                    r, ru, dy, dj, axes=(1, 2, 3))
+            )(rhoF, rho_upper, Yhat - Yhat_prev, J5 - Jprev)
+
+        dual = jnp.linalg.norm(Z - Zold) / np.sqrt(Z.size)
+        return (Jr, YF, Z, rhoF, Yhat, J5, Zbar, Xd, rho_upper), \
+            (r0, r1, dual)
+
+    def admm_program(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
+        # shapes here are the LOCAL shard: [Fl, ...]
+        carry, res0, res1, Y0F = iter0_local(x8F, uF, vF, wF, freqF, wtF,
+                                             fratioF, J0F)
 
         def body(carry, it):
-            JF, YF, Z, rhoF, Yhat_prev, Jprev, Zbar, Xd = carry
-            BZ = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
-            Jr, r0, r1 = jax.vmap(local_solve_admm)(
-                x8F, uF, vF, wF, wtF, JF, freqF,
-                YF, BZ, rhoF)
-            J5 = Jr.reshape(Fl, M, K, N, 8)
-            YF = YF + rhoF[..., None, None, None] * J5   # Y <- Y + rho J
-            Zold = Z
-            if spat is None:
-                Z = z_update(YF, rhoF)
-            else:
-                Z = z_update(YF, rhoF, Zbar, Xd)
-                Zbar, Xd = jax.lax.cond(
-                    it % spat["cadence"] == 0,
-                    lambda z, zb, xd: spatial_step(z, zb, xd),
-                    lambda z, zb, xd: (zb, xd),
-                    Z, Zbar, Xd)
-            BZn = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
-            # Yhat for BB rho uses BZ_old (slave :724-732, TAG_CONSENSUS_OLD)
-            Yhat = YF - rhoF[..., None, None, None] * jnp.einsum(
-                "fp,mpknr->fmknr", Brow, Zold)
-            YF = YF - rhoF[..., None, None, None] * BZn   # complete dual
+            return body_local(x8F, uF, vF, wF, freqF, wtF, carry, it)
 
-            if cfg.adaptive_rho:
-                rhoF = jax.vmap(
-                    lambda r, ru, dy, dj: cpoly.update_rho_bb(
-                        r, ru, dy, dj, axes=(1, 2, 3))
-                )(rhoF, rho_upper, Yhat - Yhat_prev, J5 - Jprev)
-
-            dual = jnp.linalg.norm(Z - Zold) / np.sqrt(Z.size)
-            return (Jr, YF, Z, rhoF, Yhat, J5, Zbar, Xd), (r0, r1, dual)
-
-        (JF, YF, Z, rhoF, _, _, Zbar, Xd), (r0s, r1s, duals) = jax.lax.scan(
-            body, (JF, YF, Z, rhoF, Yhat_prev, Jprev, Zbar, Xd),
-            jnp.arange(1, max(cfg.n_admm, 1)))
-
+        carry, (r0s, r1s, duals) = jax.lax.scan(
+            body, carry, jnp.arange(1, max(cfg.n_admm, 1)))
+        JF, YF, Z, rhoF = carry[0], carry[1], carry[2], carry[3]
         return JF, Z, rhoF, res0, res1, r1s, duals, Y0F
 
     from jax import shard_map
     spec_f = P(axis)
     spec_r = P()
-    prog = shard_map(
-        admm_program, mesh=mesh,
-        in_specs=(spec_f,) * 8,
-        out_specs=(spec_f, spec_r, spec_f, spec_f, spec_f,
-                   P(None, axis), spec_r, spec_f),
-        check_vma=False)
-    return jax.jit(prog)
+    if not host_loop:
+        prog = shard_map(
+            admm_program, mesh=mesh,
+            in_specs=(spec_f,) * 8,
+            out_specs=(spec_f, spec_r, spec_f, spec_f, spec_f,
+                       P(None, axis), spec_r, spec_f),
+            check_vma=False)
+        return jax.jit(prog)
+
+    # --- host-driven ADMM loop: one bounded device execution per ADMM
+    # iteration (the tunneled single-chip runtime kills executions over
+    # ~60 s; a fully traced n_admm-iteration program over folded subbands
+    # exceeds it — and this is also the natural structure for streaming
+    # telemetry per iteration, like the master's per-iter prints).
+    carry_specs = (spec_f, spec_f, spec_r, spec_f, spec_f, spec_f,
+                   spec_r, spec_r, spec_f)
+
+    def iter0_flat(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
+        carry, res0, res1, Y0F = iter0_local(x8F, uF, vF, wF, freqF, wtF,
+                                             fratioF, J0F)
+        return carry + (res0, res1, Y0F)
+
+    def body_flat(x8F, uF, vF, wF, freqF, wtF, JF, YF, Z, rhoF, Yhat,
+                  Jprev, Zbar, Xd, rho_upper, it):
+        carry = (JF, YF, Z, rhoF, Yhat, Jprev, Zbar, Xd, rho_upper)
+        carry, (r0, r1, dual) = body_local(x8F, uF, vF, wF, freqF, wtF,
+                                           carry, it)
+        return carry + (r0, r1, dual)
+
+    prog0 = jax.jit(shard_map(
+        iter0_flat, mesh=mesh, in_specs=(spec_f,) * 8,
+        out_specs=carry_specs + (spec_f, spec_f, spec_f),
+        check_vma=False))
+    progb = jax.jit(shard_map(
+        body_flat, mesh=mesh,
+        in_specs=(spec_f,) * 6 + carry_specs + (spec_r,),
+        out_specs=carry_specs + (spec_f, spec_f, spec_r),
+        check_vma=False))
+
+    def run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
+        out = prog0(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F)
+        carry, (res0, res1, Y0F) = out[:9], out[9:]
+        r1s, duals = [], []
+        for it in range(1, max(cfg.n_admm, 1)):
+            out = progb(x8F, uF, vF, wF, freqF, wtF, *carry,
+                        jnp.asarray(it, jnp.int32))
+            carry, (_, r1, dual) = out[:9], out[9:]
+            r1s.append(r1)
+            duals.append(dual)
+        JF, Z, rhoF = carry[0], carry[2], carry[3]
+        F = x8F.shape[0]
+        r1s_a = (jnp.stack(r1s) if r1s
+                 else jnp.zeros((0, F), x8F.dtype))
+        duals_a = (jnp.stack(duals) if duals
+                   else jnp.zeros((0,), x8F.dtype))
+        return JF, Z, rhoF, res0, res1, r1s_a, duals_a, Y0F
+
+    return run

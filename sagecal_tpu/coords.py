@@ -130,12 +130,30 @@ def precession_matrix(jd_tdb):
     ])
 
 
+def precess_radec_std(ra0, dec0, pmat):
+    """Precess (ra, dec) from J2000 by ``pmat`` = :func:`precession_matrix`
+    using the STANDARD spherical convention — parity with the production
+    path ``precess_source_locations`` (data.cpp:1473, casacore
+    Precession/MVDirection), which the pipeline calls once per run in
+    beam mode (fullbatch_mode.cpp:325)."""
+    pos1 = jnp.stack([
+        jnp.cos(ra0) * jnp.cos(dec0),
+        jnp.sin(ra0) * jnp.cos(dec0),
+        jnp.sin(dec0) * jnp.ones_like(ra0),
+    ])
+    pos2 = jnp.einsum("ij,j...->i...", pmat, pos1)
+    ra = jnp.arctan2(pos2[1], pos2[0])
+    dec = jnp.arcsin(jnp.clip(pos2[2], -1.0, 1.0))
+    return ra, dec
+
+
 def precess_radec(ra0, dec0, pmat):
     """Precess (ra, dec) from J2000 by ``pmat`` = :func:`precession_matrix`.
 
     Uses the reference's (nonstandard, colatitude-style) spherical unit
-    vector convention (transforms.c:266-289) so behavior matches
-    ``precess_source_locations`` exactly.
+    vector convention (transforms.c:266-289) so behavior matches the
+    transforms.c ``precession``/``precess_source_locations_deprecated``
+    path exactly; production code should use :func:`precess_radec_std`.
     """
     pos1 = jnp.stack([
         jnp.cos(ra0) * jnp.sin(dec0),

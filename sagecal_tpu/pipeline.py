@@ -27,7 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from sagecal_tpu import skymodel, utils
+from sagecal_tpu import coords, skymodel, utils
 from sagecal_tpu.config import RunConfig, SimulationMode, SolverMode
 from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.io import dataset as ds
@@ -90,24 +90,44 @@ class FullBatchPipeline:
         self.dobeam = int(cfg.beam_mode)
         self.beam_info = bm.resolve_beaminfo(self.dobeam, ms, meta, log=log)
         self._warned_no_times = False
-        # Pallas coherency kernel: point-only f32 models on a real TPU.
-        # The probe runs the PRODUCTION block configuration (same block_b
-        # and real source count) so VMEM/compile failures surface here,
-        # where we can fall back, not inside the jitted solve.
+        # precess source + beam-pointing coordinates from J2000 to the
+        # epoch of the first tile's mid timeslot, once per run
+        # (precess_source_locations data.cpp:1473, called at
+        # fullbatch_mode.cpp:325 only when the beam is on). Must happen
+        # BEFORE any solver trace: the device sky is closure-captured as
+        # jit constants.
+        self.precessed = False
+        if self.dobeam:
+            self._precess_sources(log)
+        # Pallas coherency kernel: point/gaussian f32 models on a real
+        # TPU; mixed models run hybrid (kernel + compact XLA rest,
+        # skymodel.split_for_pallas). The probe runs the PRODUCTION block
+        # configuration (same block_b and real source count) so
+        # VMEM/compile failures surface here, where we can fall back,
+        # not inside the jitted solve.
         self.use_pallas = False
+        self._pallas_skies = None
         if (platform not in ("cpu",) and not self.dobeam
                 and self.rdt == jnp.float32):
             from sagecal_tpu.ops import coh_pallas
-            if coh_pallas.supported(sky):
+            if coh_pallas.any_supported(sky):
+                sky_pg, sky_rest = skymodel.split_for_pallas(sky)
                 try:
+                    dsky_pg = rp.sky_to_device(sky_pg, self.rdt)
                     probe_b = min(1024, meta["tilesz"] * meta["nbase"])
                     z = jnp.zeros(probe_b, jnp.float32)
                     coh_pallas.coherencies(
-                        self.dsky, z, z, z,
+                        dsky_pg, z, z, z,
                         jnp.asarray([meta["freq0"]], jnp.float32),
                         meta["fdelta"]).block_until_ready()
                     self.use_pallas = True
-                    log("Pallas coherency kernel enabled")
+                    self._pallas_skies = (
+                        dsky_pg,
+                        None if sky_rest is None
+                        else rp.sky_to_device(sky_rest, self.rdt))
+                    log("Pallas coherency kernel enabled"
+                        + ("" if sky_rest is None
+                           else " (hybrid: shapelet/disk/ring via XLA)"))
                 except Exception as e:      # pragma: no cover - hw path
                     log(f"Pallas kernel unavailable ({type(e).__name__}); "
                         "using the XLA path")
@@ -127,7 +147,7 @@ class FullBatchPipeline:
         self._chan_residual_fn = None
         if cfg.per_channel_bfgs:
             self._chan_solver = self._build_chan_solver()
-            self._chan_residual_fn = jax.jit(self._chan_residual)
+            self._chan_residual_fn = self._build_chan_residual()
 
     # NOTE on jit boundaries: complex arrays cannot cross host<->device on
     # the axon TPU runtime, so solvers take/return Jones as [.., N, 8]
@@ -147,12 +167,18 @@ class FullBatchPipeline:
         # clmfit.c:1074); harmless to pass for other modes
         os_info = lm_mod.os_subset_ids(meta["tilesz"], meta["nbase"])
 
-        coh_fn = jax.jit(lambda u, v, w, sta1, sta2, beam: (
-            rp.coherencies(self.dsky, u, v, w,
-                           jnp.asarray([freq0], self.rdt),
-                           fdelta, beam=beam, dobeam=self.dobeam,
-                           tslot=tslot, sta1=sta1, sta2=sta2,
-                           use_pallas=self.use_pallas)[:, :, 0]))
+        if self.use_pallas:
+            pg, rest = self._pallas_skies
+            coh_fn = jax.jit(lambda u, v, w, sta1, sta2, beam: (
+                rp.coherencies_split(pg, rest, u, v, w,
+                                     jnp.asarray([freq0], self.rdt),
+                                     fdelta)[:, :, 0]))
+        else:
+            coh_fn = jax.jit(lambda u, v, w, sta1, sta2, beam: (
+                rp.coherencies(self.dsky, u, v, w,
+                               jnp.asarray([freq0], self.rdt),
+                               fdelta, beam=beam, dobeam=self.dobeam,
+                               tslot=tslot, sta1=sta1, sta2=sta2)[:, :, 0]))
 
         def solve(x8, u, v, w, sta1, sta2, wt, J0_r8, beam, tile_idx=0):
             # host-driven EM: one bounded device execution per cluster
@@ -168,6 +194,31 @@ class FullBatchPipeline:
                 J0, self.n, wt, config=scfg, os_id=os_info, key=key)
             return _jones_c2r_j(J), info
         return solve
+
+    def _precess_sources(self, log=print):
+        """Apply J2000 -> epoch-of-date precession to the device sky's
+        (ra, dec) and the beam pointing (data.cpp:1473 semantics: the
+        rotation is evaluated at the first tile's mid-timeslot JD)."""
+        import dataclasses
+        try:
+            t0 = self.ms.read_tile(0)
+        except Exception:
+            t0 = None
+        tj = None if t0 is None else t0.time_jd
+        if tj is None:
+            return      # placeholder-epoch warning fires in _tile_beam
+        jd = float(np.asarray(tj)[len(np.asarray(tj)) // 2])
+        pmat = coords.precession_matrix(jd)
+        ra_p, dec_p = coords.precess_radec_std(self.dsky.ra, self.dsky.dec,
+                                               pmat)
+        self.dsky = self.dsky._replace(ra=ra_p, dec=dec_p)
+        b_ra, b_dec = coords.precess_radec_std(
+            jnp.asarray(self.beam_info.ra0, self.rdt),
+            jnp.asarray(self.beam_info.dec0, self.rdt), pmat)
+        self.beam_info = dataclasses.replace(
+            self.beam_info, ra0=float(b_ra), dec0=float(b_dec))
+        self.precessed = True
+        log(f"Precessed source/beam coordinates to JD {jd:.5f}")
 
     def _tile_beam(self, tile):
         """Per-tile device beam tables (times change per tile)."""
@@ -208,28 +259,45 @@ class FullBatchPipeline:
         return self._residuals(J_r8, x_r, u, v, w, sta1, sta2, beam,
                                freqs=freq[None])
 
+    def _build_chan_residual(self):
+        """All channels' residuals in one program (vmap over channels)."""
+        return jax.jit(jax.vmap(
+            self._chan_residual,
+            in_axes=(0, 0, None, None, None, None, None, 0, None)))
+
     def _build_chan_solver(self):
         """Per-channel bandpass solve (-b 1, fullbatch_mode.cpp:442-488):
         LBFGS-only joint fit at ONE channel, warm-started from the joint
-        solution; used per channel with its own residual."""
+        solution. All channels are independent (each warm-starts from the
+        same joint p, fullbatch_mode.cpp:456 memcpy) so the whole channel
+        axis solves as ONE vmapped program instead of the reference's
+        sequential per-channel loop."""
         meta = self.ms.meta
         fdelta_chan = meta["fdelta"] / len(meta["freqs"])
         cidx = jnp.asarray(self.cidx)
         cmask = jnp.asarray(self.cmask)
         scfg = self.base_cfg._replace(max_lbfgs=self.cfg.max_lbfgs)
 
-        def solve(x8, u, v, w, sta1, sta2, wt, J0_r8, freq, beam):
-            coh = rp.coherencies(self.dsky, u, v, w, freq[None],
-                                 fdelta_chan, per_channel_flux=True,
-                                 beam=beam, dobeam=self.dobeam,
-                                 tslot=jnp.asarray(self.tslot),
-                                 sta1=sta1, sta2=sta2,
-                                 use_pallas=self.use_pallas)[:, :, 0]
+        def solve(x8, wt, freq, u, v, w, sta1, sta2, J0_r8, beam):
+            if self.use_pallas:
+                pg, rest = self._pallas_skies
+                coh = rp.coherencies_split(pg, rest, u, v, w, freq[None],
+                                           fdelta_chan,
+                                           per_channel_flux=True)[:, :, 0]
+            else:
+                coh = rp.coherencies(self.dsky, u, v, w, freq[None],
+                                     fdelta_chan, per_channel_flux=True,
+                                     beam=beam, dobeam=self.dobeam,
+                                     tslot=jnp.asarray(self.tslot),
+                                     sta1=sta1, sta2=sta2)[:, :, 0]
             J, info = sage.bfgsfit(x8, coh, sta1, sta2, cidx,
                                    ne.jones_r2c(J0_r8), self.n, wt,
                                    config=scfg, nu=self.cfg.robust_nulow)
             return ne.jones_c2r(J), info["res_0"], info["res_1"]
-        return jax.jit(solve)
+
+        return jax.jit(jax.vmap(
+            solve, in_axes=(0, 0, 0, None, None, None, None, None, None,
+                            None)))
 
     def initial_jones(self) -> np.ndarray:
         M = self.sky.n_clusters
@@ -311,42 +379,88 @@ class FullBatchPipeline:
 
             if cfg.per_channel_bfgs:
                 # -b 1: per-channel LBFGS re-solve + per-channel residual
-                # (fullbatch_mode.cpp:442-488); the last channel's
-                # solutions become the carried/written solutions
-                xout = np.array(tile.x)
+                # (fullbatch_mode.cpp:442-488). Channels are independent
+                # (each warm-starts from the same joint solution), so the
+                # whole channel axis runs as ONE vmapped solve + ONE
+                # vmapped residual program instead of a sequential loop.
+                # The last channel's solutions become the carried/written
+                # solutions (fullbatch_mode.cpp:485 memcpy).
                 J0c_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
                 flags_np = np.asarray(flags)
-                for ci_ch, fch in enumerate(tile.freqs):
+                F = len(tile.freqs)
+                Bn = tile.x.shape[0]
+                x8C = np.zeros((F, Bn, 8))
+                xC = np.zeros((F, Bn, 2, 2), np.complex128)
+                badC = np.zeros((F, Bn), bool)
+                for ci_ch in range(F):
                     xc = np.array(tile.x[:, ci_ch])
-                    # apply per-channel flags (same data the joint pack
-                    # path zeroes) + row flags
+                    # per-channel flags (same data the joint pack path
+                    # zeroes) + row flags
                     bad = flags_np == 1
                     if tile.cflags is not None:
                         bad = bad | (tile.cflags[:, ci_ch] != 0)
                     xc[bad] = 0.0
-                    x8c = jnp.asarray(utils.vis_to_x8(xc), self.rdt)
-                    if cfg.whiten:
-                        from sagecal_tpu.solvers import robust as rb
-                        x8c = rb.whiten_data(x8c, u, v, meta["freq0"])
-                    # channel-flagged rows carry zero weight in THIS
-                    # channel's solve (zeroed data must not pull the fit)
-                    wt_c = wt * jnp.asarray(~bad, self.rdt)[:, None]
-                    Jc_r8, _, _ = self._chan_solver(
-                        x8c, u, v, w, sta1, sta2, wt_c, J0c_r8,
-                        jnp.asarray(fch, self.rdt), tile_beam)
-                    if write_residuals:
-                        res_c = self._chan_residual_fn(
-                            Jc_r8,
-                            jnp.asarray(utils.c2r(xc[:, None]), self.rdt),
-                            u, v, w, sta1, sta2,
-                            jnp.asarray(fch, self.rdt), tile_beam)
-                        xout[:, ci_ch] = utils.r2c(
-                            np.asarray(res_c))[:, 0]
-                    J_last = Jc_r8
-                J = utils.jones_r2c_np(np.asarray(J_last))
+                    x8C[ci_ch] = utils.vis_to_x8(xc)
+                    xC[ci_ch] = xc
+                    badC[ci_ch] = bad
+                x8C_d = jnp.asarray(x8C, self.rdt)
+                if cfg.whiten:
+                    from sagecal_tpu.solvers import robust as rb
+                    x8C_d = jax.vmap(
+                        lambda x: rb.whiten_data(x, u, v, meta["freq0"])
+                    )(x8C_d)
+                # channel-flagged rows carry zero weight in THEIR
+                # channel's solve (zeroed data must not pull the fit)
+                wtC = wt[None] * jnp.asarray(~badC, self.rdt)[:, :, None]
+                freqsC = jnp.asarray(tile.freqs, self.rdt)
+                # blocks of channels: one vmapped execution per block so a
+                # wide band cannot exceed the tunneled chip's per-execution
+                # wall-clock kill; the last block is padded (zero weight)
+                # to keep one compiled program
+                CB = min(F, 16)
+                nblk = -(-F // CB)
+                Fp = nblk * CB
+                if Fp != F:
+                    padc = Fp - F
+                    x8C_d = jnp.concatenate(
+                        [x8C_d, jnp.zeros((padc,) + x8C_d.shape[1:],
+                                          x8C_d.dtype)])
+                    wtC = jnp.concatenate(
+                        [wtC, jnp.zeros((padc,) + wtC.shape[1:],
+                                        wtC.dtype)])
+                    freqsC = jnp.concatenate(
+                        [freqsC, jnp.full((padc,), freqsC[-1],
+                                          freqsC.dtype)])
+                JC_blocks, res_blocks = [], []
+                x_rC_full = None
                 if write_residuals:
-                    tile.x = xout.astype(np.complex128)
+                    x_rC_full = jnp.asarray(utils.c2r(xC[:, :, None]),
+                                            self.rdt)
+                    if Fp != F:
+                        x_rC_full = jnp.concatenate(
+                            [x_rC_full,
+                             jnp.zeros((Fp - F,) + x_rC_full.shape[1:],
+                                       x_rC_full.dtype)])
+                for blk in range(nblk):
+                    sl = slice(blk * CB, (blk + 1) * CB)
+                    JC_b, _, _ = self._chan_solver(
+                        x8C_d[sl], wtC[sl], freqsC[sl], u, v, w, sta1,
+                        sta2, J0c_r8, tile_beam)
+                    JC_blocks.append(np.asarray(JC_b))
+                    if write_residuals:
+                        res_b = self._chan_residual_fn(
+                            JC_b, x_rC_full[sl], u, v, w, sta1, sta2,
+                            freqsC[sl], tile_beam)
+                        res_blocks.append(np.asarray(res_b))
+                JC_r8 = np.concatenate(JC_blocks)[:F]
+                if write_residuals:
+                    resC = np.concatenate(res_blocks)[:F]
+                    # [F, B, 1, 2, 2] complex -> [B, F, 2, 2]
+                    tile.x = np.moveaxis(
+                        utils.r2c(resC)[:, :, 0], 0, 1
+                    ).astype(np.complex128)
                     ms.write_tile(ti, tile)
+                J = utils.jones_r2c_np(np.asarray(JC_r8[-1]))
                 if writer:
                     writer.write_interval(J, sky.nchunk)
             else:

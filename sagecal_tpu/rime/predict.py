@@ -184,8 +184,7 @@ def coherencies(sky: SkyArrays, u, v, w, freqs, fdelta,
                 per_channel_flux: bool = False,
                 with_shapelets: bool | None = None,
                 beam=None, dobeam: int = 0,
-                tslot=None, sta1=None, sta2=None,
-                use_pallas: bool = False):
+                tslot=None, sta1=None, sta2=None):
     """All-cluster coherencies [M, B, F, 2, 2] (no Jones applied).
 
     Equivalent of precalculate_coherencies[_multifreq] (predict.c:653/:890);
@@ -198,12 +197,6 @@ def coherencies(sky: SkyArrays, u, v, w, freqs, fdelta,
     multifreq, matching predict.c:943).
     ``with_shapelets`` defaults to auto-detect (static) from the model.
     """
-    if use_pallas and not dobeam:
-        # point-source fused TPU kernel (caller guarantees the model is
-        # point-only via ops.coh_pallas.supported)
-        from sagecal_tpu.ops import coh_pallas
-        return coh_pallas.coherencies(sky, u, v, w, freqs, fdelta,
-                                      per_channel_flux=per_channel_flux)
     if with_shapelets is None:
         if isinstance(sky.sh_n0, jax.core.Tracer):
             # under jit we cannot inspect values; keep the general path
@@ -228,6 +221,23 @@ def coherencies(sky: SkyArrays, u, v, w, freqs, fdelta,
                                       with_shapelets)
 
     return jax.lax.map(per_cluster, sky)
+
+
+def coherencies_split(sky_pg, sky_rest, u, v, w, freqs, fdelta,
+                      per_channel_flux: bool = False):
+    """Hybrid coherencies: Pallas kernel on the point/gaussian half,
+    XLA on the compact repacked rest (skymodel.split_for_pallas).
+
+    ``sky_rest`` None means the model is fully kernel-supported. The two
+    halves preserve cluster order, so outputs add elementwise.
+    """
+    from sagecal_tpu.ops import coh_pallas
+    out = coh_pallas.coherencies(sky_pg, u, v, w, freqs, fdelta,
+                                 per_channel_flux=per_channel_flux)
+    if sky_rest is not None:
+        out = out + coherencies(sky_rest, u, v, w, freqs, fdelta,
+                                per_channel_flux=per_channel_flux)
+    return out
 
 
 def uvcut_flags(flags, u, v, freqs, uvmin, uvmax):

@@ -378,3 +378,46 @@ def read_cluster_rho(path: str, cluster_ids: np.ndarray,
             elif len(tok) == 2:
                 table[int(tok[0])] = float(tok[1])
     return np.array([table.get(int(cid), default_rho) for cid in cluster_ids])
+
+
+def split_for_pallas(sky: ClusterSky):
+    """Split a model into (point+gaussian, rest) for hybrid prediction.
+
+    The Pallas coherency kernel (ops/coh_pallas.py) covers point and
+    gaussian sources; shapelet/disk/ring sources stay on the XLA path.
+    Returns ``(sky_pg, sky_rest)`` where ``sky_pg`` is the input with
+    non-point/gaussian sources masked out, and ``sky_rest`` is a compact
+    repack (Smax = max per-cluster rest count) of the remaining live
+    sources — or ``None`` when the model is fully kernel-supported.
+    Cluster count/order and nchunk are preserved on both halves so their
+    coherencies add elementwise.
+    """
+    is_pg = ((sky.stype == STYPE_POINT) | (sky.stype == STYPE_GAUSSIAN)) \
+        & sky.smask
+    rest = sky.smask & ~is_pg
+    sky_pg = dataclasses.replace(sky, smask=is_pg)
+    nrest = rest.sum(axis=1)
+    if nrest.max() == 0:
+        return sky_pg, None
+    M = sky.smask.shape[0]
+    S2 = int(nrest.max())
+
+    def pack(a, fill=0.0):
+        out = np.full((M, S2) + a.shape[2:], fill, a.dtype)
+        for m in range(M):
+            idx = np.where(rest[m])[0]
+            out[m, : len(idx)] = a[m, idx]
+        return out
+
+    fields = {}
+    for f in dataclasses.fields(sky):
+        a = getattr(sky, f.name)
+        if f.name in ("cluster_ids", "nchunk", "names"):
+            fields[f.name] = a
+        elif f.name == "smask":
+            fields[f.name] = pack(a, fill=False)
+        elif f.name == "f0":
+            fields[f.name] = pack(a, fill=1.0)   # keep log(freq/f0) finite
+        else:
+            fields[f.name] = pack(a)
+    return sky_pg, ClusterSky(**fields)

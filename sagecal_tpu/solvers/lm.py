@@ -50,7 +50,14 @@ class OSConfig(NamedTuple):
     """Ordered-subsets acceleration (clmfit.c:1074 oslevmar semantics):
     each LM iteration builds the normal equations from ONE contiguous
     time-tile subset; acceptance still tests the FULL-data cost
-    (clmfit.c:1404 computes pDp_eL2 over all N rows)."""
+    (clmfit.c:1404 computes pDp_eL2 over all N rows).
+
+    Documented behavioral deviation: on a rejected step the reference
+    retries the SAME subset with increased damping (clmfit.c:1449 inner
+    while loop); this solver advances to the next subset instead — the
+    damping increase carries over, so the retry happens against fresh
+    data (batched lax control flow keeps every chunk on the same
+    schedule)."""
 
     os_id: jax.Array       # [B] subset id per data row (os_subset_ids)
     n_subsets: int         # static subset count (<= 10, reference default)

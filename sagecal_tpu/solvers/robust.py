@@ -42,10 +42,21 @@ def update_nu_ml(w, mask, nu_old, nulow=2.0, nuhigh=30.0, nd: int = 30):
     return nus[jnp.argmin(jnp.abs(q))]
 
 
+def mean_logsumw(w, mask):
+    """1/N sum(ln w_i - w_i) over live residuals — the AECM sufficient
+    statistic (updatenu.c:253-259)."""
+    nlive = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(jnp.where(mask,
+                             jnp.log(jnp.maximum(w, 1e-30)) - w, 0.0)) / nlive
+
+
 def update_nu_aecm(logsumw, nu_old, p: int = 8, nulow=2.0, nuhigh=30.0,
                    nd: int = 30):
     """AECM nu update (update_nu, updatenu.c:264) for p-variate t:
-    ``logsumw`` = mean(ln w - w) over live residuals."""
+    ``logsumw`` = mean(ln w - w) over live residuals (:func:`mean_logsumw`).
+    The robust RTR/NSD family calls this with p=2
+    (rtr_solve_robust.c:374); the robust LM family uses
+    :func:`update_nu_ml` (update_w_and_nu) instead."""
     dgm = (jax.scipy.special.digamma((nu_old + p) * 0.5)
            - jnp.log((nu_old + p) * 0.5))
     nus = nu_grid(nulow, nuhigh, nd)

@@ -353,7 +353,11 @@ def rtr_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
                              robust_nu=nu)
         e = ne.residual8(x8, Jn, coh, sta1, sta2, chunk_id) * wt_base
         w = rb.update_weights(e, nu)
-        nu_new = rb.update_nu_ml(w, mask, nu, nulow, nuhigh)
+        # AECM nu update with p=2, matching the robust-RTR family
+        # (rtr_solve_robust.c:374, rtr_solve_robust_admm.c:394 call
+        # update_nu with p=2; the LM family uses the ML grid instead)
+        nu_new = rb.update_nu_aecm(rb.mean_logsumw(w, mask), nu, p=2,
+                                   nulow=nulow, nuhigh=nuhigh)
         return (Jn, nu_new), (info["init_cost"], info["final_cost"])
 
     (J, nu), costs = jax.lax.scan(
@@ -421,11 +425,12 @@ def nsd_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         # restart momentum for chunks where the line search failed
         p_new = jnp.where((found & chunk_mask)[:, None], p_new, p)
         # nu E-step every step (inner nu/weight updates,
-        # rtr_solve_robust.c:1640-1700)
+        # rtr_solve_robust.c:1640-1700; AECM p=2 like the TR variant)
         e = ne.residual8(x8, ne.jones_r2c(p_new.reshape(kmax, n_stations, 8)),
                          coh, sta1, sta2, chunk_id) * wt_base
         w = rb.update_weights(e, nu_)
-        nu_new = rb.update_nu_ml(w, mask, nu_, nulow, nuhigh)
+        nu_new = rb.update_nu_aecm(rb.mean_logsumw(w, mask), nu_, p=2,
+                                   nulow=nulow, nuhigh=nuhigh)
         live = k < itmax
         out = (jnp.where(live, p_new, p),
                jnp.where(live, p, p_prev),

@@ -197,6 +197,45 @@ def make_band_solver(dsky, n_stations: int, chunk_idx, chunk_mask,
     return jax.jit(solve)
 
 
+def make_band_solver_batched(dsky, n_stations: int, chunk_idx, chunk_mask,
+                             fdelta_chan: float, nu: float, max_lbfgs: int,
+                             consensus: bool, dobeam: int = 0,
+                             loss: str = "robust"):
+    """All-band variant of :func:`make_band_solver`: ONE device program
+    solves every mini-band at once (vmap over the band axis).
+
+    The reference loops bands on the host (minibatch_mode.cpp:359-437,
+    minibatch_consensus_mode.cpp:446-590) because each band is a separate
+    pthread-parallel solve; on a device the band axis is embarrassingly
+    parallel (P7: shard band axis across TPU cores). Band-stacked inputs:
+    x8F/wtF [W, B, Fp, 8], freqsF [W, Fp], p0 [W, M, K, N, 8], mem
+    (stacked pytree); consensus adds Y [W, ...], BZ [W, ...], rho [W, M].
+    Shared per-minibatch geometry (u, v, w, sta1, sta2, tslot, beam) is
+    broadcast. Returns stacked BandSolverOutputs.
+
+    Execution-time note: one call is ONE device execution over all W
+    bands; typical -w band counts (<= 8) stay well under the tunneled
+    chip's per-execution wall-clock kill because each minibatch is
+    tilesz/minibatches slim. Callers with unusually many bands should
+    block the band axis like the pipeline blocks -b 1 channels.
+    """
+    scalar = make_band_solver(dsky, n_stations, chunk_idx, chunk_mask,
+                              fdelta_chan, nu, max_lbfgs, consensus,
+                              dobeam=dobeam, loss=loss)
+    # re-wrap the UNJITTED math: jit of vmap of the inner function
+    raw = scalar.__wrapped__
+
+    def pos(x8F, u, v, w, sta1, sta2, wtF, freqsF, tslot, p0, mem,
+            Y, BZ, rho, beam):
+        return raw(x8F, u, v, w, sta1, sta2, wtF, freqsF, tslot, p0, mem,
+                   Y=Y, BZ=BZ, rho=rho, beam=beam)
+
+    band = (0, 0, 0) if consensus else (None, None, None)
+    in_axes = (0, None, None, None, None, None, 0, 0, None, 0, 0) \
+        + band + (None,)
+    return jax.jit(jax.vmap(pos, in_axes=in_axes))
+
+
 class _StochasticRunner:
     """Shared machinery for both stochastic modes."""
 
@@ -336,6 +375,33 @@ class _StochasticRunner:
     def band_inputs(self, nmb: int, band: int):
         return self._tile_inputs[(nmb, band)]
 
+    def band_inputs_all(self, nmb: int):
+        """Band-stacked inputs of one minibatch for the batched solver:
+        (x8F [W,B,Fp,8], u, v, w, sta1, sta2, wtF [W,B,Fp,8],
+        freqsF [W,Fp], tslot) — geometry is band-invariant."""
+        items = [self._tile_inputs[(nmb, b)] for b in range(self.nsolbw)]
+        x8F = jnp.stack([it[0] for it in items])
+        wtF = jnp.stack([it[6] for it in items])
+        freqsF = jnp.stack([it[7] for it in items])
+        first = items[0]
+        return (x8F, first[1], first[2], first[3], first[4], first[5],
+                wtF, freqsF, first[8])
+
+    def stack_state(self, pfreq, mems):
+        """Per-band host state -> stacked device state for the batched
+        solver."""
+        pstack = jnp.asarray(np.stack(pfreq), self.rdt)
+        memstack = jax.tree.map(lambda *xs: jnp.stack(xs), *mems)
+        return pstack, memstack
+
+    def unstack_state(self, pstack, memstack, pfreq, mems):
+        """Write stacked device state back into the per-band host lists
+        (in place: end_of_tile's reset logic owns those lists)."""
+        p_np = np.asarray(pstack)
+        for b in range(self.nsolbw):
+            pfreq[b] = p_np[b]
+            mems[b] = jax.tree.map(lambda a: a[b], memstack)
+
     def _build_residual_fn(self):
         """Jitted per-(minibatch, band) residual, reused across tiles.
 
@@ -445,7 +511,7 @@ def run_minibatch(cfg: RunConfig, log=print):
     ms, sky = _open(cfg, log)
     rn = _StochasticRunner(cfg, ms, sky, log=log)
 
-    solver = make_band_solver(
+    solver = make_band_solver_batched(
         rn.dsky, rn.n, rn.cidx, rn.cmask, rn.fdelta_chan,
         nu=cfg.robust_nulow, max_lbfgs=cfg.max_lbfgs, consensus=False,
         dobeam=rn.dobeam, loss=cfg.stochastic_loss)
@@ -464,22 +530,24 @@ def run_minibatch(cfg: RunConfig, log=print):
         rn.prepare_tile(tile)
         resband = np.zeros(rn.nsolbw)
         res_0 = res_1 = 0.0
+        # all bands ride one device program (P7); host state restacks
+        # only at tile boundaries where the reset logic lives
+        pstack, memstack = rn.stack_state(pfreq, mems)
         for nepch in range(cfg.n_epochs):
             for nmb in range(rn.minibatches):
-                r0s, r1s = [], []
-                for b in range(rn.nsolbw):
-                    args = rn.band_inputs(nmb, b)
-                    out = solver(*args, jnp.asarray(pfreq[b], rn.rdt),
-                                 mems[b], beam=rn.tile_beam)
-                    pfreq[b] = np.asarray(out.p)
-                    mems[b] = out.mem
-                    r00, r01 = float(out.res_0), float(out.res_1)
-                    resband[b] = r01
-                    r0s.append(r00); r1s.append(r01)
-                    if cfg.verbose:
+                args = rn.band_inputs_all(nmb)
+                out = solver(*args, pstack, memstack, None, None, None,
+                             rn.tile_beam)
+                pstack, memstack = out.p, out.mem
+                r0s = np.asarray(out.res_0)
+                r1s = np.asarray(out.res_1)
+                resband[:] = r1s
+                if cfg.verbose:
+                    for b in range(rn.nsolbw):
                         log(f"epoch={nepch} minibatch={nmb} band={b} "
-                            f"{r00:.6f} {r01:.6f}")
+                            f"{r0s[b]:.6f} {r1s[b]:.6f}")
                 res_0, res_1 = float(np.mean(r0s)), float(np.mean(r1s))
+        rn.unstack_state(pstack, memstack, pfreq, mems)
 
         rn.end_of_tile(tile, ti, state, resband, res_0, res_1, t0,
                        writer, history)
@@ -514,7 +582,7 @@ def run_minibatch_consensus(cfg: RunConfig, log=print):
 
     Bii = np.asarray(cpoly.find_prod_inverse(B, rhok.T))       # [M, P, P]
 
-    solver = make_band_solver(
+    solver = make_band_solver_batched(
         rn.dsky, rn.n, rn.cidx, rn.cmask, rn.fdelta_chan,
         nu=cfg.robust_nulow, max_lbfgs=cfg.max_lbfgs, consensus=True,
         dobeam=rn.dobeam, loss=cfg.stochastic_loss)
@@ -537,32 +605,34 @@ def run_minibatch_consensus(cfg: RunConfig, log=print):
         Z = np.zeros((rn.M, cfg.n_poly, rn.kmax, rn.n, 8))
         resband = np.zeros(rn.nsolbw)
         res_0 = res_1 = 0.0
+        pstack, memstack = rn.stack_state(pfreq, mems)
+        rho_d = jnp.asarray(rhok, rn.rdt)
         for nadmm in range(cfg.n_admm):
             for nepch in range(cfg.n_epochs):
                 for nmb in range(rn.minibatches):
-                    r0s, r1s = [], []
-                    for b in range(rn.nsolbw):
-                        BZ = np.einsum("p,mpkns->mkns", B[b], Z)
-                        args = rn.band_inputs(nmb, b)
-                        out = solver(*args, jnp.asarray(pfreq[b], rn.rdt),
-                                     mems[b],
-                                     Y=jnp.asarray(Y[b], rn.rdt),
-                                     BZ=jnp.asarray(BZ, rn.rdt),
-                                     rho=jnp.asarray(rhok[b], rn.rdt),
-                                     beam=rn.tile_beam)
-                        pfreq[b] = np.asarray(out.p)
-                        mems[b] = out.mem
-                        r00, r01 = float(out.res_0), float(out.res_1)
-                        # -ve residual marks a bad solve
-                        resband[b] = r01 if (r00 > 0 and r01 > 0) else np.inf
-                        r0s.append(r00); r1s.append(r01)
-                        if cfg.verbose:
+                    # ONE device program solves all bands (P7); the
+                    # host keeps only the cheap Z/Y consensus updates
+                    BZ_all = np.einsum("bp,mpkns->bmkns", B, Z)
+                    args = rn.band_inputs_all(nmb)
+                    out = solver(*args, pstack, memstack,
+                                 jnp.asarray(Y, rn.rdt),
+                                 jnp.asarray(BZ_all, rn.rdt),
+                                 rho_d, rn.tile_beam)
+                    pstack, memstack = out.p, out.mem
+                    p_np = np.asarray(pstack, np.float64)
+                    r0s = np.asarray(out.res_0)
+                    r1s = np.asarray(out.res_1)
+                    # -ve residual marks a bad solve
+                    resband[:] = np.where((r0s > 0) & (r1s > 0), r1s,
+                                          np.inf)
+                    if cfg.verbose:
+                        for b in range(rn.nsolbw):
                             primal = float(np.linalg.norm(
-                                (pfreq[b] - BZ) * cmask4)
-                                / np.sqrt(pfreq[b].size))
+                                (p_np[b] - BZ_all[b]) * cmask4)
+                                / np.sqrt(p_np[b].size))
                             log(f"admm={nadmm} epoch={nepch} "
                                 f"minibatch={nmb} band={b} primal "
-                                f"{primal:.6f} {r00:.6f} {r01:.6f}")
+                                f"{primal:.6f} {r0s[b]:.6f} {r1s[b]:.6f}")
                     res_0, res_1 = float(np.mean(r0s)), float(np.mean(r1s))
                     # flag diverged bands out of the Z update (:528-546)
                     fband = resband > RES_RATIO * res_1
@@ -570,7 +640,7 @@ def run_minibatch_consensus(cfg: RunConfig, log=print):
                     # ADMM updates (minibatch_consensus_mode.cpp:551-590)
                     good = ~fband
                     for b in np.where(good)[0]:
-                        Y[b] += rhok[b][:, None, None, None] * pfreq[b]
+                        Y[b] += rhok[b][:, None, None, None] * p_np[b]
                     zsum = np.einsum("b,bp,bmkns->mpkns",
                                      good.astype(float), B, Y)
                     Zold = Z.copy()
@@ -582,6 +652,7 @@ def run_minibatch_consensus(cfg: RunConfig, log=print):
                     for b in np.where(good)[0]:
                         BZb = np.einsum("p,mpkns->mkns", B[b], Z)
                         Y[b] -= rhok[b][:, None, None, None] * BZb
+        rn.unstack_state(pstack, memstack, pfreq, mems)
 
         if cfg.use_global_solution:
             log("Using Global")

@@ -373,3 +373,54 @@ def test_element_freq_interpolation_matches_reference_rule():
     np.testing.assert_array_equal(th_lo, ec.theta[0])
     th_hi, _ = bm.element_pattern_at(ec, 500e6)
     np.testing.assert_array_equal(th_hi, ec.theta[-1])
+
+
+def test_pipeline_precesses_sources(tmp_path):
+    """Beam mode precesses source + beam-pointing coordinates once per
+    run to the first tile's epoch (precess_source_locations data.cpp:1473
+    called at fullbatch_mode.cpp:325); no-beam mode must not."""
+    import math
+    from sagecal_tpu import cli, pipeline
+
+    (tmp_path / "sky.txt").write_text(
+        "P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 60e6\n")
+    (tmp_path / "sky.txt.cluster").write_text("0 1 P0A\n")
+    ra0 = (0 + 41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(tmp_path / "sky.txt"),
+                                    ra0, dec0, 60e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(str(tmp_path / "sky.txt.cluster")))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    tile = ds.simulate_dataset(dsky, n_stations=6, tilesz=2,
+                               freqs=[60e6], ra0=ra0, dec0=dec0,
+                               noise_sigma=0.0, seed=3)
+    msdir = tmp_path / "sim.ms"
+    info = bm.synthetic_beam(6, np.array([2451545.0]), ra0, dec0, 60e6)
+    ds.SimMS.create(str(msdir), [tile], beam_info=info)
+    ms = ds.SimMS(str(msdir))
+
+    def build(beam_flag):
+        args = cli.build_parser().parse_args([
+            "-d", str(msdir), "-s", str(tmp_path / "sky.txt"),
+            "-c", str(tmp_path / "sky.txt.cluster"),
+            "-j", "0", "-B", beam_flag])
+        cfg = cli.config_from_args(args)
+        sky2 = skymodel.read_sky_cluster(
+            str(tmp_path / "sky.txt"), str(tmp_path / "sky.txt.cluster"),
+            ms.meta["ra0"], ms.meta["dec0"], ms.meta["freq0"])
+        return pipeline.FullBatchPipeline(cfg, ms, sky2,
+                                         log=lambda *a: None)
+
+    pipe0 = build("0")
+    assert not pipe0.precessed
+
+    pipe = build("2")
+    assert pipe.precessed
+    # tile epoch is ~year 2156 (start_mjd_s=4.93e9 s): general precession
+    # of ~50.3"/yr over ~156 yr moves coordinates by ~0.03-0.04 rad in ra
+    dra = float(np.asarray(pipe.dsky.ra)[0, 0]) - sky.ra[0, 0]
+    ddec = float(np.asarray(pipe.dsky.dec)[0, 0]) - sky.dec[0, 0]
+    assert 1e-3 < abs(dra) < 0.1
+    assert abs(pipe.beam_info.ra0 - ms.meta["ra0"]) > 1e-3
+    assert abs(ddec) < 0.05

@@ -204,3 +204,49 @@ def test_mesh_admm_roundtrip(ndev):
             Gt = Jtrue[f, m, 0] @ Jtrue[f, m, 0].conj().transpose(0, 2, 1)
             err = np.abs(Gs - Gt).mean() / np.abs(Gt).mean()
             assert err < 0.2, (f, m, err)
+
+
+def test_host_loop_admm_matches_traced():
+    """host_loop=True (one bounded execution per ADMM iteration, the
+    single-chip bench path) must reproduce the fully traced runner."""
+    nf = 4
+    sky, dsky, freqs, tiles, Jtrue = _subband_problem(nf=nf)
+    n = tiles[0].n_stations
+    mesh = Mesh(np.array(jax.devices()[:4]), ("freq",))
+    cidx = rp.chunk_indices(tiles[0].tilesz, tiles[0].nbase, sky.nchunk)
+    kmax = int(sky.nchunk.max())
+    cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
+    B = cpoly.setup_polynomials(freqs, float(np.mean(freqs)), 2, 2)
+
+    cfg = cadmm.ADMMConfig(
+        n_admm=3, npoly=2, rho=2.0, manifold_iters=3, adaptive_rho=True,
+        sage=sage.SageConfig(max_emiter=1, max_iter=5, max_lbfgs=2,
+                             solver_mode=int(SolverMode.LM_LBFGS)))
+    common = (dsky, tiles[0].sta1, tiles[0].sta2, cidx, cmask, n,
+              tiles[0].fdelta, B, cfg, mesh, nf)
+    runner_t = cadmm.make_admm_runner(*common)
+    runner_h = cadmm.make_admm_runner(*common, host_loop=True)
+
+    def stack(fn):
+        return np.stack([fn(t) for t in tiles])
+
+    x8F = stack(lambda t: np.stack(
+        [t.averaged().reshape(-1, 4).real,
+         t.averaged().reshape(-1, 4).imag], -1).reshape(-1, 8))
+    uF, vF, wF = (stack(lambda t: t.u), stack(lambda t: t.v),
+                  stack(lambda t: t.w))
+    wtF = stack(lambda t: np.asarray(
+        lm_mod.make_weights(jnp.asarray(t.flags, jnp.int32), jnp.float64)))
+    fratioF = np.ones(nf)
+    J0F = np.asarray(utils.jones_c2r_np(np.tile(
+        np.eye(2, dtype=complex), (nf, sky.n_clusters, kmax, n, 1, 1))))
+    sh = NamedSharding(mesh, P("freq"))
+    args = [jax.device_put(jnp.asarray(a), sh) for a in
+            (x8F, uF, vF, wF, freqs, wtF, fratioF, J0F)]
+
+    out_t = runner_t(*args)
+    out_h = runner_h(*args)
+    names = ("JF", "Z", "rhoF", "res0", "res1", "r1s", "duals", "Y0F")
+    for nm, a, b in zip(names, out_t, out_h):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-8, err_msg=nm)

@@ -54,4 +54,80 @@ def test_supported_detects_extended():
     sky = point_sky()
     assert coh_pallas.supported(sky)
     sky.stype[0, 0] = skymodel.STYPE_GAUSSIAN
+    assert coh_pallas.supported(sky)      # gaussians now in-kernel
+    sky.stype[0, 1] = skymodel.STYPE_SHAPELET
+    sky.sh_n0[0, 1] = 1
+    sky.sh_modes[0, 1, 0] = 1.0
     assert not coh_pallas.supported(sky)
+    assert coh_pallas.any_supported(sky)
+
+
+def gaussian_sky(seed=3, project=True):
+    """Mixed point+gaussian model (gaussian_contrib parity target)."""
+    sky = point_sky(seed=seed)
+    rng = np.random.default_rng(seed)
+    for m in range(sky.stype.shape[0]):
+        sky.stype[m, 0] = skymodel.STYPE_GAUSSIAN
+        sky.eX[m, 0] = 2 * 0.002
+        sky.eY[m, 0] = 2 * 0.001
+        sky.eP[m, 0] = float(rng.random())
+        if project:
+            xi = float(rng.random())
+            phi = float(rng.random())
+            sky.cxi[m, 0], sky.sxi[m, 0] = np.cos(xi), np.sin(xi)
+            sky.cphi[m, 0], sky.sphi[m, 0] = np.cos(phi), np.sin(phi)
+            sky.use_projection[m, 0] = True
+    return sky
+
+
+@pytest.mark.parametrize("project", [False, True])
+def test_pallas_gaussian_matches_xla(project):
+    sky = gaussian_sky(project=project)
+    dsky = rp.sky_to_device(sky, jnp.float32)
+    rng = np.random.default_rng(2)
+    B = 53
+    u = jnp.asarray(rng.normal(0, 2e-6, B), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 2e-6, B), jnp.float32)
+    w = jnp.asarray(rng.normal(0, 2e-7, B), jnp.float32)
+    freqs = jnp.asarray([145e6, 155e6], jnp.float32)
+
+    want = np.asarray(rp.coherencies(dsky, u, v, w, freqs, 0.18e6))
+    got = np.asarray(coh_pallas.coherencies(
+        dsky, u, v, w, freqs, 0.18e6, block_b=16, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-5)
+
+
+def test_hybrid_split_matches_xla():
+    """Mixed point+gaussian+shapelet model: kernel half + XLA rest must
+    reproduce the full XLA path (predict.coherencies_split)."""
+    sky = gaussian_sky()
+    rng = np.random.default_rng(5)
+    # make source 1 of cluster 0 a shapelet
+    sky.stype[0, 1] = skymodel.STYPE_SHAPELET
+    sky.eX[0, 1] = sky.eY[0, 1] = 1.0
+    sky.sh_n0[0, 1] = 2
+    sky.sh_beta[0, 1] = 0.01
+    # widen the mode padding (the all-point model packed n0max=0)
+    M, S = sky.sh_n0.shape
+    sky.sh_modes = np.zeros((M, S, 4))
+    sky.sh_modes[0, 1, :4] = rng.normal(0, 0.3, 4)
+    sky.sh_modes[0, 1, 0] = 1.0
+
+    sky_pg, sky_rest = skymodel.split_for_pallas(sky)
+    assert sky_rest is not None
+    assert sky_rest.smask.sum() == 1
+    dsky = rp.sky_to_device(sky, jnp.float32)
+    pg = rp.sky_to_device(sky_pg, jnp.float32)
+    rest = rp.sky_to_device(sky_rest, jnp.float32)
+
+    B = 41
+    u = jnp.asarray(rng.normal(0, 2e-6, B), jnp.float32)
+    v = jnp.asarray(rng.normal(0, 2e-6, B), jnp.float32)
+    w = jnp.asarray(rng.normal(0, 2e-7, B), jnp.float32)
+    freqs = jnp.asarray([150e6], jnp.float32)
+
+    want = np.asarray(rp.coherencies(dsky, u, v, w, freqs, 0.18e6))
+    kern = np.asarray(coh_pallas.coherencies(
+        pg, u, v, w, freqs, 0.18e6, block_b=16, interpret=True))
+    rest_xla = np.asarray(rp.coherencies(rest, u, v, w, freqs, 0.18e6))
+    np.testing.assert_allclose(kern + rest_xla, want, rtol=2e-4, atol=1e-5)

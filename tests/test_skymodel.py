@@ -155,3 +155,27 @@ def test_coords_roundtrip():
     ra, dec = coords.precess_radec(jnp.array(1.0), jnp.array(0.5), pm)
     assert abs(float(ra) - 1.0) < 0.01
     assert abs(float(dec) - 0.5) < 0.01
+
+
+def test_precession_rates_quantitative():
+    """First-order precession rates (independent of the Capitaine series;
+    Meeus, Astronomical Algorithms ch. 21): over T years,
+    d(ra) = (m + n sin ra tan dec) T, d(dec) = n cos(ra) T with
+    m = 46.1"/yr, n = 20.04"/yr. Checked at 2% over 25 years."""
+    from sagecal_tpu import coords
+    import jax.numpy as jnp
+    T = 25.0
+    jd = 2451545.0 + 365.25 * T
+    pm = coords.precession_matrix(jnp.array(jd))
+    AS = np.pi / (180 * 3600)
+    m, n = 46.1 * AS, 20.04 * AS
+    for ra0, dec0 in [(0.3, 0.4), (2.0, -0.6), (4.5, 1.0)]:
+        ra, dec = coords.precess_radec_std(jnp.array(ra0), jnp.array(dec0),
+                                           pm)
+        dra_exp = (m + n * np.sin(ra0) * np.tan(dec0)) * T
+        ddec_exp = n * np.cos(ra0) * T
+        dra = (float(ra) - ra0 + np.pi) % (2 * np.pi) - np.pi
+        np.testing.assert_allclose(dra, dra_exp,
+                                   rtol=0.02, atol=2 * AS)
+        np.testing.assert_allclose(float(dec) - dec0, ddec_exp,
+                                   rtol=0.02, atol=2 * AS)

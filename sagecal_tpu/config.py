@@ -75,6 +75,8 @@ class RunConfig:
     solutions_file: str | None = None  # -p : output (or input for simulation)
     init_solutions: str | None = None  # -q : warm start
     format_3: bool = False             # -F 1 : 3rd-order spectral indices
+    input_column: str = "DATA"         # Data::DataField (CasaMS backend)
+    output_column: str = "CORRECTED_DATA"   # Data::OutField
 
     # --- solve shape
     tile_size: int = 120               # -t : timeslots per solve interval

@@ -465,9 +465,21 @@ class MultiSimMS:
         return _tiles_prefetch_impl(self, depth)
 
 
-def open_dataset(ms: str | None, ms_list: str | None = None):
-    """Resolve -d/-f into a dataset: a single SimMS, or a MultiSimMS from
-    a glob pattern / list file (fullbatch_mode.cpp:255-262 dispatch)."""
+def open_dataset(ms: str | None, ms_list: str | None = None,
+                 tilesz: int = 10, data_column: str = "DATA",
+                 out_column: str = "CORRECTED_DATA"):
+    """Resolve -d/-f into a dataset: a CASA MeasurementSet (python-casacore
+    backend) when the path is a casacore table, a single SimMS, or a
+    MultiSimMS from a glob pattern / list file (fullbatch_mode.cpp:255-262
+    dispatch)."""
+    from sagecal_tpu.io import casams
+    if ms and casams.is_ms_path(ms):
+        if not casams.have_casacore():
+            raise RuntimeError(
+                f"{ms} is a CASA table but python-casacore is not "
+                f"installed; install it or convert to a SimMS directory")
+        return casams.CasaMS(ms, tilesz=tilesz, data_column=data_column,
+                             out_column=out_column)
     if ms_list:
         import glob as globmod
         if os.path.isfile(ms_list):

@@ -498,7 +498,9 @@ class _StochasticRunner:
 
 
 def _open(cfg: RunConfig, log):
-    ms = ds.open_dataset(cfg.ms, cfg.ms_list)
+    ms = ds.open_dataset(cfg.ms, cfg.ms_list, tilesz=cfg.tile_size,
+                         data_column=cfg.input_column,
+                         out_column=cfg.output_column)
     meta = ms.meta
     sky = skymodel.read_sky_cluster(cfg.sky_model, cfg.cluster_file,
                                     meta["ra0"], meta["dec0"], meta["freq0"],

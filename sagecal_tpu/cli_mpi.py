@@ -65,6 +65,20 @@ def build_parser() -> argparse.ArgumentParser:
     a("-X", "--spatialreg", default=None,
       help="spatial regularization: l2,l1,order,fista_iters,cadence")
     a("-V", "--verbose", action="store_true")
+    # multi-host execution (the mpirun analogue): same program on every
+    # host, coordinated through jax.distributed; the mesh then spans all
+    # hosts' devices and subband shards ride ICI/DCN
+    a("--coordinator", default=None,
+      help="host:port of process 0 for jax.distributed.initialize "
+           "(multi-host pods; omit for single-process)")
+    a("--num-processes", type=int, default=1)
+    a("--process-id", type=int, default=0)
+    # platform overrides (the JAX_PLATFORMS env var is ignored by some
+    # TPU plugins; the config-update route always works)
+    a("--platform", default=None,
+      help="force the jax platform, e.g. 'cpu' for a virtual host mesh")
+    a("--cpu-devices", type=int, default=0,
+      help="virtual CPU device count (with --platform cpu)")
     return p
 
 
@@ -85,6 +99,18 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
     import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    if args.cpu_devices:
+        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+    if args.coordinator:
+        # multi-host SPMD: every process runs this same program; jax
+        # coordinates device enumeration and collectives across hosts
+        # (replaces mpirun rank dispatch, src/MPI/main.cpp:311-346)
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id)
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from sagecal_tpu.consensus import admm as cadmm
@@ -153,12 +179,23 @@ def main(argv=None) -> int:
     cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
     cidx = rp.chunk_indices(meta0["tilesz"], meta0["nbase"], sky.nchunk)
 
-    # mesh: largest device count dividing Nf
+    # mesh: use ALL devices up to Nf; when Nf doesn't divide (or, multi-
+    # host, when Nf < the global device count), pad the subband axis to
+    # Fl*ndev with masked zero-weight slots (admm.pad_subbands) instead
+    # of shrinking the mesh to a divisor. Multi-host: never slice the
+    # device list below a process boundary — every process must own mesh
+    # devices or the SPMD programs desynchronize.
+    multihost = args.num_processes > 1
     ndev_avail = len(jax.devices())
-    ndev = max(d for d in range(1, min(ndev_avail, nf) + 1) if nf % d == 0)
+    ndev = ndev_avail if multihost else min(ndev_avail, nf)
+    fpad = -(-max(nf, ndev) // ndev) * ndev
     mesh = Mesh(np.array(jax.devices()[:ndev]), ("freq",))
-    print(f"Subbands: {nf} over {ndev} device(s); stations {n}, "
-          f"clusters {sky.n_clusters} (Mt={sky.n_eff_clusters})")
+    is_writer = args.process_id == 0   # mpirun-analogue output ownership
+    if is_writer:
+        print(f"Subbands: {nf} over {ndev} device(s)"
+              + (f" (padded to {fpad})" if fpad != nf else "")
+              + f"; stations {n}, clusters {sky.n_clusters} "
+              f"(Mt={sky.n_eff_clusters})")
 
     rho0 = args.rho
     if args.rho_file:
@@ -169,6 +206,9 @@ def main(argv=None) -> int:
 
     Bpoly = cpoly.setup_polynomials(freqs, float(freqs.mean()),
                                     args.npoly, args.polytype)
+    # padded basis for the mesh program; Bpoly keeps the real rows for
+    # host-side uses (use_global_solution, solution writing)
+    _, Bpoly_pad, _ = cadmm.pad_subbands([], Bpoly, nf, ndev)
     spatialreg = None
     spatial_coords = None
     if args.spatialreg:
@@ -196,8 +236,8 @@ def main(argv=None) -> int:
 
     t0 = mss[0].read_tile(0)
     runner = cadmm.make_admm_runner(dsky, t0.sta1, t0.sta2, cidx, cmask, n,
-                                    meta0["fdelta"], Bpoly, cfg, mesh, nf,
-                                    spatial_coords=spatial_coords)
+                                    meta0["fdelta"], Bpoly_pad, cfg, mesh,
+                                    nf, spatial_coords=spatial_coords)
 
     # residual program (per subband, local J)
     def residual_fn(J_r8, x_r, u, v, w, freq):
@@ -212,7 +252,7 @@ def main(argv=None) -> int:
     res_jit = jax.jit(jax.vmap(residual_fn))
 
     writer = None
-    if args.solutions_file:
+    if args.solutions_file and is_writer:
         writer = sol.SolutionWriter(
             args.solutions_file, float(freqs.mean()),
             float(freqs.max() - freqs.min()),
@@ -220,6 +260,27 @@ def main(argv=None) -> int:
             sky.n_eff_clusters * args.npoly)
 
     sh = NamedSharding(mesh, P("freq"))
+
+    def stage(a):
+        """Host [Fpad, ...] -> sharded device array. Single process:
+        device_put; multi-host: every process holds the full host array
+        and each device picks out its shard via the callback (the
+        multi-host-safe staging path)."""
+        if multihost:
+            return jax.make_array_from_callback(
+                a.shape, sh, lambda idx: a[idx])
+        return jax.device_put(a, sh)
+
+    def fetch(a):
+        """Device -> host numpy. Multi-host: runner outputs span
+        non-addressable devices, so gather them to every process first
+        (the master's Y-gather analogue, over ICI/DCN instead of MPI)."""
+        if multihost:
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(a, tiled=True))
+        return np.asarray(a)
+
     n_tiles = mss[0].n_tiles
     start = args.skip_timeslots
     stop = n_tiles if not args.max_timeslots else min(
@@ -250,11 +311,19 @@ def main(argv=None) -> int:
         # rho scaled by unflagged fraction (master :646-650)
         fratioF = np.array(fr_l)
 
-        args_dev = [jax.device_put(jnp.asarray(a, rdt), sh) for a in
-                    (x8F, uF, vF, wF, freqs, wtF, fratioF, J0)]
+        padded, _, _ = cadmm.pad_subbands(
+            (x8F, uF, vF, wF, freqs, wtF, fratioF, J0), Bpoly, nf, ndev)
+        args_dev = [stage(np.asarray(a, np.dtype(rdt))) for a in padded]
         JF_r8, Z, rhoF, res0, res1, r1s, duals, Y0F = runner(*args_dev)
+        # slice padded subband rows off every per-subband output
+        JF_r8 = fetch(JF_r8)[:nf]
+        Z = fetch(Z)
+        res0, res1 = fetch(res0)[:nf], fetch(res1)[:nf]
+        r1s = fetch(r1s)[:, :nf]
+        duals = fetch(duals)
+        Y0F = fetch(Y0F)[:nf]
 
-        if args.mdl and ti == start:
+        if args.mdl and ti == start and is_writer:
             # model-order report from iteration-0 rho*J (master :815-822)
             from sagecal_tpu.consensus import mdl as mdlmod
             res = mdlmod.minimum_description_length(
@@ -274,30 +343,35 @@ def main(argv=None) -> int:
         bad = (~np.isfinite(res1)) | (res1 == 0.0) | (res1 > 5.0 * res0)
         for f in range(nf):
             J0[f] = Jinit[f] if bad[f] else J_new[f]
-            if bad[f]:
+            if bad[f] and is_writer:
                 print(f"  subband {f}: diverged; Resetting Solution")
-        print(f"Timeslot:{ti} ADMM:{cfg.n_admm} "
-              f"residual initial={res0.mean():.6g} final={res1.mean():.6g} "
-              f"dual={duals[-1] if len(duals) else 0:.3g}")
-        if args.verbose:
-            for f in range(nf):
-                print(f"  subband {f}: {res0[f]:.6g} -> {res1[f]:.6g}")
+        if is_writer:
+            print(f"Timeslot:{ti} ADMM:{cfg.n_admm} residual "
+                  f"initial={res0.mean():.6g} final={res1.mean():.6g} "
+                  f"dual={duals[-1] if len(duals) else 0:.3g}")
+            if args.verbose:
+                for f in range(nf):
+                    print(f"  subband {f}: {res0[f]:.6g} -> {res1[f]:.6g}")
 
-        # residuals + write back (slave :832-869)
-        if args.use_global_solution:
-            # evaluate BZ at each subband: smooth consensus solutions
-            BZ = np.einsum("fp,mpknr->fmknr", Bpoly, np.asarray(Z))
-            J_res = BZ.reshape(nf, sky.n_clusters, kmax, n, 8)
-        else:
-            J_res = np.asarray(JF_r8).reshape(nf, sky.n_clusters, kmax, n, 8)
-        xF_r = np.stack([utils.c2r(t.x) for t in tiles])
-        res_r = res_jit(jnp.asarray(J_res, rdt), jnp.asarray(xF_r, rdt),
-                        jnp.asarray(uF, rdt), jnp.asarray(vF, rdt),
-                        jnp.asarray(wF, rdt), jnp.asarray(freqs, rdt))
-        res_np = utils.r2c(np.asarray(res_r))
-        for f, (msx, t) in enumerate(zip(mss, tiles)):
-            t.x = res_np[f].astype(np.complex128)
-            msx.write_tile(ti, t)
+        # residuals + write back (slave :832-869); multi-host: process 0
+        # owns all outputs (shared-filesystem assumption, like the
+        # reference's slaves-glob-the-same-paths setup)
+        if is_writer:
+            if args.use_global_solution:
+                # evaluate BZ at each subband: smooth consensus solutions
+                BZ = np.einsum("fp,mpknr->fmknr", Bpoly, np.asarray(Z))
+                J_res = BZ.reshape(nf, sky.n_clusters, kmax, n, 8)
+            else:
+                J_res = np.asarray(JF_r8).reshape(
+                    nf, sky.n_clusters, kmax, n, 8)
+            xF_r = np.stack([utils.c2r(t.x) for t in tiles])
+            res_r = res_jit(jnp.asarray(J_res, rdt), jnp.asarray(xF_r, rdt),
+                            jnp.asarray(uF, rdt), jnp.asarray(vF, rdt),
+                            jnp.asarray(wF, rdt), jnp.asarray(freqs, rdt))
+            res_np = utils.r2c(np.asarray(res_r))
+            for f, (msx, t) in enumerate(zip(mss, tiles)):
+                t.x = res_np[f].astype(np.complex128)
+                msx.write_tile(ti, t)
 
         if writer:
             # Z coefficient columns: [M, P, K, N, 8] -> Jones-like blocks

@@ -22,6 +22,14 @@ SURVEY.md section 3.3), re-architected as ONE SPMD program over a
 Data multiplexing (Scurrent rotation, master :883-889) is unnecessary when
 every subband owns a shard; when F exceeds the mesh size, multiple subbands
 ride one shard via the local leading axis — same effect, no rotation.
+
+When F does not divide the mesh size, the caller pads the subband axis up
+to ``Fl * ndev`` (replicating a real subband's data so padded solves stay
+numerically tame) and passes the REAL count as ``nf_total``: rows with
+global index >= nf_total get zero basis rows in the padded ``B_poly``,
+zero rho, and are masked out of the manifold mean and every dual/Y
+quantity — so 7 subbands use 8 devices instead of shrinking the mesh to a
+divisor (the reference's analogue is idle slaves, sagecal_master.cpp:155).
 """
 
 from __future__ import annotations
@@ -51,6 +59,32 @@ class ADMMConfig(NamedTuple):
     # -X l2,l1,order,fista_iters,cadence (README.md:160-166); None = off
     spatialreg: tuple | None = None
     federated_alpha: float = 0.0  # -u : alpha of the spatial/federated prior
+
+
+def pad_subbands(arrays, B_poly, nf: int, ndev: int):
+    """THE padding contract for uneven F over the mesh, in one place.
+
+    arrays: sequence of host arrays with a leading real-subband axis
+    [nf, ...]. Returns (padded_arrays, padded_B, fpad): each array's
+    leading axis padded to ``fpad = ceil(nf/ndev)*ndev`` (ndev may exceed
+    nf: fpad then equals ndev) by replicating the first subband — padded
+    solves stay numerically tame — and B_poly gains zero rows so padded
+    slots contribute nothing to any collective. Pass the REAL count nf as
+    ``nf_total`` to :func:`make_admm_runner`; slice every per-subband
+    output back to [:nf] on the host.
+    """
+    ndev = max(int(ndev), 1)
+    fpad = -(-max(nf, ndev) // ndev) * ndev
+    if fpad == nf:
+        return list(arrays), np.asarray(B_poly), fpad
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        out.append(np.concatenate(
+            [a, np.broadcast_to(a[:1], (fpad - nf,) + a.shape[1:])]))
+    B = np.asarray(B_poly)
+    B = np.vstack([B, np.zeros((fpad - nf, B.shape[1]), B.dtype)])
+    return out, B, fpad
 
 
 def _blocks(J_r8):
@@ -107,7 +141,11 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
     where Y0F is the manifold-projected rho*J of iteration 0 (the MDL
     input, master :815-822).
 
-    B_poly: [F, P] polynomial basis (host numpy, replicated).
+    B_poly: [Fpad, P] polynomial basis (host numpy, replicated); when the
+    staged subband axis Fpad exceeds the real count ``nf_total`` (uneven
+    F over the mesh), rows >= nf_total must be zero and the caller
+    replicates some real subband's data into the padded slots — they are
+    masked out of every collective.
     spatial_coords: ([Mt] r, [Mt] theta) per-effective-cluster polar
     centroids (spatial.cluster_polar_coords) — required when
     cfg.spatialreg is set.
@@ -185,6 +223,13 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         local_ids = dev_idx * Fl + jnp.arange(Fl)
         return Bfull[local_ids]                  # [Fl, P]
 
+    def _fmask(Fl, dtype):
+        """[Fl, 1] 1.0 for real subbands, 0.0 for padded slots (global
+        index >= nf_total when the caller padded F up to the mesh)."""
+        dev_idx = jax.lax.axis_index(axis)
+        local_ids = dev_idx * Fl + jnp.arange(Fl)
+        return (local_ids < nf_total).astype(dtype)[:, None]
+
     # rho for ALL subbands (for Bii): [M, F]
     def all_rho(rhoF):
         g = jax.lax.all_gather(rhoF, axis)       # [ndev, Fl, M]
@@ -238,18 +283,25 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         plus (res0, res1, Y0F)."""
         Fl = x8F.shape[0]
         Brow = _brow(Fl)
+        fm = _fmask(Fl, x8F.dtype)               # [Fl, 1] padded-slot mask
+        fm5 = fm[:, :, None, None, None]         # [Fl, 1, 1, 1, 1]
         # per-(subband, cluster) rho scaled by unflagged fraction; cfg.rho
         # may be a scalar or an [M] per-cluster array (readsky.c:780 -G)
         rho_m = jnp.broadcast_to(jnp.asarray(cfg.rho, x8F.dtype), (M,))
-        rhoF = rho_m[None, :] * fratioF[:, None] * jnp.ones((Fl, M),
-                                                            x8F.dtype)
+        rhoF = rho_m[None, :] * fratioF[:, None] * fm * jnp.ones(
+            (Fl, M), x8F.dtype)
         alpha_vec = _alpha_vec(rho_m, x8F.dtype)
 
         JF, res0, res1 = jax.vmap(local_solve_plain)(
             x8F, uF, vF, wF, wtF, J0F, freqF)
-        YF = rhoF[..., None, None, None] * JF.reshape(Fl, M, K, N, 8)
+        # padded slots contribute exact zeros to every collective (the
+        # where also stops a non-finite padded J from poisoning 0*J)
+        YF = jnp.where(fm5 > 0,
+                       rhoF[..., None, None, None]
+                       * JF.reshape(Fl, M, K, N, 8), 0.0)
         YF = manifold_average_mesh(YF, axis, nf_total, M, K, N,
                                    cfg.manifold_iters)
+        YF = jnp.where(fm5 > 0, YF, 0.0)
         Y0F = YF     # manifold-projected rho*J: the MDL input (:815-822)
 
         # spatial-reg state (replicated); zeros when disabled
@@ -273,6 +325,8 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         JF, YF, Z, rhoF, Yhat_prev, Jprev, Zbar, Xd, rho_upper = carry
         Fl = x8F.shape[0]
         Brow = _brow(Fl)
+        fm = _fmask(Fl, x8F.dtype)
+        fm5 = fm[:, :, None, None, None]
         rho_m = jnp.broadcast_to(jnp.asarray(cfg.rho, x8F.dtype), (M,))
         alpha_vec = _alpha_vec(rho_m, x8F.dtype)
 
@@ -280,7 +334,8 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         Jr, r0, r1 = jax.vmap(local_solve_admm)(
             x8F, uF, vF, wF, wtF, JF, freqF, YF, BZ, rhoF)
         J5 = Jr.reshape(Fl, M, K, N, 8)
-        YF = YF + rhoF[..., None, None, None] * J5   # Y <- Y + rho J
+        YF = jnp.where(fm5 > 0,
+                       YF + rhoF[..., None, None, None] * J5, 0.0)
         Zold = Z
         if spat is None:
             Z = z_update(Brow, YF, rhoF, alpha_vec)
@@ -293,15 +348,18 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                 Z, Zbar, Xd)
         BZn = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
         # Yhat for BB rho uses BZ_old (slave :724-732, TAG_CONSENSUS_OLD)
-        Yhat = YF - rhoF[..., None, None, None] * jnp.einsum(
-            "fp,mpknr->fmknr", Brow, Zold)
-        YF = YF - rhoF[..., None, None, None] * BZn   # complete dual
+        Yhat = jnp.where(fm5 > 0,
+                         YF - rhoF[..., None, None, None] * jnp.einsum(
+                             "fp,mpknr->fmknr", Brow, Zold), 0.0)
+        YF = jnp.where(fm5 > 0,
+                       YF - rhoF[..., None, None, None] * BZn, 0.0)
 
         if cfg.adaptive_rho:
             rhoF = jax.vmap(
                 lambda r, ru, dy, dj: cpoly.update_rho_bb(
                     r, ru, dy, dj, axes=(1, 2, 3))
             )(rhoF, rho_upper, Yhat - Yhat_prev, J5 - Jprev)
+            rhoF = jnp.where(fm > 0, rhoF, 0.0)  # BB on padded: 0/0 guard
 
         dual = jnp.linalg.norm(Z - Zold) / np.sqrt(Z.size)
         return (Jr, YF, Z, rhoF, Yhat, J5, Zbar, Xd, rho_upper), \

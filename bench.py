@@ -392,8 +392,55 @@ def config2_stochastic(device, dtype):
     dt = (time.perf_counter() - t0) / nsteps
     r1 = float(out.res_1)
     nvis = bmb * nchan
+
+    # P7 band-axis scaling: W=nchan mini-bands (1 channel each), one
+    # batched device program vs a sequential per-band host loop
+    # (minibatch_consensus_mode's band structure; VERDICT r2 item 5)
+    W = nchan
+    solver_b = st.make_band_solver_batched(
+        dsky, n_stations, cidx, cmask, fdelta_chan, nu=2.0, max_lbfgs=10,
+        consensus=False)
+    sl = slice(row0[0], row0[0] + bmb)
+    x8W = put(np.transpose(x8F[sl].reshape(bmb, W, 1, 8), (1, 0, 2, 3)),
+              dtype)
+    wtW = put(np.transpose(wtF[sl].reshape(bmb, W, 1, 8), (1, 0, 2, 3)),
+              dtype)
+    fqW = put(np.asarray(tile.freqs).reshape(W, 1), dtype)
+    pW = put(np.broadcast_to(p0, (W,) + p0.shape).copy(), dtype)
+    memW = jax.device_put(
+        jax.tree.map(lambda a: jnp.stack([a] * W),
+                     lbfgs_mod.lbfgs_memory_init(nparam, 7)), device)
+    geo = (put(tile.u[sl], dtype), put(tile.v[sl], dtype),
+           put(tile.w[sl], dtype), put(tile.sta1[sl], jnp.int32),
+           put(tile.sta2[sl], jnp.int32))
+    tsl = put(tslot, jnp.int32)
+
+    outb = solver_b(x8W, *geo[:3], geo[3], geo[4], wtW, fqW, tsl, pW,
+                    memW, None, None, None, None)
+    jax.block_until_ready(outb.p)                 # compile
+    t0 = time.perf_counter()
+    outb = solver_b(x8W, *geo[:3], geo[3], geo[4], wtW, fqW, tsl, pW,
+                    memW, None, None, None, None)
+    jax.block_until_ready(outb.p)
+    dt_batched = time.perf_counter() - t0
+
+    solver_1 = st.make_band_solver(dsky, n_stations, cidx, cmask,
+                                   fdelta_chan, nu=2.0, max_lbfgs=10,
+                                   consensus=False)
+    out1 = solver_1(x8W[0], *geo[:3], geo[3], geo[4], wtW[0], fqW[0],
+                    tsl, pW[0], jax.tree.map(lambda a: a[0], memW))
+    jax.block_until_ready(out1.p)                 # compile
+    t0 = time.perf_counter()
+    for b in range(W):
+        out1 = solver_1(x8W[b], *geo[:3], geo[3], geo[4], wtW[b], fqW[b],
+                        tsl, pW[b], jax.tree.map(lambda a: a[b], memW))
+    jax.block_until_ready(out1.p)
+    dt_seq = time.perf_counter() - t0
+
     return dict(value=nvis / dt, unit="vis/s", res_0=r0, res_1=r1,
                 step_s=dt, compile_s=comp,
+                bands=W, bands_batched_s=dt_batched, bands_seq_s=dt_seq,
+                band_speedup=dt_seq / dt_batched,
                 shape=f"N=32 M=4 F={nchan}ch minibatch -N2")
 
 

@@ -246,7 +246,15 @@ class _StochasticRunner:
         self.log = log
         meta = ms.meta
         self.meta = meta
-        self.rdt = jnp.float32
+        # f32 on accelerators (the reference's float GPU stochastic
+        # path); f64 on the CPU mesh when x64 is on, so host-state vs
+        # device-state comparisons (the federated sharding-invariance
+        # oracle) are exact
+        import jax as _jax
+        self.rdt = (jnp.float64
+                    if (_jax.devices()[0].platform == "cpu"
+                        and _jax.config.read("jax_enable_x64"))
+                    else jnp.float32)
         self.dsky = rp.sky_to_device(sky, self.rdt)
         self.n = meta["n_stations"]
         self.nbase = meta["nbase"]
@@ -519,7 +527,7 @@ def run_minibatch(cfg: RunConfig, log=print):
         dobeam=rn.dobeam, loss=cfg.stochastic_loss)
 
     pinit, pfreq = rn.initial_p()
-    mems = [lbfgs_mod.lbfgs_memory_init(rn.nparam, cfg.lbfgs_m)
+    mems = [lbfgs_mod.lbfgs_memory_init(rn.nparam, cfg.lbfgs_m, rn.rdt)
             for _ in range(rn.nsolbw)]
     writer = rn.solution_writer()
     state = {"pfreq": pfreq, "mems": mems, "pinit": pinit, "res_prev": None}
@@ -590,7 +598,7 @@ def run_minibatch_consensus(cfg: RunConfig, log=print):
         dobeam=rn.dobeam, loss=cfg.stochastic_loss)
 
     pinit, pfreq = rn.initial_p()
-    mems = [lbfgs_mod.lbfgs_memory_init(rn.nparam, cfg.lbfgs_m)
+    mems = [lbfgs_mod.lbfgs_memory_init(rn.nparam, cfg.lbfgs_m, rn.rdt)
             for _ in range(rn.nsolbw)]
     writer = rn.solution_writer()
     state = {"pfreq": pfreq, "mems": mems, "pinit": pinit, "res_prev": None}

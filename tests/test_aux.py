@@ -2,6 +2,7 @@
 regularization (spherical harmonics + FISTA), federated averaging."""
 
 import math
+import os
 
 import numpy as np
 import jax.numpy as jnp
@@ -205,3 +206,50 @@ def test_admm_spatialreg_runs(tmp_path):
         "-l", "4", "-m", "4", "-M",
         "-u", "0.1", "-X", "0.01,0.001,2,20,2"])
     assert rc == 0
+
+
+def test_federated_mesh_matches_sequential(tmp_path):
+    """Sharding invariance (VERDICT r2 next-step 5): the mesh federated
+    program (slaves sharded over the mesh, Zavg via psum, one device
+    program per outer iteration) must reproduce the host-sequential
+    oracle — solutions and written residuals to 1e-8. 3 slaves on a
+    3-device mesh also exercises slave padding when devices > slaves
+    is simulated via a 4-device mesh."""
+    import shutil
+    from sagecal_tpu import federated
+    from sagecal_tpu.config import RunConfig
+
+    paths, sky = _make_subband_datasets(tmp_path, nf=3)
+    seqdir = tmp_path / "seq"
+    meshdir = tmp_path / "mesh"
+    for d in (seqdir, meshdir):
+        d.mkdir()
+        for p in paths:
+            shutil.copytree(p, d / os.path.basename(p))
+
+    def cfg_for(d):
+        return RunConfig(
+            ms=str(d / "band0.ms"), sky_model=str(tmp_path / "sky.txt"),
+            cluster_file=str(tmp_path / "sky.txt.cluster"),
+            solutions_file=str(d / "sol.txt"),
+            n_epochs=2, n_minibatches=1, n_admm=3, n_poly=2,
+            admm_rho=1.0, federated_alpha=0.5, max_lbfgs=6, lbfgs_m=5)
+
+    def bands(d):
+        return [str(d / os.path.basename(p)) for p in paths]
+
+    federated.run_federated_sequential(cfg_for(seqdir), bands(seqdir))
+    # 4-device mesh over 3 slaves: exercises the padded-slave mask too
+    import jax
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:4]), ("slave",))
+    federated.run_federated(cfg_for(meshdir), bands(meshdir), mesh=mesh)
+
+    for p in paths:
+        b = os.path.basename(p)
+        xs = ds.SimMS(str(seqdir / b)).read_tile(0).x
+        xm = ds.SimMS(str(meshdir / b)).read_tile(0).x
+        np.testing.assert_allclose(xm, xs, rtol=1e-8, atol=1e-10)
+    sol_s = (seqdir / "sol.txt").read_text()
+    sol_m = (meshdir / "sol.txt").read_text()
+    assert sol_s == sol_m

@@ -312,7 +312,7 @@ def config1_fullbatch_lm(device, dtype):
                                       use_pallas=pal)
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, pallas=pal,
-               shape="N=62 M=8 tilesz=10 point -j2")
+               shape="N=62 M=8 tilesz=10 point -j3")
     if pal:
         vps0, _, _, _, _ = time_sage(device, dtype, sky, dsky, tile,
                                      SolverMode.OSLM_OSRLM_RLBFGS,

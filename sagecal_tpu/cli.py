@@ -38,7 +38,8 @@ def build_parser() -> argparse.ArgumentParser:
     a("-x", "--lbfgs-m", type=int, default=7)
     a("-n", "--n-threads", type=int, default=4)
     a("-j", "--solver-mode", type=int, default=5,
-      help="0 LM, 1 OSLM, 2 OSRLM, 3 RLM, 4 RTR, 5 RRTR (default), 6 NSD")
+      help="0 OSLM, 1 LM, 2 RLM, 3 OSRLM, 4 RTR, 5 RRTR (default), "
+           "6 NSD (reference Dirac.h:1533 SM_* numbering)")
     a("-L", "--nulow", type=float, default=2.0)
     a("-H", "--nuhigh", type=float, default=30.0)
     a("-y", "--linsolv", type=int, default=1)

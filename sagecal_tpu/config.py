@@ -17,10 +17,10 @@ import jax.numpy as jnp
 class SolverMode(enum.IntEnum):
     """Solver selection, parity with ``-j`` (reference Dirac.h:1533-1539 SM_*)."""
 
-    LM_LBFGS = 0          # SM_LM_LBFGS: LM + LBFGS refine
-    OSLM_LBFGS = 1        # ordered-subsets LM + LBFGS
-    OSLM_OSRLM_RLBFGS = 2 # robust LM (Student's t) + robust LBFGS
-    RLM_RLBFGS = 3        # robust LM
+    OSLM_LBFGS = 0        # SM_OSLM_LBFGS: ordered-subsets LM + LBFGS
+    LM_LBFGS = 1          # SM_LM_LBFGS: plain LM + LBFGS refine
+    RLM_RLBFGS = 2        # SM_RLM_RLBFGS: robust LM (OS warmup iters)
+    OSLM_OSRLM_RLBFGS = 3 # SM_OSLM_OSRLM_RLBFGS: OS everywhere + robust
     RTR_OSLM_LBFGS = 4    # Riemannian trust region
     RTR_OSRLM_RLBFGS = 5  # robust RTR (production default)
     NSD_RLBFGS = 6        # Nesterov accelerated steepest descent, robust

@@ -154,8 +154,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
         return Jn, nu_new, info["init_cost"], info["final_cost"]
 
     if mode == int(SolverMode.LM_LBFGS) or os_cfg is None:
-        # without OS machinery, modes 1/3 degrade to plain/robust LM and
-        # mode 2 to robust LM (the pre-OS behavior)
+        # without OS machinery, the OS modes (0/3) degrade to
+        # plain/robust LM and mode 2 to robust LM (the pre-OS behavior)
         if _is_robust(mode):
             return robust_lm()
         return plain_lm()

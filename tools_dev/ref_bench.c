@@ -2,7 +2,7 @@
  *
  * Times sagefit_visibilities (src/lib/Dirac/lmfit.c:778) on the same
  * problem shape as bench.py config 1 (N=62 stations, M=8 clusters, one
- * chunk each, tilesz=10, solver mode 2 = SM_OSLM_OSRLM_RLBFGS) with the
+ * chunk each, tilesz=10, solver mode SM_OSLM_OSRLM_RLBFGS = 3) with the
  * same iteration budget (max_emiter=3, max_iter=10, max_lbfgs=10, m=7).
  * Coherencies are synthetic (random smooth phases); data = J_true x coh
  * x J_true^H + noise, like the bench's simulate_dataset oracle.
@@ -146,7 +146,8 @@ int main(int argc, char **argv) {
   dt /= reps;
   printf("{\"config1_vis_per_sec\": %.1f, \"wall_s\": %.3f, "
          "\"res_0\": %.6g, \"res_1\": %.6g, \"threads\": %d, "
-         "\"note\": \"reference libdirac sagefit_visibilities, mode 2, "
+         "\"note\": \"reference libdirac sagefit_visibilities, mode "
+         "SM_OSLM_OSRLM_RLBFGS (-j 3), "
          "N=62 M=8 tilesz=10, emiter=3 iter=10 lbfgs=10\"}\n",
          (double)Nbase / dt, dt, res_0, res_1, Nt);
   return 0;

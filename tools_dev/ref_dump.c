@@ -1,0 +1,107 @@
+/* Reference dump-compare driver for the parity harness.
+ *
+ * Reads a binary problem file written by tests/test_ref_parity.py
+ * (header + u,v,w,x, coherencies, initial solutions), runs the reference
+ * sagefit_visibilities (src/lib/Dirac/lmfit.c:778) with the requested
+ * solver mode and iteration budget, prints one JSON line with
+ * res_0/res_1/mean_nu, and writes the solved 8*N*Mt solution vector to
+ * the output path. This bounds the framework's documented behavioral
+ * deviations (OS subset advance, Fletcher cubic, FISTA prox) with data:
+ * both sides consume the IDENTICAL synthetic tile.
+ *
+ * Build: see tests/test_ref_parity.py (gcc against the read-only
+ * reference checkout + system BLAS/LAPACK sonames).
+ *
+ * Usage: ref_dump <in.bin> <out_p.bin>
+ *
+ * Binary layout (little-endian):
+ *   int32[12]: N, Nbase0, tilesz, M, solver_mode, max_emiter, max_iter,
+ *              max_lbfgs, lbfgs_m, linsolv, randomize, Nt
+ *   f64[4]:    freq0, fdelta, nulow, nuhigh
+ *   f64[Nbase]        u        (Nbase = Nbase0*tilesz; wavelengths)
+ *   f64[Nbase]        v
+ *   f64[Nbase]        w
+ *   f64[8*Nbase]      x        (XX re,im, XY, YX, YY per row)
+ *   f64[8*M*Nbase]    coh      (4 complex per (row, cluster), reference
+ *                               layout coh[4*M*row + 4*m + k])
+ *   f64[8*N*M]        p_init   (one chunk per cluster)
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <complex.h>
+#include <unistd.h>
+
+#include "Dirac.h"
+
+static void rd(void *p, size_t sz, size_t n, FILE *f) {
+  if (fread(p, sz, n, f) != n) {
+    fprintf(stderr, "ref_dump: short read\n");
+    exit(2);
+  }
+}
+
+int main(int argc, char **argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: ref_dump <in.bin> <out_p.bin>\n");
+    return 2;
+  }
+  FILE *f = fopen(argv[1], "rb");
+  if (!f) { perror(argv[1]); return 2; }
+  int hdr[12];
+  rd(hdr, sizeof(int), 12, f);
+  const int N = hdr[0], Nbase0 = hdr[1], tilesz = hdr[2], M = hdr[3];
+  const int solver_mode = hdr[4], max_emiter = hdr[5], max_iter = hdr[6];
+  const int max_lbfgs = hdr[7], lbfgs_m = hdr[8], linsolv = hdr[9];
+  const int randomize = hdr[10];
+  int Nt = hdr[11];
+  double dh[4];
+  rd(dh, sizeof(double), 4, f);
+  const double freq0 = dh[0], fdelta = dh[1], nulow = dh[2],
+               nuhigh = dh[3];
+  const int Nbase = Nbase0 * tilesz, Mt = M;
+  if (Nt <= 0) Nt = (int)sysconf(_SC_NPROCESSORS_ONLN);
+
+  double *u = malloc(sizeof(double) * Nbase);
+  double *v = malloc(sizeof(double) * Nbase);
+  double *w = malloc(sizeof(double) * Nbase);
+  double *x = malloc(sizeof(double) * 8 * Nbase);
+  complex double *coh = malloc(sizeof(complex double) * 4 * M * Nbase);
+  double *pp = malloc(sizeof(double) * 8 * N * Mt);
+  rd(u, sizeof(double), Nbase, f);
+  rd(v, sizeof(double), Nbase, f);
+  rd(w, sizeof(double), Nbase, f);
+  rd(x, sizeof(double), 8 * Nbase, f);
+  rd(coh, sizeof(complex double), 4 * (size_t)M * Nbase, f);
+  rd(pp, sizeof(double), 8 * (size_t)N * Mt, f);
+  fclose(f);
+
+  baseline_t *barr = calloc(Nbase, sizeof(baseline_t));
+  int row = 0;
+  for (int t = 0; t < tilesz; t++)
+    for (int i = 0; i < N; i++)
+      for (int j = i + 1; j < N; j++) {
+        barr[row].sta1 = i; barr[row].sta2 = j; barr[row].flag = 0; row++;
+      }
+  clus_source_t *carr = calloc(M, sizeof(clus_source_t));
+  for (int m = 0; m < M; m++) {
+    carr[m].N = 1; carr[m].id = m; carr[m].nchunk = 1;
+    carr[m].p = calloc(1, sizeof(int));
+    carr[m].p[0] = m * 8 * N;
+  }
+
+  double mean_nu = 0, res_0 = 0, res_1 = 0;
+  sagefit_visibilities(u, v, w, x, N, Nbase0, tilesz, barr, carr, coh, M,
+                       Mt, freq0, fdelta, pp, 0.0, Nt, max_emiter,
+                       max_iter, max_lbfgs, lbfgs_m, 0, linsolv,
+                       solver_mode, nulow, nuhigh, randomize, &mean_nu,
+                       &res_0, &res_1);
+
+  FILE *g = fopen(argv[2], "wb");
+  if (!g) { perror(argv[2]); return 2; }
+  fwrite(pp, sizeof(double), 8 * (size_t)N * Mt, g);
+  fclose(g);
+  printf("{\"res_0\": %.12g, \"res_1\": %.12g, \"mean_nu\": %.6g, "
+         "\"solver_mode\": %d}\n", res_0, res_1, mean_nu, solver_mode);
+  return 0;
+}

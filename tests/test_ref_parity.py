@@ -124,8 +124,9 @@ def run_reference(exe, prob, solver_mode, tmpdir):
                        timeout=570)
     assert r.returncode == 0, r.stderr[-500:]
     res = json.loads(r.stdout.strip().splitlines()[-1])
-    # solution layout: [M][N][8] reals -> [M, N, 2, 2] complex
-    # (README.md:188: [S0+jS1, S4+jS5; S2+jS3, S6+jS7])
+    # solution layout: [M][N][8] reals -> [M, N, 2, 2] complex, in the
+    # solver's in-memory p order (lmfit.c:443-446: G01=p[2]+j p[3],
+    # G10=p[4]+j p[5]; the solution FILE reorders to README.md:188)
     pr = np.fromfile(outp).reshape(pb["M"], pb["N"], 8)
     Jr = np.zeros((pb["M"], pb["N"], 2, 2), complex)
     Jr[..., 0, 0] = pr[..., 0] + 1j * pr[..., 1]

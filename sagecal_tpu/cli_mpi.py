@@ -79,6 +79,14 @@ def build_parser() -> argparse.ArgumentParser:
       help="force the jax platform, e.g. 'cpu' for a virtual host mesh")
     a("--cpu-devices", type=int, default=0,
       help="virtual CPU device count (with --platform cpu)")
+    a("--block-f", type=int, default=0,
+      help="single-device blocked J-update: subbands per device "
+           "execution (keeps each program under the tunneled chip's "
+           "per-execution wall-clock kill on north-star shapes); 0 = "
+           "one mesh program")
+    a("--host-loop", action="store_true",
+      help="one device execution per ADMM iteration instead of a fully "
+           "traced n_admm-iteration program")
     return p
 
 
@@ -235,9 +243,24 @@ def main(argv=None) -> int:
             nulow=args.nulow, nuhigh=args.nuhigh))
 
     t0 = mss[0].read_tile(0)
-    runner = cadmm.make_admm_runner(dsky, t0.sta1, t0.sta2, cidx, cmask, n,
-                                    meta0["fdelta"], Bpoly_pad, cfg, mesh,
-                                    nf, spatial_coords=spatial_coords)
+    blk_timer = [] if args.block_f else None
+    if args.block_f:
+        if args.block_f < 1:
+            raise ValueError(f"--block-f {args.block_f}: must be >= 1")
+        if args.host_loop:
+            raise ValueError("--block-f and --host-loop are different "
+                             "execution plans; pick one")
+        if ndev != 1:
+            raise ValueError("--block-f is the single-device execution "
+                             "plan; it needs a 1-device mesh")
+        runner = cadmm.make_admm_runner_blocked(
+            dsky, t0.sta1, t0.sta2, cidx, cmask, n, meta0["fdelta"],
+            Bpoly_pad, cfg, nf, block_f=args.block_f, timer=blk_timer)
+    else:
+        runner = cadmm.make_admm_runner(
+            dsky, t0.sta1, t0.sta2, cidx, cmask, n, meta0["fdelta"],
+            Bpoly_pad, cfg, mesh, nf, spatial_coords=spatial_coords,
+            host_loop=args.host_loop)
 
     # residual program (per subband, local J)
     def residual_fn(J_r8, x_r, u, v, w, freq):
@@ -314,7 +337,21 @@ def main(argv=None) -> int:
         padded, _, _ = cadmm.pad_subbands(
             (x8F, uF, vF, wF, freqs, wtF, fratioF, J0), Bpoly, nf, ndev)
         args_dev = [stage(np.asarray(a, np.dtype(rdt))) for a in padded]
+        if blk_timer is not None:
+            blk_timer.clear()
         JF_r8, Z, rhoF, res0, res1, r1s, duals, Y0F = runner(*args_dev)
+        if blk_timer is not None and is_writer:
+            # per-ADMM-iteration wall-clock from the blocked runner's
+            # per-execution telemetry (solve blocks + consensus); the
+            # first tile's numbers include compilation
+            nblk = -(-fpad // args.block_f)
+            times = [t for _, t in blk_timer]
+            per_iter = [sum(times[i * (nblk + 1):(i + 1) * (nblk + 1)])
+                        for i in range(cfg.n_admm)]
+            print("ADMM wall-clock/iter: "
+                  + " ".join(f"{t:.2f}s" for t in per_iter)
+                  + f" (blocks of {args.block_f} subbands, "
+                  f"{nblk} solve executions + 1 consensus each)")
         # slice padded subband rows off every per-subband output
         JF_r8 = fetch(JF_r8)[:nf]
         Z = fetch(Z)

@@ -101,30 +101,36 @@ def _unblocks(X, m, k, n):
     return ne.jones_c2r(J)
 
 
-def manifold_average_mesh(Y_r8, axis_name: str, nf_total: int, m: int,
+def manifold_average_mesh(Y_r8, axis_name, nf_total: int, m: int,
                           k: int, n: int, niter: int = 20):
     """Mesh version of calculate_manifold_average over the freq axis.
 
     Y_r8: [Fl, M, K, N, 8] local shard (Fl subbands per device). Each
     (m, k) block is rotated by ONE unitary toward the cross-frequency
     average; the reference block is the globally-first subband.
+    ``axis_name=None`` means all subbands are local (single-device
+    blocked path): psums become local sums.
     """
+    psum = ((lambda x: x) if axis_name is None
+            else (lambda x: jax.lax.psum(x, axis_name)))
     X0 = _blocks(Y_r8)                      # [Fl, MK, 2N, 2] complex
     # broadcast only the globally-first subband's block as the reference
     # (cheaper than all_gathering the whole array to read one element)
-    is_first = (jax.lax.axis_index(axis_name) == 0)
-    ref = jax.lax.psum(jnp.where(is_first, X0[0], jnp.zeros_like(X0[0])),
-                       axis_name)
+    if axis_name is None:
+        ref = X0[0]
+    else:
+        is_first = (jax.lax.axis_index(axis_name) == 0)
+        ref = psum(jnp.where(is_first, X0[0], jnp.zeros_like(X0[0])))
 
     Xp = jax.vmap(lambda Xf: mf.procrustes_project(ref, Xf))(X0)
 
     def body(Xp, _):
-        mean = jax.lax.psum(jnp.sum(Xp, axis=0), axis_name) / nf_total
+        mean = psum(jnp.sum(Xp, axis=0)) / nf_total
         Xp = jax.vmap(lambda Xf: mf.procrustes_project(mean, Xf))(Xp)
         return Xp, None
 
     Xp, _ = jax.lax.scan(body, Xp, None, length=niter)
-    mean = jax.lax.psum(jnp.sum(Xp, axis=0), axis_name) / nf_total
+    mean = psum(jnp.sum(Xp, axis=0)) / nf_total
     Xout = jax.vmap(lambda Xf: mf.procrustes_project(mean, Xf))(X0)
     return _unblocks(Xout, m, k, n)
 
@@ -132,7 +138,8 @@ def manifold_average_mesh(Y_r8, axis_name: str, nf_total: int, m: int,
 def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                      fdelta: float, B_poly: np.ndarray, cfg: ADMMConfig,
                      mesh: Mesh, nf_total: int, with_shapelets: bool = False,
-                     spatial_coords=None, host_loop: bool = False):
+                     spatial_coords=None, host_loop: bool = False,
+                     _return_parts: bool = False):
     """Build the jitted per-timeslot consensus-ADMM program.
 
     Returns ``run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F_r8)`` operating
@@ -216,23 +223,26 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
 
     axis = "freq"
 
-    def _brow(Fl):
+    def _brow(Fl, ax=axis):
         # per-subband basis rows: gather local rows from the replicated
-        # Bfull via the global subband index of each local row
-        dev_idx = jax.lax.axis_index(axis)
+        # Bfull via the global subband index of each local row. ax=None:
+        # everything is local (single-device blocked path).
+        dev_idx = 0 if ax is None else jax.lax.axis_index(ax)
         local_ids = dev_idx * Fl + jnp.arange(Fl)
         return Bfull[local_ids]                  # [Fl, P]
 
-    def _fmask(Fl, dtype):
+    def _fmask(Fl, dtype, ax=axis):
         """[Fl, 1] 1.0 for real subbands, 0.0 for padded slots (global
         index >= nf_total when the caller padded F up to the mesh)."""
-        dev_idx = jax.lax.axis_index(axis)
+        dev_idx = 0 if ax is None else jax.lax.axis_index(ax)
         local_ids = dev_idx * Fl + jnp.arange(Fl)
         return (local_ids < nf_total).astype(dtype)[:, None]
 
     # rho for ALL subbands (for Bii): [M, F]
-    def all_rho(rhoF):
-        g = jax.lax.all_gather(rhoF, axis)       # [ndev, Fl, M]
+    def all_rho(rhoF, ax=axis):
+        if ax is None:
+            return rhoF.T
+        g = jax.lax.all_gather(rhoF, ax)         # [ndev, Fl, M]
         return g.reshape(-1, M).T                # [M, F]
 
     def _alpha_vec(rho_m, dtype):
@@ -243,18 +253,19 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         return (cfg.federated_alpha * rho_m
                 / jnp.maximum(jnp.max(rho_m), 1e-30)).astype(dtype)
 
-    def z_update(Brow, YF, rhoF, alpha_vec, Zbar=None, Xd=None):
+    def z_update(Brow, YF, rhoF, alpha_vec, Zbar=None, Xd=None, ax=axis):
         """z = sum_f B_f Y_f where YF already holds Y + rho J as sent
         to the master (slave :686-700); Z = Bii z (master :755-779).
         With spatial reg the prior pulls in: z += alpha Zbar - X and
         Bii gains the federated +alpha I (master :668-673,:768-775)."""
         zsum_local = jnp.einsum("fp,fmknr->mpknr", Brow, YF)
-        zsum = jax.lax.psum(zsum_local, axis)
+        zsum = (zsum_local if ax is None
+                else jax.lax.psum(zsum_local, ax))
         if Zbar is not None:
             # alphak[cm] Zbar - X (master :768-775)
             zsum = zsum + alpha_vec[:, None, None, None, None] * Zbar - Xd
         Bii = cpoly.find_prod_inverse(
-            Bfull, all_rho(rhoF).astype(YF.dtype), alpha=alpha_vec)
+            Bfull, all_rho(rhoF, ax).astype(YF.dtype), alpha=alpha_vec)
         return cpoly.z_from_contributions(zsum, Bii)
 
     def spatial_step(Z, Zbar, Xd, dtype):
@@ -277,42 +288,41 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         Xd_new = Xd + cfg.federated_alpha * (Z - Zbar_new)
         return Zbar_new, Xd_new
 
-    def iter0_local(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
-        """ADMM iteration 0 on the LOCAL shard: plain solve + dual seed
-        + manifold average + first Z/dual update. Returns the loop carry
-        plus (res0, res1, Y0F)."""
-        Fl = x8F.shape[0]
-        Brow = _brow(Fl)
-        fm = _fmask(Fl, x8F.dtype)               # [Fl, 1] padded-slot mask
+    def iter0_post(JF, res0, res1, fratioF, ax=axis):
+        """Everything after iteration 0's solves: dual seed + manifold
+        average + first Z/dual update. Shared by the mesh path (ax =
+        mesh axis, JF local) and the blocked path (ax=None, JF full)."""
+        Fl = JF.shape[0]
+        dtype = JF.dtype
+        Brow = _brow(Fl, ax)
+        fm = _fmask(Fl, dtype, ax)               # [Fl, 1] padded-slot mask
         fm5 = fm[:, :, None, None, None]         # [Fl, 1, 1, 1, 1]
         # per-(subband, cluster) rho scaled by unflagged fraction; cfg.rho
         # may be a scalar or an [M] per-cluster array (readsky.c:780 -G)
-        rho_m = jnp.broadcast_to(jnp.asarray(cfg.rho, x8F.dtype), (M,))
+        rho_m = jnp.broadcast_to(jnp.asarray(cfg.rho, dtype), (M,))
         rhoF = rho_m[None, :] * fratioF[:, None] * fm * jnp.ones(
-            (Fl, M), x8F.dtype)
-        alpha_vec = _alpha_vec(rho_m, x8F.dtype)
+            (Fl, M), dtype)
+        alpha_vec = _alpha_vec(rho_m, dtype)
 
-        JF, res0, res1 = jax.vmap(local_solve_plain)(
-            x8F, uF, vF, wF, wtF, J0F, freqF)
         # padded slots contribute exact zeros to every collective (the
         # where also stops a non-finite padded J from poisoning 0*J)
         YF = jnp.where(fm5 > 0,
                        rhoF[..., None, None, None]
                        * JF.reshape(Fl, M, K, N, 8), 0.0)
-        YF = manifold_average_mesh(YF, axis, nf_total, M, K, N,
+        YF = manifold_average_mesh(YF, ax, nf_total, M, K, N,
                                    cfg.manifold_iters)
         YF = jnp.where(fm5 > 0, YF, 0.0)
         Y0F = YF     # manifold-projected rho*J: the MDL input (:815-822)
 
         # spatial-reg state (replicated); zeros when disabled
-        Zbar = jnp.zeros((M, Ppoly, K, N, 8), x8F.dtype)
+        Zbar = jnp.zeros((M, Ppoly, K, N, 8), dtype)
         Xd = jnp.zeros_like(Zbar)
 
         # iteration 0 Z update: Y currently = rho*J (manifold-aligned)
-        Z = z_update(Brow, YF, rhoF, alpha_vec)
+        Z = z_update(Brow, YF, rhoF, alpha_vec, ax=ax)
         if spat is not None:
             # admm==0 matches !(admm % cadence) (master :789)
-            Zbar, Xd = spatial_step(Z, Zbar, Xd, x8F.dtype)
+            Zbar, Xd = spatial_step(Z, Zbar, Xd, dtype)
         BZ = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
         YF = YF - rhoF[..., None, None, None] * BZ   # dual (slave :750)
 
@@ -320,30 +330,34 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                  Zbar, Xd, rhoF)
         return carry, res0, res1, Y0F
 
-    def body_local(x8F, uF, vF, wF, freqF, wtF, carry, it):
-        """One ADMM iteration k>0 on the LOCAL shard (slave :686-770)."""
-        JF, YF, Z, rhoF, Yhat_prev, Jprev, Zbar, Xd, rho_upper = carry
-        Fl = x8F.shape[0]
-        Brow = _brow(Fl)
-        fm = _fmask(Fl, x8F.dtype)
-        fm5 = fm[:, :, None, None, None]
-        rho_m = jnp.broadcast_to(jnp.asarray(cfg.rho, x8F.dtype), (M,))
-        alpha_vec = _alpha_vec(rho_m, x8F.dtype)
+    def iter0_local(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
+        """ADMM iteration 0 on the LOCAL shard: plain solve + post."""
+        JF, res0, res1 = jax.vmap(local_solve_plain)(
+            x8F, uF, vF, wF, wtF, J0F, freqF)
+        return iter0_post(JF, res0, res1, fratioF)
 
-        BZ = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
-        Jr, r0, r1 = jax.vmap(local_solve_admm)(
-            x8F, uF, vF, wF, wtF, JF, freqF, YF, BZ, rhoF)
+    def body_post(Jr, r0, r1, carry, it, ax=axis):
+        """Everything after iteration k>0's solves (slave :686-770)."""
+        JF, YF, Z, rhoF, Yhat_prev, Jprev, Zbar, Xd, rho_upper = carry
+        Fl = Jr.shape[0]
+        dtype = Jr.dtype
+        Brow = _brow(Fl, ax)
+        fm = _fmask(Fl, dtype, ax)
+        fm5 = fm[:, :, None, None, None]
+        rho_m = jnp.broadcast_to(jnp.asarray(cfg.rho, dtype), (M,))
+        alpha_vec = _alpha_vec(rho_m, dtype)
+
         J5 = Jr.reshape(Fl, M, K, N, 8)
         YF = jnp.where(fm5 > 0,
                        YF + rhoF[..., None, None, None] * J5, 0.0)
         Zold = Z
         if spat is None:
-            Z = z_update(Brow, YF, rhoF, alpha_vec)
+            Z = z_update(Brow, YF, rhoF, alpha_vec, ax=ax)
         else:
-            Z = z_update(Brow, YF, rhoF, alpha_vec, Zbar, Xd)
+            Z = z_update(Brow, YF, rhoF, alpha_vec, Zbar, Xd, ax=ax)
             Zbar, Xd = jax.lax.cond(
                 it % spat["cadence"] == 0,
-                lambda z, zb, xd: spatial_step(z, zb, xd, x8F.dtype),
+                lambda z, zb, xd: spatial_step(z, zb, xd, dtype),
                 lambda z, zb, xd: (zb, xd),
                 Z, Zbar, Xd)
         BZn = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
@@ -364,6 +378,23 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         dual = jnp.linalg.norm(Z - Zold) / np.sqrt(Z.size)
         return (Jr, YF, Z, rhoF, Yhat, J5, Zbar, Xd, rho_upper), \
             (r0, r1, dual)
+
+    def body_local(x8F, uF, vF, wF, freqF, wtF, carry, it):
+        """One ADMM iteration k>0 on the LOCAL shard (slave :686-770)."""
+        Fl = x8F.shape[0]
+        Brow = _brow(Fl)
+        BZ = jnp.einsum("fp,mpknr->fmknr", Brow, carry[2])
+        Jr, r0, r1 = jax.vmap(local_solve_admm)(
+            x8F, uF, vF, wF, wtF, carry[0], freqF, carry[1], BZ, carry[3])
+        return body_post(Jr, r0, r1, carry, it)
+
+    if _return_parts:
+        # building blocks for make_admm_runner_blocked (same math,
+        # different execution granularity)
+        return dict(local_solve_plain=local_solve_plain,
+                    local_solve_admm=local_solve_admm,
+                    iter0_post=iter0_post, body_post=body_post,
+                    _brow=_brow)
 
     def admm_program(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
         # shapes here are the LOCAL shard: [Fl, ...]
@@ -435,6 +466,120 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         r1s_a = (jnp.stack(r1s) if r1s
                  else jnp.zeros((0, F), x8F.dtype))
         duals_a = (jnp.stack(duals) if duals
+                   else jnp.zeros((0,), x8F.dtype))
+        return JF, Z, rhoF, res0, res1, r1s_a, duals_a, Y0F
+
+    return run
+
+
+def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
+                             n_stations: int, fdelta: float,
+                             B_poly: np.ndarray, cfg: ADMMConfig,
+                             nf_total: int, block_f: int,
+                             with_shapelets: bool = False,
+                             device=None, timer=None):
+    """Single-device consensus ADMM with the J-update split into subband
+    BLOCKS of ``block_f`` — one bounded device execution per block, tiny
+    consensus executions in between. Identical math to
+    :func:`make_admm_runner` (it reuses the same iter0_post/body_post
+    consensus code with ax=None), built for shapes where one folded
+    J-update over all subbands would exceed the tunneled chip's
+    per-execution wall-clock kill (~60 s): the north-star 64-station x
+    100-direction x 32-subband problem.
+
+    Spatial regularization is not offered here (use the mesh runner).
+    ``timer``: optional list that receives (label, seconds) tuples for
+    per-execution telemetry.
+    """
+    import time as _time
+
+    from jax.sharding import Mesh
+
+    if cfg.spatialreg is not None:
+        raise ValueError("blocked runner does not support -X spatial "
+                         "regularization; use make_admm_runner")
+    # borrow the full closure set from make_admm_runner on a 1-device
+    # mesh; we only use its ax=None entry points, never its shard_map
+    # programs
+    devs = [device] if device is not None else jax.devices()[:1]
+    mesh = Mesh(np.array(devs), ("freq",))
+    parts = make_admm_runner(
+        dsky, sta1, sta2, cidx, cmask, n_stations, fdelta, B_poly, cfg,
+        mesh, nf_total, with_shapelets=with_shapelets,
+        _return_parts=True)
+    local_solve_plain = parts["local_solve_plain"]
+    local_solve_admm = parts["local_solve_admm"]
+    iter0_post = parts["iter0_post"]
+    body_post = parts["body_post"]
+    _brow = parts["_brow"]
+
+    solve0 = jax.jit(jax.vmap(local_solve_plain))
+    solveb = jax.jit(jax.vmap(local_solve_admm))
+    cons0 = jax.jit(lambda JF, res0, res1, fratioF: iter0_post(
+        JF, res0, res1, fratioF, ax=None))
+    consb = jax.jit(lambda Jr, r0, r1, carry, it: body_post(
+        Jr, r0, r1, carry, it, ax=None))
+    bz_prog = jax.jit(
+        lambda Z, Brow: jnp.einsum("fp,mpknr->fmknr", Brow, Z))
+
+    def _t(label, t0, out):
+        if timer is not None:
+            jax.block_until_ready(out)
+            timer.append((label, _time.perf_counter() - t0))
+        return out
+
+    def run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
+        F = x8F.shape[0]
+        Brow_full = _brow(F, None)          # eager: Bfull[:F]
+        blocks = [slice(b, min(b + block_f, F))
+                  for b in range(0, F, block_f)]
+
+        def take(a, sl):
+            """Block slice, padded to block_f by repeating the first
+            row so every block compiles to ONE program shape (a ragged
+            tail block would otherwise double the solve compiles)."""
+            ab = a[sl]
+            short = block_f - ab.shape[0]
+            if short:
+                ab = jnp.concatenate(
+                    [ab, jnp.broadcast_to(ab[:1],
+                                          (short,) + ab.shape[1:])])
+            return ab
+
+        def blockwise(fn, *arrs):
+            Js, r0s, r1s = [], [], []
+            for i, sl in enumerate(blocks):
+                t0 = _time.perf_counter()
+                Jb, r0b, r1b = fn(*[take(a, sl) for a in arrs])
+                _t(f"solve[{i}]", t0, Jb)
+                nreal = sl.stop - sl.start
+                Js.append(Jb[:nreal])
+                r0s.append(r0b[:nreal])
+                r1s.append(r1b[:nreal])
+            return (jnp.concatenate(Js), jnp.concatenate(r0s),
+                    jnp.concatenate(r1s))
+
+        JF, res0, res1 = blockwise(solve0, x8F, uF, vF, wF, wtF, J0F,
+                                   freqF)
+        t0 = _time.perf_counter()
+        carry, res0, res1, Y0F = cons0(JF, res0, res1, fratioF)
+        _t("cons0", t0, carry[2])
+        r1h, dualh = [], []
+        for it in range(1, max(cfg.n_admm, 1)):
+            BZ = bz_prog(carry[2], Brow_full)
+            Jr, r0, r1 = blockwise(
+                solveb, x8F, uF, vF, wF, wtF, carry[0], freqF, carry[1],
+                BZ, carry[3])
+            t0 = _time.perf_counter()
+            carry, (r0, r1, dual) = consb(Jr, r0, r1, carry,
+                                          jnp.asarray(it, jnp.int32))
+            _t(f"cons[{it}]", t0, carry[2])
+            r1h.append(r1)
+            dualh.append(dual)
+        JF, Z, rhoF = carry[0], carry[2], carry[3]
+        r1s_a = (jnp.stack(r1h) if r1h
+                 else jnp.zeros((0, F), x8F.dtype))
+        duals_a = (jnp.stack(dualh) if dualh
                    else jnp.zeros((0,), x8F.dtype))
         return JF, Z, rhoF, res0, res1, r1s_a, duals_a, Y0F
 

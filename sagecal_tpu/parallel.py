@@ -1,0 +1,104 @@
+"""Intra-subband baseline-axis sharding (SURVEY.md P1 / long-context).
+
+The reference splits the ``Nbase*tilesz`` row axis across pthreads for
+every predict/residual/cost/grad/Jacobian evaluation
+(``predict.c:417-495``, ``thread_data_base_t``). The TPU-native
+equivalent for one subband that spans MORE THAN ONE chip is not manual
+collectives but sharding annotations + GSPMD: the solve is jitted with
+its row-indexed inputs sharded over a "base" mesh axis and the solution
+replicated; XLA's partitioner then runs every per-row computation
+shard-local and inserts all-reduces exactly where the math contracts
+over rows (residual norms, the 8N x 8N normal-equation accumulations,
+LBFGS cost/grad sums, robust nu/weight statistics) — the whole solver
+stack is reused unchanged.
+
+This module provides the staging helper + sharded entry point and is
+validated by a sharding-invariance test (``tests/test_scale.py``):
+8-way row-sharded == single-device to float tolerance, with the row
+count padded to the mesh when needed (padded rows get zero weight, which
+every reduction in the stack already honors).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sagecal_tpu.solvers import sage
+
+
+def base_mesh(n_devices: int | None = None) -> Mesh:
+    """A 1-axis mesh over the row (baseline x time) dimension."""
+    devs = jax.devices()
+    if n_devices:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), ("base",))
+
+
+def pad_rows(arrays, wt_base, nrows: int, ndev: int):
+    """Pad the leading row axis of every array (and the weight array) to
+    a multiple of the mesh size. Padded rows carry zero weight: they are
+    already excluded from every reduction the solvers perform (the same
+    contract as flagged rows, lm.py make_weights)."""
+    bpad = -(-nrows // ndev) * ndev
+    if bpad == nrows:
+        return list(arrays), np.asarray(wt_base), bpad
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        pad_shape = (bpad - nrows,) + a.shape[1:]
+        out.append(np.concatenate([a, np.zeros(pad_shape, a.dtype)]))
+    wt = np.asarray(wt_base)
+    wt = np.concatenate([wt, np.zeros((bpad - nrows,) + wt.shape[1:],
+                                      wt.dtype)])
+    return out, wt, bpad
+
+
+def sharded_sagefit(mesh: Mesh, dsky, fdelta: float, chunk_mask,
+                    n_stations: int, config=None,
+                    with_shapelets: bool = False):
+    """Build a row-sharded full solve: coherency predict + SAGE-EM with
+    the [B]-indexed inputs sharded over ``mesh``'s "base" axis.
+
+    Returns ``solve(x8, u, v, w, sta1, sta2, cidx, wt, J0_r8, freq)``
+    where cidx is [M, B] (sharded on its row axis) and J0_r8 is the
+    [M, K, N, 8] real Jones (replicated). The caller stages inputs with
+    :func:`shard_rows`; outputs (J, res_0, res_1) come back replicated.
+    """
+    from sagecal_tpu.rime import predict as rp
+    from sagecal_tpu.solvers import normal_eq as ne
+
+    cfg = config or sage.SageConfig()
+    cmask_j = jnp.asarray(chunk_mask)
+    rows = NamedSharding(mesh, P("base"))
+    rows2 = NamedSharding(mesh, P(None, "base"))
+    repl = NamedSharding(mesh, P())
+
+    def solve(x8, u, v, w, sta1, sta2, cidx, wt, J0_r8, freq):
+        coh = rp.coherencies(dsky, u, v, w, freq[None], fdelta,
+                             with_shapelets=with_shapelets)[:, :, 0]
+        J, info = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask_j,
+                               ne.jones_r2c(J0_r8), n_stations, wt,
+                               config=cfg)
+        return ne.jones_c2r(J), info["res_0"], info["res_1"]
+
+    return jax.jit(
+        solve,
+        in_shardings=(rows, rows, rows, rows, rows, rows, rows2, rows,
+                      repl, repl),
+        out_shardings=(repl, repl, repl))
+
+
+def shard_rows(mesh: Mesh, *arrays, row_axis: int = 0):
+    """Stage host arrays with their row axis sharded over "base"."""
+    out = []
+    for a in arrays:
+        spec = [None] * np.asarray(a).ndim
+        spec[row_axis] = "base"
+        out.append(jax.device_put(jnp.asarray(a),
+                                  NamedSharding(mesh, P(*spec))))
+    return out

@@ -347,6 +347,9 @@ def cluster_sources(ll, mm, sI, k: int, seed: int = 0, iters: int = 50,
     V = _sphere_vecs(np.asarray(ll, float), np.asarray(mm, float))
     nc = min(abs(k), S)
     if k > 0:
+        if init not in ("kmeans++", "brightest"):
+            raise ValueError(f"init={init!r}: use 'kmeans++' or "
+                             f"'brightest'")
         rng = np.random.default_rng(seed)
         if init == "brightest":
             cent = V[np.argsort(-w)[:nc]].copy()

@@ -172,3 +172,72 @@ def test_mesh_admm_subband_folding():
                                rtol=1e-8, atol=1e-10)
     np.testing.assert_allclose(np.asarray(JF), np.asarray(JF1),
                                rtol=1e-8, atol=1e-10)
+
+
+def test_baseline_axis_sharding_matches_single_device():
+    """P1 intra-subband row sharding (SURVEY long-context item): the
+    full predict+SAGE solve jitted with its [B]-indexed inputs sharded
+    over an 8-way "base" mesh axis must equal the single-device solve —
+    GSPMD inserts the all-reduces where the math contracts over rows
+    (normal equations, residual norms, robust statistics). Rows are
+    padded to the mesh with zero weight."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sagecal_tpu import parallel, utils
+
+    n_stations, tilesz = 10, 3
+    sky = _big_sky(n_clusters=4)
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jtrue = ds.random_jones(sky.n_clusters, sky.nchunk, n_stations,
+                            seed=51, scale=0.15)
+    tile = ds.simulate_dataset(dsky, n_stations=n_stations, tilesz=tilesz,
+                               freqs=[150e6], ra0=0.1, dec0=0.9,
+                               jones=Jtrue, nchunk=sky.nchunk,
+                               noise_sigma=0.01, seed=52,
+                               flag_fraction=0.05)
+    kmax = int(sky.nchunk.max())
+    cidx = np.asarray(rp.chunk_indices(tilesz, tile.nbase, sky.nchunk))
+    cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
+    xa = tile.averaged()
+    x8 = np.stack([xa.reshape(-1, 4).real, xa.reshape(-1, 4).imag],
+                  -1).reshape(-1, 8)
+    wt = np.asarray(lm_mod.make_weights(
+        jnp.asarray(tile.flags, jnp.int32), jnp.float64))
+    J0 = utils.jones_c2r_np(np.tile(
+        np.eye(2, dtype=complex), (sky.n_clusters, kmax, n_stations, 1, 1)))
+    cfg = sage.SageConfig(max_emiter=2, max_iter=5, max_lbfgs=3,
+                          solver_mode=int(SolverMode.LM_LBFGS))
+
+    mesh8 = parallel.base_mesh(8)
+    mesh1 = parallel.base_mesh(1)
+    B = tile.nrows
+    (x8p, up, vp, wp, s1p, s2p), wtp, bpad = parallel.pad_rows(
+        (x8, tile.u, tile.v, tile.w, tile.sta1, tile.sta2), wt, B, 8)
+    cidxp = np.concatenate(
+        [cidx, np.zeros((sky.n_clusters, bpad - B), cidx.dtype)], axis=1)
+    freq = np.array([tile.freq0])
+
+    outs = {}
+    for name, mesh in (("sharded", mesh8), ("single", mesh1)):
+        solve = parallel.sharded_sagefit(mesh, dsky, tile.fdelta, cmask,
+                                         n_stations, config=cfg)
+        args = parallel.shard_rows(mesh, x8p, up, vp, wp, s1p, s2p)
+        (cidx_d,) = parallel.shard_rows(mesh, cidxp, row_axis=1)
+        (wt_d,) = parallel.shard_rows(mesh, wtp)
+        J, r0, r1 = solve(*args, cidx_d, wt_d,
+                          jax.device_put(jnp.asarray(J0),
+                                         NamedSharding(mesh, P())),
+                          jax.device_put(jnp.asarray(freq),
+                                         NamedSharding(mesh, P())))
+        outs[name] = (np.asarray(J), float(r0), float(r1))
+        # the sharded run must actually shard: every [B]-input lives
+        # across all 8 devices
+        if name == "sharded":
+            assert len(args[0].sharding.device_set) == 8
+
+    Js, r0s, r1s = outs["sharded"]
+    J1, r01, r11 = outs["single"]
+    np.testing.assert_allclose(r0s, r01, rtol=1e-9)
+    np.testing.assert_allclose(r1s, r11, rtol=1e-6)
+    np.testing.assert_allclose(Js, J1, rtol=1e-6, atol=1e-9)
+    assert r1s < r0s

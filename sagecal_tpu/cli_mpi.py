@@ -200,6 +200,8 @@ def main(argv=None) -> int:
     mesh = Mesh(np.array(jax.devices()[:ndev]), ("freq",))
     is_writer = args.process_id == 0   # mpirun-analogue output ownership
     if is_writer:
+        print(f"Platform: {jax.devices()[0].platform} "
+              f"({ndev_avail} device(s))")
         print(f"Subbands: {nf} over {ndev} device(s)"
               + (f" (padded to {fpad})" if fpad != nf else "")
               + f"; stations {n}, clusters {sky.n_clusters} "

@@ -493,8 +493,6 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
     """
     import time as _time
 
-    from jax.sharding import Mesh
-
     if cfg.spatialreg is not None:
         raise ValueError("blocked runner does not support -X spatial "
                          "regularization; use make_admm_runner")
@@ -546,11 +544,19 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
                                           (short,) + ab.shape[1:])])
             return ab
 
-        def blockwise(fn, *arrs):
+        # constant per-tile inputs: slice/pad each block ONCE, not per
+        # ADMM iteration
+        const_blocks = [tuple(take(a, sl)
+                              for a in (x8F, uF, vF, wF, wtF, freqF))
+                        for sl in blocks]
+
+        def blockwise(fn, *per_iter):
+            """fn(x8, u, v, w, wt, freq, *per-iteration block args)."""
             Js, r0s, r1s = [], [], []
             for i, sl in enumerate(blocks):
                 t0 = _time.perf_counter()
-                Jb, r0b, r1b = fn(*[take(a, sl) for a in arrs])
+                Jb, r0b, r1b = fn(*const_blocks[i],
+                                  *[take(a, sl) for a in per_iter])
                 _t(f"solve[{i}]", t0, Jb)
                 nreal = sl.stop - sl.start
                 Js.append(Jb[:nreal])
@@ -559,17 +565,21 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
             return (jnp.concatenate(Js), jnp.concatenate(r0s),
                     jnp.concatenate(r1s))
 
-        JF, res0, res1 = blockwise(solve0, x8F, uF, vF, wF, wtF, J0F,
-                                   freqF)
+        def solve0_re(x8, u, v, w, wt, freq, J0):
+            return solve0(x8, u, v, w, wt, J0, freq)
+
+        def solveb_re(x8, u, v, w, wt, freq, J, Y, BZ, rho):
+            return solveb(x8, u, v, w, wt, J, freq, Y, BZ, rho)
+
+        JF, res0, res1 = blockwise(solve0_re, J0F)
         t0 = _time.perf_counter()
         carry, res0, res1, Y0F = cons0(JF, res0, res1, fratioF)
         _t("cons0", t0, carry[2])
         r1h, dualh = [], []
         for it in range(1, max(cfg.n_admm, 1)):
             BZ = bz_prog(carry[2], Brow_full)
-            Jr, r0, r1 = blockwise(
-                solveb, x8F, uF, vF, wF, wtF, carry[0], freqF, carry[1],
-                BZ, carry[3])
+            Jr, r0, r1 = blockwise(solveb_re, carry[0], carry[1], BZ,
+                                   carry[3])
             t0 = _time.perf_counter()
             carry, (r0, r1, dual) = consb(Jr, r0, r1, carry,
                                           jnp.asarray(it, jnp.int32))

@@ -21,8 +21,6 @@ every reduction in the stack already honors).
 
 from __future__ import annotations
 
-import functools
-
 import numpy as np
 import jax
 import jax.numpy as jnp

@@ -54,6 +54,10 @@ from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.solvers import robust as rb
 from sagecal_tpu.solvers import rtr as rtr_mod
 
+# sagefit_host sweep-fusion verdicts, per problem shape (see its
+# docstring); process-lifetime cache, entries are tiny
+_FUSION_CACHE: dict = {}
+
 
 class SageConfig(NamedTuple):
     max_emiter: int = 3
@@ -461,8 +465,14 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
 
     # granularity: start per-cluster (always safe); once a timed sweep
     # shows the whole sweep fits comfortably under the runtime's
-    # per-execution limit, fuse subsequent sweeps into one program
-    fused = False
+    # per-execution limit, fuse subsequent sweeps into one program. The
+    # verdict is remembered per problem shape across calls — re-learning
+    # it every solve cost ~M extra tunnel round-trips per tile (the
+    # warm-path gap between round-2 and round-3 config-1 numbers).
+    fuse_key = (M, x8.shape, n_stations, chunk_mask.shape, str(dtype),
+                dev_config, os_id is None, 0 if os_id is None
+                else int(os_id[1]))
+    fused = _FUSION_CACHE.get(fuse_key, False)
     for ci in range(config.max_emiter):
         weighted = config.randomize and (ci % 2 == 1)
         last = ci == config.max_emiter - 1
@@ -496,6 +506,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
             # so a 25 s per-cluster sweep bounds it well under the ~60 s
             # execution kill
             fused = time.perf_counter() - t_sweep < 25.0
+            _FUSION_CACHE[fuse_key] = fused
         total = float(jnp.sum(nerr_acc))
         nerr = nerr_acc / total if total > 0 else nerr_acc
 

@@ -134,8 +134,12 @@ def main():
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True, env=env)
     per_tile_iters = []
+    platform = "cpu" if args.cpu else "unknown"
     for line in proc.stdout:
         print(line, end="", flush=True)
+        pm = re.match(r"Platform: (\w+)", line)
+        if pm:
+            platform = pm.group(1)   # provenance from the actual backend
         m = re.match(r"ADMM wall-clock/iter: (.*) \(blocks", line)
         if m:
             per_tile_iters.append(
@@ -158,8 +162,7 @@ def main():
     rec = {"metric": "ADMM wall-clock/iter (north-star shape)",
            "value": round(per_iter, 3), "unit": "s/ADMM-iter",
            "shape": shape, "per_tile_iters": per_tile_iters,
-           "total_wall_s": round(wall, 1),
-           "platform": "cpu" if args.cpu else "tpu"}
+           "total_wall_s": round(wall, 1), "platform": platform}
     with open(os.path.join(HERE, "NORTHSTAR.json"), "w") as f:
         json.dump(rec, f, indent=1)
     row = (f"| northstar | {per_iter:.2f} | s/ADMM-iter | — | — | — | "

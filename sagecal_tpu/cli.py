@@ -47,6 +47,17 @@ def build_parser() -> argparse.ArgumentParser:
     a("-I", "--uvmin", type=float, default=0.0)
     a("-o", "--uvmax", type=float, default=1e9)
     a("-W", "--whiten", type=int, default=0)
+    a("--profile", default=None, metavar="DIR",
+      help="write a jax.profiler trace of the first solve interval")
+    a("--shard-baselines", action="store_true",
+      help="shard the baseline row axis of the (single) subband over "
+           "all devices (P1 intra-subband parallelism)")
+    # platform overrides (the JAX_PLATFORMS env var is ignored by some
+    # TPU plugins; the config-update route always works)
+    a("--platform", default=None,
+      help="force the jax platform, e.g. 'cpu' for a virtual host mesh")
+    a("--cpu-devices", type=int, default=0,
+      help="virtual CPU device count (with --platform cpu)")
     a("-w", "--nsolbw", type=int, default=1,
       help="frequency mini-bands for bandpass consensus")
     a("-b", "--per-channel", type=int, default=0)
@@ -95,11 +106,19 @@ def config_from_args(args) -> RunConfig:
         stochastic_loss=args.loss,
         n_admm=args.admm, n_poly=args.npoly, poly_type=args.polytype,
         admm_rho=args.rho, rho_file=args.rho_file,
-        max_timeslots=args.max_timeslots, verbose=args.verbose)
+        max_timeslots=args.max_timeslots, verbose=args.verbose,
+        profile_dir=args.profile,
+        shard_baselines=bool(args.shard_baselines))
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.platform or args.cpu_devices:
+        import jax
+        if args.platform:
+            jax.config.update("jax_platforms", args.platform)
+        if args.cpu_devices:
+            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
     cfg = config_from_args(args)
     if (not cfg.ms and not cfg.ms_list) or not cfg.sky_model \
             or not cfg.cluster_file:

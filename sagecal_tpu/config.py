@@ -132,6 +132,14 @@ class RunConfig:
     mdl_report: bool = False           # -M (mpi app): model-order selection report
     verbose: bool = False              # -V
 
+    # --- observability
+    profile_dir: str | None = None     # --profile : jax.profiler trace of
+    #                                    the first solve interval
+
+    # --- intra-subband distribution (P1): shard the baseline x time row
+    # axis of ONE subband over all devices (GSPMD; parallel.py)
+    shard_baselines: bool = False      # --shard-baselines
+
     # --- device policy
     precision: Precision = dataclasses.field(default_factory=Precision)
 

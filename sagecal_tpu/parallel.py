@@ -58,14 +58,20 @@ def pad_rows(arrays, wt_base, nrows: int, ndev: int):
 
 def sharded_sagefit(mesh: Mesh, dsky, fdelta: float, chunk_mask,
                     n_stations: int, config=None,
-                    with_shapelets: bool = False):
+                    with_shapelets: bool | None = None,
+                    os_nsub: int = 0):
     """Build a row-sharded full solve: coherency predict + SAGE-EM with
     the [B]-indexed inputs sharded over ``mesh``'s "base" axis.
 
-    Returns ``solve(x8, u, v, w, sta1, sta2, cidx, wt, J0_r8, freq)``
-    where cidx is [M, B] (sharded on its row axis) and J0_r8 is the
-    [M, K, N, 8] real Jones (replicated). The caller stages inputs with
-    :func:`shard_rows`; outputs (J, res_0, res_1) come back replicated.
+    Returns ``solve(x8, u, v, w, sta1, sta2, cidx, wt, J0_r8, freq,
+    os_ids, key)`` where cidx is [M, B] (sharded on its row axis),
+    J0_r8 is the [M, K, N, 8] real Jones (replicated), os_ids the [B]
+    ordered-subset ids (row-sharded; pass with ``os_nsub`` > 0 to keep
+    the P4 acceleration on the sharded path) and ``key`` the per-tile
+    PRNG key (replicated). ``with_shapelets=None`` auto-detects from the
+    sky model like the unsharded predict. The caller stages inputs with
+    :func:`shard_rows`; outputs (J, res_0, res_1, mean_nu) come back
+    replicated.
     """
     from sagecal_tpu.rime import predict as rp
     from sagecal_tpu.solvers import normal_eq as ne
@@ -76,19 +82,22 @@ def sharded_sagefit(mesh: Mesh, dsky, fdelta: float, chunk_mask,
     rows2 = NamedSharding(mesh, P(None, "base"))
     repl = NamedSharding(mesh, P())
 
-    def solve(x8, u, v, w, sta1, sta2, cidx, wt, J0_r8, freq):
+    def solve(x8, u, v, w, sta1, sta2, cidx, wt, J0_r8, freq, os_ids,
+              key):
         coh = rp.coherencies(dsky, u, v, w, freq[None], fdelta,
                              with_shapelets=with_shapelets)[:, :, 0]
+        os_id = (os_ids, os_nsub) if os_nsub else None
         J, info = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask_j,
                                ne.jones_r2c(J0_r8), n_stations, wt,
-                               config=cfg)
-        return ne.jones_c2r(J), info["res_0"], info["res_1"]
+                               config=cfg, os_id=os_id, key=key)
+        return (ne.jones_c2r(J), info["res_0"], info["res_1"],
+                info["mean_nu"])
 
     return jax.jit(
         solve,
         in_shardings=(rows, rows, rows, rows, rows, rows, rows2, rows,
-                      repl, repl),
-        out_shardings=(repl, repl, repl))
+                      repl, repl, rows, repl),
+        out_shardings=(repl, repl, repl, repl))
 
 
 def shard_rows(mesh: Mesh, *arrays, row_axis: int = 0):

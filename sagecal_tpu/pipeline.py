@@ -162,6 +162,9 @@ class FullBatchPipeline:
         cidx = jnp.asarray(self.cidx)
         cmask = jnp.asarray(self.cmask)
 
+        if getattr(self.cfg, "shard_baselines", False):
+            return self._build_sharded_solver(scfg, meta, freq0, fdelta)
+
         tslot = jnp.asarray(self.tslot)
         # ordered-subsets partition for solver modes 1/2/3 (P4,
         # clmfit.c:1074); harmless to pass for other modes
@@ -193,6 +196,62 @@ class FullBatchPipeline:
                 jnp.asarray(x8, self.rdt), coh, sta1, sta2, cidx, cmask,
                 J0, self.n, wt, config=scfg, os_id=os_info, key=key)
             return _jones_c2r_j(J), info
+        return solve
+
+    def _build_sharded_solver(self, scfg, meta, freq0, fdelta):
+        """--shard-baselines: one subband spanning the whole mesh (P1).
+
+        The predict + SAGE solve runs as ONE program with the row axis
+        sharded over a "base" mesh axis and the solutions replicated —
+        GSPMD places the all-reduces (parallel.sharded_sagefit). Rows
+        pad to the mesh with zero weight; the OS-subset ids and per-tile
+        PRNG key ride through so modes 1/2/3 keep the P4 acceleration.
+        Beam mode raises (the beam chain is not sharded yet)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from sagecal_tpu import parallel
+
+        if self.dobeam:
+            raise ValueError("--shard-baselines with beam mode is not "
+                             "supported yet; drop -B or the flag")
+        mesh = parallel.base_mesh()
+        ndev = mesh.devices.size
+        os_ids_np, os_nsub = lm_mod.os_subset_ids(meta["tilesz"],
+                                                  meta["nbase"])
+        solve_j = parallel.sharded_sagefit(mesh, self.dsky, fdelta,
+                                           self.cmask, self.n,
+                                           config=scfg, os_nsub=os_nsub)
+        cidx_np = np.asarray(self.cidx)
+        freq = np.asarray([freq0])
+        repl = NamedSharding(mesh, P())
+
+        def solve(x8, u, v, w, sta1, sta2, wt, J0_r8, beam, tile_idx=0):
+            B = np.asarray(x8).shape[0]
+            arrs, wtp, bpad = parallel.pad_rows(
+                (x8, u, v, w, sta1, sta2), wt, B, ndev)
+            cidxp = np.concatenate(
+                [cidx_np, np.zeros((cidx_np.shape[0], bpad - B),
+                                   cidx_np.dtype)], axis=1)
+            # padded rows get subset id 0; their zero weight already
+            # excludes them from every subset's normal equations
+            osp = np.concatenate(
+                [np.asarray(os_ids_np),
+                 np.zeros(bpad - B, np.asarray(os_ids_np).dtype)])
+            args = parallel.shard_rows(
+                mesh, *[np.asarray(a, np.dtype(self.rdt)
+                                   if np.asarray(a).dtype.kind == "f"
+                                   else None) for a in arrs])
+            (cidx_d,) = parallel.shard_rows(mesh, cidxp, row_axis=1)
+            (wt_d,) = parallel.shard_rows(
+                mesh, np.asarray(wtp, np.dtype(self.rdt)))
+            (os_d,) = parallel.shard_rows(mesh, osp)
+            key = jax.random.fold_in(jax.random.PRNGKey(199), tile_idx)
+            J, r0, r1, mnu = solve_j(
+                *args, cidx_d, wt_d,
+                jax.device_put(jnp.asarray(J0_r8, self.rdt), repl),
+                jax.device_put(jnp.asarray(freq, self.rdt), repl),
+                os_d, jax.device_put(key, repl))
+            return J, {"res_0": r0, "res_1": r1, "mean_nu": mnu}
         return solve
 
     def _precess_sources(self, log=print):
@@ -320,6 +379,17 @@ class FullBatchPipeline:
 
         pinit = self.initial_jones()
         J = pinit.copy()
+        # --profile: capture an XLA/device timeline of the FIRST solve
+        # interval (SURVEY.md section 5 tracing — the reference has only
+        # wall-clock prints; a jax.profiler trace is the superset).
+        # Bounded to one tile so trace size stays sane.
+        prof_dir = getattr(cfg, "profile_dir", None)
+        prof_live = False
+        if prof_dir:
+            import jax.profiler
+            jax.profiler.start_trace(prof_dir)
+            prof_live = True
+            log(f"profiling first solve interval -> {prof_dir}")
         writer = None
         if solution_path:
             writer = sol.SolutionWriter(
@@ -330,159 +400,169 @@ class FullBatchPipeline:
         res_prev = None
         first = True
         history = []
-        for ti, tile in ms.tiles_prefetch():
-            if max_tiles is not None and ti >= max_tiles:
-                break
-            t0 = time.time()
-            u = jnp.asarray(tile.u, self.rdt)
-            v = jnp.asarray(tile.v, self.rdt)
-            w = jnp.asarray(tile.w, self.rdt)
-            # shared staging decision (VisTile.solve_input): native
-            # per-channel-flag packing when applicable, plain mean else;
-            # stored uv-cut rows survive either way
-            x8_np, rowflags, _good = tile.solve_input(
-                uvtaper_m=cfg.uvtaper)
-            base_flags = jnp.asarray(rowflags, jnp.int32)
-            x8 = jnp.asarray(x8_np, self.rdt)
-            flags = rp.uvcut_flags(base_flags, u, v,
-                                   jnp.asarray(tile.freqs, self.rdt),
-                                   cfg.uvmin, cfg.uvmax)
-            if cfg.whiten:
-                # -W: uv-density whitening of the solve input only
-                # (fullbatch_mode.cpp applies whiten_data to the averaged x)
-                from sagecal_tpu.solvers import robust as rb
-                x8 = rb.whiten_data(x8, u, v, meta["freq0"])
-            wt = lm_mod.make_weights(flags, self.rdt)
-            sta1 = jnp.asarray(tile.sta1)
-            sta2 = jnp.asarray(tile.sta2)
-
-            solver = self._solve_first if first else self._solve_rest
-            J_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
-            tile_beam = self._tile_beam(tile)
-            Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8,
-                                 tile_beam, tile_idx=ti)
-            first = False
-            res_0 = float(info["res_0"])
-            res_1 = float(info["res_1"])
-            mean_nu = float(info["mean_nu"])
-            J = utils.jones_r2c_np(np.asarray(Jd_r8))
-
-            # divergence reset (fullbatch_mode.cpp:605-621)
-            if res_1 == 0.0 or not np.isfinite(res_1) or (
-                    res_prev is not None and res_1 > RES_RATIO * res_prev):
-                log(f"tile {ti}: Resetting Solution")
-                J = pinit.copy()
-                first = True
-                res_prev = res_1 if np.isfinite(res_1) else None
-            else:
-                res_prev = res_1 if res_prev is None else min(res_prev, res_1)
-
-            if cfg.per_channel_bfgs:
-                # -b 1: per-channel LBFGS re-solve + per-channel residual
-                # (fullbatch_mode.cpp:442-488). Channels are independent
-                # (each warm-starts from the same joint solution), so the
-                # whole channel axis runs as ONE vmapped solve + ONE
-                # vmapped residual program instead of a sequential loop.
-                # The last channel's solutions become the carried/written
-                # solutions (fullbatch_mode.cpp:485 memcpy).
-                J0c_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
-                flags_np = np.asarray(flags)
-                F = len(tile.freqs)
-                Bn = tile.x.shape[0]
-                x8C = np.zeros((F, Bn, 8))
-                xC = np.zeros((F, Bn, 2, 2), np.complex128)
-                badC = np.zeros((F, Bn), bool)
-                for ci_ch in range(F):
-                    xc = np.array(tile.x[:, ci_ch])
-                    # per-channel flags (same data the joint pack path
-                    # zeroes) + row flags
-                    bad = flags_np == 1
-                    if tile.cflags is not None:
-                        bad = bad | (tile.cflags[:, ci_ch] != 0)
-                    xc[bad] = 0.0
-                    x8C[ci_ch] = utils.vis_to_x8(xc)
-                    xC[ci_ch] = xc
-                    badC[ci_ch] = bad
-                x8C_d = jnp.asarray(x8C, self.rdt)
+        try:
+            for ti, tile in ms.tiles_prefetch():
+                if max_tiles is not None and ti >= max_tiles:
+                    break
+                t0 = time.time()
+                u = jnp.asarray(tile.u, self.rdt)
+                v = jnp.asarray(tile.v, self.rdt)
+                w = jnp.asarray(tile.w, self.rdt)
+                # shared staging decision (VisTile.solve_input): native
+                # per-channel-flag packing when applicable, plain mean else;
+                # stored uv-cut rows survive either way
+                x8_np, rowflags, _good = tile.solve_input(
+                    uvtaper_m=cfg.uvtaper)
+                base_flags = jnp.asarray(rowflags, jnp.int32)
+                x8 = jnp.asarray(x8_np, self.rdt)
+                flags = rp.uvcut_flags(base_flags, u, v,
+                                       jnp.asarray(tile.freqs, self.rdt),
+                                       cfg.uvmin, cfg.uvmax)
                 if cfg.whiten:
+                    # -W: uv-density whitening of the solve input only
+                    # (fullbatch_mode.cpp applies whiten_data to the averaged x)
                     from sagecal_tpu.solvers import robust as rb
-                    x8C_d = jax.vmap(
-                        lambda x: rb.whiten_data(x, u, v, meta["freq0"])
-                    )(x8C_d)
-                # channel-flagged rows carry zero weight in THEIR
-                # channel's solve (zeroed data must not pull the fit)
-                wtC = wt[None] * jnp.asarray(~badC, self.rdt)[:, :, None]
-                freqsC = jnp.asarray(tile.freqs, self.rdt)
-                # blocks of channels: one vmapped execution per block so a
-                # wide band cannot exceed the tunneled chip's per-execution
-                # wall-clock kill; the last block is padded (zero weight)
-                # to keep one compiled program
-                CB = min(F, 16)
-                nblk = -(-F // CB)
-                Fp = nblk * CB
-                if Fp != F:
-                    padc = Fp - F
-                    x8C_d = jnp.concatenate(
-                        [x8C_d, jnp.zeros((padc,) + x8C_d.shape[1:],
-                                          x8C_d.dtype)])
-                    wtC = jnp.concatenate(
-                        [wtC, jnp.zeros((padc,) + wtC.shape[1:],
-                                        wtC.dtype)])
-                    freqsC = jnp.concatenate(
-                        [freqsC, jnp.full((padc,), freqsC[-1],
-                                          freqsC.dtype)])
-                JC_blocks, res_blocks = [], []
-                x_rC_full = None
-                if write_residuals:
-                    x_rC_full = jnp.asarray(utils.c2r(xC[:, :, None]),
-                                            self.rdt)
+                    x8 = rb.whiten_data(x8, u, v, meta["freq0"])
+                wt = lm_mod.make_weights(flags, self.rdt)
+                sta1 = jnp.asarray(tile.sta1)
+                sta2 = jnp.asarray(tile.sta2)
+
+                solver = self._solve_first if first else self._solve_rest
+                J_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
+                tile_beam = self._tile_beam(tile)
+                Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8,
+                                     tile_beam, tile_idx=ti)
+                first = False
+                res_0 = float(info["res_0"])
+                res_1 = float(info["res_1"])
+                mean_nu = float(info["mean_nu"])
+                J = utils.jones_r2c_np(np.asarray(Jd_r8))
+
+                # divergence reset (fullbatch_mode.cpp:605-621)
+                if res_1 == 0.0 or not np.isfinite(res_1) or (
+                        res_prev is not None and res_1 > RES_RATIO * res_prev):
+                    log(f"tile {ti}: Resetting Solution")
+                    J = pinit.copy()
+                    first = True
+                    res_prev = res_1 if np.isfinite(res_1) else None
+                else:
+                    res_prev = res_1 if res_prev is None else min(res_prev, res_1)
+
+                if cfg.per_channel_bfgs:
+                    # -b 1: per-channel LBFGS re-solve + per-channel residual
+                    # (fullbatch_mode.cpp:442-488). Channels are independent
+                    # (each warm-starts from the same joint solution), so the
+                    # whole channel axis runs as ONE vmapped solve + ONE
+                    # vmapped residual program instead of a sequential loop.
+                    # The last channel's solutions become the carried/written
+                    # solutions (fullbatch_mode.cpp:485 memcpy).
+                    J0c_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
+                    flags_np = np.asarray(flags)
+                    F = len(tile.freqs)
+                    Bn = tile.x.shape[0]
+                    x8C = np.zeros((F, Bn, 8))
+                    xC = np.zeros((F, Bn, 2, 2), np.complex128)
+                    badC = np.zeros((F, Bn), bool)
+                    for ci_ch in range(F):
+                        xc = np.array(tile.x[:, ci_ch])
+                        # per-channel flags (same data the joint pack path
+                        # zeroes) + row flags
+                        bad = flags_np == 1
+                        if tile.cflags is not None:
+                            bad = bad | (tile.cflags[:, ci_ch] != 0)
+                        xc[bad] = 0.0
+                        x8C[ci_ch] = utils.vis_to_x8(xc)
+                        xC[ci_ch] = xc
+                        badC[ci_ch] = bad
+                    x8C_d = jnp.asarray(x8C, self.rdt)
+                    if cfg.whiten:
+                        from sagecal_tpu.solvers import robust as rb
+                        x8C_d = jax.vmap(
+                            lambda x: rb.whiten_data(x, u, v, meta["freq0"])
+                        )(x8C_d)
+                    # channel-flagged rows carry zero weight in THEIR
+                    # channel's solve (zeroed data must not pull the fit)
+                    wtC = wt[None] * jnp.asarray(~badC, self.rdt)[:, :, None]
+                    freqsC = jnp.asarray(tile.freqs, self.rdt)
+                    # blocks of channels: one vmapped execution per block so a
+                    # wide band cannot exceed the tunneled chip's per-execution
+                    # wall-clock kill; the last block is padded (zero weight)
+                    # to keep one compiled program
+                    CB = min(F, 16)
+                    nblk = -(-F // CB)
+                    Fp = nblk * CB
                     if Fp != F:
-                        x_rC_full = jnp.concatenate(
-                            [x_rC_full,
-                             jnp.zeros((Fp - F,) + x_rC_full.shape[1:],
-                                       x_rC_full.dtype)])
-                for blk in range(nblk):
-                    sl = slice(blk * CB, (blk + 1) * CB)
-                    JC_b, _, _ = self._chan_solver(
-                        x8C_d[sl], wtC[sl], freqsC[sl], u, v, w, sta1,
-                        sta2, J0c_r8, tile_beam)
-                    JC_blocks.append(np.asarray(JC_b))
+                        padc = Fp - F
+                        x8C_d = jnp.concatenate(
+                            [x8C_d, jnp.zeros((padc,) + x8C_d.shape[1:],
+                                              x8C_d.dtype)])
+                        wtC = jnp.concatenate(
+                            [wtC, jnp.zeros((padc,) + wtC.shape[1:],
+                                            wtC.dtype)])
+                        freqsC = jnp.concatenate(
+                            [freqsC, jnp.full((padc,), freqsC[-1],
+                                              freqsC.dtype)])
+                    JC_blocks, res_blocks = [], []
+                    x_rC_full = None
                     if write_residuals:
-                        res_b = self._chan_residual_fn(
-                            JC_b, x_rC_full[sl], u, v, w, sta1, sta2,
-                            freqsC[sl], tile_beam)
-                        res_blocks.append(np.asarray(res_b))
-                JC_r8 = np.concatenate(JC_blocks)[:F]
-                if write_residuals:
-                    resC = np.concatenate(res_blocks)[:F]
-                    # [F, B, 1, 2, 2] complex -> [B, F, 2, 2]
-                    tile.x = np.moveaxis(
-                        utils.r2c(resC)[:, :, 0], 0, 1
-                    ).astype(np.complex128)
-                    ms.write_tile(ti, tile)
-                J = utils.jones_r2c_np(np.asarray(JC_r8[-1]))
-                if writer:
-                    writer.write_interval(J, sky.nchunk)
-            else:
-                if writer:
-                    writer.write_interval(J, sky.nchunk)
+                        x_rC_full = jnp.asarray(utils.c2r(xC[:, :, None]),
+                                                self.rdt)
+                        if Fp != F:
+                            x_rC_full = jnp.concatenate(
+                                [x_rC_full,
+                                 jnp.zeros((Fp - F,) + x_rC_full.shape[1:],
+                                           x_rC_full.dtype)])
+                    for blk in range(nblk):
+                        sl = slice(blk * CB, (blk + 1) * CB)
+                        JC_b, _, _ = self._chan_solver(
+                            x8C_d[sl], wtC[sl], freqsC[sl], u, v, w, sta1,
+                            sta2, J0c_r8, tile_beam)
+                        JC_blocks.append(np.asarray(JC_b))
+                        if write_residuals:
+                            res_b = self._chan_residual_fn(
+                                JC_b, x_rC_full[sl], u, v, w, sta1, sta2,
+                                freqsC[sl], tile_beam)
+                            res_blocks.append(np.asarray(res_b))
+                    JC_r8 = np.concatenate(JC_blocks)[:F]
+                    if write_residuals:
+                        resC = np.concatenate(res_blocks)[:F]
+                        # [F, B, 1, 2, 2] complex -> [B, F, 2, 2]
+                        tile.x = np.moveaxis(
+                            utils.r2c(resC)[:, :, 0], 0, 1
+                        ).astype(np.complex128)
+                        ms.write_tile(ti, tile)
+                    J = utils.jones_r2c_np(np.asarray(JC_r8[-1]))
+                    if writer:
+                        writer.write_interval(J, sky.nchunk)
+                else:
+                    if writer:
+                        writer.write_interval(J, sky.nchunk)
 
-                if write_residuals:
-                    res_r = self._residual_fn(
-                        jnp.asarray(utils.jones_c2r_np(J), self.rdt),
-                        jnp.asarray(utils.c2r(tile.x), self.rdt),
-                        u, v, w, sta1, sta2, tile_beam)
-                    tile.x = utils.r2c(np.asarray(res_r)).astype(
-                        np.complex128)
-                    ms.write_tile(ti, tile)
+                    if write_residuals:
+                        res_r = self._residual_fn(
+                            jnp.asarray(utils.jones_c2r_np(J), self.rdt),
+                            jnp.asarray(utils.c2r(tile.x), self.rdt),
+                            u, v, w, sta1, sta2, tile_beam)
+                        tile.x = utils.r2c(np.asarray(res_r)).astype(
+                            np.complex128)
+                        ms.write_tile(ti, tile)
 
-            dt = (time.time() - t0) / 60.0
-            log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
-                f"final={res_1:.6g}, Time spent={dt:.3g} minutes, "
-                f"nu={mean_nu:.2f}")
-            history.append({"tile": ti, "res_0": res_0, "res_1": res_1,
-                            "mean_nu": mean_nu, "minutes": dt})
+                dt = (time.time() - t0) / 60.0
+                log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
+                    f"final={res_1:.6g}, Time spent={dt:.3g} minutes, "
+                    f"nu={mean_nu:.2f}")
+                history.append({"tile": ti, "res_0": res_0, "res_1": res_1,
+                                "mean_nu": mean_nu, "minutes": dt})
+                if prof_live:
+                    import jax.profiler
+                    jax.profiler.stop_trace()
+                    prof_live = False
+                    log(f"profile trace written to {prof_dir}")
 
+        finally:
+            if prof_live:   # abnormal exit or 0-tile run:
+                import jax.profiler
+                jax.profiler.stop_trace()  # close the trace
         if writer:
             writer.close()
         return history

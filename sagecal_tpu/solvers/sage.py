@@ -57,6 +57,25 @@ from sagecal_tpu.solvers import rtr as rtr_mod
 # sagefit_host sweep-fusion verdicts, per problem shape (see its
 # docstring); process-lifetime cache, entries are tiny
 _FUSION_CACHE: dict = {}
+# ... and full-trace promotion verdicts: once the timed fused sweeps
+# prove the WHOLE solve fits comfortably under the tunneled runtime's
+# ~60 s per-execution kill, subsequent calls run the fully traced
+# sagefit — ~3 device round-trips per solve instead of ~max_emiter+4,
+# which matters when tunnel dispatch latency spikes (observed: the same
+# chip serving config-1 steps at 6 s and, hours later, 12 s purely from
+# per-execution overhead)
+_PROMOTE_CACHE: dict = {}
+_PROMOTE_BUDGET_S = 35.0
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_stations", "config", "os_nsub"))
+def _jit_sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
+                 n_stations, wt_base, nu0, config, os_ids, os_nsub, key):
+    os_id = None if os_ids is None else (os_ids, os_nsub)
+    return sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
+                   n_stations, wt_base, nu0=nu0, config=config,
+                   os_id=os_id, key=key)
 
 
 class SageConfig(NamedTuple):
@@ -455,24 +474,35 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
 
     os_ids, os_nsub = (None, 0) if os_id is None else \
         (jnp.asarray(os_id[0]), int(os_id[1]))
-    xres, res_0 = _jit_prelude(x8, coh, sta1, sta2, jnp.asarray(chunk_idx),
+    chunk_idx = jnp.asarray(chunk_idx)
+    chunk_mask = jnp.asarray(chunk_mask)
+
+    # sweep-fusion and full-trace-promotion verdicts are remembered per
+    # problem shape across calls — re-learning fusion every solve cost
+    # ~M extra tunnel round-trips per tile (the warm-path gap between
+    # round-2 and round-3 config-1 numbers). The fusion key deliberately
+    # excludes the iteration budget (dev_config strips max_emiter, and a
+    # sweep's cost doesn't depend on how many sweeps run) so the
+    # first-tile EM boost and the rest-tiles share one verdict; the
+    # promotion key must include the budget — it bounds a WHOLE solve.
+    fuse_key = (M, x8.shape, n_stations, chunk_mask.shape, str(dtype),
+                dev_config, os_id is None, os_nsub)
+    promote_key = fuse_key + (config.max_emiter, config.max_lbfgs)
+    if _PROMOTE_CACHE.get(promote_key, False):
+        # whole solve proven to fit under the per-execution kill: one
+        # traced program, minimal tunnel round-trips
+        return _jit_sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask,
+                            J0, n_stations, wt_base,
+                            jnp.asarray(nu0, dtype), config,
+                            os_ids if os_id is not None else None,
+                            os_nsub, key)
+    xres, res_0 = _jit_prelude(x8, coh, sta1, sta2, chunk_idx,
                                J0, wt_base)
     J = J0
     nerr = jnp.zeros((M,), dtype)
     nuM = jnp.full((M,), jnp.asarray(nu0, dtype))
-    chunk_idx = jnp.asarray(chunk_idx)
-    chunk_mask = jnp.asarray(chunk_mask)
-
-    # granularity: start per-cluster (always safe); once a timed sweep
-    # shows the whole sweep fits comfortably under the runtime's
-    # per-execution limit, fuse subsequent sweeps into one program. The
-    # verdict is remembered per problem shape across calls — re-learning
-    # it every solve cost ~M extra tunnel round-trips per tile (the
-    # warm-path gap between round-2 and round-3 config-1 numbers).
-    fuse_key = (M, x8.shape, n_stations, chunk_mask.shape, str(dtype),
-                dev_config, os_id is None, 0 if os_id is None
-                else int(os_id[1]))
     fused = _FUSION_CACHE.get(fuse_key, False)
+    sweep_times: list = []
     for ci in range(config.max_emiter):
         weighted = config.randomize and (ci % 2 == 1)
         last = ci == config.max_emiter - 1
@@ -486,11 +516,14 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
         else:
             order = np.arange(M)
         if fused:
+            t_sweep = time.perf_counter()
             J, xres, nerr_acc, nuM = _jit_em_sweep(
                 J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                 wt_base, nerr, jnp.asarray(weighted), jnp.asarray(last),
                 kci, jnp.asarray(order, jnp.int32), os_ids,
                 n_stations, dev_config, total_iter, iter_bar, os_nsub)
+            jax.block_until_ready(J)
+            sweep_times.append(time.perf_counter() - t_sweep)
         else:
             t_sweep = time.perf_counter()
             nerr_acc = jnp.zeros((M,), dtype)
@@ -509,6 +542,13 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
             _FUSION_CACHE[fuse_key] = fused
         total = float(jnp.sum(nerr_acc))
         nerr = nerr_acc / total if total > 0 else nerr_acc
+
+    # promote: non-first fused sweeps are warm device executions, so
+    # max_emiter of them (+ refine margin) bounds the traced program's
+    # execution time; promote only when comfortably under the kill
+    warm = sweep_times[1:] if len(sweep_times) > 1 else sweep_times
+    if warm and max(warm) * (config.max_emiter + 1) < _PROMOTE_BUDGET_S:
+        _PROMOTE_CACHE[promote_key] = True
 
     mean_nu = jnp.clip(jnp.mean(nuM), config.nulow, config.nuhigh)
     if config.max_lbfgs > 0:

@@ -178,3 +178,30 @@ def test_sagefit_host_randomized_converges():
                                 wt, config=cfg, os_id=os_info,
                                 key=jax.random.PRNGKey(11))
     assert float(info["res_1"]) < 0.3 * float(info["res_0"])
+
+
+def test_sagefit_host_promotion_consistent():
+    """After timed fused sweeps prove the whole solve fits under the
+    per-execution budget, sagefit_host promotes to ONE traced program —
+    repeated identical calls must return identical results across the
+    promotion boundary."""
+    sky, tile, *arrs = _problem(n_stations=8, n_clusters=2, tilesz=4)
+    x8, coh, sta1, sta2, cidx, cmask, wt, J0 = arrs
+    n = tile.n_stations
+    cfg = sage.SageConfig(max_emiter=2, max_iter=4, max_lbfgs=3,
+                          solver_mode=int(SolverMode.LM_LBFGS),
+                          randomize=False)
+    outs = []
+    promoted = []
+    for _ in range(3):
+        J, info = sage.sagefit_host(x8, coh, sta1, sta2, cidx, cmask,
+                                    J0, n, wt, config=cfg)
+        outs.append((np.asarray(J), float(info["res_1"])))
+        key = [k for k in sage._PROMOTE_CACHE
+               if k[0] == sky.n_clusters and k[2] == n]
+        promoted.append(bool(key and sage._PROMOTE_CACHE.get(key[0])))
+    # on the CPU test mesh the tiny solve always qualifies
+    assert promoted[-1], "promotion never engaged"
+    for J2, r2 in outs[1:]:
+        np.testing.assert_allclose(J2, outs[0][0], rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(r2, outs[0][1], rtol=1e-8)

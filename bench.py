@@ -597,6 +597,20 @@ def write_table(results, platform):
             f"| {name} | {r['value']:.1f} | {r['unit']} | {res} | "
             f"{_fmt_s(r, 'step_s', '.3f')} | {_fmt_s(r, 'compile_s', '.1f')}"
             f" | {shape} |")
+    # the north-star scale row (tools_dev/northstar.py) is measured by a
+    # separate scripted run; re-emit it from its record so regenerating
+    # this table never drops it
+    ns_path = os.path.join(HERE, "NORTHSTAR.json")
+    if os.path.exists(ns_path):
+        try:
+            with open(ns_path) as f:
+                ns = json.load(f)
+            lines.append(
+                f"| northstar | {ns['value']:.2f} | {ns['unit']} | — | — "
+                f"| — | {ns.get('shape', '')} "
+                f"[{ns.get('platform', '?')}] |")
+        except Exception as e:
+            log(f"# NORTHSTAR.json unreadable: {e}")
     with open(os.path.join(HERE, "BENCH_TABLE.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
     with open(os.path.join(HERE, "bench_results.json"), "w") as f:

@@ -107,7 +107,10 @@ class FullBatchPipeline:
         # not inside the jitted solve.
         self.use_pallas = False
         self._pallas_skies = None
+        # the sharded (GSPMD) solve path predicts with plain XLA — don't
+        # probe/log a kernel it would silently bypass
         if (platform not in ("cpu",) and not self.dobeam
+                and not getattr(cfg, "shard_baselines", False)
                 and self.rdt == jnp.float32):
             from sagecal_tpu.ops import coh_pallas
             if coh_pallas.any_supported(sky):

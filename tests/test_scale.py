@@ -218,17 +218,24 @@ def test_baseline_axis_sharding_matches_single_device():
     freq = np.array([tile.freq0])
 
     outs = {}
+    os_ids, os_nsub = lm_mod.os_subset_ids(tilesz, tile.nbase)
+    os_p = np.concatenate([np.asarray(os_ids),
+                           np.zeros(bpad - B, np.asarray(os_ids).dtype)])
     for name, mesh in (("sharded", mesh8), ("single", mesh1)):
         solve = parallel.sharded_sagefit(mesh, dsky, tile.fdelta, cmask,
-                                         n_stations, config=cfg)
+                                         n_stations, config=cfg,
+                                         os_nsub=os_nsub)
         args = parallel.shard_rows(mesh, x8p, up, vp, wp, s1p, s2p)
         (cidx_d,) = parallel.shard_rows(mesh, cidxp, row_axis=1)
         (wt_d,) = parallel.shard_rows(mesh, wtp)
-        J, r0, r1 = solve(*args, cidx_d, wt_d,
-                          jax.device_put(jnp.asarray(J0),
-                                         NamedSharding(mesh, P())),
-                          jax.device_put(jnp.asarray(freq),
-                                         NamedSharding(mesh, P())))
+        (os_d,) = parallel.shard_rows(mesh, os_p)
+        repl = NamedSharding(mesh, P())
+        J, r0, r1, mnu = solve(
+            *args, cidx_d, wt_d,
+            jax.device_put(jnp.asarray(J0), repl),
+            jax.device_put(jnp.asarray(freq), repl),
+            os_d, jax.device_put(jax.random.PRNGKey(7), repl))
+        assert np.isfinite(float(mnu))
         outs[name] = (np.asarray(J), float(r0), float(r1))
         # the sharded run must actually shard: every [B]-input lives
         # across all 8 devices

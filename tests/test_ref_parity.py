@@ -171,7 +171,10 @@ def procrustes_err(Ja, Jb):
     return float(np.mean(errs))
 
 
-@pytest.mark.parametrize("mode", [1, 5])   # SM_LM_LBFGS, SM_RTR_OSRLM_RLBFGS
+# SM_LM_LBFGS, SM_OSLM_OSRLM_RLBFGS, SM_RTR_OSRLM_RLBFGS, SM_NSD_RLBFGS.
+# Mode 3's ordered subsets draw from different PRNGs on the two sides
+# (libc rand() vs jax PRNG), so its solution comparison is looser.
+@pytest.mark.parametrize("mode", [1, 3, 5, 6])
 def test_reference_parity(mode, tmp_path):
     exe = _build_ref_dump()
     prob = make_problem()
@@ -188,7 +191,8 @@ def test_reference_parity(mode, tmp_path):
 
     # solved Jones agree up to the per-cluster unitary ambiguity
     err = procrustes_err(Jgot, Jref)
-    assert err < 0.05, f"mode {mode}: Procrustes-aligned misfit {err}"
+    tol = 0.1 if mode == 3 else 0.05
+    assert err < tol, f"mode {mode}: Procrustes-aligned misfit {err}"
 
     # and both recover the TRUE Jones to similar accuracy
     err_true_ref = procrustes_err(Jref, prob["Jt"])

@@ -165,16 +165,17 @@ def main():
            "total_wall_s": round(wall, 1), "platform": platform}
     with open(os.path.join(HERE, "NORTHSTAR.json"), "w") as f:
         json.dump(rec, f, indent=1)
-    row = (f"| northstar | {per_iter:.2f} | s/ADMM-iter | — | — | — | "
-           f"{shape} |\n")
-    tbl = os.path.join(HERE, "BENCH_TABLE.md")
-    if os.path.exists(tbl):
-        with open(tbl) as f:
-            lines = f.readlines()
-        lines = [ln for ln in lines if not ln.startswith("| northstar ")]
-        lines.append(row)
-        with open(tbl, "w") as f:
-            f.writelines(lines)
+    # ONE row formatter: bench.write_table re-emits the northstar row
+    # from NORTHSTAR.json; regenerate the table through it so the two
+    # writers can never drift
+    try:
+        sys.path.insert(0, HERE)
+        import bench
+        with open(os.path.join(HERE, "bench_results.json")) as f:
+            br = json.load(f)
+        bench.write_table(br["results"], br["platform"])
+    except Exception as e:
+        print(f"table regeneration skipped ({e}); NORTHSTAR.json written")
     print(json.dumps(rec))
     return 0
 

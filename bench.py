@@ -281,13 +281,14 @@ def time_sage(device, dtype, sky, dsky, tile, solver_mode, reps=2,
     jax.block_until_ready(J)
     compile_s = time.perf_counter() - tc0
     # untimed settling calls: sagefit_host may PROMOTE this shape to the
-    # fully traced program a call or two in, and that compile must not
-    # land inside the timed reps — settle until two consecutive call
-    # times agree (max 3 calls)
+    # fully traced program a call in (it qualifies during the warmup call
+    # for max_emiter >= 2 — every bench config), and that compile must
+    # not land inside the timed reps. Two settle calls bound the cost:
+    # call 1 absorbs the promoted compile, call 2 confirms steady state.
     t_prev = None
     settle_s = 0.0
     n_settle = 0
-    for _ in range(3):
+    for _ in range(2):
         tp0 = time.perf_counter()
         J, r0, r1 = step(*args)
         jax.block_until_ready(J)
@@ -590,12 +591,16 @@ def _fmt_s(r, key, fmt):
             else format(v, fmt) + "s")
 
 
-def write_table(results, platform):
+def write_table(results, platform, date=None):
+    """``date``: measurement timestamp; None stamps now. Regenerators
+    (tools_dev/northstar.py) pass the stored stamp so stale results are
+    never re-dated as fresh."""
+    date = date or time.strftime("%Y-%m-%d %H:%M:%S")
     lines = [
         "# BENCH table (auto-generated by bench.py)",
         "",
         f"Device platform: **{platform}**  |  dtype f32  |  "
-        f"date {time.strftime('%Y-%m-%d %H:%M:%S')}",
+        f"date {date}",
         "",
         "| config | value | unit | res_0 -> res_1 | step | compile | shape |",
         "|---|---|---|---|---|---|---|",
@@ -632,7 +637,8 @@ def write_table(results, platform):
     with open(os.path.join(HERE, "BENCH_TABLE.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
     with open(os.path.join(HERE, "bench_results.json"), "w") as f:
-        json.dump({"platform": platform, "results": results}, f, indent=1,
+        json.dump({"platform": platform, "date": date,
+                   "results": results}, f, indent=1,
                   default=float)
 
 

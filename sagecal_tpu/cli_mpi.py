@@ -104,12 +104,13 @@ def discover_datasets(pattern: str) -> list:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
 
     if args.epochs > 0 and args.num_processes > 1:
         # fail fast on parsed arguments — before the distributed
         # handshake, which blocks until every peer shows up
-        raise ValueError(
+        parser.error(
             "federated stochastic mode (-N) currently stages data "
             "single-process; run it per host or use the ADMM mode "
             "for multi-host")

@@ -173,7 +173,8 @@ def main():
         import bench
         with open(os.path.join(HERE, "bench_results.json")) as f:
             br = json.load(f)
-        bench.write_table(br["results"], br["platform"])
+        bench.write_table(br["results"], br["platform"],
+                          date=br.get("date"))
     except Exception as e:
         print(f"table regeneration skipped ({e}); NORTHSTAR.json written")
     print(json.dumps(rec))

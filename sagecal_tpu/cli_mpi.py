@@ -65,6 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
     a("-X", "--spatialreg", default=None,
       help="spatial regularization: l2,l1,order,fista_iters,cadence")
     a("-V", "--verbose", action="store_true")
+    a("--input-column", default="DATA",
+      help="MS data column to calibrate (CasaMS backend)")
+    a("--output-column", default="CORRECTED_DATA",
+      help="MS column receiving residuals (CasaMS backend)")
     # multi-host execution (the mpirun analogue): same program on every
     # host, coordinated through jax.distributed; the mesh then spans all
     # hosts' devices and subband shards ride ICI/DCN
@@ -157,11 +161,17 @@ def main(argv=None) -> int:
             skip_timeslots=args.skip_timeslots,
             max_lbfgs=args.max_lbfgs, lbfgs_m=args.lbfgs_m,
             robust_nulow=args.nulow, robust_nuhigh=args.nuhigh,
+            tile_size=args.tile_size,
+            input_column=args.input_column,
+            output_column=args.output_column,
             verbose=args.verbose)
         federated.run_federated(cfg, paths)
         return 0
 
-    mss = [ds.SimMS(p) for p in paths]
+    # each subband path may be a SimMS directory or a real CASA table
+    mss = [ds.open_part(p, tilesz=args.tile_size,
+                        data_column=args.input_column,
+                        out_column=args.output_column) for p in paths]
     nf = len(mss)
     meta0 = mss[0].meta
     # metadata consistency check (master :239-284)
@@ -172,7 +182,7 @@ def main(argv=None) -> int:
                 f'({len(msx.meta["freqs"])} vs {len(meta0["freqs"])}) '
                 "— the mesh program needs a uniform channel count per "
                 "subband")
-        for key in ("n_stations", "nbase", "tilesz"):
+        for key in ("n_stations", "nbase", "tilesz", "n_tiles"):
             if msx.meta[key] != meta0[key]:
                 raise ValueError(
                     f"dataset {msx.path}: {key} mismatch "

@@ -152,7 +152,10 @@ def _fed_setup(cfg: RunConfig, paths: list):
     """Shared slave/basis/state setup for both federated implementations
     — the identical-math premise of the sharding-invariance oracle rests
     on both paths consuming exactly this."""
-    mss = [ds.SimMS(p) for p in paths]
+    # each slave path may be a SimMS directory or a real CASA table
+    mss = [ds.open_part(p, tilesz=cfg.tile_size,
+                        data_column=cfg.input_column,
+                        out_column=cfg.output_column) for p in paths]
     meta0 = mss[0].meta
     sky = skymodel.read_sky_cluster(
         cfg.sky_model, cfg.cluster_file, meta0["ra0"], meta0["dec0"],

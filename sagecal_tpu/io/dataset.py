@@ -377,12 +377,14 @@ class MultiSimMS:
     the combined channel axis is monotone.
     """
 
-    def __init__(self, paths):
+    def __init__(self, paths, tilesz: int = 10, data_column: str = "DATA",
+                 out_column: str = "CORRECTED_DATA"):
         if isinstance(paths, str):
             paths = [paths]
         if not paths:
             raise ValueError("MultiSimMS: empty dataset list")
-        parts = [SimMS(p) for p in paths]
+        parts = [open_part(p, tilesz, data_column, out_column)
+                 for p in paths]
         parts.sort(key=lambda m: float(np.mean(m.meta["freqs"])))
         m0 = parts[0].meta
         for mx in parts[1:]:
@@ -465,21 +467,35 @@ class MultiSimMS:
         return _tiles_prefetch_impl(self, depth)
 
 
+def open_part(path: str, tilesz: int = 10, data_column: str = "DATA",
+              out_column: str = "CORRECTED_DATA"):
+    """One dataset path -> CasaMS (casacore table) or SimMS. Every place
+    that consumes a subband path (cli_mpi slaves, federated slaves,
+    MultiSimMS parts) dispatches through here so real MeasurementSets
+    work wherever SimMS directories do."""
+    from sagecal_tpu.io import casams
+    if casams.is_ms_path(path):
+        if not casams.have_casacore():
+            raise RuntimeError(
+                f"{path} is a CASA table but python-casacore is not "
+                f"installed; install it or convert to a SimMS directory")
+        return casams.CasaMS(path, tilesz=tilesz, data_column=data_column,
+                             out_column=out_column)
+    return SimMS(path)
+
+
 def open_dataset(ms: str | None, ms_list: str | None = None,
                  tilesz: int = 10, data_column: str = "DATA",
                  out_column: str = "CORRECTED_DATA"):
     """Resolve -d/-f into a dataset: a CASA MeasurementSet (python-casacore
     backend) when the path is a casacore table, a single SimMS, or a
     MultiSimMS from a glob pattern / list file (fullbatch_mode.cpp:255-262
-    dispatch)."""
-    from sagecal_tpu.io import casams
-    if ms and casams.is_ms_path(ms):
-        if not casams.have_casacore():
-            raise RuntimeError(
-                f"{ms} is a CASA table but python-casacore is not "
-                f"installed; install it or convert to a SimMS directory")
-        return casams.CasaMS(ms, tilesz=tilesz, data_column=data_column,
-                             out_column=out_column)
+    dispatch).
+
+    ``-f``/``ms_list`` takes precedence over ``-d`` when both are given
+    (the reference's loadDataList dispatch order)."""
+    if ms and not ms_list:
+        return open_part(ms, tilesz, data_column, out_column)
     if ms_list:
         import glob as globmod
         if os.path.isfile(ms_list):
@@ -492,9 +508,10 @@ def open_dataset(ms: str | None, ms_list: str | None = None,
         if not paths:
             raise ValueError(f"-f {ms_list}: no datasets found")
         if len(paths) == 1:
-            return SimMS(paths[0])
-        return MultiSimMS(paths)
-    return SimMS(ms)
+            return open_part(paths[0], tilesz, data_column, out_column)
+        return MultiSimMS(paths, tilesz=tilesz, data_column=data_column,
+                          out_column=out_column)
+    raise ValueError("open_dataset: need -d dataset or -f list")
 
 
 def _tiles_prefetch_impl(dataset, depth: int = 2):

@@ -182,7 +182,7 @@ def main(argv=None) -> int:
                 f'({len(msx.meta["freqs"])} vs {len(meta0["freqs"])}) '
                 "— the mesh program needs a uniform channel count per "
                 "subband")
-        for key in ("n_stations", "nbase", "tilesz", "n_tiles"):
+        for key in ("n_stations", "nbase", "tilesz"):
             if msx.meta[key] != meta0[key]:
                 raise ValueError(
                     f"dataset {msx.path}: {key} mismatch "
@@ -324,7 +324,12 @@ def main(argv=None) -> int:
                 multihost_utils.process_allgather(a, tiled=True))
         return np.asarray(a)
 
-    n_tiles = mss[0].n_tiles
+    # ragged real-MS subbands (a lost trailing scan) truncate to the
+    # common prefix, like the federated path
+    n_tiles = min(m.n_tiles for m in mss)
+    if is_writer and any(m.n_tiles != n_tiles for m in mss):
+        print(f"Warning: subband tile counts differ; calibrating the "
+              f"common {n_tiles} tiles")
     start = args.skip_timeslots
     stop = n_tiles if not args.max_timeslots else min(
         n_tiles, start + args.max_timeslots)

@@ -59,19 +59,22 @@ def pad_rows(arrays, wt_base, nrows: int, ndev: int):
 def sharded_sagefit(mesh: Mesh, dsky, fdelta: float, chunk_mask,
                     n_stations: int, config=None,
                     with_shapelets: bool | None = None,
-                    os_nsub: int = 0):
+                    os_nsub: int = 0, dobeam: int = 0):
     """Build a row-sharded full solve: coherency predict + SAGE-EM with
     the [B]-indexed inputs sharded over ``mesh``'s "base" axis.
 
     Returns ``solve(x8, u, v, w, sta1, sta2, cidx, wt, J0_r8, freq,
-    os_ids, key)`` where cidx is [M, B] (sharded on its row axis),
-    J0_r8 is the [M, K, N, 8] real Jones (replicated), os_ids the [B]
-    ordered-subset ids (row-sharded; pass with ``os_nsub`` > 0 to keep
-    the P4 acceleration on the sharded path) and ``key`` the per-tile
-    PRNG key (replicated). ``with_shapelets=None`` auto-detects from the
-    sky model like the unsharded predict. The caller stages inputs with
-    :func:`shard_rows`; outputs (J, res_0, res_1, mean_nu) come back
-    replicated.
+    os_ids, key, tslot, beam)`` where cidx is [M, B] (sharded on its row
+    axis), J0_r8 is the [M, K, N, 8] real Jones (replicated), os_ids the
+    [B] ordered-subset ids (row-sharded; pass with ``os_nsub`` > 0 to
+    keep the P4 acceleration on the sharded path), ``key`` the per-tile
+    PRNG key (replicated), ``tslot`` [B] row timeslot indices
+    (row-sharded) and ``beam`` a replicated BeamArrays pytree (or None
+    with ``dobeam=0`` — beam tables are small and per (station, time),
+    so they replicate while the row-indexed beam gathers shard).
+    ``with_shapelets=None`` auto-detects from the sky model like the
+    unsharded predict. The caller stages inputs with :func:`shard_rows`;
+    outputs (J, res_0, res_1, mean_nu) come back replicated.
     """
     from sagecal_tpu.rime import predict as rp
     from sagecal_tpu.solvers import normal_eq as ne
@@ -83,9 +86,11 @@ def sharded_sagefit(mesh: Mesh, dsky, fdelta: float, chunk_mask,
     repl = NamedSharding(mesh, P())
 
     def solve(x8, u, v, w, sta1, sta2, cidx, wt, J0_r8, freq, os_ids,
-              key):
+              key, tslot, beam):
         coh = rp.coherencies(dsky, u, v, w, freq[None], fdelta,
-                             with_shapelets=with_shapelets)[:, :, 0]
+                             with_shapelets=with_shapelets, beam=beam,
+                             dobeam=dobeam, tslot=tslot, sta1=sta1,
+                             sta2=sta2)[:, :, 0]
         os_id = (os_ids, os_nsub) if os_nsub else None
         J, info = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask_j,
                                ne.jones_r2c(J0_r8), n_stations, wt,
@@ -96,7 +101,7 @@ def sharded_sagefit(mesh: Mesh, dsky, fdelta: float, chunk_mask,
     return jax.jit(
         solve,
         in_shardings=(rows, rows, rows, rows, rows, rows, rows2, rows,
-                      repl, repl, rows, repl),
+                      repl, repl, rows, repl, rows, repl),
         out_shardings=(repl, repl, repl, repl))
 
 

@@ -208,22 +208,21 @@ class FullBatchPipeline:
         sharded over a "base" mesh axis and the solutions replicated —
         GSPMD places the all-reduces (parallel.sharded_sagefit). Rows
         pad to the mesh with zero weight; the OS-subset ids and per-tile
-        PRNG key ride through so modes 1/2/3 keep the P4 acceleration.
-        Beam mode raises (the beam chain is not sharded yet)."""
+        PRNG key ride through so modes 1/2/3 keep the P4 acceleration;
+        beam tables replicate while the row-indexed gathers shard."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from sagecal_tpu import parallel
 
-        if self.dobeam:
-            raise ValueError("--shard-baselines with beam mode is not "
-                             "supported yet; drop -B or the flag")
         mesh = parallel.base_mesh()
         ndev = mesh.devices.size
         os_ids_np, os_nsub = lm_mod.os_subset_ids(meta["tilesz"],
                                                   meta["nbase"])
         solve_j = parallel.sharded_sagefit(mesh, self.dsky, fdelta,
                                            self.cmask, self.n,
-                                           config=scfg, os_nsub=os_nsub)
+                                           config=scfg, os_nsub=os_nsub,
+                                           dobeam=self.dobeam)
+        tslot_np = np.asarray(self.tslot)
         cidx_np = np.asarray(self.cidx)
         freq = np.asarray([freq0])
         repl = NamedSharding(mesh, P())
@@ -235,11 +234,13 @@ class FullBatchPipeline:
             cidxp = np.concatenate(
                 [cidx_np, np.zeros((cidx_np.shape[0], bpad - B),
                                    cidx_np.dtype)], axis=1)
-            # padded rows get subset id 0; their zero weight already
-            # excludes them from every subset's normal equations
+            # padded rows get subset id 0 / timeslot 0; their zero
+            # weight already excludes them from every reduction
             osp = np.concatenate(
                 [np.asarray(os_ids_np),
                  np.zeros(bpad - B, np.asarray(os_ids_np).dtype)])
+            tsp = np.concatenate(
+                [tslot_np, np.zeros(bpad - B, tslot_np.dtype)])
             args = parallel.shard_rows(
                 mesh, *[np.asarray(a, np.dtype(self.rdt)
                                    if np.asarray(a).dtype.kind == "f"
@@ -248,12 +249,15 @@ class FullBatchPipeline:
             (wt_d,) = parallel.shard_rows(
                 mesh, np.asarray(wtp, np.dtype(self.rdt)))
             (os_d,) = parallel.shard_rows(mesh, osp)
+            (ts_d,) = parallel.shard_rows(mesh, tsp)
             key = jax.random.fold_in(jax.random.PRNGKey(199), tile_idx)
+            beam_d = (None if beam is None
+                      else jax.device_put(beam, repl))
             J, r0, r1, mnu = solve_j(
                 *args, cidx_d, wt_d,
                 jax.device_put(jnp.asarray(J0_r8, self.rdt), repl),
                 jax.device_put(jnp.asarray(freq, self.rdt), repl),
-                os_d, jax.device_put(key, repl))
+                os_d, jax.device_put(key, repl), ts_d, beam_d)
             return J, {"res_0": r0, "res_1": r1, "mean_nu": mnu}
         return solve
 

@@ -221,6 +221,8 @@ def test_baseline_axis_sharding_matches_single_device():
     os_ids, os_nsub = lm_mod.os_subset_ids(tilesz, tile.nbase)
     os_p = np.concatenate([np.asarray(os_ids),
                            np.zeros(bpad - B, np.asarray(os_ids).dtype)])
+    ts = np.asarray(ds.row_tslot(B, tile.nbase))
+    ts_p = np.concatenate([ts, np.zeros(bpad - B, ts.dtype)])
     for name, mesh in (("sharded", mesh8), ("single", mesh1)):
         solve = parallel.sharded_sagefit(mesh, dsky, tile.fdelta, cmask,
                                          n_stations, config=cfg,
@@ -229,12 +231,14 @@ def test_baseline_axis_sharding_matches_single_device():
         (cidx_d,) = parallel.shard_rows(mesh, cidxp, row_axis=1)
         (wt_d,) = parallel.shard_rows(mesh, wtp)
         (os_d,) = parallel.shard_rows(mesh, os_p)
+        (ts_d,) = parallel.shard_rows(mesh, ts_p)
         repl = NamedSharding(mesh, P())
         J, r0, r1, mnu = solve(
             *args, cidx_d, wt_d,
             jax.device_put(jnp.asarray(J0), repl),
             jax.device_put(jnp.asarray(freq), repl),
-            os_d, jax.device_put(jax.random.PRNGKey(7), repl))
+            os_d, jax.device_put(jax.random.PRNGKey(7), repl),
+            ts_d, None)
         assert np.isfinite(float(mnu))
         outs[name] = (np.asarray(J), float(r0), float(r1))
         # the sharded run must actually shard: every [B]-input lives

@@ -647,6 +647,15 @@ def run_one_config(name: str):
     import jax
     if os.environ.get("SAGECAL_BENCH_CPU"):
         jax.config.update("jax_platforms", "cpu")
+    try:
+        # persistent XLA compilation cache: each config runs in a fresh
+        # process (device-fault isolation), so without this every run
+        # re-pays ~50 s of compiles per config
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(HERE, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception as e:
+        log(f"# compilation cache unavailable: {e}")
     dev = jax.devices()[0]
     import jax.numpy as jnp
     fn = dict(CONFIGS)[name]

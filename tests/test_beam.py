@@ -193,12 +193,10 @@ def test_residual_withbeam_roundtrip():
     assert float(jnp.max(jnp.abs(res))) < 1e-8
 
 
-def test_fullbatch_pipeline_withbeam(tmp_path):
-    """dosage.sh-with-beam analogue: simulate beam-corrupted data, then
-    calibrate with -B FULL through the full pipeline; solver must converge
-    and beat the initial residual."""
+def _beam_pipeline_fixture(tmp_path):
+    """Shared sky + synthetic beam + corrupted SimMS for the fullbatch
+    beam-pipeline tests (unsharded and --shard-baselines)."""
     import math
-    from sagecal_tpu import cli, pipeline
 
     sky_txt = ("P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 60e6\n"
                "P1A 1 20 0 38 0 0 2.5 0 0 0 0 0 0 0 0 60e6\n")
@@ -227,17 +225,39 @@ def test_fullbatch_pipeline_withbeam(tmp_path):
                                beam=beam_dev, dobeam=bm.DOBEAM_FULL)
     msdir = tmp_path / "sim.ms"
     ds.SimMS.create(str(msdir), [tile], beam_info=info)
+    return msdir
+
+
+def _run_beam_pipeline(tmp_path, msdir, extra_args):
+    from sagecal_tpu import cli, pipeline
 
     args = cli.build_parser().parse_args([
         "-d", str(msdir), "-s", str(tmp_path / "sky.txt"),
         "-c", str(tmp_path / "sky.txt.cluster"),
-        "-j", "0", "-e", "2", "-l", "10", "-m", "5", "-B", "2"])
+        "-e", "2", "-m", "5", "-B", "2"] + extra_args)
     cfg = cli.config_from_args(args)
     history = pipeline.run(cfg, log=lambda *a: None)
     assert len(history) == 1
     h = history[0]
     assert np.isfinite(h["res_1"])
     assert h["res_1"] < 0.5 * h["res_0"]
+
+
+def test_fullbatch_pipeline_withbeam(tmp_path):
+    """dosage.sh-with-beam analogue: simulate beam-corrupted data, then
+    calibrate with -B FULL through the full pipeline; solver must
+    converge and beat the initial residual."""
+    msdir = _beam_pipeline_fixture(tmp_path)
+    _run_beam_pipeline(tmp_path, msdir, ["-j", "0", "-l", "10"])
+
+
+def test_fullbatch_pipeline_withbeam_sharded(tmp_path):
+    """--shard-baselines with -B: beam tables replicate, row-indexed
+    gathers shard — the sharded GSPMD solve must converge like the
+    unsharded beam run."""
+    msdir = _beam_pipeline_fixture(tmp_path)
+    _run_beam_pipeline(tmp_path, msdir,
+                       ["-j", "1", "-l", "8", "--shard-baselines"])
 
 
 def test_stochastic_pipeline_withbeam(tmp_path):
@@ -424,50 +444,3 @@ def test_pipeline_precesses_sources(tmp_path):
     assert 1e-3 < abs(dra) < 0.1
     assert abs(pipe.beam_info.ra0 - ms.meta["ra0"]) > 1e-3
     assert abs(ddec) < 0.05
-
-
-def test_fullbatch_pipeline_withbeam_sharded(tmp_path):
-    """--shard-baselines with -B: beam tables replicate, row-indexed
-    gathers shard — the sharded GSPMD solve must converge like the
-    unsharded beam run (test_fullbatch_pipeline_withbeam)."""
-    import math
-    from sagecal_tpu import cli, pipeline
-
-    sky_txt = ("P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 60e6\n"
-               "P1A 1 20 0 38 0 0 2.5 0 0 0 0 0 0 0 0 60e6\n")
-    (tmp_path / "sky.txt").write_text(sky_txt)
-    (tmp_path / "sky.txt.cluster").write_text("0 1 P0A\n1 1 P1A\n")
-    ra0 = (0 + 41 / 60) * math.pi / 12
-    dec0 = 40 * math.pi / 180
-    srcs = skymodel.parse_sky_model(str(tmp_path / "sky.txt"),
-                                    ra0, dec0, 60e6)
-    sky = skymodel.build_cluster_sky(
-        srcs, skymodel.parse_cluster_file(str(tmp_path / "sky.txt.cluster")))
-    dsky = rp.sky_to_device(sky, jnp.float64)
-
-    n_sta, tilesz = 8, 3
-    info = bm.synthetic_beam(n_sta, np.array([2456789.0]), ra0, dec0, 60e6)
-    t_mjd = 4.93e9 + 10.0 * (np.arange(tilesz) + 0.5)
-    beam_dev = bm.beam_to_device(info, 60e6, jnp.float64,
-                                 time_jd=t_mjd / 86400.0 + 2400000.5)
-    Jtrue = ds.random_jones(sky.n_clusters, sky.nchunk, n_sta,
-                            seed=2, scale=0.2)
-    tile = ds.simulate_dataset(dsky, n_stations=n_sta, tilesz=tilesz,
-                               freqs=[59e6, 61e6], ra0=ra0, dec0=dec0,
-                               jones=Jtrue, nchunk=sky.nchunk,
-                               noise_sigma=0.01, seed=3,
-                               beam=beam_dev, dobeam=bm.DOBEAM_FULL)
-    msdir = tmp_path / "sim.ms"
-    ds.SimMS.create(str(msdir), [tile], beam_info=info)
-
-    args = cli.build_parser().parse_args([
-        "-d", str(msdir), "-s", str(tmp_path / "sky.txt"),
-        "-c", str(tmp_path / "sky.txt.cluster"),
-        "-j", "1", "-e", "2", "-l", "8", "-m", "5", "-B", "2",
-        "--shard-baselines"])
-    cfg = cli.config_from_args(args)
-    history = pipeline.run(cfg, log=lambda *a: None)
-    assert len(history) == 1
-    h = history[0]
-    assert np.isfinite(h["res_1"])
-    assert h["res_1"] < 0.5 * h["res_0"]

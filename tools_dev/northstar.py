@@ -127,6 +127,10 @@ def main():
            "-t", str(args.tilesz), "-V",
            "--block-f", str(args.block_f)]
     env = dict(os.environ)
+    # persistent XLA compilation cache: re-runs (and the second tile's
+    # programs) skip the big solve compiles
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(HERE, ".jax_cache"))
     if args.cpu:
         cmd += ["--platform", "cpu", "--cpu-devices", "1"]
     print("running:", " ".join(cmd), flush=True)

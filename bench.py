@@ -37,6 +37,10 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 SEED = 17
 PROBE_CACHE = os.path.join(HERE, ".bench_probe_cache.json")
 PROBE_CACHE_TTL_S = 45 * 60
+# a "no TPU" verdict ages out much faster: the tunnel flaps, and a stale
+# negative is exactly how rounds 2 and 3 recorded CPU-fallback official
+# numbers while the chip was healthy again minutes later
+PROBE_CACHE_NEG_TTL_S = 8 * 60
 
 PROBE_SRC = (
     "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform)"
@@ -51,7 +55,8 @@ def _read_probe_cache():
     try:
         with open(PROBE_CACHE) as f:
             c = json.load(f)
-        if time.time() - c.get("ts", 0) < PROBE_CACHE_TTL_S:
+        ttl = PROBE_CACHE_TTL_S if c["tpu"] else PROBE_CACHE_NEG_TTL_S
+        if time.time() - c.get("ts", 0) < ttl:
             return bool(c["tpu"])
     except Exception:
         pass
@@ -66,19 +71,49 @@ def _write_probe_cache(tpu: bool):
         pass
 
 
-def probe_tpu(attempts: int = 2, timeout_s: int = 60,
-              retry_sleep_s: int = 5) -> bool:
+def cpu_fingerprint() -> str:
+    """Short hash of this host's CPU feature set. The persistent XLA
+    compile cache must not serve code compiled under a different CPU
+    profile (round-3 driver tail: "cached code's CPU features mismatch
+    the host ... could lead to execution errors such as SIGILL")."""
+    import hashlib
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = [ln for ln in f if ln.startswith("flags")][:1]
+        blob = (flags[0] if flags else "none").encode()
+    except OSError:
+        blob = b"none"
+    return hashlib.sha256(blob).hexdigest()[:10]
+
+
+def compile_cache_dir(platform: str) -> str:
+    """Per-backend persistent compile cache path. TPU executables are
+    host-independent (shared dir); CPU executables are keyed by the host
+    CPU feature fingerprint so they can never SIGILL another host."""
+    if platform == "cpu":
+        return os.path.join(HERE, ".jax_cache", f"cpu-{cpu_fingerprint()}")
+    return os.path.join(HERE, ".jax_cache", platform)
+
+
+def probe_tpu(attempts: int = 3, timeout_s: int = 75,
+              retry_sleep_s: int = 10, force: bool = False) -> bool:
     """Probe TPU backend availability in a subprocess (cannot hang us).
 
-    Capped at ~2 min worst case (round-2 failure mode: three 150 s probe
-    timeouts burned 8 minutes of the driver budget before any config ran).
-    A recent last-good answer is reused from ``.bench_probe_cache.json``;
-    the cache is refreshed from each config's actually-observed platform.
+    Bounded at ~attempts*(timeout+sleep) worst case; the default schedule
+    (3 x 75 s with 10 s backoff) is deliberately longer than round 3's
+    (2 x 60 s) — the official round-3 record fell back to CPU because the
+    probe window missed the chip. A recent last-good answer is reused from
+    ``.bench_probe_cache.json`` (negative answers age out after
+    ``PROBE_CACHE_NEG_TTL_S``); the cache is refreshed from each config's
+    actually-observed platform. ``force`` skips the cache read — used by
+    the mid-run re-probe that upgrades a CPU-fallback run when the tunnel
+    comes back.
     """
-    cached = _read_probe_cache()
-    if cached is not None:
-        log(f"# tpu probe: cached answer tpu={cached}")
-        return cached
+    if not force:
+        cached = _read_probe_cache()
+        if cached is not None:
+            log(f"# tpu probe: cached answer tpu={cached}")
+            return cached
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     for i in range(attempts):
@@ -99,6 +134,7 @@ def probe_tpu(attempts: int = 2, timeout_s: int = 60,
             log(f"# tpu probe {i + 1}/{attempts}: timeout after {timeout_s}s")
         if i + 1 < attempts:
             time.sleep(retry_sleep_s)
+    _write_probe_cache(False)
     return False
 
 
@@ -155,7 +191,10 @@ def make_sky(n_clusters, srcs_per_cluster=3, seed=SEED, extended=False,
 
 
 def build_fullbatch(dtype, n_stations, n_clusters, tilesz, extended=False,
-                    spectra3=False, nchan=1, seed=SEED):
+                    spectra3=False, nchan=1, seed=SEED, n_tiles=1):
+    """Returns (sky, dsky, tiles): ``n_tiles`` independent solve intervals
+    of the same observation (tile 0 is the historical single-tile shape,
+    so residual figures stay comparable across rounds)."""
     import jax.numpy as jnp
     from sagecal_tpu.io import dataset as ds
     from sagecal_tpu.rime import predict as rp
@@ -167,40 +206,96 @@ def build_fullbatch(dtype, n_stations, n_clusters, tilesz, extended=False,
                             seed=seed + 1, scale=0.2)
     f0 = 150e6
     freqs = f0 + 0.2e6 * np.arange(nchan)
-    tile = ds.simulate_dataset(dsky, n_stations=n_stations, tilesz=tilesz,
-                               freqs=freqs, ra0=0.1, dec0=0.9,
-                               jones=Jtrue, nchunk=sky.nchunk,
-                               noise_sigma=0.01, seed=seed + 2)
-    return sky, dsky, tile
+    tiles = [ds.simulate_dataset(dsky, n_stations=n_stations, tilesz=tilesz,
+                                 freqs=freqs, ra0=0.1, dec0=0.9,
+                                 jones=Jtrue, nchunk=sky.nchunk,
+                                 noise_sigma=0.01, seed=seed + 2 + 1000 * t)
+             for t in range(n_tiles)]
+    return sky, dsky, tiles
 
 
-def _sage_inputs(sky, tile, dtype, device):
+def _sage_inputs(sky, tiles, dtype, device):
+    """Device inputs for a batched multi-tile solve; arrays that differ
+    per tile carry a leading [T] axis, shared geometry does not."""
     import jax
     import jax.numpy as jnp
     from sagecal_tpu import utils
     from sagecal_tpu.rime import predict as rp
     from sagecal_tpu.solvers import lm as lm_mod
 
+    tile = tiles[0]
+    T = len(tiles)
     kmax = int(sky.nchunk.max())
     n = tile.n_stations
     cidx = rp.chunk_indices(tile.tilesz, tile.nbase, sky.nchunk)
     cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
-    xa = tile.averaged()
-    x8 = np.stack([xa.reshape(-1, 4).real, xa.reshape(-1, 4).imag],
-                  -1).reshape(-1, 8)
+
+    def x8_of(t):
+        xa = t.averaged()
+        return np.stack([xa.reshape(-1, 4).real, xa.reshape(-1, 4).imag],
+                        -1).reshape(-1, 8)
+    x8 = np.stack([x8_of(t) for t in tiles])
     J0 = np.tile(np.eye(2, dtype=complex),
-                 (sky.n_clusters, kmax, n, 1, 1))
+                 (T, sky.n_clusters, kmax, n, 1, 1))
     put = lambda a, dt: jax.device_put(jnp.asarray(a, dt), device)
-    wt = lm_mod.make_weights(put(tile.flags, jnp.int32), dtype)
+    wt = jnp.stack([lm_mod.make_weights(put(t.flags, jnp.int32), dtype)
+                    for t in tiles])
     return dict(
-        x8=put(x8, dtype), u=put(tile.u, dtype), v=put(tile.v, dtype),
-        w=put(tile.w, dtype), s1=put(tile.sta1, jnp.int32),
+        x8=put(x8, dtype),
+        u=put(np.stack([t.u for t in tiles]), dtype),
+        v=put(np.stack([t.v for t in tiles]), dtype),
+        w=put(np.stack([t.w for t in tiles]), dtype),
+        s1=put(tile.sta1, jnp.int32),
         s2=put(tile.sta2, jnp.int32), wt=wt,
         # Jones cross the boundary as [.., 8] reals (complex h2d/d2h is
         # unimplemented on the axon TPU runtime)
         J0=put(utils.jones_c2r_np(J0), dtype),
         cidx=put(cidx, jnp.int32), cmask=put(cmask, bool),
         freq=put([tile.freq0], dtype), kmax=kmax)
+
+
+# bf16 peak FLOP/s per chip by device kind — the MFU denominator. The
+# solvers run f32 (which the MXU executes below bf16 peak), so the
+# reported "% of bf16 peak" is a conservative utilization figure.
+_PEAK_BF16 = (("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
+              ("v4", 275e12), ("v3", 123e12), ("v2", 45e12))
+
+
+def peak_flops(device):
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for key, pk in _PEAK_BF16:
+        if key in kind:
+            return pk
+    return None
+
+
+def _cost_flops(jfn, args, kwargs):
+    """Static FLOP count of one compiled program via XLA cost analysis.
+    Loop bodies are counted ONCE (measured: a 10-trip fori_loop prices
+    like a single trip), so per-program figures are lower bounds."""
+    comp = jfn.lower(*args, **kwargs).compile()
+    ca = comp.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return float(ca.get("flops", 0.0))
+
+
+def flops_of_stats(stats, extra=()):
+    """Sum cost-analysis FLOPs x call count over the solver's program log
+    (sage.program_stats) plus ``extra`` (jfn, args, kwargs, n) entries.
+    Returns None when any program refuses to lower (older jax, etc.)."""
+    total = 0.0
+    try:
+        for name, (jfn, argkw, n) in stats.items():
+            if argkw is None or n == 0:
+                continue
+            total += _cost_flops(jfn, argkw[0], argkw[1]) * n
+        for jfn, args, kwargs, n in extra:
+            total += _cost_flops(jfn, args, kwargs) * n
+    except Exception as e:          # pragma: no cover - version-dependent
+        log(f"# flop accounting unavailable: {type(e).__name__}: {e}")
+        return None
+    return total
 
 
 def pallas_ok(device, dtype, sky) -> bool:
@@ -230,19 +325,32 @@ def pallas_ok(device, dtype, sky) -> bool:
         return False
 
 
-def time_sage(device, dtype, sky, dsky, tile, solver_mode, reps=2,
+def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
               max_emiter=3, max_iter=10, max_lbfgs=10, use_pallas=False):
-    """Compile + time one SAGE solve interval; returns (vis/s, r0, r1, dt).
+    """Compile + time one batched SAGE solve over ``tiles`` independent
+    solve intervals; returns (vis/s, r0, r1, dt, compile_s, flops_step).
 
-    Uses the host-driven EM loop (sage.sagefit_host): one bounded device
-    execution per cluster solve — required on the tunneled chip, which
-    kills single executions over ~60 s.
+    Uses the host-driven EM loop over a tile batch
+    (sage.sagefit_host_tiles): T tiles run as ONE vmapped program per
+    bounded device execution — the tile axis is what keeps the MXU fed
+    (VERDICT r3 item 1); per-execution wall-clock stays under the
+    tunneled chip's ~60 s kill via the same fusion/promotion machinery.
+    Residual figures are tile 0's, which solves identically to the
+    historical single-tile bench (sage.tile_keys keeps its PRNG stream).
+
+    ``flops_step``: achieved FLOPs of one timed step, summed from XLA
+    cost analysis over every device program the step executed
+    (sage.program_stats) — a lower bound, since XLA prices loop bodies
+    once regardless of trip count.
     """
     import jax
+    import jax.numpy as jnp
     from sagecal_tpu.rime import predict as rp
     from sagecal_tpu.solvers import lm as lm_mod, normal_eq as ne, sage
 
-    inp = _sage_inputs(sky, tile, dtype, device)
+    tile = tiles[0]
+    T = len(tiles)
+    inp = _sage_inputs(sky, tiles, dtype, device)
     dsky_d = jax.device_put(dsky, device)
     os_ids, ns = lm_mod.os_subset_ids(tile.tilesz, tile.nbase)
     cfg = sage.SageConfig(max_emiter=max_emiter, max_iter=max_iter,
@@ -250,6 +358,7 @@ def time_sage(device, dtype, sky, dsky, tile, solver_mode, reps=2,
     n = tile.n_stations
     cidx_d, cmask_d, freq = inp["cidx"], inp["cmask"], inp["freq"]
     os_d = (jax.device_put(jnp_i32(os_ids), device), ns)
+    keys = jax.device_put(sage.tile_keys(T), device)
 
     if use_pallas:
         from sagecal_tpu import skymodel as sm
@@ -257,22 +366,29 @@ def time_sage(device, dtype, sky, dsky, tile, solver_mode, reps=2,
         pg_d = jax.device_put(rp.sky_to_device(sky_pg, dtype), device)
         rest_d = (None if sky_rest is None else
                   jax.device_put(rp.sky_to_device(sky_rest, dtype), device))
-        coh_fn = jax.jit(lambda u, v, w: rp.coherencies_split(
-            pg_d, rest_d, u, v, w, freq, tile.fdelta)[:, :, 0])
+
+        def coh_one(u1, v1, w1):
+            return rp.coherencies_split(pg_d, rest_d, u1, v1, w1, freq,
+                                        tile.fdelta)[:, :, 0]
     else:
-        coh_fn = jax.jit(lambda u, v, w: rp.coherencies(
-            dsky_d, u, v, w, freq, tile.fdelta)[:, :, 0])
-    # complex<->real conversions must run jitted: eager complex ops are
-    # unimplemented on the axon TPU runtime
+        def coh_one(u1, v1, w1):
+            return rp.coherencies(dsky_d, u1, v1, w1, freq,
+                                  tile.fdelta)[:, :, 0]
+    # all tiles' coherencies in ONE program (T unrolled predicts: the
+    # Pallas kernel needs no batching rule this way); complex stacking
+    # and the real<->complex Jones conversions must run jitted — eager
+    # complex ops are unimplemented on the axon TPU runtime
+    coh_fn = jax.jit(lambda u, v, w: jnp.stack(
+        [coh_one(u[t], v[t], w[t]) for t in range(T)]))
     r2c = jax.jit(ne.jones_r2c)
     c2r = jax.jit(ne.jones_c2r)
 
     def step(x8, u, v, w, s1, s2, wt, J0):
         coh = coh_fn(u, v, w)
-        J, info = sage.sagefit_host(x8, coh, s1, s2, cidx_d, cmask_d,
-                                    r2c(J0), n, wt, config=cfg,
-                                    os_id=os_d)
-        return c2r(J), info["res_0"], info["res_1"]
+        J, info = sage.sagefit_host_tiles(
+            x8, coh, s1, s2, cidx_d, cmask_d, r2c(J0), n, wt, config=cfg,
+            os_id=os_d, keys=keys)
+        return J, info["res_0"], info["res_1"]
 
     args = (inp["x8"], inp["u"], inp["v"], inp["w"], inp["s1"], inp["s2"],
             inp["wt"], inp["J0"])
@@ -280,11 +396,12 @@ def time_sage(device, dtype, sky, dsky, tile, solver_mode, reps=2,
     J, r0, r1 = step(*args)
     jax.block_until_ready(J)
     compile_s = time.perf_counter() - tc0
-    # untimed settling calls: sagefit_host may PROMOTE this shape to the
-    # fully traced program a call in (it qualifies during the warmup call
-    # for max_emiter >= 2 — every bench config), and that compile must
-    # not land inside the timed reps. Two settle calls bound the cost:
-    # call 1 absorbs the promoted compile, call 2 confirms steady state.
+    # untimed settling calls: sagefit_host_tiles may PROMOTE this shape
+    # to the fully traced program a call in (it qualifies during the
+    # warmup call for max_emiter >= 2 — every bench config), and that
+    # compile must not land inside the timed reps. Two settle calls
+    # bound the cost: call 1 absorbs the promoted compile, call 2
+    # confirms steady state.
     t_prev = None
     settle_s = 0.0
     n_settle = 0
@@ -298,14 +415,21 @@ def time_sage(device, dtype, sky, dsky, tile, solver_mode, reps=2,
         if t_prev is not None and abs(t_call - t_prev) < 0.25 * t_prev:
             break
         t_prev = t_call
+    sage.program_stats_reset()
     t0 = time.perf_counter()
     for _ in range(reps):
         J, r0, r1 = step(*args)
     jax.block_until_ready(J)
     dt = (time.perf_counter() - t0) / reps
     compile_s += max(settle_s - n_settle * dt, 0.0)
-    nvis = tile.nrows * len(tile.freqs)
-    return nvis / dt, float(r0), float(r1), dt, compile_s
+    flops = flops_of_stats(
+        sage.program_stats(),
+        extra=[(coh_fn, (inp["u"], inp["v"], inp["w"]), {}, reps)])
+    flops_step = None if flops is None else flops / reps
+    nvis = T * tile.nrows * len(tile.freqs)
+    r0_0 = float(np.asarray(r0).reshape(-1)[0])
+    r1_0 = float(np.asarray(r1).reshape(-1)[0])
+    return nvis / dt, r0_0, r1_0, dt, compile_s, flops_step
 
 
 def jnp_i32(a):
@@ -317,25 +441,47 @@ def jnp_i32(a):
 # configs
 # ---------------------------------------------------------------------------
 
+def _tiles_for(device, default: int) -> int:
+    """Tile-batch width: env override, else ``default`` on TPU and 1 on
+    the (single-core) CPU fallback, where batching just multiplies
+    wall-clock."""
+    envv = int(os.environ.get("SAGECAL_BENCH_TILES", 0))
+    if envv:
+        return envv
+    return default if device.platform == "tpu" else 1
+
+
+def _mfu_fields(out, device, flops_step, dt):
+    if flops_step:
+        out["flops_step"] = flops_step
+        out["flops_per_s"] = flops_step / dt
+        pk = peak_flops(device)
+        if pk:
+            out["mfu_pct"] = 100.0 * flops_step / dt / pk
+    return out
+
+
 def config1_fullbatch_lm(device, dtype):
     """BASELINE config 1: point sources, LM-family solver (smoke shape
-    scaled to LOFAR station count). On TPU the Pallas coherency kernel is
-    measured against the XLA path (kernel-on/off throughput both
-    recorded)."""
+    scaled to LOFAR station count), batched over 8 solve intervals. On
+    TPU the Pallas coherency kernel is measured against the XLA path
+    (kernel-on/off throughput both recorded)."""
     from sagecal_tpu.config import SolverMode
-    sky, dsky, tile = build_fullbatch(dtype, n_stations=62, n_clusters=8,
-                                      tilesz=10)
+    T = _tiles_for(device, 8)
+    sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=8,
+                                       tilesz=10, n_tiles=T)
     pal = pallas_ok(device, dtype, sky)
-    vps, r0, r1, dt, comp = time_sage(device, dtype, sky, dsky, tile,
-                                      SolverMode.OSLM_OSRLM_RLBFGS,
-                                      use_pallas=pal)
+    vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
+                                          SolverMode.OSLM_OSRLM_RLBFGS,
+                                          use_pallas=pal)
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
-               step_s=dt, compile_s=comp, pallas=pal,
-               shape="N=62 M=8 tilesz=10 point -j3")
+               step_s=dt, compile_s=comp, pallas=pal, tiles=T,
+               shape=f"N=62 M=8 tilesz=10 point -j3 T{T}")
+    _mfu_fields(out, device, fl, dt)
     if pal:
-        vps0, _, _, _, _ = time_sage(device, dtype, sky, dsky, tile,
-                                     SolverMode.OSLM_OSRLM_RLBFGS,
-                                     use_pallas=False)
+        vps0, _, _, _, _, _ = time_sage(device, dtype, sky, dsky, tiles,
+                                        SolverMode.OSLM_OSRLM_RLBFGS,
+                                        use_pallas=False)
         out["value_xla"] = vps0
         out["pallas_speedup"] = vps / vps0
     return out
@@ -351,8 +497,9 @@ def config2_stochastic(device, dtype):
     from sagecal_tpu import stochastic as st
 
     n_stations, n_clusters, tilesz, nchan = 32, 4, 8, 8
-    sky, dsky, tile = build_fullbatch(dtype, n_stations, n_clusters, tilesz,
-                                      nchan=nchan)
+    sky, dsky, tiles = build_fullbatch(dtype, n_stations, n_clusters,
+                                       tilesz, nchan=nchan)
+    tile = tiles[0]
     dsky = jax.device_put(dsky, device)
     kmax = int(sky.nchunk.max())
     cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
@@ -382,16 +529,19 @@ def config2_stochastic(device, dtype):
     bmb = tpm * tile.nbase
     tslot = ds.row_tslot(bmb, tile.nbase)
 
+    last_args = {}
+
     def run_minibatch(nb, p, mem):
         lo = row0[nb]
         sl = slice(lo, lo + bmb)
-        out = solver(put(x8F[sl], dtype), put(tile.u[sl], dtype),
-                     put(tile.v[sl], dtype), put(tile.w[sl], dtype),
-                     put(tile.sta1[sl], jnp.int32),
-                     put(tile.sta2[sl], jnp.int32),
-                     put(wtF[sl], dtype), freqsF,
-                     put(tslot, jnp.int32), put(p, dtype), mem)
-        return out
+        args = (put(x8F[sl], dtype), put(tile.u[sl], dtype),
+                put(tile.v[sl], dtype), put(tile.w[sl], dtype),
+                put(tile.sta1[sl], jnp.int32),
+                put(tile.sta2[sl], jnp.int32),
+                put(wtF[sl], dtype), freqsF,
+                put(tslot, jnp.int32), put(p, dtype), mem)
+        last_args["a"] = args
+        return solver(*args)
 
     # warmup/compile on minibatch 0
     tc0 = time.perf_counter()
@@ -456,47 +606,64 @@ def config2_stochastic(device, dtype):
     jax.block_until_ready(out1.p)
     dt_seq = time.perf_counter() - t0
 
-    return dict(value=nvis / dt, unit="vis/s", res_0=r0, res_1=r1,
+    out2 = dict(value=nvis / dt, unit="vis/s", res_0=r0, res_1=r1,
                 step_s=dt, compile_s=comp,
                 bands=W, bands_batched_s=dt_batched, bands_seq_s=dt_seq,
                 band_speedup=dt_seq / dt_batched,
                 shape=f"N=32 M=4 F={nchan}ch minibatch -N2")
+    try:
+        fl = _cost_flops(solver, last_args["a"], {})
+    except Exception as e:          # pragma: no cover - version-dependent
+        log(f"# flop accounting unavailable: {type(e).__name__}: {e}")
+        fl = None
+    return _mfu_fields(out2, device, fl, dt)
 
 
 def config3_rtr16(device, dtype):
-    """BASELINE config 3: robust Student's-t + RTR (-j 5), 16 clusters."""
+    """BASELINE config 3: robust Student's-t + RTR (-j 5), 16 clusters,
+    batched over 4 solve intervals (the round-3 ≥5x utilization target,
+    VERDICT item 1)."""
     from sagecal_tpu.config import SolverMode
     # 2 EM iterations: a 3-EM robust-RTR step at 16 clusters is ~150 s
     # on-chip and the subprocess must fit warmup + 1 timed rep in 570 s
-    sky, dsky, tile = build_fullbatch(dtype, n_stations=62, n_clusters=16,
-                                      tilesz=10, seed=SEED + 10)
-    vps, r0, r1, dt, comp = time_sage(device, dtype, sky, dsky, tile,
-                                      SolverMode.RTR_OSRLM_RLBFGS, reps=1,
-                                      max_emiter=2)
-    return dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
-                step_s=dt, compile_s=comp,
-                shape="N=62 M=16 tilesz=10 point -j5")
+    T = _tiles_for(device, 4)
+    sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=16,
+                                       tilesz=10, seed=SEED + 10,
+                                       n_tiles=T)
+    vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
+                                          SolverMode.RTR_OSRLM_RLBFGS,
+                                          reps=1, max_emiter=2)
+    out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
+               step_s=dt, compile_s=comp, tiles=T,
+               shape=f"N=62 M=16 tilesz=10 point -j5 T{T}")
+    return _mfu_fields(out, device, fl, dt)
 
 
 def config4_extended(device, dtype):
     """BASELINE config 4: shapelet + Gaussian sources, 3rd-order spectra,
-    64 stations. On TPU the hybrid Pallas split (kernel for
-    point+gaussian, XLA for shapelets) is measured against pure XLA."""
+    64 stations, batched over 4 solve intervals. On TPU the hybrid
+    Pallas split (kernel for point+gaussian, XLA for shapelets) is
+    measured against pure XLA."""
     from sagecal_tpu.config import SolverMode
-    sky, dsky, tile = build_fullbatch(dtype, n_stations=64, n_clusters=8,
-                                      tilesz=10, extended=True,
-                                      spectra3=True, seed=SEED + 20)
+    T = _tiles_for(device, 4)
+    sky, dsky, tiles = build_fullbatch(dtype, n_stations=64, n_clusters=8,
+                                       tilesz=10, extended=True,
+                                       spectra3=True, seed=SEED + 20,
+                                       n_tiles=T)
     pal = pallas_ok(device, dtype, sky)
-    vps, r0, r1, dt, comp = time_sage(device, dtype, sky, dsky, tile,
-                                      SolverMode.RTR_OSRLM_RLBFGS, reps=1,
-                                      max_emiter=2, use_pallas=pal)
+    vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
+                                          SolverMode.RTR_OSRLM_RLBFGS,
+                                          reps=1, max_emiter=2,
+                                          use_pallas=pal)
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
-               step_s=dt, compile_s=comp, pallas=pal,
-               shape="N=64 M=8 shapelet+gauss -F1 -j5")
+               step_s=dt, compile_s=comp, pallas=pal, tiles=T,
+               shape=f"N=64 M=8 shapelet+gauss -F1 -j5 T{T}")
+    _mfu_fields(out, device, fl, dt)
     if pal:
-        vps0, _, _, _, _ = time_sage(device, dtype, sky, dsky, tile,
-                                     SolverMode.RTR_OSRLM_RLBFGS, reps=1,
-                                     max_emiter=2, use_pallas=False)
+        vps0, _, _, _, _, _ = time_sage(device, dtype, sky, dsky, tiles,
+                                        SolverMode.RTR_OSRLM_RLBFGS,
+                                        reps=1, max_emiter=2,
+                                        use_pallas=False)
         out["value_xla"] = vps0
         out["pallas_speedup"] = vps / vps0
     return out
@@ -519,8 +686,9 @@ def config5_admm32(device, dtype):
     F = 32
     n_stations, n_clusters, tilesz = 32, 16, 4
     n_admm = 5
-    sky, dsky, tile = build_fullbatch(dtype, n_stations, n_clusters, tilesz,
-                                      seed=SEED + 30)
+    sky, dsky, tiles = build_fullbatch(dtype, n_stations, n_clusters,
+                                       tilesz, seed=SEED + 30)
+    tile = tiles[0]
     dsky = jax.device_put(dsky, device)
     n = tile.n_stations
     kmax = int(sky.nchunk.max())
@@ -602,12 +770,17 @@ def write_table(results, platform, date=None):
         f"Device platform: **{platform}**  |  dtype f32  |  "
         f"date {date}",
         "",
-        "| config | value | unit | res_0 -> res_1 | step | compile | shape |",
-        "|---|---|---|---|---|---|---|",
+        "MFU≥ = achieved FLOP/s vs bf16 peak, from XLA cost analysis of "
+        "every device program a timed step executed; loop bodies price "
+        "once regardless of trip count, so it is a lower bound.",
+        "",
+        "| config | value | unit | res_0 -> res_1 | step | compile | "
+        "GFLOP/s | MFU≥ | shape |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     for name, r in results.items():
         if "error" in r:
-            lines.append(f"| {name} | FAILED | — | — | — | — | "
+            lines.append(f"| {name} | FAILED | — | — | — | — | — | — | "
                          f"{r['error'][:80]} |")
             continue
         res = (f"{r.get('res_0', float('nan')):.4g} -> "
@@ -616,10 +789,14 @@ def write_table(results, platform, date=None):
         if r.get("pallas"):
             sp = r.get("pallas_speedup")
             shape += (f" [pallas x{sp:.2f}]" if sp else " [pallas]")
+        gfs = r.get("flops_per_s")
+        gfs_s = "—" if not gfs else f"{gfs / 1e9:.1f}"
+        mfu = r.get("mfu_pct")
+        mfu_s = "—" if mfu is None else f"{mfu:.2f}%"
         lines.append(
             f"| {name} | {r['value']:.1f} | {r['unit']} | {res} | "
             f"{_fmt_s(r, 'step_s', '.3f')} | {_fmt_s(r, 'compile_s', '.1f')}"
-            f" | {shape} |")
+            f" | {gfs_s} | {mfu_s} | {shape} |")
     # the north-star scale row (tools_dev/northstar.py) is measured by a
     # separate scripted run; re-emit it from its record so regenerating
     # this table never drops it
@@ -628,9 +805,13 @@ def write_table(results, platform, date=None):
         try:
             with open(ns_path) as f:
                 ns = json.load(f)
+            gfs = ns.get("flops_per_s")
+            gfs_s = "—" if not gfs else f"{gfs / 1e9:.1f}"
+            mfu = ns.get("mfu_pct")
+            mfu_s = "—" if mfu is None else f"{mfu:.2f}%"
             lines.append(
                 f"| northstar | {ns['value']:.2f} | {ns['unit']} | — | — "
-                f"| — | {ns.get('shape', '')} "
+                f"| — | {gfs_s} | {mfu_s} | {ns.get('shape', '')} "
                 f"[{ns.get('platform', '?')}] |")
         except Exception as e:
             log(f"# NORTHSTAR.json unreadable: {e}")
@@ -647,16 +828,25 @@ def run_one_config(name: str):
     import jax
     if os.environ.get("SAGECAL_BENCH_CPU"):
         jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    # platform assertion: a config expected on TPU must never silently
+    # produce a CPU number under a TPU label (round-3 weak item 4)
+    expect = os.environ.get("SAGECAL_BENCH_EXPECT")
+    if expect and dev.platform != expect:
+        print("BENCHRESULT " + json.dumps(
+            {"error": f"platform assertion: expected {expect}, "
+                      f"got {dev.platform}", "platform": dev.platform}))
+        return
     try:
         # persistent XLA compilation cache: each config runs in a fresh
         # process (device-fault isolation), so without this every run
-        # re-pays ~50 s of compiles per config
+        # re-pays ~50 s of compiles per config. Keyed per platform (+ CPU
+        # feature fingerprint) — see compile_cache_dir.
         jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(HERE, ".jax_cache"))
+                          compile_cache_dir(dev.platform))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     except Exception as e:
         log(f"# compilation cache unavailable: {e}")
-    dev = jax.devices()[0]
     import jax.numpy as jnp
     fn = dict(CONFIGS)[name]
     r = fn(dev, jnp.float32)
@@ -674,11 +864,13 @@ def run_config_subprocess(name: str, timeout_s: int = 570, cpu=False):
     env = dict(os.environ)
     if cpu:
         env["SAGECAL_BENCH_CPU"] = "1"
+        env.pop("SAGECAL_BENCH_EXPECT", None)
     else:
         # an exported JAX_PLATFORMS=cpu (the documented flaky-TPU
         # workaround) must not silently demote the children while the
         # probe reports TPU
         env.pop("JAX_PLATFORMS", None)
+        env["SAGECAL_BENCH_EXPECT"] = "tpu"
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--config", name],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
@@ -744,12 +936,15 @@ class _Emitter:
         head = self.results.get("1-fullbatch-lm", {})
         value = head.get("value", 0.0)
         vs = self.vs if self.vs is not None else 1.0
+        # the headline device is the platform the headline config
+        # ACTUALLY ran on, not the probe's belief
+        device = head.get("platform", self.platform)
         print(json.dumps({
             "metric": "visibilities calibrated/sec/chip",
             "value": round(float(value), 1),
             "unit": "vis/s",
             "vs_baseline": round(float(vs), 3),
-            "device": self.platform,
+            "device": device,
             "configs_ok": sum(1 for r in self.results.values()
                               if "error" not in r),
             "configs_total": self.total,
@@ -776,18 +971,11 @@ def main():
     log(f"# bench platform: {em.platform} (timeout {timeout_s}s/config, "
         f"budget {budget_s}s)")
 
-    for name, fn in CONFIGS:
-        if quick and not name.startswith("1"):
-            continue
-        remaining = budget_s - (time.perf_counter() - t_start) - 30
-        if remaining < 60:
-            em.results[name] = {"error": "skipped: bench budget exhausted"}
-            log(f"# {name}: skipped (budget)")
-            write_table(em.results, em.platform)
-            continue
+    def run_and_record(name, cpu: bool):
         t0 = time.perf_counter()
+        remaining = budget_s - (time.perf_counter() - t_start) - 30
         r = run_config_subprocess(name, timeout_s=int(
-            min(timeout_s, remaining)), cpu=not have_tpu)
+            min(timeout_s, remaining)), cpu=cpu)
         if "error" not in r:
             r["total_s"] = round(time.perf_counter() - t0, 1)
             log(f"# {name}: {r['value']:.1f} {r['unit']} "
@@ -801,7 +989,7 @@ def main():
                     em.platform = r["platform"]
         else:
             log(f"# {name}: FAILED {r['error']}")
-            if have_tpu:
+            if not cpu:
                 # a failing TPU config invalidates the cached last-good
                 # answer so the NEXT bench run re-probes instead of
                 # repeating a zero round inside the cache TTL
@@ -813,6 +1001,46 @@ def main():
         # flush after EVERY config: a later timeout/fault can no longer
         # zero the round's perf record
         write_table(em.results, em.platform)
+        return r
+
+    last_reprobe = time.perf_counter()
+    for name, fn in CONFIGS:
+        if quick and not name.startswith("1"):
+            continue
+        remaining = budget_s - (time.perf_counter() - t_start) - 30
+        if remaining < 60:
+            em.results[name] = {"error": "skipped: bench budget exhausted"}
+            log(f"# {name}: skipped (budget)")
+            write_table(em.results, em.platform)
+            continue
+        if (not have_tpu and remaining > 300
+                and time.perf_counter() - last_reprobe > 120):
+            # CPU-fallback run: keep trying to catch the tunnel coming
+            # back (the round-3 official record was a stale CPU verdict)
+            last_reprobe = time.perf_counter()
+            if probe_tpu(attempts=1, timeout_s=45, force=True):
+                log("# tpu probe: chip came back mid-run; switching")
+                have_tpu = True
+                em.platform = "tpu"
+        run_and_record(name, cpu=not have_tpu)
+
+    # upgrade pass: if the run ended on TPU but earlier configs fell back
+    # to CPU (or errored), re-run those on the chip with leftover budget —
+    # headline config 1 first, so the official record says TPU
+    if have_tpu:
+        stale = [n for n, _ in CONFIGS if n in em.results
+                 and em.results[n].get("platform", "cpu") != "tpu"]
+        stale.sort(key=lambda n: not n.startswith("1"))
+        for name in stale:
+            remaining = budget_s - (time.perf_counter() - t_start) - 30
+            if remaining < 90:
+                break
+            log(f"# upgrade pass: re-running {name} on tpu")
+            prev = em.results[name]
+            r = run_and_record(name, cpu=False)
+            if "error" in r and "error" not in prev:
+                em.results[name] = prev     # keep the CPU number
+                write_table(em.results, em.platform)
 
     head = em.results.get("1-fullbatch-lm", {})
     value = head.get("value", 0.0)
@@ -826,7 +1054,10 @@ def main():
             rv = ref.get("config1_vis_per_sec")
             if rv:
                 em.vs = value / rv
-                log(f"# vs_baseline = TPU {value:.0f} / reference-CPU "
+                # label with the platform config 1 ACTUALLY ran on —
+                # round 3's record said "TPU 374" about a CPU run
+                dev = head.get("platform", em.platform)
+                log(f"# vs_baseline = {dev} {value:.0f} / reference-CPU "
                     f"{rv:.0f} vis/s ({ref.get('note', '')})")
         except Exception as e:
             log(f"# ref_baseline.json unreadable: {e}")

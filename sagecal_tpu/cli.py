@@ -49,6 +49,16 @@ def build_parser() -> argparse.ArgumentParser:
     a("-W", "--whiten", type=int, default=0)
     a("--profile", default=None, metavar="DIR",
       help="write a jax.profiler trace of the first solve interval")
+    a("--tile-batch", type=int, default=1,
+      help=">1: solve this many intervals as one batched device program "
+           "(throughput lever; warm start becomes batch-granular)")
+    a("--solve-fuse", choices=("auto", "on", "off"), default="auto",
+      help="EM-sweep fusion: learn from timed sweeps (auto) or force")
+    a("--solve-promote", choices=("auto", "on", "off"), default="auto",
+      help="full-trace solve promotion: learn (auto) or force")
+    a("--inflight", type=int, default=1,
+      help="clusters solved concurrently per SAGE sweep step (block-"
+           "Jacobi groups); 1 = reference Gauss-Seidel sequencing")
     a("--shard-baselines", action="store_true",
       help="shard the baseline row axis of the (single) subband over "
            "all devices (P1 intra-subband parallelism)")
@@ -108,6 +118,9 @@ def config_from_args(args) -> RunConfig:
         admm_rho=args.rho, rho_file=args.rho_file,
         max_timeslots=args.max_timeslots, verbose=args.verbose,
         profile_dir=args.profile,
+        tile_batch=args.tile_batch, solve_fuse=args.solve_fuse,
+        solve_promote=args.solve_promote,
+        cluster_inflight=args.inflight,
         shard_baselines=bool(args.shard_baselines))
 
 

@@ -88,6 +88,10 @@ def build_parser() -> argparse.ArgumentParser:
            "execution (keeps each program under the tunneled chip's "
            "per-execution wall-clock kill on north-star shapes); 0 = "
            "one mesh program")
+    a("--inflight", type=int, default=1,
+      help="clusters solved concurrently per SAGE sweep step (block-"
+           "Jacobi groups; the reference GPU pipeline's 2-in-flight "
+           "analogue, lmfit_cuda.c:450). 1 = strict sequencing")
     a("--host-loop", action="store_true",
       help="one device execution per ADMM iteration instead of a fully "
            "traced n_admm-iteration program")
@@ -260,7 +264,8 @@ def main(argv=None) -> int:
             max_emiter=args.max_em_iter, max_iter=args.max_iter,
             max_lbfgs=args.max_lbfgs, lbfgs_m=args.lbfgs_m,
             solver_mode=int(SolverMode(args.solver_mode)),
-            nulow=args.nulow, nuhigh=args.nuhigh))
+            nulow=args.nulow, nuhigh=args.nuhigh,
+            inflight=args.inflight))
 
     t0 = mss[0].read_tile(0)
     blk_timer = [] if args.block_f else None

@@ -132,6 +132,23 @@ class RunConfig:
     mdl_report: bool = False           # -M (mpi app): model-order selection report
     verbose: bool = False              # -V
 
+    # --- execution plan (host solve driver)
+    # --tile-batch : solve intervals batched into one vmapped device
+    # program (T>1 changes warm-start semantics: every tile in a batch
+    # warm-starts from the last completed batch's solution instead of
+    # the immediately preceding tile's — a deliberate throughput trade;
+    # sage.sagefit_host_tiles)
+    tile_batch: int = 1
+    # --solve-fuse/--solve-promote : force ("on"/"off") or learn
+    # ("auto") the wall-clock execution-plan heuristics
+    # (sage.SageConfig.fuse/promote) so perf runs are reproducible
+    solve_fuse: str = "auto"
+    solve_promote: str = "auto"
+    # --inflight : clusters solved concurrently per SAGE sweep step
+    # (block-Jacobi groups, sage.SageConfig.inflight); 1 = reference
+    # Gauss-Seidel sequencing
+    cluster_inflight: int = 1
+
     # --- observability
     profile_dir: str | None = None     # --profile : jax.profiler trace of
     #                                    the first solve interval

@@ -140,8 +140,25 @@ class FullBatchPipeline:
             max_lbfgs=0 if cfg.per_channel_bfgs else cfg.max_lbfgs,
             lbfgs_m=cfg.lbfgs_m, solver_mode=mode, nulow=cfg.robust_nulow,
             nuhigh=cfg.robust_nuhigh, randomize=cfg.randomize,
-            linsolv=cfg.linsolv)
+            linsolv=cfg.linsolv,
+            fuse=getattr(cfg, "solve_fuse", "auto"),
+            promote=getattr(cfg, "solve_promote", "auto"),
+            inflight=max(1, int(getattr(cfg, "cluster_inflight", 1))))
         self.boost = first_tile_boost(self.n)
+
+        # --tile-batch: T>1 solves T intervals as one vmapped program
+        # (sagefit_host_tiles) — the utilization lever for small solves.
+        # Restricted to the plain fullbatch path: the beam path needs
+        # per-tile beam tables and the sharded path is its own program.
+        self.tile_batch = max(1, int(getattr(cfg, "tile_batch", 1)))
+        self.batch_ok = (self.tile_batch > 1 and not cfg.per_channel_bfgs
+                         and not getattr(cfg, "shard_baselines", False)
+                         and not self.dobeam)
+        if self.tile_batch > 1 and not self.batch_ok:
+            log("tile-batch disabled (per-channel/sharded/beam path); "
+                "running sequentially")
+        self._solve_tiles = (self._build_tiles_solver(self.tile_batch)
+                             if self.batch_ok else None)
 
         self._solve_first = self._build_solver(self.boost)
         self._solve_rest = self._build_solver(1)
@@ -198,6 +215,47 @@ class FullBatchPipeline:
             J, info = sage.sagefit_host(
                 jnp.asarray(x8, self.rdt), coh, sta1, sta2, cidx, cmask,
                 J0, self.n, wt, config=scfg, os_id=os_info, key=key)
+            return _jones_c2r_j(J), info
+        return solve
+
+    def _build_tiles_solver(self, T: int):
+        """Batched variant of :meth:`_build_solver` (emiter_mult=1): T
+        staged tiles solve as one vmapped program. Per-tile PRNG keys are
+        the SAME fold_in(199, tile_idx) stream as the sequential path, so
+        each tile's subset draws/permutations match a sequential run —
+        only the warm start differs (batch-granular instead of
+        tile-granular)."""
+        scfg = self.base_cfg
+        meta = self.ms.meta
+        freq0 = meta["freq0"]
+        fdelta = meta["fdelta"]
+        cidx = jnp.asarray(self.cidx)
+        cmask = jnp.asarray(self.cmask)
+        os_info = lm_mod.os_subset_ids(meta["tilesz"], meta["nbase"])
+        freq = jnp.asarray([freq0], self.rdt)
+
+        if self.use_pallas:
+            pg, rest = self._pallas_skies
+
+            def coh_one(u1, v1, w1):
+                return rp.coherencies_split(pg, rest, u1, v1, w1, freq,
+                                            fdelta)[:, :, 0]
+        else:
+            def coh_one(u1, v1, w1):
+                return rp.coherencies(self.dsky, u1, v1, w1, freq,
+                                      fdelta)[:, :, 0]
+        coh_fn = jax.jit(lambda u, v, w: jnp.stack(
+            [coh_one(u[t], v[t], w[t]) for t in range(T)]))
+
+        def solve(x8T, uT, vT, wT, sta1, sta2, wtT, J0_r8T, tile_ids):
+            coh = coh_fn(uT, vT, wT)
+            keys = jnp.stack([
+                jax.random.fold_in(jax.random.PRNGKey(199), int(ti))
+                for ti in tile_ids])
+            J, info = sage.sagefit_host_tiles(
+                jnp.asarray(x8T, self.rdt), coh, sta1, sta2, cidx, cmask,
+                _jones_r2c_j(jnp.asarray(J0_r8T, self.rdt)), self.n, wtT,
+                config=scfg, os_id=os_info, keys=keys)
             return _jones_c2r_j(J), info
         return solve
 
@@ -378,8 +436,141 @@ class FullBatchPipeline:
                 J0 = last[0] if isinstance(last, list) else last
         return J0
 
+    def _run_batched(self, write_residuals, solution_path, max_tiles, log):
+        """--tile-batch>1 fullbatch driver: tile 0 (and every re-armed
+        boost tile after a divergence reset) solves solo, then groups of
+        T tiles solve as ONE vmapped program (sagefit_host_tiles); the
+        stream tail runs solo. Semantics vs the sequential driver: each
+        tile in a group warm-starts from the solution carried into the
+        group (batch-granular warm start) — everything else (PRNG
+        streams, residual math, divergence resets, solution writing)
+        matches tile for tile."""
+        cfg, ms, sky = self.cfg, self.ms, self.sky
+        meta = ms.meta
+        from sagecal_tpu.solvers import robust as rb
+        T = self.tile_batch
+        pinit = self.initial_jones()
+        writer = None
+        if solution_path:
+            writer = sol.SolutionWriter(
+                solution_path, meta["freq0"], meta["fdelta"],
+                meta["tilesz"] * meta["tdelta"] / 60.0, self.n,
+                sky.n_clusters, sky.n_eff_clusters)
+        history = []
+        state = {"J": pinit.copy(), "first": True, "res_prev": None}
+        pending = []
+
+        def stage(ti, tile):
+            u = jnp.asarray(tile.u, self.rdt)
+            v = jnp.asarray(tile.v, self.rdt)
+            w = jnp.asarray(tile.w, self.rdt)
+            x8_np, rowflags, _good = tile.solve_input(uvtaper_m=cfg.uvtaper)
+            x8 = jnp.asarray(x8_np, self.rdt)
+            flags = rp.uvcut_flags(jnp.asarray(rowflags, jnp.int32), u, v,
+                                   jnp.asarray(tile.freqs, self.rdt),
+                                   cfg.uvmin, cfg.uvmax)
+            if cfg.whiten:
+                x8 = rb.whiten_data(x8, u, v, meta["freq0"])
+            return dict(ti=ti, tile=tile, u=u, v=v, w=w, x8=x8,
+                        wt=lm_mod.make_weights(flags, self.rdt),
+                        sta1=jnp.asarray(tile.sta1),
+                        sta2=jnp.asarray(tile.sta2))
+
+        def post(stg, res_0, res_1, mean_nu, Jnew, minutes):
+            ti, tile = stg["ti"], stg["tile"]
+            if res_1 == 0.0 or not np.isfinite(res_1) or (
+                    state["res_prev"] is not None
+                    and res_1 > RES_RATIO * state["res_prev"]):
+                log(f"tile {ti}: Resetting Solution")
+                state["J"] = pinit.copy()
+                state["first"] = True
+                state["res_prev"] = res_1 if np.isfinite(res_1) else None
+            else:
+                state["J"] = Jnew
+                state["res_prev"] = (res_1 if state["res_prev"] is None
+                                     else min(state["res_prev"], res_1))
+            if writer:
+                writer.write_interval(state["J"] if state["first"]
+                                      else Jnew, sky.nchunk)
+            if write_residuals:
+                res_r = self._residual_fn(
+                    jnp.asarray(utils.jones_c2r_np(
+                        state["J"] if state["first"] else Jnew), self.rdt),
+                    jnp.asarray(utils.c2r(tile.x), self.rdt),
+                    stg["u"], stg["v"], stg["w"], stg["sta1"], stg["sta2"],
+                    None)
+                tile.x = utils.r2c(np.asarray(res_r)).astype(np.complex128)
+                ms.write_tile(ti, tile)
+            log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
+                f"final={res_1:.6g}, Time spent={minutes:.3g} minutes, "
+                f"nu={mean_nu:.2f}")
+            history.append({"tile": ti, "res_0": res_0, "res_1": res_1,
+                            "mean_nu": mean_nu, "minutes": minutes})
+
+        def solve_solo(stg, boosted):
+            t0 = time.time()
+            solver = self._solve_first if boosted else self._solve_rest
+            J_r8 = jnp.asarray(utils.jones_c2r_np(state["J"]), self.rdt)
+            Jd_r8, info = solver(stg["x8"], stg["u"], stg["v"], stg["w"],
+                                 stg["sta1"], stg["sta2"], stg["wt"],
+                                 J_r8, None, tile_idx=stg["ti"])
+            state["first"] = False
+            post(stg, float(info["res_0"]), float(info["res_1"]),
+                 float(info["mean_nu"]),
+                 utils.jones_r2c_np(np.asarray(Jd_r8)),
+                 (time.time() - t0) / 60.0)
+
+        def flush(group):
+            if not group:
+                return
+            if len(group) < T:
+                for stg in group:
+                    solve_solo(stg, boosted=False)
+                return
+            t0 = time.time()
+            J0 = np.broadcast_to(
+                utils.jones_c2r_np(state["J"]),
+                (T,) + utils.jones_c2r_np(state["J"]).shape).copy()
+            Jd, info = self._solve_tiles(
+                jnp.stack([g["x8"] for g in group]),
+                jnp.stack([g["u"] for g in group]),
+                jnp.stack([g["v"] for g in group]),
+                jnp.stack([g["w"] for g in group]),
+                group[0]["sta1"], group[0]["sta2"],
+                jnp.stack([g["wt"] for g in group]),
+                J0, [g["ti"] for g in group])
+            Jd = np.asarray(Jd)
+            r0 = np.asarray(info["res_0"])
+            r1 = np.asarray(info["res_1"])
+            mnu = np.asarray(info["mean_nu"])
+            minutes = (time.time() - t0) / 60.0 / T
+            for t, stg in enumerate(group):
+                post(stg, float(r0[t]), float(r1[t]), float(mnu[t]),
+                     utils.jones_r2c_np(Jd[t]), minutes)
+
+        try:
+            for ti, tile in ms.tiles_prefetch():
+                if max_tiles is not None and ti >= max_tiles:
+                    break
+                stg = stage(ti, tile)
+                if state["first"]:
+                    solve_solo(stg, boosted=True)
+                    continue
+                pending.append(stg)
+                if len(pending) == T:
+                    flush(pending)
+                    pending = []
+        finally:
+            flush(pending)
+            if writer:
+                writer.close()
+        return history
+
     def run(self, write_residuals: bool = True, solution_path=None,
             max_tiles=None, log=print):
+        if getattr(self, "batch_ok", False):
+            return self._run_batched(write_residuals, solution_path,
+                                     max_tiles, log)
         cfg, ms, sky = self.cfg, self.ms, self.sky
         meta = ms.meta
         cdt = jnp.complex64 if self.rdt == jnp.float32 else jnp.complex128

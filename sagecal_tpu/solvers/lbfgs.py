@@ -88,19 +88,27 @@ def mult_hessian(g, mem: LBFGSMemory):
 def linesearch_backtrack(cost_func: Callable, xk, pk, gk, alpha0,
                          c: float = 1e-4, max_steps: int = 15):
     """Armijo backtracking (lbfgs.c:444): halve alpha until
-    f(x+a p) <= f(x) + c a p^T g (NaN treated as failure)."""
+    f(x+a p) <= f(x) + c a p^T g (NaN treated as failure).
+
+    The body re-tests the Armijo condition and freezes satisfied states:
+    under vmap the loop runs until EVERY batch element passes, and an
+    already-accepted alpha must not keep halving."""
     f0 = cost_func(xk)
     slope = c * jnp.dot(pk, gk)
 
+    def _bad(alpha, fnew):
+        return jnp.isnan(fnew) | (fnew > f0 + alpha * slope)
+
     def cond(state):
         alpha, fnew, i = state
-        bad = jnp.isnan(fnew) | (fnew > f0 + alpha * slope)
-        return (i < max_steps) & bad
+        return (i < max_steps) & _bad(alpha, fnew)
 
     def body(state):
-        alpha, _, i = state
-        alpha = alpha * 0.5
-        return alpha, cost_func(xk + alpha * pk), i + 1
+        alpha, fnew, i = state
+        bad = _bad(alpha, fnew)
+        alpha2 = jnp.where(bad, alpha * 0.5, alpha)
+        fnew2 = jnp.where(bad, cost_func(xk + alpha2 * pk), fnew)
+        return alpha2, fnew2, i + 1
 
     alpha0 = jnp.asarray(alpha0, xk.dtype)
     fnew0 = cost_func(xk + alpha0 * pk)
@@ -227,7 +235,12 @@ def linesearch_fletcher(cost_func, grad_func, xk, pk, gk=None,
         bj_n = jnp.where(no_suff, alphaj_n,
                          jnp.where(gphi_j * (bj - aj) >= 0.0, aj, bj))
         aj_n = jnp.where(no_suff, aj, alphaj_n)
-        return cj + 1, aj_n, bj_n, alphaj_n, found_n
+        # freeze finished states: under vmap the loop keeps running until
+        # every batch element finds its alpha, and a found alphaj must
+        # not drift with further bracket updates
+        upd = ~found
+        return (cj + 1, jnp.where(upd, aj_n, aj), jnp.where(upd, bj_n, bj),
+                jnp.where(upd, alphaj_n, alphaj), found | found_n)
 
     _, _, _, alphaj, _ = jax.lax.while_loop(
         p2_cond, p2_body,
@@ -260,7 +273,8 @@ def _lbfgs_loop(cost_func, grad_func, x0, mem0: LBFGSMemory, itmax: int,
     def body(s: _IterState):
         mem = s.mem
         batch_changed = stochastic & (mem.niter > 0) & (s.k == 0)
-        mem = mem._replace(niter=mem.niter + 1)
+        # niter freezes once done (vmap: body runs past convergence)
+        mem = mem._replace(niter=mem.niter + jnp.where(s.done, 0, 1))
         gradnrm = jnp.linalg.norm(s.g)
 
         alphabar = s.alphabar
@@ -300,7 +314,10 @@ def _lbfgs_loop(cost_func, grad_func, x0, mem0: LBFGSMemory, itmax: int,
         yk = jnp.where(g1nrm > 1e3 * lm0, yk + lm0 * sk, yk)
         rhok = 1.0 / jnp.where(jnp.abs(jnp.dot(yk, sk)) > _EPS,
                                jnp.dot(yk, sk), jnp.inf)
-        store = ~batch_changed & ~bad_alpha & jnp.isfinite(g1nrm)
+        # freeze after done: under vmap the loop body keeps running until
+        # every batch element is done, and a finished element must not
+        # take further steps (unbatched, cond exits before this matters)
+        store = ~batch_changed & ~bad_alpha & jnp.isfinite(g1nrm) & ~s.done
 
         def do_store(mem):
             return mem._replace(
@@ -311,9 +328,10 @@ def _lbfgs_loop(cost_func, grad_func, x0, mem0: LBFGSMemory, itmax: int,
                 nfilled=jnp.minimum(mem.nfilled + 1, mem.s.shape[0]))
         mem = jax.lax.cond(store, do_store, lambda m: m, mem)
 
-        done = bad_alpha | ~jnp.isfinite(g1nrm) | (g1nrm < _EPS)
-        x_out = jnp.where(bad_alpha, s.x, x1)
-        g_out = jnp.where(bad_alpha, s.g, g1)
+        done = s.done | bad_alpha | ~jnp.isfinite(g1nrm) | (g1nrm < _EPS)
+        frozen = bad_alpha | s.done
+        x_out = jnp.where(frozen, s.x, x1)
+        g_out = jnp.where(frozen, s.g, g1)
         return _IterState(x=x_out, g=g_out, mem=mem, alphabar=alphabar,
                           k=s.k + 1, done=done)
 

@@ -225,7 +225,13 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         # Lagrangian cost is signed, so a small/negative value there does
         # not mean convergence
         small_cost = (cost <= config.eps3) if admm is None else jnp.zeros_like(s.stop)
-        stop = s.stop | small_grad | (accept & small_dp) | small_cost
+        # iteration-budget exhaustion joins the stop mask so the body is
+        # a no-op past itmax — required for exact semantics under vmap
+        # (batched while_loop keeps running the body until EVERY batch
+        # element's cond is false; sagefit_tiles vmaps over tiles whose
+        # dynamic iteration budgets differ)
+        stop = s.stop | small_grad | (accept & small_dp) | small_cost \
+            | (s.k + 1 >= itmax)
         return LMState(p=p, JTJ=JTJ, JTe=JTe, mu=mu, nu=nu, cost=cost,
                        stop=stop, k=s.k + 1)
 

@@ -319,8 +319,12 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         g_next = jax.lax.cond(jnp.any(accept), lambda: rgrad_at(p),
                               lambda: s.g)
         gn = jnp.sqrt(_dot(g_next, g_next))
+        # budget exhaustion joins the stop mask (vmap-exactness: see
+        # lm.py body note — a finished tile must freeze while other
+        # batch elements keep iterating)
         stop = s.stop | (gn <= config.eps_grad * jnp.maximum(g0n, 1e-30)) \
-            | (delta <= 1e-12 * jnp.maximum(xnorm0, 1e-30))
+            | (delta <= 1e-12 * jnp.maximum(xnorm0, 1e-30)) \
+            | (s.k + 1 >= itmax)
         return _RTRState(p=p, g=g_next, cost=cost, delta=delta, stop=stop,
                          k=s.k + 1)
 

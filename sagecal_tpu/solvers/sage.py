@@ -40,6 +40,7 @@ absent: XLA's async dispatch over a sharded mesh replaces it.
 from __future__ import annotations
 
 import functools
+import logging
 import time
 from typing import NamedTuple
 
@@ -57,7 +58,40 @@ from sagecal_tpu.solvers import rtr as rtr_mod
 # sagefit_host sweep-fusion verdicts, per problem shape (see its
 # docstring); process-lifetime cache, entries are tiny
 _FUSION_CACHE: dict = {}
-# ... and full-trace promotion verdicts: once the timed fused sweeps
+# device-program call log for FLOP accounting (bench.py MFU column):
+# name -> [jitted_fn, (args, kwargs of the last call), n_calls]. The
+# bench resets this around its timed reps, then prices each program once
+# via compiled.cost_analysis() and multiplies by the call count.
+_PROGRAM_CALLS: dict = {}
+
+
+def program_stats_reset():
+    _PROGRAM_CALLS.clear()
+
+
+def program_stats():
+    """{name: (jitted_fn, (args, kwargs), n_calls)} since the last reset."""
+    return {k: (v[0], v[1], v[2]) for k, v in _PROGRAM_CALLS.items()}
+
+
+def _call(name, jfn, *args, **kwargs):
+    rec = _PROGRAM_CALLS.setdefault(name, [jfn, None, 0])
+    rec[1] = (args, kwargs)
+    rec[2] += 1
+    return jfn(*args, **kwargs)
+
+
+_LOG = logging.getLogger(__name__)
+
+
+def _learned(kind: str, key, verdict) -> None:
+    """Execution-plan verdicts are logged per shape so perf runs can be
+    reproduced with the force knobs (SageConfig.fuse/promote)."""
+    _LOG.info("sagefit_host %s verdict for shape %s: %s", kind,
+              key[:4], verdict)
+
+
+# sweep-fusion verdicts feed full-trace promotion: once the timed fused sweeps
 # prove the WHOLE solve fits comfortably under the tunneled runtime's
 # ~60 s per-execution kill, subsequent calls run the fully traced
 # sagefit — ~3 device round-trips per solve instead of ~max_emiter+4,
@@ -88,6 +122,25 @@ class SageConfig(NamedTuple):
     nuhigh: float = 30.0
     randomize: bool = True
     linsolv: int = 1
+    # host-driver execution plan: "auto" learns from timed sweeps (the
+    # wall-clock heuristics below), "on"/"off" force the verdict — perf
+    # runs become reproducible across tunnel-latency weather
+    # (--solve-fuse/--solve-promote; VERDICT r3 weak item 6)
+    fuse: str = "auto"            # fuse an EM sweep into one execution
+    promote: str = "auto"         # promote the whole solve to one program
+    # clusters solved concurrently per SAGE sweep step (--inflight).
+    # 1 = the reference's strict Gauss-Seidel sequencing. G>1 solves G
+    # clusters per step against the SAME entering residual and applies
+    # their updates jointly (block-Jacobi within the group) — the TPU
+    # analogue of the reference GPU pipeline keeping 2 clusters in
+    # flight per device (lmfit_cuda.c:450-516), batching the small
+    # per-cluster systems G-wide on the MXU. The EM residual bookkeeping
+    # stays exact (group updates sum model deltas against one base
+    # residual), but simultaneous updates overcorrect when a large
+    # fraction of clusters move at once (measured: G=M diverges on a
+    # cold start), so the EFFECTIVE width is clamped to M//4 — the
+    # M >> G regime this exists for (north-star M=100 with G=4..8).
+    inflight: int = 1
 
 
 _OS_MODES = (int(SolverMode.OSLM_LBFGS),
@@ -246,6 +299,93 @@ def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     return J, xres, nerr_acc, nuM
 
 
+def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
+                  wt_base, n_stations: int, config: SageConfig,
+                  nerr_prev, weighted, last, key, admm, os_id,
+                  total_iter: int, iter_bar: int):
+    """Visit a GROUP of clusters concurrently (config.inflight > 1).
+
+    ``cjs`` [G] holds distinct cluster indices; padded slots carry the
+    out-of-range index M — their scatter updates are dropped (JAX's
+    default OOB-scatter semantics) and their residual contribution is
+    masked. Every member's solve sees the residual AS OF GROUP ENTRY
+    (block-Jacobi); the group's model deltas then apply jointly:
+    xres += sum_g (model(J_old_g) - model(J_new_g)).
+    """
+    J, xres, nerr_acc, nuM = state
+    M = chunk_mask.shape[0]
+    mode = int(config.solver_mode)
+    valid = cjs < M
+
+    def solve_one(cj):
+        coh_m = jnp.take(coh, cj, axis=0)      # OOB clips; masked below
+        cidx_m = jnp.take(chunk_idx, cj, axis=0)
+        cmask_m = jnp.take(chunk_mask, cj, axis=0)
+        J_m = jnp.take(J, cj, axis=0)
+        itermax = jnp.where(
+            weighted,
+            (0.2 * jnp.take(nerr_prev, cj, mode="clip") * total_iter)
+            .astype(jnp.int32) + iter_bar,
+            config.max_iter)
+        admm_m = None
+        if admm is not None:
+            Y_all, BZ_all, rho_all = admm
+            admm_m = (jnp.take(Y_all, cj, axis=0),
+                      jnp.take(BZ_all, cj, axis=0),
+                      jnp.take(rho_all, cj, mode="clip"))
+        os_cfg = None
+        if os_id is not None and mode in _OS_MODES:
+            ids, n_sub = os_id
+            os_cfg = lm_mod.OSConfig(
+                os_id=ids, n_subsets=int(n_sub),
+                key=jax.random.fold_in(key, cj),
+                randomize=config.randomize)
+        xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
+        itcap = int(config.max_iter) + iter_bar
+        Jn, nu_new, init_cost, final_cost = _cluster_solve(
+            mode, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m, wt_base,
+            J_m, n_stations, jnp.take(nuM, cj, mode="clip"), config,
+            itermax, itcap, admm_m, os_cfg, last)
+        delta = (_model8(J_m, coh_m, sta1, sta2, cidx_m)
+                 - _model8(Jn, coh_m, sta1, sta2, cidx_m))
+        return Jn, nu_new, init_cost, final_cost, delta
+
+    Jn_g, nu_g, ic_g, fc_g, delta_g = jax.vmap(solve_one)(cjs)
+    vm = valid.astype(xres.dtype)
+    xres = xres + jnp.einsum("g,gbx->bx", vm, delta_g)
+    init_res = jnp.sum(ic_g, axis=-1)
+    final_res = jnp.sum(fc_g, axis=-1)
+    dcost = jnp.where(init_res > 0,
+                      jnp.maximum((init_res - final_res)
+                                  / jnp.maximum(init_res, 1e-30), 0.0),
+                      0.0)
+    # padded indices (cjs == M) are dropped by the scatters
+    nerr_acc = nerr_acc.at[cjs].set(dcost)
+    nuM = nuM.at[cjs].set(nu_g)
+    J = J.at[cjs].set(Jn_g)
+    return J, xres, nerr_acc, nuM
+
+
+def _eff_inflight(config: SageConfig, M: int) -> int:
+    """Effective in-flight group width: the configured value clamped to
+    M//4 (see SageConfig.inflight — wider groups overcorrect)."""
+    G = int(config.inflight)
+    if G <= 1:
+        return 1
+    return max(1, min(G, M // 4))
+
+
+def _pad_order(order, M: int, G: int):
+    """Pad a cluster visiting order up to ceil(M/G)*G with the sentinel
+    index M (dropped by the group scatters)."""
+    n_groups = -(-M // G)
+    pad = n_groups * G - M
+    if pad == 0:
+        return order, n_groups
+    fill = jnp.full(order.shape[:-1] + (pad,), M, order.dtype)
+    return jnp.concatenate([order, fill], axis=-1), n_groups
+
+
 def _cluster_perm(ci, nerr_prev, weighted, key, M: int,
                   config: SageConfig):
     """Cluster visiting order for EM iteration ``ci`` (random_permutation,
@@ -321,6 +461,8 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
     total_iter = M * config.max_iter
     iter_bar = int(-(-0.8 * total_iter // M))  # ceil(0.8/M * total), host-side
 
+    G = _eff_inflight(config, M)
+
     def em_iter(ci, carry):
         J, xres, nerr, nuM = carry
         weighted = (ci % 2 == 1) if config.randomize else jnp.asarray(False)
@@ -328,16 +470,34 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
         perm = _cluster_perm(ci, nerr, weighted, key, M, config)
         kci = jax.random.fold_in(key, ci)
 
-        def cluster_step(cj, inner):
-            cj_eff = cj if perm is None else jnp.take(perm, cj)
-            J, xres, nerr_acc, nuM = _cluster_update(
-                cj_eff, inner, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
-                wt_base, n_stations, config, nerr, weighted, last, kci,
-                admm, os_id, total_iter, iter_bar)
-            return J, xres, nerr_acc, nuM
+        if G == 1:
+            def cluster_step(cj, inner):
+                cj_eff = cj if perm is None else jnp.take(perm, cj)
+                return _cluster_update(
+                    cj_eff, inner, x8, coh, sta1, sta2, chunk_idx,
+                    chunk_mask, wt_base, n_stations, config, nerr,
+                    weighted, last, kci, admm, os_id, total_iter,
+                    iter_bar)
 
-        J, xres, nerr_new, nuM = jax.lax.fori_loop(
-            0, M, cluster_step, (J, xres, jnp.zeros((M,), dtype), nuM))
+            J, xres, nerr_new, nuM = jax.lax.fori_loop(
+                0, M, cluster_step, (J, xres, jnp.zeros((M,), dtype),
+                                     nuM))
+        else:
+            base = (perm if perm is not None
+                    else jnp.arange(M, dtype=jnp.int32))
+            order_pad, n_groups = _pad_order(base, M, G)
+
+            def group_step(g, inner):
+                cjs = jax.lax.dynamic_slice(order_pad, (g * G,), (G,))
+                return _group_update(
+                    cjs, inner, x8, coh, sta1, sta2, chunk_idx,
+                    chunk_mask, wt_base, n_stations, config, nerr,
+                    weighted, last, kci, admm, os_id, total_iter,
+                    iter_bar)
+
+            J, xres, nerr_new, nuM = jax.lax.fori_loop(
+                0, n_groups, group_step, (J, xres, jnp.zeros((M,), dtype),
+                                          nuM))
         total = jnp.sum(nerr_new)
         nerr = jnp.where(total > 0, nerr_new / total, nerr_new)
         return J, xres, nerr, nuM
@@ -390,6 +550,22 @@ def _jit_cluster_update(cj, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
 @functools.partial(jax.jit,
                    static_argnames=("n_stations", "config", "total_iter",
                                     "iter_bar", "os_nsub"))
+def _jit_group_update(cjs, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
+                      chunk_idx, chunk_mask, wt_base, nerr_prev, weighted,
+                      last, key, os_ids, n_stations, config, total_iter,
+                      iter_bar, os_nsub):
+    """One in-flight GROUP of cluster solves as a bounded execution
+    (config.inflight > 1 on the unfused host path)."""
+    os_id = None if os_ids is None else (os_ids, os_nsub)
+    return _group_update(cjs, (J, xres, nerr_acc, nuM), x8, coh, sta1,
+                         sta2, chunk_idx, chunk_mask, wt_base, n_stations,
+                         config, nerr_prev, weighted, last, key, None,
+                         os_id, total_iter, iter_bar)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_stations", "config", "total_iter",
+                                    "iter_bar", "os_nsub"))
 def _jit_em_sweep(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                   wt_base, nerr_prev, weighted, last, kci, perm, os_ids,
                   n_stations, config, total_iter, iter_bar, os_nsub):
@@ -398,16 +574,32 @@ def _jit_em_sweep(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     program fits the runtime's per-execution wall-clock limit)."""
     os_id = None if os_ids is None else (os_ids, os_nsub)
     M = chunk_mask.shape[0]
+    G = _eff_inflight(config, M)
 
-    def cluster_step(cj, inner):
-        cj_eff = jnp.take(perm, cj)
-        return _cluster_update(cj_eff, inner, x8, coh, sta1, sta2,
-                               chunk_idx, chunk_mask, wt_base, n_stations,
-                               config, nerr_prev, weighted, last, kci,
-                               None, os_id, total_iter, iter_bar)
+    if G == 1:
+        def cluster_step(cj, inner):
+            cj_eff = jnp.take(perm, cj)
+            return _cluster_update(cj_eff, inner, x8, coh, sta1, sta2,
+                                   chunk_idx, chunk_mask, wt_base,
+                                   n_stations, config, nerr_prev,
+                                   weighted, last, kci, None, os_id,
+                                   total_iter, iter_bar)
+
+        return jax.lax.fori_loop(
+            0, M, cluster_step,
+            (J, xres, jnp.zeros((M,), x8.dtype), nuM))
+
+    order_pad, n_groups = _pad_order(perm, M, G)
+
+    def group_step(g, inner):
+        cjs = jax.lax.dynamic_slice(order_pad, (g * G,), (G,))
+        return _group_update(cjs, inner, x8, coh, sta1, sta2, chunk_idx,
+                             chunk_mask, wt_base, n_stations, config,
+                             nerr_prev, weighted, last, kci, None, os_id,
+                             total_iter, iter_bar)
 
     return jax.lax.fori_loop(
-        0, M, cluster_step,
+        0, n_groups, group_step,
         (J, xres, jnp.zeros((M,), x8.dtype), nuM))
 
 
@@ -466,11 +658,14 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     total_iter = M * config.max_iter
     iter_bar = int(-(-0.8 * total_iter // M))
 
-    # max_emiter drives only THIS host loop; strip it from the static
-    # config handed to the jitted programs so the first-tile EM boost
-    # (pipeline.py) reuses the compiled per-cluster/sweep/refine programs
-    # instead of compiling a second identical set.
-    dev_config = config._replace(max_emiter=0)
+    # max_emiter drives only THIS host loop; strip it (and the
+    # host-only execution-plan knobs) from the static config handed to
+    # the jitted programs so the first-tile EM boost (pipeline.py) and
+    # runs differing only in force knobs reuse the compiled
+    # per-cluster/sweep/refine programs instead of compiling a second
+    # identical set.
+    fuse_mode, promote_mode = config.fuse, config.promote
+    dev_config = config._replace(max_emiter=0, fuse="auto", promote="auto")
 
     os_ids, os_nsub = (None, 0) if os_id is None else \
         (jnp.asarray(os_id[0]), int(os_id[1]))
@@ -485,23 +680,28 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     # sweep's cost doesn't depend on how many sweeps run) so the
     # first-tile EM boost and the rest-tiles share one verdict; the
     # promotion key must include the budget — it bounds a WHOLE solve.
+    # The force knobs ("on"/"off") bypass the caches entirely.
     fuse_key = (M, x8.shape, n_stations, chunk_mask.shape, str(dtype),
                 dev_config, os_id is None, os_nsub)
     promote_key = fuse_key + (config.max_emiter, config.max_lbfgs)
-    if _PROMOTE_CACHE.get(promote_key, False):
+    promoted = promote_mode == "on" or (
+        promote_mode == "auto" and _PROMOTE_CACHE.get(promote_key, False))
+    if promoted:
         # whole solve proven to fit under the per-execution kill: one
         # traced program, minimal tunnel round-trips
-        return _jit_sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask,
-                            J0, n_stations, wt_base,
-                            jnp.asarray(nu0, dtype), config,
-                            os_ids if os_id is not None else None,
-                            os_nsub, key)
-    xres, res_0 = _jit_prelude(x8, coh, sta1, sta2, chunk_idx,
-                               J0, wt_base)
+        return _call("sagefit", _jit_sagefit, x8, coh, sta1, sta2,
+                     chunk_idx, chunk_mask, J0, n_stations, wt_base,
+                     jnp.asarray(nu0, dtype),
+                     config._replace(fuse="auto", promote="auto"),
+                     os_ids if os_id is not None else None,
+                     os_nsub, key)
+    xres, res_0 = _call("prelude", _jit_prelude, x8, coh, sta1, sta2,
+                        chunk_idx, J0, wt_base)
     J = J0
     nerr = jnp.zeros((M,), dtype)
     nuM = jnp.full((M,), jnp.asarray(nu0, dtype))
-    fused = _FUSION_CACHE.get(fuse_key, False)
+    fused = (fuse_mode == "on" or
+             (fuse_mode == "auto" and _FUSION_CACHE.get(fuse_key, False)))
     sweep_times: list = []
     for ci in range(config.max_emiter):
         weighted = config.randomize and (ci % 2 == 1)
@@ -517,7 +717,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
             order = np.arange(M)
         if fused:
             t_sweep = time.perf_counter()
-            J, xres, nerr_acc, nuM = _jit_em_sweep(
+            J, xres, nerr_acc, nuM = _call("em_sweep", _jit_em_sweep,
                 J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                 wt_base, nerr, jnp.asarray(weighted), jnp.asarray(last),
                 kci, jnp.asarray(order, jnp.int32), os_ids,
@@ -527,19 +727,36 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
         else:
             t_sweep = time.perf_counter()
             nerr_acc = jnp.zeros((M,), dtype)
-            for cj in order:
-                J, xres, nerr_acc, nuM = _jit_cluster_update(
-                    jnp.asarray(int(cj), jnp.int32), J, xres, nerr_acc,
-                    nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
-                    wt_base, nerr, jnp.asarray(weighted),
-                    jnp.asarray(last), kci, None, os_ids,
-                    n_stations, dev_config, total_iter, iter_bar, os_nsub)
+            Gi = _eff_inflight(config, M)
+            if Gi == 1:
+                for cj in order:
+                    J, xres, nerr_acc, nuM = _call(
+                        "cluster_update", _jit_cluster_update,
+                        jnp.asarray(int(cj), jnp.int32), J, xres,
+                        nerr_acc, nuM, x8, coh, sta1, sta2, chunk_idx,
+                        chunk_mask, wt_base, nerr, jnp.asarray(weighted),
+                        jnp.asarray(last), kci, None, os_ids, n_stations,
+                        dev_config, total_iter, iter_bar, os_nsub)
+            else:
+                opad = np.concatenate(
+                    [np.asarray(order),
+                     np.full((-(-M // Gi)) * Gi - M, M)]).astype(np.int32)
+                for g in range(len(opad) // Gi):
+                    J, xres, nerr_acc, nuM = _call(
+                        "group_update", _jit_group_update,
+                        jnp.asarray(opad[g * Gi:(g + 1) * Gi]), J, xres,
+                        nerr_acc, nuM, x8, coh, sta1, sta2, chunk_idx,
+                        chunk_mask, wt_base, nerr, jnp.asarray(weighted),
+                        jnp.asarray(last), kci, os_ids, n_stations,
+                        dev_config, total_iter, iter_bar, os_nsub)
             jax.block_until_ready(J)
             # the fused program does the same work minus dispatch overhead,
             # so a 25 s per-cluster sweep bounds it well under the ~60 s
             # execution kill
-            fused = time.perf_counter() - t_sweep < 25.0
-            _FUSION_CACHE[fuse_key] = fused
+            if fuse_mode == "auto":
+                fused = time.perf_counter() - t_sweep < 25.0
+                _FUSION_CACHE[fuse_key] = fused
+                _learned("fuse", fuse_key, fused)
         total = float(jnp.sum(nerr_acc))
         nerr = nerr_acc / total if total > 0 else nerr_acc
 
@@ -547,17 +764,309 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     # max_emiter of them (+ refine margin) bounds the traced program's
     # execution time; promote only when comfortably under the kill
     warm = sweep_times[1:] if len(sweep_times) > 1 else sweep_times
-    if warm and max(warm) * (config.max_emiter + 1) < _PROMOTE_BUDGET_S:
+    if (promote_mode == "auto" and warm
+            and max(warm) * (config.max_emiter + 1) < _PROMOTE_BUDGET_S):
         _PROMOTE_CACHE[promote_key] = True
+        _learned("promote", promote_key, True)
 
     mean_nu = jnp.clip(jnp.mean(nuM), config.nulow, config.nuhigh)
     if config.max_lbfgs > 0:
-        J, res_1 = _jit_refine(x8, coh, sta1, sta2, chunk_idx, J, wt_base,
-                               mean_nu, n_stations, dev_config, robust)
+        J, res_1 = _call("refine", _jit_refine, x8, coh, sta1, sta2,
+                         chunk_idx, J, wt_base, mean_nu, n_stations,
+                         dev_config, robust)
     else:
-        res_1 = _jit_res(x8, coh, sta1, sta2, chunk_idx, J, wt_base)
+        res_1 = _call("res", _jit_res, x8, coh, sta1, sta2, chunk_idx, J,
+                      wt_base)
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
                "nerr": nerr}
+
+
+# ---------------------------------------------------------------------------
+# multi-tile batched variant: T independent solve intervals as one program
+# ---------------------------------------------------------------------------
+#
+# SAGE's cluster loop is sequential (P2) and each per-cluster system is
+# small (8N x 8N with a handful of hybrid chunks), so a single tile keeps
+# the MXU nearly idle — round-3 measured well under 1% utilization. Solve
+# intervals (tiles) are INDEPENDENT problems; vmapping the whole solve
+# over a tile axis multiplies every batched operation (normal-equation
+# einsums, Cholesky factors, tCG matvecs) by T with near-constant step
+# latency — the TPU equivalent of lmfit_cuda.c:450-516 keeping multiple
+# clusters in flight per GPU. The math per tile is EXACTLY sagefit's:
+# per-tile iteration budgets, robust nu, and cluster permutations ride
+# through vmap (the while-loop bodies freeze converged/budget-exhausted
+# states, see lm.py/rtr.py/lbfgs.py).
+
+_TILE_AXES = (0, 0, None, None, None, None, 0)   # x8, coh, sta1, sta2,
+#                                                  cidx, cmask, J0
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_stations", "config", "os_nsub"))
+def _jit_sagefit_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
+                       n_stations, wt_base, nu0, config, os_ids, os_nsub,
+                       keys):
+    def one(x8_t, coh_t, J0_t, wt_t, key_t):
+        os_id = None if os_ids is None else (os_ids, os_nsub)
+        return sagefit(x8_t, coh_t, sta1, sta2, chunk_idx, chunk_mask,
+                       J0_t, n_stations, wt_t, nu0=nu0, config=config,
+                       os_id=os_id, key=key_t)
+    return jax.vmap(one)(x8, coh, J0, wt_base, keys)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_stations", "config", "total_iter",
+                                    "iter_bar", "os_nsub"))
+def _jit_em_sweep_tiles(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx,
+                        chunk_mask, wt_base, nerr_prev, weighted, last,
+                        keys, perm, os_ids, n_stations, config, total_iter,
+                        iter_bar, os_nsub):
+    """One EM sweep over all clusters for T tiles at once (vmapped
+    :func:`_jit_em_sweep`; per-tile visiting order ``perm`` [T, M])."""
+    def one(J_t, xres_t, nuM_t, x8_t, coh_t, wt_t, nerr_t, key_t, perm_t):
+        os_id = None if os_ids is None else (os_ids, os_nsub)
+        M = chunk_mask.shape[0]
+        G = _eff_inflight(config, M)
+
+        if G == 1:
+            def cluster_step(cj, inner):
+                cj_eff = jnp.take(perm_t, cj)
+                return _cluster_update(cj_eff, inner, x8_t, coh_t, sta1,
+                                       sta2, chunk_idx, chunk_mask, wt_t,
+                                       n_stations, config, nerr_t,
+                                       weighted, last, key_t, None, os_id,
+                                       total_iter, iter_bar)
+            return jax.lax.fori_loop(
+                0, M, cluster_step,
+                (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t))
+
+        order_pad, n_groups = _pad_order(perm_t, M, G)
+
+        def group_step(g, inner):
+            cjs = jax.lax.dynamic_slice(order_pad, (g * G,), (G,))
+            return _group_update(cjs, inner, x8_t, coh_t, sta1, sta2,
+                                 chunk_idx, chunk_mask, wt_t, n_stations,
+                                 config, nerr_t, weighted, last, key_t,
+                                 None, os_id, total_iter, iter_bar)
+        return jax.lax.fori_loop(
+            0, n_groups, group_step,
+            (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t))
+    return jax.vmap(one)(J, xres, nuM, x8, coh, wt_base, nerr_prev, keys,
+                         perm)
+
+
+@jax.jit
+def _jit_prelude_tiles(x8, coh, sta1, sta2, chunk_idx, J0, wt_base):
+    return jax.vmap(
+        lambda x8_t, coh_t, J0_t, wt_t: _jit_prelude.__wrapped__(
+            x8_t, coh_t, sta1, sta2, chunk_idx, J0_t, wt_t)
+    )(x8, coh, J0, wt_base)
+
+
+@functools.partial(jax.jit, static_argnames=("n_stations", "config",
+                                             "robust"))
+def _jit_refine_tiles(x8, coh, sta1, sta2, chunk_idx, J, wt_base, mean_nu,
+                      n_stations, config, robust):
+    return jax.vmap(
+        lambda x8_t, coh_t, J_t, wt_t, mnu_t: _jit_refine.__wrapped__(
+            x8_t, coh_t, sta1, sta2, chunk_idx, J_t, wt_t, mnu_t,
+            n_stations, config, robust)
+    )(x8, coh, J, wt_base, mean_nu)
+
+
+@jax.jit
+def _jit_res_tiles(x8, coh, sta1, sta2, chunk_idx, J, wt_base):
+    return jax.vmap(
+        lambda x8_t, coh_t, J_t, wt_t: _jit_res.__wrapped__(
+            x8_t, coh_t, sta1, sta2, chunk_idx, J_t, wt_t)
+    )(x8, coh, J, wt_base)
+
+
+def tile_keys(n_tiles: int, base=None):
+    """Per-tile PRNG keys. Tile 0 keeps the single-tile default key so a
+    batched solve makes the same PRNG draws (subset choices, cluster
+    permutations) for tile 0 as the unbatched driver."""
+    base = jax.random.PRNGKey(42) if base is None else base
+    if n_tiles == 1:
+        return base[None]
+    rest = jax.vmap(lambda t: jax.random.fold_in(base, t))(
+        jnp.arange(1, n_tiles) + 1000)
+    return jnp.concatenate([base[None], rest])
+
+
+def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
+                       n_stations: int, wt_base, nu0=None,
+                       config: SageConfig = SageConfig(), os_id=None,
+                       keys=None):
+    """:func:`sagefit_host` over a leading tile axis T.
+
+    Args are sagefit_host's with x8 [T, B, 8], coh [T, M, B, 2, 2],
+    J0 [T, M, K, N, 2, 2], wt_base [T, B, 8] and per-tile ``keys``
+    [T, key]; geometry (sta1/sta2/chunk arrays) is shared — tiles of one
+    dataset have identical baseline ordering. Returns (J [T, ...], info)
+    with per-tile res_0/res_1/mean_nu/nerr arrays.
+
+    Shares the sweep-fusion and full-trace-promotion machinery (and its
+    caches) with the single-tile driver; the timed verdicts are learned
+    per (shape, T) so a wide batch never blows the ~60 s per-execution
+    kill unproven.
+    """
+    T, M = coh.shape[0], coh.shape[1]
+    dtype = x8.dtype
+    robust = _is_robust(config.solver_mode)
+    if nu0 is None:
+        nu0 = config.nulow
+    if keys is None:
+        keys = tile_keys(T)
+
+    total_iter = M * config.max_iter
+    iter_bar = int(-(-0.8 * total_iter // M))
+    fuse_mode, promote_mode = config.fuse, config.promote
+    dev_config = config._replace(max_emiter=0, fuse="auto", promote="auto")
+
+    os_ids, os_nsub = (None, 0) if os_id is None else \
+        (jnp.asarray(os_id[0]), int(os_id[1]))
+    chunk_idx = jnp.asarray(chunk_idx)
+    chunk_mask = jnp.asarray(chunk_mask)
+
+    fuse_key = (M, x8.shape, n_stations, chunk_mask.shape, str(dtype),
+                dev_config, os_id is None, os_nsub, "tiles")
+    promote_key = fuse_key + (config.max_emiter, config.max_lbfgs)
+    promoted = promote_mode == "on" or (
+        promote_mode == "auto" and _PROMOTE_CACHE.get(promote_key, False))
+    if promoted:
+        return _call("sagefit_tiles", _jit_sagefit_tiles, x8, coh,
+                     sta1, sta2, chunk_idx, chunk_mask, J0, n_stations,
+                     wt_base, jnp.asarray(nu0, dtype),
+                     config._replace(fuse="auto", promote="auto"),
+                     os_ids if os_id is not None else None,
+                     os_nsub, keys)
+    xres, res_0 = _call("prelude_tiles", _jit_prelude_tiles, x8, coh,
+                        sta1, sta2, chunk_idx, J0, wt_base)
+    J = J0
+    nerr = jnp.zeros((T, M), dtype)
+    nuM = jnp.full((T, M), jnp.asarray(nu0, dtype))
+    fused = (fuse_mode == "on" or
+             (fuse_mode == "auto" and _FUSION_CACHE.get(fuse_key, False)))
+    sweep_times: list = []
+    for ci in range(config.max_emiter):
+        weighted = config.randomize and (ci % 2 == 1)
+        last = ci == config.max_emiter - 1
+        kci = jax.vmap(lambda k: jax.random.fold_in(k, ci))(keys)
+        if config.randomize and M > 1:
+            if weighted:
+                order = np.argsort(-np.asarray(nerr), axis=1)
+            else:
+                order = np.stack([
+                    np.asarray(jax.random.permutation(
+                        jax.random.fold_in(keys[t], 104729 + ci), M))
+                    for t in range(T)])
+        else:
+            order = np.tile(np.arange(M), (T, 1))
+        order = jnp.asarray(order, jnp.int32)
+        t_sweep = time.perf_counter()
+        if fused:
+            J, xres, nerr_acc, nuM = _call(
+                "em_sweep_tiles", _jit_em_sweep_tiles,
+                J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
+                wt_base, nerr, jnp.asarray(weighted), jnp.asarray(last),
+                kci, order, os_ids, n_stations, dev_config, total_iter,
+                iter_bar, os_nsub)
+            jax.block_until_ready(J)
+            sweep_times.append(time.perf_counter() - t_sweep)
+        else:
+            nerr_acc = jnp.zeros((T, M), dtype)
+            Gi = _eff_inflight(config, M)
+            if Gi == 1:
+                for cj in range(M):
+                    J, xres, nerr_acc, nuM = _call(
+                        "cluster_update_tiles", _jit_cluster_update_tiles,
+                        order[:, cj], J, xres, nerr_acc, nuM, x8, coh,
+                        sta1, sta2, chunk_idx, chunk_mask, wt_base, nerr,
+                        jnp.asarray(weighted), jnp.asarray(last), kci,
+                        os_ids, n_stations, dev_config, total_iter,
+                        iter_bar, os_nsub)
+            else:
+                pad = (-(-M // Gi)) * Gi - M
+                opad = jnp.concatenate(
+                    [order, jnp.full((T, pad), M, order.dtype)], axis=1)
+                for g in range(opad.shape[1] // Gi):
+                    J, xres, nerr_acc, nuM = _call(
+                        "group_update_tiles", _jit_group_update_tiles,
+                        opad[:, g * Gi:(g + 1) * Gi], J, xres, nerr_acc,
+                        nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
+                        wt_base, nerr, jnp.asarray(weighted),
+                        jnp.asarray(last), kci, os_ids, n_stations,
+                        dev_config, total_iter, iter_bar, os_nsub)
+            jax.block_until_ready(J)
+            if fuse_mode == "auto":
+                fused = time.perf_counter() - t_sweep < 25.0
+                _FUSION_CACHE[fuse_key] = fused
+                _learned("fuse", fuse_key, fused)
+        total = jnp.sum(nerr_acc, axis=1, keepdims=True)
+        nerr = jnp.where(total > 0, nerr_acc / jnp.maximum(total, 1e-30),
+                         nerr_acc)
+
+    warm = sweep_times[1:] if len(sweep_times) > 1 else sweep_times
+    if (promote_mode == "auto" and warm
+            and max(warm) * (config.max_emiter + 1) < _PROMOTE_BUDGET_S):
+        _PROMOTE_CACHE[promote_key] = True
+        _learned("promote", promote_key, True)
+
+    mean_nu = jnp.clip(jnp.mean(nuM, axis=1), config.nulow, config.nuhigh)
+    if config.max_lbfgs > 0:
+        J, res_1 = _call("refine_tiles", _jit_refine_tiles, x8, coh,
+                         sta1, sta2, chunk_idx, J, wt_base, mean_nu,
+                         n_stations, dev_config, robust)
+    else:
+        res_1 = _call("res_tiles", _jit_res_tiles, x8, coh, sta1, sta2,
+                      chunk_idx, J, wt_base)
+    return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
+               "nerr": nerr}
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_stations", "config", "total_iter",
+                                    "iter_bar", "os_nsub"))
+def _jit_cluster_update_tiles(cj, J, xres, nerr_acc, nuM, x8, coh, sta1,
+                              sta2, chunk_idx, chunk_mask, wt_base,
+                              nerr_prev, weighted, last, keys, os_ids,
+                              n_stations, config, total_iter, iter_bar,
+                              os_nsub):
+    """Vmapped :func:`_jit_cluster_update`: one cluster visit (per-tile
+    cluster index ``cj`` [T]) across all tiles in one execution."""
+    def one(cj_t, J_t, xres_t, nerr_acc_t, nuM_t, x8_t, coh_t, wt_t,
+            nerr_t, key_t):
+        os_id = None if os_ids is None else (os_ids, os_nsub)
+        return _cluster_update(cj_t, (J_t, xres_t, nerr_acc_t, nuM_t),
+                               x8_t, coh_t, sta1, sta2, chunk_idx,
+                               chunk_mask, wt_t, n_stations, config,
+                               nerr_t, weighted, last, key_t, None, os_id,
+                               total_iter, iter_bar)
+    return jax.vmap(one)(cj, J, xres, nerr_acc, nuM, x8, coh, wt_base,
+                         nerr_prev, keys)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_stations", "config", "total_iter",
+                                    "iter_bar", "os_nsub"))
+def _jit_group_update_tiles(cjs, J, xres, nerr_acc, nuM, x8, coh, sta1,
+                            sta2, chunk_idx, chunk_mask, wt_base,
+                            nerr_prev, weighted, last, keys, os_ids,
+                            n_stations, config, total_iter, iter_bar,
+                            os_nsub):
+    """Vmapped :func:`_jit_group_update`: one in-flight group visit
+    (per-tile index rows ``cjs`` [T, G]) across all tiles."""
+    def one(cjs_t, J_t, xres_t, na_t, nuM_t, x8_t, coh_t, wt_t, nerr_t,
+            key_t):
+        os_id = None if os_ids is None else (os_ids, os_nsub)
+        return _group_update(cjs_t, (J_t, xres_t, na_t, nuM_t), x8_t,
+                             coh_t, sta1, sta2, chunk_idx, chunk_mask,
+                             wt_t, n_stations, config, nerr_t, weighted,
+                             last, key_t, None, os_id, total_iter,
+                             iter_bar)
+    return jax.vmap(one)(cjs, J, xres, nerr_acc, nuM, x8, coh, wt_base,
+                         nerr_prev, keys)
 
 
 def bfgsfit(x8, coh, sta1, sta2, chunk_idx, J0, n_stations: int,

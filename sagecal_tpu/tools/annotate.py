@@ -1,0 +1,117 @@
+"""Sky-model annotation CLI: DS9 regions + kvis annotations.
+
+Capability parity with ``/root/reference/src/buildsky/annotate.py``
+(flags -s/-c/-o/-n/-i/-C/-t: per-cluster fk5 ``point=x`` markers labeled
+by cluster id or source name, optional az/el in the label); the az/el
+path replaces python-casacore ``measures`` with the package's own
+transforms (coords.radec2azel) at the reference's hardcoded LOFAR-core
+ITRF position (annotate.py:27-29).
+
+Additionally emits karma/kvis ``.ann`` annotations (--kvis), which the
+reference tool family references (buildsky.c kvis pixel-numbering notes)
+but never writes — CROSS markers + TEXT labels, and ELLIPSEs for
+gaussians, in the documented kvis annotation-file syntax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+
+import numpy as np
+
+from sagecal_tpu import coords, skymodel
+
+# LOFAR core ITRF meters (annotate.py:27-29)
+LOFAR_X, LOFAR_Y, LOFAR_Z = 3826896.23513, 460979.454666, 5064658.203
+
+
+def read_clusters(path):
+    """{cluster_id_str: [source names]} preserving file order."""
+    out = {}
+    for cid, _h, names in skymodel.parse_cluster_file(path):
+        out[str(cid)] = list(names)
+    return out
+
+
+def _azel(ra, dec, utc_s: float):
+    """Az/el (degrees) at the LOFAR core for UTC seconds (the measures
+    call of annotate.py:127-131, via coords.radec2azel)."""
+    lon, lat, _h = coords.xyz2llh(LOFAR_X, LOFAR_Y, LOFAR_Z)
+    jd = utc_s / 86400.0 + 2400000.5   # casacore UTC epoch is MJD secs
+    az, el = coords.radec2azel(ra, dec, float(lon), float(lat), jd)
+    return math.degrees(float(az)), math.degrees(float(el))
+
+
+def annotate(skyfile, clusterfile, outfile, clid=None, color="yellow",
+             rname=False, utc=None, kvis=False):
+    srcs = skymodel.parse_sky_model(skyfile, 0.0, 0.0, 150e6)
+    CL = read_clusters(clusterfile)
+    annlist = ([str(clid)] if clid is not None and str(clid) in CL
+               else list(CL))
+    n = 0
+    with open(outfile, "w") as f:
+        if kvis:
+            f.write("# karma annotation file (sagecal-tpu annotate)\n")
+            f.write(f"COLOR {color.upper()}\n")
+            f.write("COORD W\n")
+        else:
+            f.write("# Region file format: DS9 version 4.1\n")
+            f.write('global color=blue dashlist=8 3 width=1 '
+                    'font="helvetica 10 normal" select=1 highlite=1 '
+                    'dash=0 fixed=0 edit=1 move=1 delete=1 include=1 '
+                    'source=1\n')
+        for clname in annlist:
+            for slname in CL[clname]:
+                if slname not in srcs:
+                    continue
+                s = srcs[slname]
+                ra_d = math.degrees(s.ra % (2 * math.pi))
+                dec_d = math.degrees(s.dec)
+                label = slname if rname else clname
+                if utc is not None:
+                    az, el = _azel(s.ra, s.dec, float(utc))
+                    label += f" {az:1.2f} {el:1.2f}"
+                if kvis:
+                    f.write(f"CROSS {ra_d:.6f} {dec_d:.6f} 0.002 0.002\n")
+                    f.write(f"TEXT {ra_d:.6f} {dec_d:.6f} {label}\n")
+                    if getattr(s, "stype", 0) and s.eX and s.eY:
+                        f.write(f"ELLIPSE {ra_d:.6f} {dec_d:.6f} "
+                                f"{math.degrees(s.eX):.6f} "
+                                f"{math.degrees(s.eY):.6f} "
+                                f"{math.degrees(s.eP):.2f}\n")
+                else:
+                    f.write(f"fk5;point({ra_d},{dec_d}) # point=x "
+                            f"color={color} text={{{label}}}\n")
+                n += 1
+    return n
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="sagecal-tpu-annotate",
+        description="DS9 regions / kvis annotations from sky + cluster "
+                    "files")
+    p.add_argument("-s", "--skymodel", required=True)
+    p.add_argument("-c", "--clusters", required=True)
+    p.add_argument("-o", "--outfile", required=True)
+    p.add_argument("-n", "--names", dest="rname", action="store_true",
+                   help="label with source names (default: cluster id)")
+    p.add_argument("-i", "--id", type=int, dest="num", default=None,
+                   help="cluster id to annotate (default all)")
+    p.add_argument("-C", "--color", default="yellow")
+    p.add_argument("-t", "--time", dest="utc", default=None,
+                   help="UTC (s) for az/el labels")
+    p.add_argument("--kvis", action="store_true",
+                   help="write karma/kvis .ann instead of DS9 .reg")
+    args = p.parse_args(argv)
+    n = annotate(args.skymodel, args.clusters, args.outfile,
+                 clid=args.num, color=args.color, rname=args.rname,
+                 utc=args.utc, kvis=args.kvis)
+    print(f"wrote {args.outfile}: {n} annotations")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -426,6 +426,44 @@ def cluster_sources(ll, mm, sI, k: int, seed: int = 0, iters: int = 50,
     return lab
 
 
+def cluster_by_method(ll, mm, sI, k: int, method: str = "kmeans++",
+                      img=None, seed: int = 0):
+    """Dispatch over every supported clustering criterion (--cluster-
+    method): the in-house spherical k-means++/brightest and Ward
+    (:func:`cluster_sources`), the generic linkage/k-cluster library
+    (cluster_lib, parity with the reference's cluster.c criteria), and
+    the reference create_clusters.py tangent-plane algorithm ("tangent",
+    needs ``img`` for the projection center)."""
+    from sagecal_tpu.tools import cluster_lib as cl
+    if method in ("kmeans++", "brightest"):
+        if k < 0:
+            return cluster_sources(ll, mm, sI, k, seed=seed)   # Ward
+        return cluster_sources(ll, mm, sI, k, seed=seed, init=method)
+    nc = max(1, abs(int(k))) if k else 1
+    X = np.stack([np.asarray(ll, float), np.asarray(mm, float)], 1)
+    w = np.abs(np.asarray(sI, float)) + 1e-12
+    if method == "ward":
+        return cluster_lib_labels(X, nc, "ward", w)
+    if method in ("single", "complete", "average", "centroid"):
+        return cluster_lib_labels(X, nc, method, None)
+    if method == "kmedians":
+        return cl.kcluster(X, nc, method="m", seed=seed)[0]
+    if method == "tangent":
+        if img is None:
+            raise ValueError("tangent method needs the FITS image center")
+        pairs = [cl.lm_to_radec(img.ra0, img.dec0, float(l), float(m))
+                 for l, m in zip(ll, mm)]
+        ra = np.array([p[0] for p in pairs])
+        dec = np.array([p[1] for p in pairs])
+        return cl.tangent_kmeans(ra, dec, np.asarray(sI, float), nc)
+    raise ValueError(f"unknown cluster method {method!r}")
+
+
+def cluster_lib_labels(X, nc, method, w):
+    from sagecal_tpu.tools import cluster_lib as cl
+    return cl.linkage_labels(X, nc, method=method, weight=w)
+
+
 # ---------------------------------------------------------------------------
 # output (LSM format3 / BBS; cluster file; annotations)
 # ---------------------------------------------------------------------------
@@ -664,6 +702,12 @@ def build_parser():
     a("-c", "--merge", type=float, default=0.0)
     a("-l", "--maxfits", type=int, default=10)
     a("-k", "--clusters", type=int, default=0)
+    a("--cluster-method", default="kmeans++",
+      choices=("kmeans++", "brightest", "ward", "single", "complete",
+               "average", "centroid", "kmedians", "tangent"),
+      help="clustering criterion: in-house spherical k-means/Ward, the "
+           "cluster.c-parity linkage/k-cluster library, or the "
+           "create_clusters.py tangent-plane algorithm")
     a("-s", "--unique", default="")
     a("-N", "--negative", action="store_true")
     a("-q", "--scaleflux", type=int, default=0)
@@ -711,9 +755,10 @@ def main(argv=None) -> int:
         base = args.output or (args.image + ".sky.txt")
 
     write_lsm(base, sources, fmt=args.format)
-    labels = cluster_sources(
+    labels = cluster_by_method(
         np.array([s.l for s in sources]), np.array([s.m for s in sources]),
-        np.array([s.sI for s in sources]), args.clusters)
+        np.array([s.sI for s in sources]), args.clusters,
+        method=args.cluster_method, img=img)
     write_cluster_file(base + ".cluster", sources, labels)
     write_ds9_regions(base + ".reg", sources, hulls=hulls, img=img)
     print(f"wrote {base} (+.cluster, +.reg): {len(sources)} sources, "

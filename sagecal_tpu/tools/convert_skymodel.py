@@ -1,0 +1,197 @@
+"""BBS <-> LSM sky-model converter CLI.
+
+Capability parity with ``/root/reference/src/buildsky/convert_skymodel.py``
+(flags -i/-o/-b/-l). Independent implementation: the reference drives two
+giant regexes; here BBS lines are parsed as comma fields with optional
+columns, LSM lines via the package's sky-model parser.
+
+Conventions carried over from the reference:
+- BBS -> LSM (:25): GAUSSIAN sources get a 'G' name prefix (the LSM
+  name-prefix typing, readsky.c:405); BBS axes are FWHM arcsec ->
+  LSM half-axes in rad (x 0.5/3600 deg->rad, :515-517); position angle
+  maps as pi/2 - (pi - deg->rad) (:518); gaussians with axes < 1e-6 rad
+  are dropped as bad (:519-521); missing Q/U/V/spectra default to 0.
+- LSM -> BBS (:557): emits the BBS header + a CENTER patch stub, one
+  ``name, POINT|GAUSSIAN, CENTER, h:m:s, d.m.s, I, Q, U, V, f0, [SI]``
+  row per source, type chosen by the G name prefix.
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import sys
+
+from sagecal_tpu import skymodel
+
+
+def _parse_angle_ra(tok: str):
+    h, m, s = tok.split(":")
+    sign = -1.0 if h.strip().startswith("-") else 1.0
+    val = abs(float(h)) + float(m) / 60.0 + float(s) / 3600.0
+    return sign * val * 15.0 * math.pi / 180.0
+
+
+def _parse_angle_dec(tok: str):
+    d, m, s = tok.split(".", 2)
+    sign = -1.0 if d.strip().startswith("-") else 1.0
+    val = abs(float(d)) + float(m) / 60.0 + float(s) / 3600.0
+    return sign * val * math.pi / 180.0
+
+
+def _fmt_ra(ra: float):
+    h = (ra % (2 * math.pi)) * 12.0 / math.pi
+    hh = int(h)
+    mm = int((h - hh) * 60)
+    ss = ((h - hh) * 60 - mm) * 60
+    return f"{hh}:{mm}:{ss:.4f}"
+
+
+def _fmt_dec(dec: float):
+    d = math.degrees(dec)
+    sign = "-" if d < 0 else "+"
+    d = abs(d)
+    dd = int(d)
+    mm = int((d - dd) * 60)
+    ss = ((d - dd) * 60 - mm) * 60
+    return f"{sign}{dd}.{mm}.{ss:.4f}"
+
+
+def _floats(tok: str, default=0.0):
+    tok = tok.strip()
+    if not tok:
+        return default
+    return float(tok)
+
+
+def parse_bbs(path):
+    """Yield dicts from a BBS sky model; tolerant of the format's
+    optional columns (patch present or not, gaussian axes, reference
+    frequency, [spectral terms])."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith(("#", "format", "(")):
+                continue
+            # spectral terms: strip the [...] block first
+            spec = []
+            if "[" in line:
+                head, _, rest = line.partition("[")
+                terms = rest.partition("]")[0]
+                spec = [float(t) for t in terms.split(",") if t.strip()]
+                line = head.rstrip().rstrip(",")
+            toks = [t.strip() for t in line.split(",")]
+            if len(toks) < 4 or not toks[0]:
+                continue        # patch stubs like ", , CENTER, ..."
+            name, stype = toks[0], toks[1].upper()
+            if stype not in ("POINT", "GAUSSIAN"):
+                continue
+            k = 2
+            if ":" not in toks[k]:
+                k += 1          # skip the patch column when present
+            try:
+                ra = _parse_angle_ra(toks[k])
+                dec = _parse_angle_dec(toks[k + 1])
+            except (ValueError, IndexError):
+                continue
+            rest = toks[k + 2:]
+            sI = _floats(rest[0]) if len(rest) > 0 else 0.0
+            sQ = _floats(rest[1]) if len(rest) > 1 else 0.0
+            sU = _floats(rest[2]) if len(rest) > 2 else 0.0
+            sV = _floats(rest[3]) if len(rest) > 3 else 0.0
+            rest = rest[4:]
+            maj = mnr = pa = 0.0
+            if stype == "GAUSSIAN" and len(rest) >= 3:
+                maj = _floats(rest[0])
+                mnr = _floats(rest[1])
+                pa = _floats(rest[2])
+                rest = rest[3:]
+            f0 = _floats(rest[0], 0.0) if rest else 0.0
+            out.append(dict(name=name, stype=stype, ra=ra, dec=dec,
+                            sI=sI, sQ=sQ, sU=sU, sV=sV,
+                            maj=maj, mnr=mnr, pa=pa, f0=f0 or 150e6,
+                            spec=spec))
+    return out
+
+
+def bbs_to_lsm(infile, outfile):
+    """Reference convert_sky_bbs_lsm semantics (:25-556)."""
+    rows = parse_bbs(infile)
+    nkept = 0
+    with open(outfile, "w") as f:
+        f.write("## LSM file converted from BBS format\n")
+        f.write("# NAME RA(h m s) DEC(d m s) sI sQ sU sV SI RM eX eY eP "
+                "freq0\n")
+        for r in rows:
+            name = r["name"]
+            if r["stype"] == "GAUSSIAN":
+                if not name.upper().startswith("G"):
+                    name = "G" + name
+                # BBS FWHM arcsec -> LSM half-axis rad (:515-517)
+                eX = r["maj"] * (0.5 / 3600.0) * math.pi / 180.0
+                eY = r["mnr"] * (0.5 / 3600.0) * math.pi / 180.0
+                eP = math.pi / 2 - (math.pi - math.radians(r["pa"]))
+                if eX < 1e-6 or eY < 1e-6:
+                    continue    # bad gaussian (:519-521)
+            else:
+                eX = eY = eP = 0.0
+            si = r["spec"][0] if r["spec"] else 0.0
+            ra_h = (r["ra"] % (2 * math.pi)) * 12.0 / math.pi
+            hh = int(ra_h)
+            mm = int((ra_h - hh) * 60)
+            ss = ((ra_h - hh) * 60 - mm) * 60
+            dd_f = math.degrees(r["dec"])
+            sgn = "-" if dd_f < 0 else ""
+            dd_f = abs(dd_f)
+            dd = int(dd_f)
+            dm = int((dd_f - dd) * 60)
+            dsec = ((dd_f - dd) * 60 - dm) * 60
+            f.write(f"{name} {hh} {mm} {ss:.6f} {sgn}{dd} {dm} "
+                    f"{dsec:.6f} {r['sI']} {r['sQ']} {r['sU']} {r['sV']} "
+                    f"{si} 0 {eX:.8g} {eY:.8g} {eP:.8g} {r['f0']}\n")
+            nkept += 1
+    return nkept
+
+
+def lsm_to_bbs(infile, outfile):
+    """Reference convert_sky_lsm_bbs semantics (:557-666)."""
+    srcs = skymodel.parse_sky_model(infile, 0.0, 0.0, 150e6)
+    with open(outfile, "w") as f:
+        f.write("# (Name, Type, Patch, Ra, Dec, I, Q, U, V, "
+                "ReferenceFrequency='150e6',  SpectralIndex='[0.0]', "
+                "Ishapelet) = format\n")
+        f.write("# The above line defines the field order and is "
+                "required.\n")
+        f.write(", , CENTER, put:ra:here, put.dec.here\n")
+        for name, s in srcs.items():
+            gauss = name[:1].upper() == "G"
+            stype = "GAUSSIAN" if gauss else "POINT"
+            f.write(f"{name}, {stype}, CENTER, {_fmt_ra(s.ra)}, "
+                    f"{_fmt_dec(s.dec)}, {s.sI}, {s.sQ}, {s.sU}, "
+                    f"{s.sV}, {s.f0}, [{s.spec_idx}]\n")
+    return len(srcs)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="sagecal-tpu-convert-skymodel",
+        description="convert sky models between BBS and LSM formats")
+    p.add_argument("-i", "--infile", required=True)
+    p.add_argument("-o", "--outfile", required=True,
+                   help="output sky model (overwritten!)")
+    p.add_argument("-b", "--bbstolsm", action="store_true")
+    p.add_argument("-l", "--lsmtobbs", action="store_true")
+    args = p.parse_args(argv)
+    if args.bbstolsm == args.lsmtobbs:
+        p.error("choose exactly one of -b / -l")
+    if args.bbstolsm:
+        n = bbs_to_lsm(args.infile, args.outfile)
+    else:
+        n = lsm_to_bbs(args.infile, args.outfile)
+    print(f"wrote {args.outfile}: {n} sources")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

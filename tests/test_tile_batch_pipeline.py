@@ -1,0 +1,160 @@
+"""--tile-batch pipeline driver + --solve-fuse/--solve-promote knobs.
+
+The batched driver groups solve intervals into one vmapped program
+(pipeline._run_batched); semantics contract: tile 0 boosts solo, every
+tile keeps its sequential PRNG stream, residuals/solutions are written
+per tile — only the warm start is batch-granular.
+"""
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from sagecal_tpu import cli, pipeline, skymodel
+from sagecal_tpu.io import dataset as ds, solutions as sol
+from sagecal_tpu.rime import predict as rp
+from sagecal_tpu.solvers import sage
+
+from test_pipeline import SKY, CLUSTER
+
+
+@pytest.fixture
+def simdir5(tmp_path):
+    sky_path = tmp_path / "sky.txt"
+    sky_path.write_text(SKY)
+    clus_path = tmp_path / "sky.txt.cluster"
+    clus_path.write_text(CLUSTER)
+    ra0 = (0 + 41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(sky_path), ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(str(clus_path)))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jtrue = ds.random_jones(sky.n_clusters, sky.nchunk, 10, seed=2,
+                            scale=0.2)
+    tiles = [ds.simulate_dataset(dsky, n_stations=10, tilesz=4,
+                                 freqs=[149e6, 151e6], ra0=ra0, dec0=dec0,
+                                 jones=Jtrue, nchunk=sky.nchunk,
+                                 noise_sigma=0.02, seed=3 + i)
+             for i in range(5)]
+    msdir = tmp_path / "sim.ms"
+    ds.SimMS.create(str(msdir), tiles)
+    return tmp_path, str(msdir), str(sky_path), str(clus_path)
+
+
+def _run(tmp, msdir, sky_path, clus_path, extra, solname):
+    solpath = str(tmp / solname)
+    args = cli.build_parser().parse_args([
+        "-d", msdir, "-s", sky_path, "-c", clus_path, "-p", solpath,
+        "-j", "0", "-e", "2", "-l", "8", "-m", "4", "-t", "4"] + extra)
+    cfg = cli.config_from_args(args)
+    return pipeline.run(cfg, log=lambda *a: None), solpath
+
+
+def test_tile_batch_pipeline_matches_sequential(simdir5):
+    tmp, msdir, sky_path, clus_path = simdir5
+    hist_b, sol_b = _run(tmp, msdir, sky_path, clus_path,
+                         ["--tile-batch", "2"], "sol_b.txt")
+    assert len(hist_b) == 5
+    for h in hist_b:
+        assert np.isfinite(h["res_1"]) and h["res_1"] < h["res_0"]
+    # solutions written for every interval
+    ms = ds.SimMS(msdir)
+    sky = skymodel.read_sky_cluster(sky_path, clus_path, ms.meta["ra0"],
+                                    ms.meta["dec0"], ms.meta["freq0"])
+    hdr, blocks = sol.read_solutions(sol_b, sky.nchunk)
+    assert len(blocks) == 5
+    # residuals written back are smaller than the raw data
+    t1 = ms.read_tile(1)
+    assert np.isfinite(np.abs(t1.x)).all()
+
+
+def test_tile_batch_close_to_sequential(tmp_path):
+    """Same dataset calibrated twice (fresh copies): batched residuals
+    track sequential ones tile for tile (only warm-start granularity
+    differs)."""
+    sky_path = tmp_path / "sky.txt"
+    sky_path.write_text(SKY)
+    clus_path = tmp_path / "sky.txt.cluster"
+    clus_path.write_text(CLUSTER)
+    ra0 = (0 + 41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(sky_path), ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(str(clus_path)))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jtrue = ds.random_jones(sky.n_clusters, sky.nchunk, 10, seed=2,
+                            scale=0.2)
+    tiles = [ds.simulate_dataset(dsky, n_stations=10, tilesz=4,
+                                 freqs=[150e6], ra0=ra0, dec0=dec0,
+                                 jones=Jtrue, nchunk=sky.nchunk,
+                                 noise_sigma=0.02, seed=30 + i)
+             for i in range(3)]
+    hists = []
+    for tag, extra in (("seq", []), ("bat", ["--tile-batch", "2"])):
+        msdir = str(tmp_path / f"{tag}.ms")
+        # each run gets a pristine on-disk copy (runs write residuals)
+        ds.SimMS.create(msdir, tiles)
+        h, _ = _run(tmp_path, msdir, str(sky_path), str(clus_path), extra,
+                    f"sol_{tag}.txt")
+        hists.append(h)
+    seq, bat = hists
+    assert len(seq) == len(bat) == 3
+    # tile 0 runs solo in both drivers with identical inputs
+    np.testing.assert_allclose(bat[0]["res_1"], seq[0]["res_1"],
+                               rtol=1e-6)
+    for hs, hb in zip(seq[1:], bat[1:]):
+        # later tiles differ only via warm start; residual quality must
+        # be equivalent
+        assert hb["res_1"] < 1.5 * hs["res_1"] + 1e-6
+
+
+def test_solve_knobs_force_modes():
+    """fuse/promote force knobs select the intended execution paths."""
+    from test_sage import _calib_problem
+    from sagecal_tpu.config import SolverMode
+    from sagecal_tpu.solvers import lm as lm_mod
+
+    sky, dsky, Jtrue, tile = _calib_problem(noise=0.01)
+    coh = rp.coherencies(dsky, jnp.asarray(tile.u), jnp.asarray(tile.v),
+                         jnp.asarray(tile.w), jnp.asarray([tile.freq0]),
+                         tile.fdelta)[:, :, 0]
+    xa = tile.averaged()
+    x8 = np.stack([xa.reshape(-1, 4).real, xa.reshape(-1, 4).imag],
+                  -1).reshape(-1, 8)
+    cidx = rp.chunk_indices(tile.tilesz, tile.nbase, sky.nchunk)
+    kmax = int(sky.nchunk.max())
+    cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
+    J0 = np.tile(np.eye(2, dtype=complex),
+                 (sky.n_clusters, kmax, tile.n_stations, 1, 1))
+    wt = lm_mod.make_weights(jnp.asarray(tile.flags, jnp.int32),
+                             jnp.float64)
+    results = {}
+    for fuse, promote in (("off", "off"), ("on", "off"), ("auto", "on")):
+        cfg = sage.SageConfig(max_emiter=2, max_iter=6, max_lbfgs=4,
+                              solver_mode=int(SolverMode.LM_LBFGS),
+                              fuse=fuse, promote=promote)
+        sage.program_stats_reset()
+        J, info = sage.sagefit_host(
+            jnp.asarray(x8), coh, jnp.asarray(tile.sta1),
+            jnp.asarray(tile.sta2), jnp.asarray(cidx), jnp.asarray(cmask),
+            jnp.asarray(J0), tile.n_stations, wt, config=cfg)
+        stats = sage.program_stats()
+        results[(fuse, promote)] = (np.asarray(J), float(info["res_1"]),
+                                    set(stats))
+    # promote=on: ONE traced program, no sweep/cluster programs
+    assert "sagefit" in results[("auto", "on")][2]
+    assert "cluster_update" not in results[("auto", "on")][2]
+    # fuse=off + promote=off: per-cluster updates only
+    assert "cluster_update" in results[("off", "off")][2]
+    assert "em_sweep" not in results[("off", "off")][2]
+    # fuse=on: fused sweeps from the first EM iteration
+    assert "em_sweep" in results[("on", "off")][2]
+    assert "cluster_update" not in results[("on", "off")][2]
+    # all three paths agree on the solve itself
+    J_ref, r_ref, _ = results[("off", "off")]
+    for key, (J, r, _) in results.items():
+        np.testing.assert_allclose(J, J_ref, atol=1e-6)
+        np.testing.assert_allclose(r, r_ref, rtol=1e-6)

@@ -104,6 +104,8 @@ def main():
     ap.add_argument("--tilesz", type=int, default=4)
     ap.add_argument("--tiles", type=int, default=2)
     ap.add_argument("--solver", type=int, default=5)
+    ap.add_argument("--inflight", type=int, default=1,
+                    help="clusters in flight per SAGE sweep step")
     ap.add_argument("--keep", default=None,
                     help="reuse/keep the dataset directory")
     args = ap.parse_args()
@@ -125,12 +127,17 @@ def main():
            "-A", str(args.admm), "-P", "2", "-Q", "2", "-r", "5",
            "-j", str(args.solver), "-e", "1", "-l", "3", "-m", "0",
            "-t", str(args.tilesz), "-V",
-           "--block-f", str(args.block_f)]
+           "--block-f", str(args.block_f),
+           "--inflight", str(args.inflight)]
     env = dict(os.environ)
     # persistent XLA compilation cache: re-runs (and the second tile's
-    # programs) skip the big solve compiles
+    # programs) skip the big solve compiles. Keyed per platform (+ CPU
+    # feature fingerprint) so code compiled under another host's CPU
+    # profile is never loaded here (bench.compile_cache_dir).
+    sys.path.insert(0, HERE)
+    import bench
     env.setdefault("JAX_COMPILATION_CACHE_DIR",
-                   os.path.join(HERE, ".jax_cache"))
+                   bench.compile_cache_dir("cpu" if args.cpu else "tpu"))
     if args.cpu:
         cmd += ["--platform", "cpu", "--cpu-devices", "1"]
     print("running:", " ".join(cmd), flush=True)

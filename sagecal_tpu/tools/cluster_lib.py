@@ -306,3 +306,38 @@ def tangent_kmeans(ra, dec, sI, Q: int, max_iterations: int = 5):
             Mm = float((w[sel] * M).sum() / sw)
             Cra[c], Cdec[c] = lm_to_radec(Cra[c], Cdec[c], Lm, Mm)
     return lab
+
+
+# ---------------------------------------------------------------------------
+# principal components analysis (cluster.c:808-877 pca)
+# ---------------------------------------------------------------------------
+
+
+def pca(data):
+    """Principal components analysis of a column-centered matrix.
+
+    Capability parity with the reference ``pca()``
+    (``/root/reference/src/buildsky/cluster.c:808-877``), which runs a
+    hand-rolled Golub-Reinsch SVD; here it is one ``numpy.linalg.svd``
+    call. ``data`` [nrows, ncolumns] is assumed column-mean-centered
+    (same contract as the reference).
+
+    Returns ``(coords, components, eigenvalues)``:
+
+    - ``coords`` [nrows, n]: coordinates of each row w.r.t. the
+      principal components (U @ diag(w));
+    - ``components`` [n, ncolumns]: the principal component vectors
+      (rows), so ``coords @ components`` reproduces ``data``;
+    - ``eigenvalues`` [n]: eigenvalues of the covariance matrix
+      (squared singular values), largest first,
+
+    with ``n = min(nrows, ncolumns)``. The reference swaps which output
+    array holds coordinates vs components depending on the matrix
+    orientation purely to reuse its fixed-size buffers; this returns the
+    same decomposition in one orientation for both cases.
+    """
+    a = np.asarray(data, float)
+    if a.ndim != 2:
+        raise ValueError("pca expects a 2-D matrix")
+    u, w, vt = np.linalg.svd(a, full_matrices=False)
+    return u * w, vt, w ** 2

@@ -291,3 +291,30 @@ def test_annotate_azel_labels(tmp_path):
     # label carries two extra az/el numbers
     label = first.split("text={")[1].split("}")[0]
     assert len(label.split()) == 3
+
+
+def test_pca_reconstruction_and_order():
+    """pca() matches the reference contract (cluster.c:808-877): coords @
+    components reproduces the centered data, eigenvalues of the
+    covariance matrix come back largest-first, both orientations."""
+    rng = np.random.default_rng(3)
+    for shape in [(9, 4), (4, 9)]:
+        a = rng.normal(size=shape)
+        a -= a.mean(axis=0)
+        coords, comps, ev = cl.pca(a)
+        n = min(shape)
+        assert coords.shape == (shape[0], n)
+        assert comps.shape == (n, shape[1])
+        assert ev.shape == (n,)
+        assert np.allclose(coords @ comps, a)
+        assert np.all(np.diff(ev) <= 1e-12)
+        # eigenvalues are the squared singular values of the data
+        sv = np.linalg.svd(a, compute_uv=False)
+        assert np.allclose(ev, sv ** 2)
+
+
+def test_pca_rank_deficient():
+    a = np.outer(np.arange(6.0) - 2.5, [1.0, 2.0, -1.0])  # rank 1
+    coords, comps, ev = cl.pca(a)
+    assert np.allclose(coords @ comps, a)
+    assert ev[0] > 1e-6 and np.all(ev[1:] < 1e-12)

@@ -341,25 +341,27 @@ def _lbfgs_loop(cost_func, grad_func, x0, mem0: LBFGSMemory, itmax: int,
         k=jnp.zeros((), jnp.int32),
         done=jnp.linalg.norm(g0) < _EPS)
     out = jax.lax.while_loop(cond, body, init)
-    return out.x, out.mem
+    return out.x, out.mem, out.k
 
 
 def lbfgs_fit(cost_func, grad_func, p0, itmax: int = 20, M: int = 7,
-              linesearch: str = "fletcher"):
+              linesearch: str = "fletcher", return_iters: bool = False):
     """Full-batch LBFGS (lbfgs_fit, lbfgs.c:933): fresh memory each call.
 
     ``linesearch``: "fletcher" (reference full-batch default) or
-    "backtrack" (Armijo)."""
+    "backtrack" (Armijo). ``return_iters`` additionally returns the
+    executed iteration count (bench.py MFU trip accounting)."""
     mem = lbfgs_memory_init(p0.shape[0], M, p0.dtype)
-    x, _ = _lbfgs_loop(cost_func, grad_func, p0, mem, itmax,
-                       stochastic=False,
-                       force_backtrack=(linesearch == "backtrack"))
-    return x
+    x, _, k = _lbfgs_loop(cost_func, grad_func, p0, mem, itmax,
+                          stochastic=False,
+                          force_backtrack=(linesearch == "backtrack"))
+    return (x, k) if return_iters else x
 
 
 def lbfgs_fit_minibatch(cost_func, grad_func, p0, mem: LBFGSMemory,
                         itmax: int = 10):
     """Stochastic LBFGS step over one minibatch with persistent state
-    (lbfgs_fit_minibatch, lbfgs.c:717). Returns (p, updated memory)."""
+    (lbfgs_fit_minibatch, lbfgs.c:717). Returns (p, updated memory,
+    executed iteration count)."""
     return _lbfgs_loop(cost_func, grad_func, p0, mem, itmax,
                        stochastic=True)

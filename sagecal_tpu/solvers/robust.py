@@ -101,12 +101,16 @@ def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         w2 = update_weights(e2, nu)
         nu_new = update_nu_ml(w2, mask, nu, nulow, nuhigh)
         return (Jn, nu_new, jnp.zeros((), bool)), (info["init_cost"],
-                                                   info["final_cost"])
+                                                   info["final_cost"],
+                                                   info["iters"])
 
     (J, nu, _), costs = jax.lax.scan(
         round_body, (J0, jnp.asarray(nu0, x8.dtype), jnp.ones((), bool)),
         jnp.arange(wt_rounds))
-    info = {"init_cost": costs[0][0], "final_cost": costs[1][-1]}
+    # "iters": executed inner-LM damping iterations summed over IRLS
+    # rounds — feeds the bench's MFU trip accounting (bench.py)
+    info = {"init_cost": costs[0][0], "final_cost": costs[1][-1],
+            "iters": jnp.sum(costs[2]).astype(jnp.int32)}
     return J, nu, info
 
 

@@ -362,12 +362,16 @@ def rtr_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         # update_nu with p=2; the LM family uses the ML grid instead)
         nu_new = rb.update_nu_aecm(rb.mean_logsumw(w, mask), nu, p=2,
                                    nulow=nulow, nuhigh=nuhigh)
-        return (Jn, nu_new), (info["init_cost"], info["final_cost"])
+        return (Jn, nu_new), (info["init_cost"], info["final_cost"],
+                              info["iters"])
 
     (J, nu), costs = jax.lax.scan(
         round_body, (J0, jnp.asarray(nu0, x8.dtype)), None,
         length=wt_rounds)
-    info = {"init_cost": costs[0][0], "final_cost": costs[1][-1]}
+    # "iters": executed outer TR iterations summed over IRLS rounds
+    # (bench.py MFU trip accounting)
+    info = {"init_cost": costs[0][0], "final_cost": costs[1][-1],
+            "iters": jnp.sum(costs[2]).astype(jnp.int32)}
     return J, nu, info
 
 
@@ -448,4 +452,7 @@ def nsd_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         jnp.arange(config.itmax))
     J = ne.jones_r2c(p.reshape(kmax, n_stations, 8))
     J = jnp.where(chunk_mask[:, None, None, None], J, J0)
-    return J, nu, {"init_cost": cost0, "final_cost": costs[-1]}
+    # the scan body executes all config.itmax steps (budget exhaustion
+    # only freezes the carry), so the executed trip count is static
+    return J, nu, {"init_cost": cost0, "final_cost": costs[-1],
+                   "iters": jnp.asarray(config.itmax, jnp.int32)}

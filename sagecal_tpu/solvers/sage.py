@@ -181,7 +181,9 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
 
     ``last`` (traced bool) is the is-last-EM-iteration switch; ``os_cfg``
     is an lm.OSConfig or None (static). Returns
-    (Jn [K,N,2,2], nu_new scalar, init_cost [K], final_cost [K]).
+    (Jn [K,N,2,2], nu_new scalar, init_cost [K], final_cost [K],
+    iters i32 scalar — executed inner-solver iterations, for the bench's
+    MFU trip accounting).
     """
     lm_cfg = lm_mod.LMConfig(itmax=itcap)
 
@@ -190,7 +192,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             chunk_mask=cmask_m, config=lm_cfg, itmax_dynamic=itermax,
             admm=admm_m, os=os)
-        return Jn, nu_cj, info["init_cost"], info["final_cost"]
+        return (Jn, nu_cj, info["init_cost"], info["final_cost"],
+                info["iters"])
 
     def robust_lm(os=None):
         Jn, nu_new, info = rb.robust_lm_solve(
@@ -198,7 +201,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
             nu0=nu_cj, nulow=config.nulow, nuhigh=config.nuhigh,
             chunk_mask=cmask_m, config=lm_cfg, wt_rounds=3,  # wt_itmax=3,
             itmax_dynamic=itermax, admm=admm_m, os=os)       # robustlm.c:103
-        return Jn, nu_new, info["init_cost"], info["final_cost"]
+        return (Jn, nu_new, info["init_cost"], info["final_cost"],
+                info["iters"])
 
     if mode == int(SolverMode.RTR_OSLM_LBFGS):
         rtr_cfg = rtr_mod.RTRConfig(itmax=itcap)
@@ -206,7 +210,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             chunk_mask=cmask_m, config=rtr_cfg, itmax_dynamic=itermax,
             admm=admm_m)
-        return Jn, nu_cj, info["init_cost"], info["final_cost"]
+        return (Jn, nu_cj, info["init_cost"], info["final_cost"],
+                info["iters"])
 
     if mode == int(SolverMode.RTR_OSRLM_RLBFGS):
         rtr_cfg = rtr_mod.RTRConfig(itmax=itcap)
@@ -218,7 +223,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
             # :1842), not the LM path's wt_itmax=3
             chunk_mask=cmask_m, config=rtr_cfg, wt_rounds=2,
             itmax_dynamic=itermax, admm=admm_m)
-        return Jn, nu_new, info["init_cost"], info["final_cost"]
+        return (Jn, nu_new, info["init_cost"], info["final_cost"],
+                info["iters"])
 
     if mode == int(SolverMode.NSD_RLBFGS):
         nsd_cfg = rtr_mod.NSDConfig(itmax=2 * itcap)
@@ -227,7 +233,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
             nu0=nu_cj, nulow=config.nulow, nuhigh=config.nuhigh,
             chunk_mask=cmask_m, config=nsd_cfg, itmax_dynamic=2 * itermax,
             admm=admm_m)
-        return Jn, nu_new, info["init_cost"], info["final_cost"]
+        return (Jn, nu_new, info["init_cost"], info["final_cost"],
+                info["iters"])
 
     if mode == int(SolverMode.LM_LBFGS) or os_cfg is None:
         # without OS machinery, the OS modes (0/3) degrade to
@@ -254,8 +261,9 @@ def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                     nerr_prev, weighted, last, key, admm, os_id,
                     total_iter: int, iter_bar: int):
     """Visit one cluster: add model back to residual, solve, re-subtract
-    (lmfit.c:890-981). ``state`` = (J, xres, nerr_acc, nuM)."""
-    J, xres, nerr_acc, nuM = state
+    (lmfit.c:890-981). ``state`` = (J, xres, nerr_acc, nuM, tk) with
+    ``tk`` the running executed-iteration count (MFU accounting)."""
+    J, xres, nerr_acc, nuM, tk = state
     mode = int(config.solver_mode)
     coh_m = jnp.take(coh, cj, axis=0)
     cidx_m = jnp.take(chunk_idx, cj, axis=0)
@@ -282,7 +290,7 @@ def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
 
     itcap = int(config.max_iter) + iter_bar  # static while-loop cap
-    Jn, nu_new, init_cost, final_cost = _cluster_solve(
+    Jn, nu_new, init_cost, final_cost, its = _cluster_solve(
         mode, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m, wt_base, J_m,
         n_stations, jnp.take(nuM, cj), config, itermax, itcap, admm_m,
         os_cfg, last)
@@ -296,7 +304,7 @@ def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     nerr_acc = nerr_acc.at[cj].set(dcost)
     xres = xdummy - _model8(Jn, coh_m, sta1, sta2, cidx_m)
     J = J.at[cj].set(Jn)
-    return J, xres, nerr_acc, nuM
+    return J, xres, nerr_acc, nuM, tk + its
 
 
 def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
@@ -312,7 +320,7 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     (block-Jacobi); the group's model deltas then apply jointly:
     xres += sum_g (model(J_old_g) - model(J_new_g)).
     """
-    J, xres, nerr_acc, nuM = state
+    J, xres, nerr_acc, nuM, tk = state
     M = chunk_mask.shape[0]
     mode = int(config.solver_mode)
     valid = cjs < M
@@ -342,15 +350,15 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                 randomize=config.randomize)
         xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
         itcap = int(config.max_iter) + iter_bar
-        Jn, nu_new, init_cost, final_cost = _cluster_solve(
+        Jn, nu_new, init_cost, final_cost, its = _cluster_solve(
             mode, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m, wt_base,
             J_m, n_stations, jnp.take(nuM, cj, mode="clip"), config,
             itermax, itcap, admm_m, os_cfg, last)
         delta = (_model8(J_m, coh_m, sta1, sta2, cidx_m)
                  - _model8(Jn, coh_m, sta1, sta2, cidx_m))
-        return Jn, nu_new, init_cost, final_cost, delta
+        return Jn, nu_new, init_cost, final_cost, delta, its
 
-    Jn_g, nu_g, ic_g, fc_g, delta_g = jax.vmap(solve_one)(cjs)
+    Jn_g, nu_g, ic_g, fc_g, delta_g, its_g = jax.vmap(solve_one)(cjs)
     vm = valid.astype(xres.dtype)
     xres = xres + jnp.einsum("g,gbx->bx", vm, delta_g)
     init_res = jnp.sum(ic_g, axis=-1)
@@ -363,7 +371,11 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     nerr_acc = nerr_acc.at[cjs].set(dcost)
     nuM = nuM.at[cjs].set(nu_g)
     J = J.at[cjs].set(Jn_g)
-    return J, xres, nerr_acc, nuM
+    # useful-work iteration count: sum over live lanes (a lower bound on
+    # executed trips — the G-wide batched loop runs until its slowest
+    # lane finishes)
+    return (J, xres, nerr_acc, nuM,
+            tk + jnp.sum(jnp.where(valid, its_g, 0)).astype(jnp.int32))
 
 
 def _eff_inflight(config: SageConfig, M: int) -> int:
@@ -464,7 +476,7 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
     G = _eff_inflight(config, M)
 
     def em_iter(ci, carry):
-        J, xres, nerr, nuM = carry
+        J, xres, nerr, nuM, tk = carry
         weighted = (ci % 2 == 1) if config.randomize else jnp.asarray(False)
         last = ci == config.max_emiter - 1
         perm = _cluster_perm(ci, nerr, weighted, key, M, config)
@@ -479,9 +491,9 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
                     weighted, last, kci, admm, os_id, total_iter,
                     iter_bar)
 
-            J, xres, nerr_new, nuM = jax.lax.fori_loop(
+            J, xres, nerr_new, nuM, tk = jax.lax.fori_loop(
                 0, M, cluster_step, (J, xres, jnp.zeros((M,), dtype),
-                                     nuM))
+                                     nuM, tk))
         else:
             base = (perm if perm is not None
                     else jnp.arange(M, dtype=jnp.int32))
@@ -495,22 +507,24 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
                     weighted, last, kci, admm, os_id, total_iter,
                     iter_bar)
 
-            J, xres, nerr_new, nuM = jax.lax.fori_loop(
+            J, xres, nerr_new, nuM, tk = jax.lax.fori_loop(
                 0, n_groups, group_step, (J, xres, jnp.zeros((M,), dtype),
-                                          nuM))
+                                          nuM, tk))
         total = jnp.sum(nerr_new)
         nerr = jnp.where(total > 0, nerr_new / total, nerr_new)
-        return J, xres, nerr, nuM
+        return J, xres, nerr, nuM, tk
 
     nuM0 = jnp.full((M,), jnp.asarray(nu0, dtype))
-    J, xres, nerr, nuM = jax.lax.fori_loop(
+    J, xres, nerr, nuM, tk = jax.lax.fori_loop(
         0, config.max_emiter, em_iter,
-        (J0, xres0, jnp.zeros((M,), dtype), nuM0))
+        (J0, xres0, jnp.zeros((M,), dtype), nuM0,
+         jnp.zeros((), jnp.int32)))
 
     mean_nu = jnp.clip(jnp.mean(nuM), config.nulow, config.nuhigh)
 
     # joint LBFGS refine over all parameters (lmfit.c:1019-1037);
     # skipped in ADMM mode (sagecal_slave.cpp passes max_lbfgs=0)
+    lbfgs_k = jnp.zeros((), jnp.int32)
     if config.max_lbfgs > 0 and admm is None:
         shape = (M * kmax, n_stations, 8)
         Jflat = J.reshape(M * kmax, n_stations, 2, 2)
@@ -519,14 +533,16 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
                                   shape, M, kmax, n_stations, robust,
                                   mean_nu)
         grad_fn = jax.grad(cost_fn)
-        p1 = lbfgs_mod.lbfgs_fit(cost_fn, grad_fn, p0,
-                                 itmax=config.max_lbfgs, M=config.lbfgs_m)
+        p1, lbfgs_k = lbfgs_mod.lbfgs_fit(cost_fn, grad_fn, p0,
+                                          itmax=config.max_lbfgs,
+                                          M=config.lbfgs_m,
+                                          return_iters=True)
         J = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations, 2, 2)
 
     xres_f = x8 - full_model8(J, coh, sta1, sta2, chunk_idx)
     res_1 = jnp.linalg.norm(xres_f * wt_base) / n
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
-               "nerr": nerr}
+               "nerr": nerr, "solver_iters": tk, "lbfgs_iters": lbfgs_k}
 
 
 # ---------------------------------------------------------------------------
@@ -541,7 +557,9 @@ def _jit_cluster_update(cj, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
                         last, key, admm, os_ids, n_stations, config,
                         total_iter, iter_bar, os_nsub):
     os_id = None if os_ids is None else (os_ids, os_nsub)
-    return _cluster_update(cj, (J, xres, nerr_acc, nuM), x8, coh, sta1,
+    return _cluster_update(cj, (J, xres, nerr_acc, nuM,
+                                jnp.zeros((), jnp.int32)),
+                           x8, coh, sta1,
                            sta2, chunk_idx, chunk_mask, wt_base, n_stations,
                            config, nerr_prev, weighted, last, key, admm,
                            os_id, total_iter, iter_bar)
@@ -557,7 +575,9 @@ def _jit_group_update(cjs, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
     """One in-flight GROUP of cluster solves as a bounded execution
     (config.inflight > 1 on the unfused host path)."""
     os_id = None if os_ids is None else (os_ids, os_nsub)
-    return _group_update(cjs, (J, xres, nerr_acc, nuM), x8, coh, sta1,
+    return _group_update(cjs, (J, xres, nerr_acc, nuM,
+                               jnp.zeros((), jnp.int32)),
+                         x8, coh, sta1,
                          sta2, chunk_idx, chunk_mask, wt_base, n_stations,
                          config, nerr_prev, weighted, last, key, None,
                          os_id, total_iter, iter_bar)
@@ -587,7 +607,8 @@ def _jit_em_sweep(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
 
         return jax.lax.fori_loop(
             0, M, cluster_step,
-            (J, xres, jnp.zeros((M,), x8.dtype), nuM))
+            (J, xres, jnp.zeros((M,), x8.dtype), nuM,
+             jnp.zeros((), jnp.int32)))
 
     order_pad, n_groups = _pad_order(perm, M, G)
 
@@ -600,7 +621,8 @@ def _jit_em_sweep(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
 
     return jax.lax.fori_loop(
         0, n_groups, group_step,
-        (J, xres, jnp.zeros((M,), x8.dtype), nuM))
+        (J, xres, jnp.zeros((M,), x8.dtype), nuM,
+         jnp.zeros((), jnp.int32)))
 
 
 @jax.jit
@@ -620,13 +642,14 @@ def _jit_refine(x8, coh, sta1, sta2, chunk_idx, J, wt_base, mean_nu,
         .reshape(-1).astype(dtype)
     cost_fn = _refine_cost_fn(x8, coh, sta1, sta2, chunk_idx, wt_base,
                               shape, M, kmax, n_stations, robust, mean_nu)
-    p1 = lbfgs_mod.lbfgs_fit(cost_fn, jax.grad(cost_fn), p0,
-                             itmax=config.max_lbfgs, M=config.lbfgs_m)
+    p1, k = lbfgs_mod.lbfgs_fit(cost_fn, jax.grad(cost_fn), p0,
+                                itmax=config.max_lbfgs, M=config.lbfgs_m,
+                                return_iters=True)
     Jn = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations, 2, 2)
     res = jnp.linalg.norm(
         (x8 - full_model8(Jn, coh, sta1, sta2, chunk_idx)) * wt_base) \
         / (x8.shape[0] * 8)
-    return Jn, res
+    return Jn, res, k
 
 
 @jax.jit
@@ -703,6 +726,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     fused = (fuse_mode == "on" or
              (fuse_mode == "auto" and _FUSION_CACHE.get(fuse_key, False)))
     sweep_times: list = []
+    tk_total = jnp.zeros((), jnp.int32)
     for ci in range(config.max_emiter):
         weighted = config.randomize and (ci % 2 == 1)
         last = ci == config.max_emiter - 1
@@ -717,11 +741,12 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
             order = np.arange(M)
         if fused:
             t_sweep = time.perf_counter()
-            J, xres, nerr_acc, nuM = _call("em_sweep", _jit_em_sweep,
+            J, xres, nerr_acc, nuM, tk = _call("em_sweep", _jit_em_sweep,
                 J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                 wt_base, nerr, jnp.asarray(weighted), jnp.asarray(last),
                 kci, jnp.asarray(order, jnp.int32), os_ids,
                 n_stations, dev_config, total_iter, iter_bar, os_nsub)
+            tk_total = tk_total + tk
             jax.block_until_ready(J)
             sweep_times.append(time.perf_counter() - t_sweep)
         else:
@@ -730,25 +755,27 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
             Gi = _eff_inflight(config, M)
             if Gi == 1:
                 for cj in order:
-                    J, xres, nerr_acc, nuM = _call(
+                    J, xres, nerr_acc, nuM, tk = _call(
                         "cluster_update", _jit_cluster_update,
                         jnp.asarray(int(cj), jnp.int32), J, xres,
                         nerr_acc, nuM, x8, coh, sta1, sta2, chunk_idx,
                         chunk_mask, wt_base, nerr, jnp.asarray(weighted),
                         jnp.asarray(last), kci, None, os_ids, n_stations,
                         dev_config, total_iter, iter_bar, os_nsub)
+                    tk_total = tk_total + tk
             else:
                 opad = np.concatenate(
                     [np.asarray(order),
                      np.full((-(-M // Gi)) * Gi - M, M)]).astype(np.int32)
                 for g in range(len(opad) // Gi):
-                    J, xres, nerr_acc, nuM = _call(
+                    J, xres, nerr_acc, nuM, tk = _call(
                         "group_update", _jit_group_update,
                         jnp.asarray(opad[g * Gi:(g + 1) * Gi]), J, xres,
                         nerr_acc, nuM, x8, coh, sta1, sta2, chunk_idx,
                         chunk_mask, wt_base, nerr, jnp.asarray(weighted),
                         jnp.asarray(last), kci, os_ids, n_stations,
                         dev_config, total_iter, iter_bar, os_nsub)
+                    tk_total = tk_total + tk
             jax.block_until_ready(J)
             # the fused program does the same work minus dispatch overhead,
             # so a 25 s per-cluster sweep bounds it well under the ~60 s
@@ -770,15 +797,17 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
         _learned("promote", promote_key, True)
 
     mean_nu = jnp.clip(jnp.mean(nuM), config.nulow, config.nuhigh)
+    lbfgs_k = jnp.zeros((), jnp.int32)
     if config.max_lbfgs > 0:
-        J, res_1 = _call("refine", _jit_refine, x8, coh, sta1, sta2,
-                         chunk_idx, J, wt_base, mean_nu, n_stations,
-                         dev_config, robust)
+        J, res_1, lbfgs_k = _call("refine", _jit_refine, x8, coh, sta1,
+                                  sta2, chunk_idx, J, wt_base, mean_nu,
+                                  n_stations, dev_config, robust)
     else:
         res_1 = _call("res", _jit_res, x8, coh, sta1, sta2, chunk_idx, J,
                       wt_base)
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
-               "nerr": nerr}
+               "nerr": nerr, "solver_iters": tk_total,
+               "lbfgs_iters": lbfgs_k}
 
 
 # ---------------------------------------------------------------------------
@@ -838,7 +867,8 @@ def _jit_em_sweep_tiles(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx,
                                        total_iter, iter_bar)
             return jax.lax.fori_loop(
                 0, M, cluster_step,
-                (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t))
+                (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t,
+                 jnp.zeros((), jnp.int32)))
 
         order_pad, n_groups = _pad_order(perm_t, M, G)
 
@@ -850,7 +880,8 @@ def _jit_em_sweep_tiles(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx,
                                  None, os_id, total_iter, iter_bar)
         return jax.lax.fori_loop(
             0, n_groups, group_step,
-            (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t))
+            (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t,
+             jnp.zeros((), jnp.int32)))
     return jax.vmap(one)(J, xres, nuM, x8, coh, wt_base, nerr_prev, keys,
                          perm)
 
@@ -949,6 +980,7 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     fused = (fuse_mode == "on" or
              (fuse_mode == "auto" and _FUSION_CACHE.get(fuse_key, False)))
     sweep_times: list = []
+    tk_total = jnp.zeros((T,), jnp.int32)
     for ci in range(config.max_emiter):
         weighted = config.randomize and (ci % 2 == 1)
         last = ci == config.max_emiter - 1
@@ -966,12 +998,13 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
         order = jnp.asarray(order, jnp.int32)
         t_sweep = time.perf_counter()
         if fused:
-            J, xres, nerr_acc, nuM = _call(
+            J, xres, nerr_acc, nuM, tk = _call(
                 "em_sweep_tiles", _jit_em_sweep_tiles,
                 J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                 wt_base, nerr, jnp.asarray(weighted), jnp.asarray(last),
                 kci, order, os_ids, n_stations, dev_config, total_iter,
                 iter_bar, os_nsub)
+            tk_total = tk_total + tk
             jax.block_until_ready(J)
             sweep_times.append(time.perf_counter() - t_sweep)
         else:
@@ -979,25 +1012,27 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
             Gi = _eff_inflight(config, M)
             if Gi == 1:
                 for cj in range(M):
-                    J, xres, nerr_acc, nuM = _call(
+                    J, xres, nerr_acc, nuM, tk = _call(
                         "cluster_update_tiles", _jit_cluster_update_tiles,
                         order[:, cj], J, xres, nerr_acc, nuM, x8, coh,
                         sta1, sta2, chunk_idx, chunk_mask, wt_base, nerr,
                         jnp.asarray(weighted), jnp.asarray(last), kci,
                         os_ids, n_stations, dev_config, total_iter,
                         iter_bar, os_nsub)
+                    tk_total = tk_total + tk
             else:
                 pad = (-(-M // Gi)) * Gi - M
                 opad = jnp.concatenate(
                     [order, jnp.full((T, pad), M, order.dtype)], axis=1)
                 for g in range(opad.shape[1] // Gi):
-                    J, xres, nerr_acc, nuM = _call(
+                    J, xres, nerr_acc, nuM, tk = _call(
                         "group_update_tiles", _jit_group_update_tiles,
                         opad[:, g * Gi:(g + 1) * Gi], J, xres, nerr_acc,
                         nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                         wt_base, nerr, jnp.asarray(weighted),
                         jnp.asarray(last), kci, os_ids, n_stations,
                         dev_config, total_iter, iter_bar, os_nsub)
+                    tk_total = tk_total + tk
             jax.block_until_ready(J)
             if fuse_mode == "auto":
                 fused = time.perf_counter() - t_sweep < 25.0
@@ -1014,15 +1049,17 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
         _learned("promote", promote_key, True)
 
     mean_nu = jnp.clip(jnp.mean(nuM, axis=1), config.nulow, config.nuhigh)
+    lbfgs_k = jnp.zeros((T,), jnp.int32)
     if config.max_lbfgs > 0:
-        J, res_1 = _call("refine_tiles", _jit_refine_tiles, x8, coh,
-                         sta1, sta2, chunk_idx, J, wt_base, mean_nu,
-                         n_stations, dev_config, robust)
+        J, res_1, lbfgs_k = _call("refine_tiles", _jit_refine_tiles, x8,
+                                  coh, sta1, sta2, chunk_idx, J, wt_base,
+                                  mean_nu, n_stations, dev_config, robust)
     else:
         res_1 = _call("res_tiles", _jit_res_tiles, x8, coh, sta1, sta2,
                       chunk_idx, J, wt_base)
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
-               "nerr": nerr}
+               "nerr": nerr, "solver_iters": tk_total,
+               "lbfgs_iters": lbfgs_k}
 
 
 @functools.partial(jax.jit,
@@ -1038,7 +1075,8 @@ def _jit_cluster_update_tiles(cj, J, xres, nerr_acc, nuM, x8, coh, sta1,
     def one(cj_t, J_t, xres_t, nerr_acc_t, nuM_t, x8_t, coh_t, wt_t,
             nerr_t, key_t):
         os_id = None if os_ids is None else (os_ids, os_nsub)
-        return _cluster_update(cj_t, (J_t, xres_t, nerr_acc_t, nuM_t),
+        return _cluster_update(cj_t, (J_t, xres_t, nerr_acc_t, nuM_t,
+                                      jnp.zeros((), jnp.int32)),
                                x8_t, coh_t, sta1, sta2, chunk_idx,
                                chunk_mask, wt_t, n_stations, config,
                                nerr_t, weighted, last, key_t, None, os_id,
@@ -1060,7 +1098,8 @@ def _jit_group_update_tiles(cjs, J, xres, nerr_acc, nuM, x8, coh, sta1,
     def one(cjs_t, J_t, xres_t, na_t, nuM_t, x8_t, coh_t, wt_t, nerr_t,
             key_t):
         os_id = None if os_ids is None else (os_ids, os_nsub)
-        return _group_update(cjs_t, (J_t, xres_t, na_t, nuM_t), x8_t,
+        return _group_update(cjs_t, (J_t, xres_t, na_t, nuM_t,
+                                     jnp.zeros((), jnp.int32)), x8_t,
                              coh_t, sta1, sta2, chunk_idx, chunk_mask,
                              wt_t, n_stations, config, nerr_t, weighted,
                              last, key_t, None, os_id, total_iter,
@@ -1095,9 +1134,10 @@ def bfgsfit(x8, coh, sta1, sta2, chunk_idx, J0, n_stations: int,
 
     res_0 = jnp.linalg.norm(
         (x8 - full_model8(J0, coh, sta1, sta2, chunk_idx)) * wt_base) / n
-    p1 = lbfgs_mod.lbfgs_fit(cost_fn, jax.grad(cost_fn), p0,
-                             itmax=config.max_lbfgs, M=config.lbfgs_m)
+    p1, k = lbfgs_mod.lbfgs_fit(cost_fn, jax.grad(cost_fn), p0,
+                                itmax=config.max_lbfgs, M=config.lbfgs_m,
+                                return_iters=True)
     J = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations, 2, 2)
     res_1 = jnp.linalg.norm(
         (x8 - full_model8(J, coh, sta1, sta2, chunk_idx)) * wt_base) / n
-    return J, {"res_0": res_0, "res_1": res_1}
+    return J, {"res_0": res_0, "res_1": res_1, "lbfgs_iters": k}

@@ -135,35 +135,23 @@ class BandSolverOutputs(NamedTuple):
     mem: lbfgs_mod.LBFGSMemory
     res_0: jax.Array
     res_1: jax.Array
+    iters: jax.Array        # executed LBFGS iterations (MFU accounting)
 
 
-def make_band_solver(dsky, n_stations: int, chunk_idx, chunk_mask,
-                     fdelta_chan: float, nu: float, max_lbfgs: int,
-                     consensus: bool, dobeam: int = 0,
-                     loss: str = "robust"):
-    """Build the jitted per-(band, minibatch) robust LBFGS solve.
+def make_band_cost(chunk_idx, chunk_mask, n_stations: int, nu: float,
+                   consensus: bool, loss: str = "robust"):
+    """Build the band objective used by :func:`make_band_solver`:
+    ``cost_of(x8F, coh, wtF, sta1, sta2, Y, BZ, rho) -> cost_fn(pflat)``.
 
-    Parity: ``bfgsfit_minibatch_visibilities`` (plain) /
-    ``bfgsfit_minibatch_consensus`` (adds the ADMM augmentation) in
-    robust_batchmode_lbfgs.c:1446/:1504. Cost is the Student's-t robust
-    objective sum log(1 + r^2/nu) over all real residual components of the
-    band's channels; the gradient is autodiff (the reference hand-writes
-    ``cpu_calc_deriv_multifreq``). The persistent LBFGS memory rides
-    through as a pytree (persistent_data_t).
+    Factored out so the bench's per-LBFGS-iteration FLOP price
+    (bench.py config2) lowers the SAME objective the solver minimizes —
+    a hand-copied objective would silently drift if this one changes.
     """
     M, kmax = chunk_mask.shape
     cidx = jnp.asarray(chunk_idx)
     cmask3 = jnp.asarray(chunk_mask)[..., None, None]     # [M, K, 1, 1]
 
-    def solve(x8F, u, v, w, sta1, sta2, wtF, freqsF, tslot, p0, mem,
-              Y=None, BZ=None, rho=None, beam=None):
-        # x8F/wtF: [B, Fp, 8]; freqsF: [Fp]; p0: [M, K, N, 8] reals
-        coh = rp.coherencies(dsky, u, v, w, freqsF, fdelta_chan,
-                             per_channel_flux=True, beam=beam,
-                             dobeam=dobeam, tslot=tslot,
-                             sta1=sta1, sta2=sta2)       # [M, B, Fp, 2, 2]
-        nreal = jnp.maximum(jnp.sum(wtF > 0), 1).astype(x8F.dtype)
-
+    def cost_of(x8F, coh, wtF, sta1, sta2, Y=None, BZ=None, rho=None):
         def cost_fn(pflat):
             p = pflat.reshape(M, kmax, n_stations, 8)
             J = ne.jones_r2c(p)
@@ -184,15 +172,47 @@ def make_band_solver(dsky, n_stations: int, chunk_idx, chunk_mask,
                 c = c + 0.5 * jnp.sum(
                     rho[:, None, None, None] * jnp.sum(d * d, axis=(2, 3)))
             return c
+        return cost_fn
 
+    return cost_of
+
+
+def make_band_solver(dsky, n_stations: int, chunk_idx, chunk_mask,
+                     fdelta_chan: float, nu: float, max_lbfgs: int,
+                     consensus: bool, dobeam: int = 0,
+                     loss: str = "robust"):
+    """Build the jitted per-(band, minibatch) robust LBFGS solve.
+
+    Parity: ``bfgsfit_minibatch_visibilities`` (plain) /
+    ``bfgsfit_minibatch_consensus`` (adds the ADMM augmentation) in
+    robust_batchmode_lbfgs.c:1446/:1504. Cost is the Student's-t robust
+    objective sum log(1 + r^2/nu) over all real residual components of the
+    band's channels; the gradient is autodiff (the reference hand-writes
+    ``cpu_calc_deriv_multifreq``). The persistent LBFGS memory rides
+    through as a pytree (persistent_data_t).
+    """
+    M, kmax = chunk_mask.shape
+    cost_of = make_band_cost(chunk_idx, chunk_mask, n_stations, nu,
+                             consensus, loss=loss)
+
+    def solve(x8F, u, v, w, sta1, sta2, wtF, freqsF, tslot, p0, mem,
+              Y=None, BZ=None, rho=None, beam=None):
+        # x8F/wtF: [B, Fp, 8]; freqsF: [Fp]; p0: [M, K, N, 8] reals
+        coh = rp.coherencies(dsky, u, v, w, freqsF, fdelta_chan,
+                             per_channel_flux=True, beam=beam,
+                             dobeam=dobeam, tslot=tslot,
+                             sta1=sta1, sta2=sta2)       # [M, B, Fp, 2, 2]
+        nreal = jnp.maximum(jnp.sum(wtF > 0), 1).astype(x8F.dtype)
+        cost_fn = cost_of(x8F, coh, wtF, sta1, sta2, Y=Y, BZ=BZ, rho=rho)
         grad_fn = jax.grad(cost_fn)
         p0f = p0.reshape(-1)
         res_0 = cost_fn(p0f) / nreal
-        p1f, mem1 = lbfgs_mod.lbfgs_fit_minibatch(cost_fn, grad_fn, p0f,
-                                                  mem, itmax=max_lbfgs)
+        p1f, mem1, k = lbfgs_mod.lbfgs_fit_minibatch(cost_fn, grad_fn,
+                                                     p0f, mem,
+                                                     itmax=max_lbfgs)
         res_1 = cost_fn(p1f) / nreal
         return BandSolverOutputs(p1f.reshape(M, kmax, n_stations, 8),
-                                 mem1, res_0, res_1)
+                                 mem1, res_0, res_1, k)
 
     return jax.jit(solve)
 

@@ -1,0 +1,97 @@
+"""Executed-iteration counters for the bench's MFU trip accounting.
+
+VERDICT r4 weak 2: XLA cost analysis prices loop bodies once, so the
+solvers now report how many iterations actually ran
+(info["solver_iters"] / info["lbfgs_iters"]); bench.py multiplies these
+by per-trip FLOP prices. These tests pin the counter contract: present,
+positive, and identical between the fully traced and host-driven
+drivers (same math -> same trip counts).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sagecal_tpu.config import SolverMode
+from sagecal_tpu.solvers import sage
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(0)
+    N, M, K = 6, 3, 2
+    pairs = [(i, j) for i in range(N) for j in range(i + 1, N)]
+    tsz = 6
+    B = len(pairs) * tsz
+    sta1 = np.tile(np.array([p[0] for p in pairs]), tsz).astype(np.int32)
+    sta2 = np.tile(np.array([p[1] for p in pairs]), tsz).astype(np.int32)
+    coh = (rng.normal(size=(M, B, 2, 2))
+           + 1j * rng.normal(size=(M, B, 2, 2))).astype(np.complex128)
+    cidx = (np.arange(B) // (B // K)).clip(0, K - 1)[None, :] \
+        .repeat(M, 0).astype(np.int32)
+    cmask = np.ones((M, K), bool)
+    J0 = np.tile(np.eye(2, dtype=np.complex128), (M, K, N, 1, 1))
+    Jt = J0 + 0.1 * (rng.normal(size=J0.shape)
+                     + 1j * rng.normal(size=J0.shape))
+    x8 = sage.full_model8(jnp.asarray(Jt), jnp.asarray(coh),
+                          jnp.asarray(sta1), jnp.asarray(sta2),
+                          jnp.asarray(cidx))
+    wt = np.ones((B, 8), np.float64)
+    return (jnp.asarray(x8, jnp.float64), jnp.asarray(coh),
+            jnp.asarray(sta1), jnp.asarray(sta2), jnp.asarray(cidx),
+            jnp.asarray(cmask), jnp.asarray(J0), N, jnp.asarray(wt))
+
+
+def test_iters_traced_vs_host(problem):
+    cfg = sage.SageConfig(max_emiter=2, max_iter=5, max_lbfgs=4,
+                          solver_mode=int(SolverMode.OSLM_OSRLM_RLBFGS))
+    _, info_t = sage.sagefit(*problem, config=cfg)
+    _, info_h = sage.sagefit_host(*problem, config=cfg)
+    for info in (info_t, info_h):
+        assert int(info["solver_iters"]) > 0
+        assert 0 < int(info["lbfgs_iters"]) <= cfg.max_lbfgs
+    assert int(info_t["solver_iters"]) == int(info_h["solver_iters"])
+    assert int(info_t["lbfgs_iters"]) == int(info_h["lbfgs_iters"])
+
+
+def test_iters_rtr_bounded(problem):
+    cfg = sage.SageConfig(max_emiter=1, max_iter=4, max_lbfgs=0,
+                          solver_mode=int(SolverMode.RTR_OSRLM_RLBFGS))
+    _, info = sage.sagefit(*problem, config=cfg)
+    M = problem[1].shape[0]
+    iter_bar = -(-int(0.8 * M * cfg.max_iter) // M)
+    # 2 IRLS rounds per cluster solve, each <= max_iter + iter_bar trips
+    cap = M * cfg.max_emiter * 2 * (cfg.max_iter + iter_bar)
+    assert 0 < int(info["solver_iters"]) <= cap
+    assert int(info["lbfgs_iters"]) == 0
+
+
+def test_iters_tiles_per_tile(problem):
+    cfg = sage.SageConfig(max_emiter=1, max_iter=3, max_lbfgs=2,
+                          solver_mode=int(SolverMode.LM_LBFGS))
+    T = 2
+    x8, coh, s1, s2, cidx, cmask, J0, N, wt = problem
+    targs = (jnp.stack([x8] * T), jnp.stack([coh] * T), s1, s2, cidx,
+             cmask, jnp.stack([J0] * T), N, jnp.stack([wt] * T))
+    _, info = sage.sagefit_host_tiles(*targs, config=cfg)
+    si = np.asarray(info["solver_iters"])
+    assert si.shape == (T,) and (si > 0).all()
+    # identical tiles solve identically under per-tile PRNG key 0 vs 1?
+    # keys differ, but LM trips at eps=1e-15 are budget-capped: equal
+    assert si[0] == si[1]
+
+
+def test_band_solver_reports_iters():
+    """BandSolverOutputs.iters: executed LBFGS iterations (config2)."""
+    from sagecal_tpu.solvers import lbfgs as lbfgs_mod
+
+    def cost(p):
+        return jnp.sum((p - 2.0) ** 2)
+
+    p0 = jnp.zeros(5, jnp.float32)
+    mem = lbfgs_mod.lbfgs_memory_init(5, 3)
+    p1, mem1, k = lbfgs_mod.lbfgs_fit_minibatch(cost, jax.grad(cost), p0,
+                                                mem, itmax=6)
+    assert 0 < int(k) <= 6
+    assert np.allclose(np.asarray(p1), 2.0, atol=1e-3)

@@ -215,7 +215,11 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
 
     def local_solve_admm(x8, u, v, w, wt, J_r8, freq, Y_r8, BZ_r8, rho_m):
         coh = coh_for(u, v, w, freq)
-        scfg = cfg.sage._replace(max_lbfgs=0)
+        # ADMM iterations k>0 always warm-start from the previous
+        # iterate, so cluster groups (inflight>1) skip the cold-start
+        # width restriction; iteration 0 (local_solve_plain, cfg.sage
+        # unmodified) keeps it
+        scfg = cfg.sage._replace(max_lbfgs=0, inflight_warm=True)
         J, info = sage.sagefit(x8, coh, sta1_j, sta2_j, cidx_j, cmask_j,
                                ne.jones_r2c(J_r8), N, wt, config=scfg,
                                admm=(Y_r8, BZ_r8, rho_m))

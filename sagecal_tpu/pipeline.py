@@ -161,7 +161,7 @@ class FullBatchPipeline:
                              if self.batch_ok else None)
 
         self._solve_first = self._build_solver(self.boost)
-        self._solve_rest = self._build_solver(1)
+        self._solve_rest = self._build_solver(1, warm=True)
         self._residual_fn = jax.jit(self._residuals)
         self._chan_solver = None
         self._chan_residual_fn = None
@@ -173,9 +173,32 @@ class FullBatchPipeline:
     # the axon TPU runtime, so solvers take/return Jones as [.., N, 8]
     # reals and visibilities as stacked [..., 2] real pairs (utils.c2r).
 
-    def _build_solver(self, emiter_mult: int):
+    def _inflight_downgrade(self, log=print) -> None:
+        """Divergence guard for --inflight (VERDICT r5 item 6): a
+        divergence reset with block-Jacobi groups active is treated as
+        evidence of group overcorrection, and the run falls back to the
+        reference's strict sequential cluster updates for all remaining
+        tiles — the same downgrade philosophy as the LMCUT solver
+        fallback (fullbatch_mode.cpp:397). Sticky: groups never re-arm
+        within the run. Callers skip it for res_1 == 0 resets (fully
+        flagged data says nothing about group overcorrection); residual
+        growth and non-finite blowups both count as evidence."""
+        if self.base_cfg.inflight <= 1:
+            return
+        log("inflight downgrade: divergence reset with cluster groups "
+            "active; falling back to sequential updates (G=1)")
+        self.base_cfg = self.base_cfg._replace(inflight=1)
+        self._solve_first = self._build_solver(self.boost)
+        self._solve_rest = self._build_solver(1, warm=True)
+        if self._solve_tiles is not None:
+            self._solve_tiles = self._build_tiles_solver(self.tile_batch)
+
+    def _build_solver(self, emiter_mult: int, warm: bool = False):
         scfg = self.base_cfg._replace(
-            max_emiter=self.base_cfg.max_emiter * emiter_mult)
+            max_emiter=self.base_cfg.max_emiter * emiter_mult,
+            # warm solves (J0 carried from the previous tile) skip the
+            # cold-start inflight width restriction (sage.SageConfig)
+            inflight_warm=warm)
         meta = self.ms.meta
         freq0 = meta["freq0"]
         fdelta = meta["fdelta"]
@@ -225,7 +248,10 @@ class FullBatchPipeline:
         each tile's subset draws/permutations match a sequential run —
         only the warm start differs (batch-granular instead of
         tile-granular)."""
-        scfg = self.base_cfg
+        # batches always run after the solo boost tile, so they are
+        # warm-started (the cold-start inflight restriction is the solo
+        # first solve's job)
+        scfg = self.base_cfg._replace(inflight_warm=True)
         meta = self.ms.meta
         freq0 = meta["freq0"]
         fdelta = meta["fdelta"]
@@ -482,6 +508,8 @@ class FullBatchPipeline:
                     state["res_prev"] is not None
                     and res_1 > RES_RATIO * state["res_prev"]):
                 log(f"tile {ti}: Resetting Solution")
+                if res_1 != 0.0:    # zero = flagged data, not divergence
+                    self._inflight_downgrade(log)
                 state["J"] = pinit.copy()
                 state["first"] = True
                 state["res_prev"] = res_1 if np.isfinite(res_1) else None
@@ -640,6 +668,8 @@ class FullBatchPipeline:
                 if res_1 == 0.0 or not np.isfinite(res_1) or (
                         res_prev is not None and res_1 > RES_RATIO * res_prev):
                     log(f"tile {ti}: Resetting Solution")
+                    if res_1 != 0.0:   # zero = flagged data, not divergence
+                        self._inflight_downgrade(log)
                     J = pinit.copy()
                     first = True
                     res_prev = res_1 if np.isfinite(res_1) else None

@@ -138,9 +138,16 @@ class SageConfig(NamedTuple):
     # stays exact (group updates sum model deltas against one base
     # residual), but simultaneous updates overcorrect when a large
     # fraction of clusters move at once (measured: G=M diverges on a
-    # cold start), so the EFFECTIVE width is clamped to M//4 — the
-    # M >> G regime this exists for (north-star M=100 with G=4..8).
+    # cold start), so the EFFECTIVE width is clamped (see _eff_inflight;
+    # the M >> G regime this exists for is north-star M=100 with G=4..8)
+    # and a COLD start additionally restricts the first EM sweep to
+    # width <= 2 — measured at M=32: G>=4 from identity Jones diverges
+    # (residual grows 10x+) while G=2 tracks sequential, and G=4 from a
+    # one-sweep warm start converges fine. Callers whose J0 is already
+    # near a solution (pipeline warm tiles, ADMM iterations > 0) set
+    # inflight_warm=True to skip the restriction.
     inflight: int = 1
+    inflight_warm: bool = False
 
 
 _OS_MODES = (int(SolverMode.OSLM_LBFGS),
@@ -378,13 +385,26 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
             tk + jnp.sum(jnp.where(valid, its_g, 0)).astype(jnp.int32))
 
 
+_COLD_INFLIGHT = 2      # widest group proven safe from an identity start
+
+
 def _eff_inflight(config: SageConfig, M: int) -> int:
     """Effective in-flight group width: the configured value clamped to
-    M//4 (see SageConfig.inflight — wider groups overcorrect)."""
+    min(M//4, max(2, M//8)) (see SageConfig.inflight — wider groups
+    overcorrect; the M//8 term is calibrated by the M=32 measurement
+    where warm G=4 converges and warm G=8 stalls)."""
     G = int(config.inflight)
     if G <= 1:
         return 1
-    return max(1, min(G, M // 4))
+    return max(1, min(G, M // 4, max(2, M // 8)))
+
+
+def _inflight_widths(config: SageConfig, M: int) -> tuple[int, int]:
+    """(first-sweep width, steady width): a cold start restricts the
+    first EM sweep to _COLD_INFLIGHT (see SageConfig.inflight docs)."""
+    G = _eff_inflight(config, M)
+    G0 = G if config.inflight_warm else min(G, _COLD_INFLIGHT)
+    return G0, G
 
 
 def _pad_order(order, M: int, G: int):
@@ -473,16 +493,16 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
     total_iter = M * config.max_iter
     iter_bar = int(-(-0.8 * total_iter // M))  # ceil(0.8/M * total), host-side
 
-    G = _eff_inflight(config, M)
+    G0, G = _inflight_widths(config, M)
 
-    def em_iter(ci, carry):
+    def em_iter_width(ci, carry, Gi):
         J, xres, nerr, nuM, tk = carry
         weighted = (ci % 2 == 1) if config.randomize else jnp.asarray(False)
         last = ci == config.max_emiter - 1
         perm = _cluster_perm(ci, nerr, weighted, key, M, config)
         kci = jax.random.fold_in(key, ci)
 
-        if G == 1:
+        if Gi == 1:
             def cluster_step(cj, inner):
                 cj_eff = cj if perm is None else jnp.take(perm, cj)
                 return _cluster_update(
@@ -497,10 +517,10 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
         else:
             base = (perm if perm is not None
                     else jnp.arange(M, dtype=jnp.int32))
-            order_pad, n_groups = _pad_order(base, M, G)
+            order_pad, n_groups = _pad_order(base, M, Gi)
 
             def group_step(g, inner):
-                cjs = jax.lax.dynamic_slice(order_pad, (g * G,), (G,))
+                cjs = jax.lax.dynamic_slice(order_pad, (g * Gi,), (Gi,))
                 return _group_update(
                     cjs, inner, x8, coh, sta1, sta2, chunk_idx,
                     chunk_mask, wt_base, n_stations, config, nerr,
@@ -515,10 +535,18 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
         return J, xres, nerr, nuM, tk
 
     nuM0 = jnp.full((M,), jnp.asarray(nu0, dtype))
-    J, xres, nerr, nuM, tk = jax.lax.fori_loop(
-        0, config.max_emiter, em_iter,
-        (J0, xres0, jnp.zeros((M,), dtype), nuM0,
-         jnp.zeros((), jnp.int32)))
+    carry0 = (J0, xres0, jnp.zeros((M,), dtype), nuM0,
+              jnp.zeros((), jnp.int32))
+    if G0 == G or config.max_emiter < 1:
+        J, xres, nerr, nuM, tk = jax.lax.fori_loop(
+            0, config.max_emiter, lambda ci, c: em_iter_width(ci, c, G),
+            carry0)
+    else:
+        # cold start: first sweep at the restricted width, rest at G
+        carry0 = em_iter_width(0, carry0, G0)
+        J, xres, nerr, nuM, tk = jax.lax.fori_loop(
+            1, config.max_emiter, lambda ci, c: em_iter_width(ci, c, G),
+            carry0)
 
     mean_nu = jnp.clip(jnp.mean(nuM), config.nulow, config.nuhigh)
 
@@ -688,7 +716,10 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     # per-cluster/sweep/refine programs instead of compiling a second
     # identical set.
     fuse_mode, promote_mode = config.fuse, config.promote
-    dev_config = config._replace(max_emiter=0, fuse="auto", promote="auto")
+    dev_config = config._replace(max_emiter=0, fuse="auto", promote="auto",
+                                 inflight_warm=False)
+    # per-sweep group widths (cold-start restriction, see SageConfig)
+    G0_w, Gs_w = _inflight_widths(config, M)
 
     os_ids, os_nsub = (None, 0) if os_id is None else \
         (jnp.asarray(os_id[0]), int(os_id[1]))
@@ -739,20 +770,23 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                     jax.random.fold_in(key, 104729 + ci), M))
         else:
             order = np.arange(M)
+        # cold-start width restriction applies to the first sweep only;
+        # the device programs see the EXACT width via config.inflight
+        Gi = G0_w if ci == 0 else Gs_w
+        cfg_i = dev_config._replace(inflight=Gi)
         if fused:
             t_sweep = time.perf_counter()
             J, xres, nerr_acc, nuM, tk = _call("em_sweep", _jit_em_sweep,
                 J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                 wt_base, nerr, jnp.asarray(weighted), jnp.asarray(last),
                 kci, jnp.asarray(order, jnp.int32), os_ids,
-                n_stations, dev_config, total_iter, iter_bar, os_nsub)
+                n_stations, cfg_i, total_iter, iter_bar, os_nsub)
             tk_total = tk_total + tk
             jax.block_until_ready(J)
             sweep_times.append(time.perf_counter() - t_sweep)
         else:
             t_sweep = time.perf_counter()
             nerr_acc = jnp.zeros((M,), dtype)
-            Gi = _eff_inflight(config, M)
             if Gi == 1:
                 for cj in order:
                     J, xres, nerr_acc, nuM, tk = _call(
@@ -761,7 +795,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                         nerr_acc, nuM, x8, coh, sta1, sta2, chunk_idx,
                         chunk_mask, wt_base, nerr, jnp.asarray(weighted),
                         jnp.asarray(last), kci, None, os_ids, n_stations,
-                        dev_config, total_iter, iter_bar, os_nsub)
+                        cfg_i, total_iter, iter_bar, os_nsub)
                     tk_total = tk_total + tk
             else:
                 opad = np.concatenate(
@@ -774,7 +808,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                         nerr_acc, nuM, x8, coh, sta1, sta2, chunk_idx,
                         chunk_mask, wt_base, nerr, jnp.asarray(weighted),
                         jnp.asarray(last), kci, os_ids, n_stations,
-                        dev_config, total_iter, iter_bar, os_nsub)
+                        cfg_i, total_iter, iter_bar, os_nsub)
                     tk_total = tk_total + tk
             jax.block_until_ready(J)
             # the fused program does the same work minus dispatch overhead,
@@ -789,10 +823,16 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
 
     # promote: non-first fused sweeps are warm device executions, so
     # max_emiter of them (+ refine margin) bounds the traced program's
-    # execution time; promote only when comfortably under the kill
+    # execution time; promote only when comfortably under the kill.
+    # A cold restricted first sweep (G0 < Gs) runs ~Gs/G0 times more
+    # group dispatches than a steady sweep and the promoted program
+    # includes it — charge that extra cost or the estimate undershoots
+    # the ~60 s kill.
     warm = sweep_times[1:] if len(sweep_times) > 1 else sweep_times
+    cold_extra = (Gs_w / G0_w - 1.0) if G0_w != Gs_w else 0.0
     if (promote_mode == "auto" and warm
-            and max(warm) * (config.max_emiter + 1) < _PROMOTE_BUDGET_S):
+            and max(warm) * (config.max_emiter + 1 + cold_extra)
+            < _PROMOTE_BUDGET_S):
         _PROMOTE_CACHE[promote_key] = True
         _learned("promote", promote_key, True)
 
@@ -953,7 +993,9 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     total_iter = M * config.max_iter
     iter_bar = int(-(-0.8 * total_iter // M))
     fuse_mode, promote_mode = config.fuse, config.promote
-    dev_config = config._replace(max_emiter=0, fuse="auto", promote="auto")
+    dev_config = config._replace(max_emiter=0, fuse="auto", promote="auto",
+                                 inflight_warm=False)
+    G0_w, Gs_w = _inflight_widths(config, M)
 
     os_ids, os_nsub = (None, 0) if os_id is None else \
         (jnp.asarray(os_id[0]), int(os_id[1]))
@@ -997,19 +1039,20 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
             order = np.tile(np.arange(M), (T, 1))
         order = jnp.asarray(order, jnp.int32)
         t_sweep = time.perf_counter()
+        Gi = G0_w if ci == 0 else Gs_w      # cold-start width restriction
+        cfg_i = dev_config._replace(inflight=Gi)
         if fused:
             J, xres, nerr_acc, nuM, tk = _call(
                 "em_sweep_tiles", _jit_em_sweep_tiles,
                 J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                 wt_base, nerr, jnp.asarray(weighted), jnp.asarray(last),
-                kci, order, os_ids, n_stations, dev_config, total_iter,
+                kci, order, os_ids, n_stations, cfg_i, total_iter,
                 iter_bar, os_nsub)
             tk_total = tk_total + tk
             jax.block_until_ready(J)
             sweep_times.append(time.perf_counter() - t_sweep)
         else:
             nerr_acc = jnp.zeros((T, M), dtype)
-            Gi = _eff_inflight(config, M)
             if Gi == 1:
                 for cj in range(M):
                     J, xres, nerr_acc, nuM, tk = _call(
@@ -1017,7 +1060,7 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                         order[:, cj], J, xres, nerr_acc, nuM, x8, coh,
                         sta1, sta2, chunk_idx, chunk_mask, wt_base, nerr,
                         jnp.asarray(weighted), jnp.asarray(last), kci,
-                        os_ids, n_stations, dev_config, total_iter,
+                        os_ids, n_stations, cfg_i, total_iter,
                         iter_bar, os_nsub)
                     tk_total = tk_total + tk
             else:
@@ -1031,7 +1074,7 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                         nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                         wt_base, nerr, jnp.asarray(weighted),
                         jnp.asarray(last), kci, os_ids, n_stations,
-                        dev_config, total_iter, iter_bar, os_nsub)
+                        cfg_i, total_iter, iter_bar, os_nsub)
                     tk_total = tk_total + tk
             jax.block_until_ready(J)
             if fuse_mode == "auto":
@@ -1043,8 +1086,12 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                          nerr_acc)
 
     warm = sweep_times[1:] if len(sweep_times) > 1 else sweep_times
+    # charge the cold restricted first sweep's extra dispatches (see
+    # the sagefit_host promote comment)
+    cold_extra = (Gs_w / G0_w - 1.0) if G0_w != Gs_w else 0.0
     if (promote_mode == "auto" and warm
-            and max(warm) * (config.max_emiter + 1) < _PROMOTE_BUDGET_S):
+            and max(warm) * (config.max_emiter + 1 + cold_extra)
+            < _PROMOTE_BUDGET_S):
         _PROMOTE_CACHE[promote_key] = True
         _learned("promote", promote_key, True)
 

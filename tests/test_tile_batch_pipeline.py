@@ -158,3 +158,56 @@ def test_solve_knobs_force_modes():
     for key, (J, r, _) in results.items():
         np.testing.assert_allclose(J, J_ref, atol=1e-6)
         np.testing.assert_allclose(r, r_ref, rtol=1e-6)
+
+
+def test_tile_batch_beam_path(tmp_path):
+    """VERDICT r5 item 7: the beam path batches too — per-tile beam
+    tables are a gmst leading axis. Batched beam residuals track the
+    sequential beam run tile for tile."""
+    sky_path = tmp_path / "sky.txt"
+    sky_path.write_text(SKY)
+    clus_path = tmp_path / "sky.txt.cluster"
+    clus_path.write_text(CLUSTER)
+    ra0 = (0 + 41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(sky_path), ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(str(clus_path)))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jtrue = ds.random_jones(sky.n_clusters, sky.nchunk, 10, seed=2,
+                            scale=0.2)
+    # distinct per-tile epochs: the gmst rows of the stacked beam axis
+    # must actually differ, or a wrong-row slice would go undetected
+    tiles = [ds.simulate_dataset(dsky, n_stations=10, tilesz=4,
+                                 freqs=[150e6], ra0=ra0, dec0=dec0,
+                                 jones=Jtrue, nchunk=sky.nchunk,
+                                 noise_sigma=0.02, seed=40 + i,
+                                 start_mjd_s=4.93e9 + i * 160.0)
+             for i in range(3)]
+    hists = []
+    for tag, extra in (("seqB", ["-B", "1"]),
+                       ("batB", ["-B", "1", "--tile-batch", "2"])):
+        msdir = str(tmp_path / f"{tag}.ms")
+        ds.SimMS.create(msdir, tiles)
+        h, _ = _run(tmp_path, msdir, str(sky_path), str(clus_path), extra,
+                    f"sol_{tag}.txt")
+        hists.append(h)
+    seq, bat = hists
+    assert len(seq) == len(bat) == 3
+    for h in bat:
+        assert np.isfinite(h["res_1"]) and h["res_1"] < h["res_0"]
+    # tile 0 runs solo in both drivers with identical inputs (incl. the
+    # per-tile beam tables)
+    np.testing.assert_allclose(bat[0]["res_1"], seq[0]["res_1"],
+                               rtol=1e-6)
+    # tile 1: both drivers warm-start from tile 0's solution, so the
+    # BATCHED beam program must reproduce the solo beam solve — this is
+    # the gmst-axis staging correctness gate (measured: exact)
+    np.testing.assert_allclose(bat[1]["res_0"], seq[1]["res_0"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(bat[1]["res_1"], seq[1]["res_1"],
+                               rtol=1e-5)
+    # tile 2 differs only by the documented batch-granular warm start
+    # (batch enters from tile 0's solution, sequential from tile 1's);
+    # quality must stay in the same regime
+    assert bat[2]["res_1"] < 2.5 * seq[2]["res_1"] + 1e-6

@@ -145,6 +145,8 @@ def main():
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
                             stderr=subprocess.STDOUT, text=True, env=env)
     per_tile_iters = []
+    residuals = []          # (initial, final) mean residual per tile —
+    # the G=1 vs --inflight parity evidence (VERDICT r5 item 2)
     platform = "cpu" if args.cpu else "unknown"
     for line in proc.stdout:
         print(line, end="", flush=True)
@@ -155,6 +157,10 @@ def main():
         if m:
             per_tile_iters.append(
                 [float(x[:-1]) for x in m.group(1).split()])
+        rm = re.match(r"Timeslot:\d+ ADMM:\d+ residual "
+                      r"initial=([0-9.e+-]+) final=([0-9.e+-]+)", line)
+        if rm:
+            residuals.append([float(rm.group(1)), float(rm.group(2))])
     rc = proc.wait()
     wall = time.time() - t0
     if rc != 0:
@@ -173,6 +179,7 @@ def main():
     rec = {"metric": "ADMM wall-clock/iter (north-star shape)",
            "value": round(per_iter, 3), "unit": "s/ADMM-iter",
            "shape": shape, "per_tile_iters": per_tile_iters,
+           "residuals": residuals, "inflight": args.inflight,
            "total_wall_s": round(wall, 1), "platform": platform}
     with open(os.path.join(HERE, "NORTHSTAR.json"), "w") as f:
         json.dump(rec, f, indent=1)

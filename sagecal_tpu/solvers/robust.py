@@ -39,7 +39,9 @@ def update_nu_ml(w, mask, nu_old, nulow=2.0, nuhigh=30.0, nd: int = 30):
          - jnp.log((nus + 1.0) * 0.5)
          - jax.scipy.special.digamma(nus * 0.5) + jnp.log(nus * 0.5)
          - sumq + 1.0)
-    return nus[jnp.argmin(jnp.abs(q))]
+    # the grid is built at default precision; return in the caller's nu
+    # dtype so IRLS scan carries stay type-stable (f32 data under x64)
+    return nus[jnp.argmin(jnp.abs(q))].astype(jnp.asarray(nu_old).dtype)
 
 
 def mean_logsumw(w, mask):
@@ -62,7 +64,8 @@ def update_nu_aecm(logsumw, nu_old, p: int = 8, nulow=2.0, nuhigh=30.0,
     nus = nu_grid(nulow, nuhigh, nd)
     q = (-jax.scipy.special.digamma(nus * 0.5) + jnp.log(nus * 0.5)
          - (-logsumw - dgm) + 1.0)
-    return nus[jnp.argmin(jnp.abs(q))]
+    # dtype-stable for scan carries, like update_nu_ml
+    return nus[jnp.argmin(jnp.abs(q))].astype(jnp.asarray(nu_old).dtype)
 
 
 def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,

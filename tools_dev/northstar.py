@@ -158,8 +158,10 @@ def main():
             per_tile_iters.append(
                 [float(x[:-1]) for x in m.group(1).split()])
         rm = re.match(r"Timeslot:\d+ ADMM:\d+ residual "
-                      r"initial=([0-9.e+-]+) final=([0-9.e+-]+)", line)
+                      r"initial=(\S+) final=(\S+)", line)
         if rm:
+            # float() handles nan/inf too — divergence is exactly the
+            # evidence the parity record must not drop
             residuals.append([float(rm.group(1)), float(rm.group(2))])
     rc = proc.wait()
     wall = time.time() - t0

@@ -1008,9 +1008,14 @@ def write_table(results, platform, date=None):
         f"Device platform: **{platform}**  |  dtype f32  |  "
         f"date {date}",
         "",
-        "MFU≥ = achieved FLOP/s vs bf16 peak, from XLA cost analysis of "
-        "every device program a timed step executed; loop bodies price "
-        "once regardless of trip count, so it is a lower bound.",
+        "MFU≥ = achieved FLOP/s vs bf16 peak. FLOPs = XLA cost analysis "
+        "of every device program a timed step executed PLUS the "
+        "dynamic-trip correction: the solvers report executed "
+        "iteration counts and one iteration of each solver family is "
+        "priced by lowering its component functions at the solve "
+        "shapes (see bench.py's MFU trip-accounting block). Remaining "
+        "slack is lower-bound-leaning: line-search evaluations beyond "
+        "1/iter and per-IRLS-round E-steps are uncounted.",
         "",
         "| config | value | unit | res_0 -> res_1 | step | compile | "
         "GFLOP/s | MFU≥ | shape |",

@@ -482,7 +482,8 @@ def pallas_ok(device, dtype, sky) -> bool:
 
 
 def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
-              max_emiter=3, max_iter=10, max_lbfgs=10, use_pallas=False):
+              max_emiter=3, max_iter=10, max_lbfgs=10, use_pallas=False,
+              inflight=1):
     """Compile + time one batched SAGE solve over ``tiles`` independent
     solve intervals; returns (vis/s, r0, r1, dt, compile_s, flops_step).
 
@@ -491,8 +492,12 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
     bounded device execution — the tile axis is what keeps the MXU fed
     (VERDICT r3 item 1); per-execution wall-clock stays under the
     tunneled chip's ~60 s kill via the same fusion/promotion machinery.
-    Residual figures are tile 0's, which solves identically to the
-    historical single-tile bench (sage.tile_keys keeps its PRNG stream).
+    Residual figures are tile 0's. With ``inflight`` == 1 tile 0 solves
+    identically to the historical single-tile bench (sage.tile_keys
+    keeps its PRNG stream); with groups active (the round-5 TPU default
+    G=2) the EM sweep semantics change (block-Jacobi groups), so
+    res_0/res_1 are NOT bit-comparable with the BENCH_r01..r04 records
+    — the shape string's G tag marks which regime a record is from.
 
     ``flops_step``: achieved FLOPs of one timed step = XLA cost analysis
     over every device program the step executed (sage.program_stats) PLUS
@@ -513,7 +518,8 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
     dsky_d = jax.device_put(dsky, device)
     os_ids, ns = lm_mod.os_subset_ids(tile.tilesz, tile.nbase)
     cfg = sage.SageConfig(max_emiter=max_emiter, max_iter=max_iter,
-                          max_lbfgs=max_lbfgs, solver_mode=int(solver_mode))
+                          max_lbfgs=max_lbfgs, solver_mode=int(solver_mode),
+                          inflight=inflight)
     n = tile.n_stations
     cidx_d, cmask_d, freq = inp["cidx"], inp["cmask"], inp["freq"]
     os_d = (jax.device_put(jnp_i32(os_ids), device), ns)
@@ -622,14 +628,30 @@ def jnp_i32(a):
 # configs
 # ---------------------------------------------------------------------------
 
-def _tiles_for(device, default: int) -> int:
-    """Tile-batch width: env override, else ``default`` on TPU and 1 on
-    the (single-core) CPU fallback, where batching just multiplies
+def _env_or_tpu_default(env_name: str, device, default: int) -> int:
+    """Env-int override, else ``default`` on TPU and 1 on the
+    (single-core) CPU fallback, where batching just multiplies
     wall-clock."""
-    envv = int(os.environ.get("SAGECAL_BENCH_TILES", 0))
+    envv = int(os.environ.get(env_name, 0) or 0)
     if envv:
         return envv
     return default if device.platform == "tpu" else 1
+
+
+def _tiles_for(device, default: int) -> int:
+    """Tile-batch width (SAGECAL_BENCH_TILES override)."""
+    return _env_or_tpu_default("SAGECAL_BENCH_TILES", device, default)
+
+
+def _inflight_for(device, M: int, default: int = 2) -> tuple[int, int]:
+    """(requested, effective) --inflight group width for the SAGE
+    configs (SAGECAL_BENCH_INFLIGHT override; default 2 on TPU — the
+    VERDICT r5 item-1 lever). The EFFECTIVE width after the solver's
+    clamp is what the record must say: attributing clamped-G numbers to
+    the requested G would make wider groups look free."""
+    from sagecal_tpu.solvers import sage
+    G = _env_or_tpu_default("SAGECAL_BENCH_INFLIGHT", device, default)
+    return G, sage._eff_inflight(sage.SageConfig(inflight=G), M)
 
 
 def _mfu_fields(out, device, flops_step, dt):
@@ -649,20 +671,22 @@ def config1_fullbatch_lm(device, dtype):
     (kernel-on/off throughput both recorded)."""
     from sagecal_tpu.config import SolverMode
     T = _tiles_for(device, 8)
+    G, Ge = _inflight_for(device, 8)
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=8,
                                        tilesz=10, n_tiles=T)
     pal = pallas_ok(device, dtype, sky)
     vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
                                           SolverMode.OSLM_OSRLM_RLBFGS,
-                                          use_pallas=pal)
+                                          use_pallas=pal, inflight=G)
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, pallas=pal, tiles=T,
-               shape=f"N=62 M=8 tilesz=10 point -j3 T{T}")
+               inflight=G, inflight_eff=Ge,
+               shape=f"N=62 M=8 tilesz=10 point -j3 T{T} G{Ge}")
     _mfu_fields(out, device, fl, dt)
     if pal:
         vps0, _, _, _, _, _ = time_sage(device, dtype, sky, dsky, tiles,
                                         SolverMode.OSLM_OSRLM_RLBFGS,
-                                        use_pallas=False)
+                                        use_pallas=False, inflight=G)
         out["value_xla"] = vps0
         out["pallas_speedup"] = vps / vps0
     return out
@@ -840,16 +864,19 @@ def config3_rtr16(device, dtype):
     on_tpu = device.platform == "tpu"
     emi = 2 if on_tpu else 1
     T = _tiles_for(device, 4)
+    G, Ge = _inflight_for(device, 16)
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=16,
                                        tilesz=10, seed=SEED + 10,
                                        n_tiles=T)
     vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
                                           SolverMode.RTR_OSRLM_RLBFGS,
-                                          reps=1, max_emiter=emi)
+                                          reps=1, max_emiter=emi,
+                                          inflight=G)
     small = "" if on_tpu else " (cpu-small E1)"
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
-               step_s=dt, compile_s=comp, tiles=T,
-               shape=f"N=62 M=16 tilesz=10 point -j5 T{T}{small}")
+               step_s=dt, compile_s=comp, tiles=T, inflight=G,
+               inflight_eff=Ge,
+               shape=f"N=62 M=16 tilesz=10 point -j5 T{T} G{Ge}{small}")
     return _mfu_fields(out, device, fl, dt)
 
 
@@ -862,6 +889,7 @@ def config4_extended(device, dtype):
     on_tpu = device.platform == "tpu"
     emi = 2 if on_tpu else 1      # CPU fallback: budget, see config 3
     T = _tiles_for(device, 4)
+    G, Ge = _inflight_for(device, 8)
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=64, n_clusters=8,
                                        tilesz=10, extended=True,
                                        spectra3=True, seed=SEED + 20,
@@ -870,17 +898,18 @@ def config4_extended(device, dtype):
     vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
                                           SolverMode.RTR_OSRLM_RLBFGS,
                                           reps=1, max_emiter=emi,
-                                          use_pallas=pal)
+                                          use_pallas=pal, inflight=G)
     small = "" if on_tpu else " (cpu-small E1)"
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, pallas=pal, tiles=T,
-               shape=f"N=64 M=8 shapelet+gauss -F1 -j5 T{T}{small}")
+               inflight=G, inflight_eff=Ge,
+               shape=f"N=64 M=8 shapelet+gauss -F1 -j5 T{T} G{Ge}{small}")
     _mfu_fields(out, device, fl, dt)
     if pal:
         vps0, _, _, _, _, _ = time_sage(device, dtype, sky, dsky, tiles,
                                         SolverMode.RTR_OSRLM_RLBFGS,
-                                        reps=1, max_emiter=2,
-                                        use_pallas=False)
+                                        reps=1, max_emiter=emi,
+                                        use_pallas=False, inflight=G)
         out["value_xla"] = vps0
         out["pallas_speedup"] = vps / vps0
     return out

@@ -1,0 +1,49 @@
+#!/bin/bash
+# Chip-wake playbook (VERDICT r5 items 1+2): the moment the tunneled TPU
+# answers, bank the on-chip evidence in this order — the tunnel goes
+# through multi-hour dead phases, so the record must land on the FIRST
+# healthy window, not after iterating.
+#
+#   1. full bench on the chip  -> BENCH_TPU_r05.json + commit
+#   2. north-star at --inflight 4 (warm ADMM iterations use the group
+#      width; the G=1 baseline is the committed NORTHSTAR.json at
+#      114.045 s/iter) -> NORTHSTAR.json + commit
+#
+# Usage: bash tools_dev/tpu_wake.sh   (from the repo root)
+set -e
+cd "$(dirname "$0")/.."
+
+echo "== probe =="
+timeout 75 python -c "import jax; print('PLATFORM='+jax.devices()[0].platform)" \
+    | grep -q "PLATFORM=tpu" || { echo "chip not answering; abort"; exit 1; }
+python - <<'PY'
+import json, time
+json.dump({"tpu": True, "ts": time.time()},
+          open(".bench_probe_cache.json", "w"))
+PY
+
+echo "== full bench on chip =="
+timeout 1750 python bench.py || true
+python - <<'PY'
+import json, shutil
+with open("bench_results.json") as f:
+    br = json.load(f)
+ok = sum(1 for r in br["results"].values() if "error" not in r)
+tpu = sum(1 for r in br["results"].values()
+          if r.get("platform") == "tpu")
+print(f"configs ok={ok} on-tpu={tpu}")
+if tpu >= 1:
+    shutil.copy("bench_results.json", "BENCH_TPU_r05.json")
+    print("banked BENCH_TPU_r05.json")
+PY
+if [ -f BENCH_TPU_r05.json ]; then
+    git add BENCH_TPU_r05.json BENCH_TABLE.md bench_results.json
+    git commit -m "Archive the round-5 healthy-chip TPU bench record"
+fi
+
+echo "== north-star with inflight 4 =="
+timeout 3000 python tools_dev/northstar.py --inflight 4 || exit 0
+git add NORTHSTAR.json BENCH_TABLE.md
+git commit -m "North-star re-run on chip with --inflight 4"
+echo "done; compare NORTHSTAR.json value vs the 114.045 baseline and"
+echo "residuals vs the G=1 run's before pushing further (G=8, tiles)."

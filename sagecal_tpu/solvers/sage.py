@@ -269,7 +269,9 @@ def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                     total_iter: int, iter_bar: int):
     """Visit one cluster: add model back to residual, solve, re-subtract
     (lmfit.c:890-981). ``state`` = (J, xres, nerr_acc, nuM, tk) with
-    ``tk`` the running executed-iteration count (MFU accounting)."""
+    ``tk`` an i32[2] counter pair: [0] executed inner-solver iterations
+    (MFU accounting), [1] rejected group steps (always 0 here — only
+    :func:`_group_update` can reject)."""
     J, xres, nerr_acc, nuM, tk = state
     mode = int(config.solver_mode)
     coh_m = jnp.take(coh, cj, axis=0)
@@ -311,13 +313,13 @@ def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     nerr_acc = nerr_acc.at[cj].set(dcost)
     xres = xdummy - _model8(Jn, coh_m, sta1, sta2, cidx_m)
     J = J.at[cj].set(Jn)
-    return J, xres, nerr_acc, nuM, tk + its
+    return J, xres, nerr_acc, nuM, tk.at[0].add(its)
 
 
 def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                   wt_base, n_stations: int, config: SageConfig,
                   nerr_prev, weighted, last, key, admm, os_id,
-                  total_iter: int, iter_bar: int):
+                  total_iter: int, iter_bar: int, res_anchor=None):
     """Visit a GROUP of clusters concurrently (config.inflight > 1).
 
     ``cjs`` [G] holds distinct cluster indices; padded slots carry the
@@ -326,6 +328,20 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     masked. Every member's solve sees the residual AS OF GROUP ENTRY
     (block-Jacobi); the group's model deltas then apply jointly:
     xres += sum_g (model(J_old_g) - model(J_new_g)).
+
+    Group-step safeguard: the joint update is REJECTED (state kept,
+    group becomes a no-op, tk[1] incremented) when it increases the
+    weighted residual L2 — strictly vs the entering value, OR past 5%
+    above ``res_anchor`` (the SWEEP-entry residual). The anchor keeps
+    the slack from compounding: per-step relative slack alone would
+    admit exponential growth at 1.05/step. Measured without the guard:
+    overlapping clusters make joint updates overcorrect — warm G=8 at
+    M=64 grows the residual 70x over one EM sweep while per-lane solves
+    all report cost decreases (each lane's decrease is against the
+    ENTRY residual; summed deltas double-subtract shared flux). The
+    test is plain weighted L2 (cheap, mode-independent); robust/ADMM
+    modes may legitimately trade a few percent of L2 for their own
+    cost decrease, hence the anchored slack.
     """
     J, xres, nerr_acc, nuM, tk = state
     M = chunk_mask.shape[0]
@@ -367,22 +383,32 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
 
     Jn_g, nu_g, ic_g, fc_g, delta_g, its_g = jax.vmap(solve_one)(cjs)
     vm = valid.astype(xres.dtype)
-    xres = xres + jnp.einsum("g,gbx->bx", vm, delta_g)
+    xres_new = xres + jnp.einsum("g,gbx->bx", vm, delta_g)
+    res_old = jnp.sum((xres * wt_base) ** 2)
+    res_new = jnp.sum((xres_new * wt_base) ** 2)
+    anchor = res_old if res_anchor is None else res_anchor
+    accept = (res_new <= res_old * (1.0 + 1e-9)) \
+        | (res_new <= 1.05 * anchor)
     init_res = jnp.sum(ic_g, axis=-1)
     final_res = jnp.sum(fc_g, axis=-1)
     dcost = jnp.where(init_res > 0,
                       jnp.maximum((init_res - final_res)
                                   / jnp.maximum(init_res, 1e-30), 0.0),
                       0.0)
-    # padded indices (cjs == M) are dropped by the scatters
-    nerr_acc = nerr_acc.at[cjs].set(dcost)
-    nuM = nuM.at[cjs].set(nu_g)
-    J = J.at[cjs].set(Jn_g)
-    # useful-work iteration count: sum over live lanes (a lower bound on
-    # executed trips — the G-wide batched loop runs until its slowest
-    # lane finishes)
-    return (J, xres, nerr_acc, nuM,
-            tk + jnp.sum(jnp.where(valid, its_g, 0)).astype(jnp.int32))
+    # padded indices (cjs == M) are dropped by the scatters; a rejected
+    # group keeps the entering state entirely
+    nerr_acc = jnp.where(accept, nerr_acc.at[cjs].set(dcost), nerr_acc)
+    nuM = jnp.where(accept, nuM.at[cjs].set(nu_g), nuM)
+    J = jnp.where(accept, J.at[cjs].set(Jn_g), J)
+    xres = jnp.where(accept, xres_new, xres)
+    # tk[0]: useful-work iterations, summed over live lanes (a lower
+    # bound on executed trips — the G-wide batched loop runs until its
+    # slowest lane finishes; rejected groups still executed them).
+    # tk[1]: rejected group steps — the observability hook for "groups
+    # are all vetoing" (info['rejected_groups']).
+    tk = tk.at[0].add(jnp.sum(jnp.where(valid, its_g, 0)).astype(jnp.int32))
+    tk = tk.at[1].add((~accept).astype(jnp.int32))
+    return J, xres, nerr_acc, nuM, tk
 
 
 _COLD_INFLIGHT = 2      # widest group proven safe from an identity start
@@ -518,6 +544,8 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
             base = (perm if perm is not None
                     else jnp.arange(M, dtype=jnp.int32))
             order_pad, n_groups = _pad_order(base, M, Gi)
+            # sweep-entry anchor for the group-step safeguard
+            anchor = jnp.sum((xres * wt_base) ** 2)
 
             def group_step(g, inner):
                 cjs = jax.lax.dynamic_slice(order_pad, (g * Gi,), (Gi,))
@@ -525,7 +553,7 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
                     cjs, inner, x8, coh, sta1, sta2, chunk_idx,
                     chunk_mask, wt_base, n_stations, config, nerr,
                     weighted, last, kci, admm, os_id, total_iter,
-                    iter_bar)
+                    iter_bar, res_anchor=anchor)
 
             J, xres, nerr_new, nuM, tk = jax.lax.fori_loop(
                 0, n_groups, group_step, (J, xres, jnp.zeros((M,), dtype),
@@ -536,7 +564,7 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
 
     nuM0 = jnp.full((M,), jnp.asarray(nu0, dtype))
     carry0 = (J0, xres0, jnp.zeros((M,), dtype), nuM0,
-              jnp.zeros((), jnp.int32))
+              jnp.zeros((2,), jnp.int32))
     if G0 == G or config.max_emiter < 1:
         J, xres, nerr, nuM, tk = jax.lax.fori_loop(
             0, config.max_emiter, lambda ci, c: em_iter_width(ci, c, G),
@@ -570,7 +598,8 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
     xres_f = x8 - full_model8(J, coh, sta1, sta2, chunk_idx)
     res_1 = jnp.linalg.norm(xres_f * wt_base) / n
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
-               "nerr": nerr, "solver_iters": tk, "lbfgs_iters": lbfgs_k}
+               "nerr": nerr, "solver_iters": tk[0],
+               "rejected_groups": tk[1], "lbfgs_iters": lbfgs_k}
 
 
 # ---------------------------------------------------------------------------
@@ -586,7 +615,7 @@ def _jit_cluster_update(cj, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
                         total_iter, iter_bar, os_nsub):
     os_id = None if os_ids is None else (os_ids, os_nsub)
     return _cluster_update(cj, (J, xres, nerr_acc, nuM,
-                                jnp.zeros((), jnp.int32)),
+                                jnp.zeros((2,), jnp.int32)),
                            x8, coh, sta1,
                            sta2, chunk_idx, chunk_mask, wt_base, n_stations,
                            config, nerr_prev, weighted, last, key, admm,
@@ -599,16 +628,19 @@ def _jit_cluster_update(cj, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
 def _jit_group_update(cjs, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
                       chunk_idx, chunk_mask, wt_base, nerr_prev, weighted,
                       last, key, os_ids, n_stations, config, total_iter,
-                      iter_bar, os_nsub):
+                      iter_bar, os_nsub, res_anchor):
     """One in-flight GROUP of cluster solves as a bounded execution
-    (config.inflight > 1 on the unfused host path)."""
+    (config.inflight > 1 on the unfused host path). ``res_anchor`` is
+    the sweep-entry weighted residual L2 (host-computed) for the
+    group-step safeguard."""
     os_id = None if os_ids is None else (os_ids, os_nsub)
     return _group_update(cjs, (J, xres, nerr_acc, nuM,
-                               jnp.zeros((), jnp.int32)),
+                               jnp.zeros((2,), jnp.int32)),
                          x8, coh, sta1,
                          sta2, chunk_idx, chunk_mask, wt_base, n_stations,
                          config, nerr_prev, weighted, last, key, None,
-                         os_id, total_iter, iter_bar)
+                         os_id, total_iter, iter_bar,
+                         res_anchor=res_anchor)
 
 
 @functools.partial(jax.jit,
@@ -636,21 +668,22 @@ def _jit_em_sweep(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
         return jax.lax.fori_loop(
             0, M, cluster_step,
             (J, xres, jnp.zeros((M,), x8.dtype), nuM,
-             jnp.zeros((), jnp.int32)))
+             jnp.zeros((2,), jnp.int32)))
 
     order_pad, n_groups = _pad_order(perm, M, G)
+    anchor = jnp.sum((xres * wt_base) ** 2)   # sweep-entry safeguard ref
 
     def group_step(g, inner):
         cjs = jax.lax.dynamic_slice(order_pad, (g * G,), (G,))
         return _group_update(cjs, inner, x8, coh, sta1, sta2, chunk_idx,
                              chunk_mask, wt_base, n_stations, config,
                              nerr_prev, weighted, last, kci, None, os_id,
-                             total_iter, iter_bar)
+                             total_iter, iter_bar, res_anchor=anchor)
 
     return jax.lax.fori_loop(
         0, n_groups, group_step,
         (J, xres, jnp.zeros((M,), x8.dtype), nuM,
-         jnp.zeros((), jnp.int32)))
+         jnp.zeros((2,), jnp.int32)))
 
 
 @jax.jit
@@ -685,6 +718,18 @@ def _jit_res(x8, coh, sta1, sta2, chunk_idx, J, wt_base):
     return jnp.linalg.norm(
         (x8 - full_model8(J, coh, sta1, sta2, chunk_idx)) * wt_base) \
         / (x8.shape[0] * 8)
+
+
+@jax.jit
+def _jit_wres2(xres, wt_base):
+    """Weighted residual L2^2 — the sweep-entry anchor the host group
+    path feeds the group-step safeguard."""
+    return jnp.sum((xres * wt_base) ** 2)
+
+
+@jax.jit
+def _jit_wres2_tiles(xres, wt_base):
+    return jax.vmap(lambda x, w: jnp.sum((x * w) ** 2))(xres, wt_base)
 
 
 def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
@@ -757,7 +802,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     fused = (fuse_mode == "on" or
              (fuse_mode == "auto" and _FUSION_CACHE.get(fuse_key, False)))
     sweep_times: list = []
-    tk_total = jnp.zeros((), jnp.int32)
+    tk_total = jnp.zeros((2,), jnp.int32)
     for ci in range(config.max_emiter):
         weighted = config.randomize and (ci % 2 == 1)
         last = ci == config.max_emiter - 1
@@ -801,6 +846,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                 opad = np.concatenate(
                     [np.asarray(order),
                      np.full((-(-M // Gi)) * Gi - M, M)]).astype(np.int32)
+                anchor = _call("wres2", _jit_wres2, xres, wt_base)
                 for g in range(len(opad) // Gi):
                     J, xres, nerr_acc, nuM, tk = _call(
                         "group_update", _jit_group_update,
@@ -808,7 +854,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                         nerr_acc, nuM, x8, coh, sta1, sta2, chunk_idx,
                         chunk_mask, wt_base, nerr, jnp.asarray(weighted),
                         jnp.asarray(last), kci, os_ids, n_stations,
-                        cfg_i, total_iter, iter_bar, os_nsub)
+                        cfg_i, total_iter, iter_bar, os_nsub, anchor)
                     tk_total = tk_total + tk
             jax.block_until_ready(J)
             # the fused program does the same work minus dispatch overhead,
@@ -846,8 +892,8 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
         res_1 = _call("res", _jit_res, x8, coh, sta1, sta2, chunk_idx, J,
                       wt_base)
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
-               "nerr": nerr, "solver_iters": tk_total,
-               "lbfgs_iters": lbfgs_k}
+               "nerr": nerr, "solver_iters": tk_total[0],
+               "rejected_groups": tk_total[1], "lbfgs_iters": lbfgs_k}
 
 
 # ---------------------------------------------------------------------------
@@ -908,20 +954,22 @@ def _jit_em_sweep_tiles(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx,
             return jax.lax.fori_loop(
                 0, M, cluster_step,
                 (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t,
-                 jnp.zeros((), jnp.int32)))
+                 jnp.zeros((2,), jnp.int32)))
 
         order_pad, n_groups = _pad_order(perm_t, M, G)
+        anchor = jnp.sum((xres_t * wt_t) ** 2)   # per-tile sweep anchor
 
         def group_step(g, inner):
             cjs = jax.lax.dynamic_slice(order_pad, (g * G,), (G,))
             return _group_update(cjs, inner, x8_t, coh_t, sta1, sta2,
                                  chunk_idx, chunk_mask, wt_t, n_stations,
                                  config, nerr_t, weighted, last, key_t,
-                                 None, os_id, total_iter, iter_bar)
+                                 None, os_id, total_iter, iter_bar,
+                                 res_anchor=anchor)
         return jax.lax.fori_loop(
             0, n_groups, group_step,
             (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t,
-             jnp.zeros((), jnp.int32)))
+             jnp.zeros((2,), jnp.int32)))
     return jax.vmap(one)(J, xres, nuM, x8, coh, wt_base, nerr_prev, keys,
                          perm)
 
@@ -1022,7 +1070,7 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     fused = (fuse_mode == "on" or
              (fuse_mode == "auto" and _FUSION_CACHE.get(fuse_key, False)))
     sweep_times: list = []
-    tk_total = jnp.zeros((T,), jnp.int32)
+    tk_total = jnp.zeros((T, 2), jnp.int32)
     for ci in range(config.max_emiter):
         weighted = config.randomize and (ci % 2 == 1)
         last = ci == config.max_emiter - 1
@@ -1067,6 +1115,8 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                 pad = (-(-M // Gi)) * Gi - M
                 opad = jnp.concatenate(
                     [order, jnp.full((T, pad), M, order.dtype)], axis=1)
+                anchor = _call("wres2_tiles", _jit_wres2_tiles, xres,
+                               wt_base)
                 for g in range(opad.shape[1] // Gi):
                     J, xres, nerr_acc, nuM, tk = _call(
                         "group_update_tiles", _jit_group_update_tiles,
@@ -1074,7 +1124,7 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                         nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                         wt_base, nerr, jnp.asarray(weighted),
                         jnp.asarray(last), kci, os_ids, n_stations,
-                        cfg_i, total_iter, iter_bar, os_nsub)
+                        cfg_i, total_iter, iter_bar, os_nsub, anchor)
                     tk_total = tk_total + tk
             jax.block_until_ready(J)
             if fuse_mode == "auto":
@@ -1105,7 +1155,8 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
         res_1 = _call("res_tiles", _jit_res_tiles, x8, coh, sta1, sta2,
                       chunk_idx, J, wt_base)
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
-               "nerr": nerr, "solver_iters": tk_total,
+               "nerr": nerr, "solver_iters": tk_total[:, 0],
+               "rejected_groups": tk_total[:, 1],
                "lbfgs_iters": lbfgs_k}
 
 
@@ -1123,7 +1174,7 @@ def _jit_cluster_update_tiles(cj, J, xres, nerr_acc, nuM, x8, coh, sta1,
             nerr_t, key_t):
         os_id = None if os_ids is None else (os_ids, os_nsub)
         return _cluster_update(cj_t, (J_t, xres_t, nerr_acc_t, nuM_t,
-                                      jnp.zeros((), jnp.int32)),
+                                      jnp.zeros((2,), jnp.int32)),
                                x8_t, coh_t, sta1, sta2, chunk_idx,
                                chunk_mask, wt_t, n_stations, config,
                                nerr_t, weighted, last, key_t, None, os_id,
@@ -1139,20 +1190,21 @@ def _jit_group_update_tiles(cjs, J, xres, nerr_acc, nuM, x8, coh, sta1,
                             sta2, chunk_idx, chunk_mask, wt_base,
                             nerr_prev, weighted, last, keys, os_ids,
                             n_stations, config, total_iter, iter_bar,
-                            os_nsub):
+                            os_nsub, res_anchor):
     """Vmapped :func:`_jit_group_update`: one in-flight group visit
-    (per-tile index rows ``cjs`` [T, G]) across all tiles."""
+    (per-tile index rows ``cjs`` [T, G]) across all tiles;
+    ``res_anchor`` [T] carries each tile's sweep-entry safeguard ref."""
     def one(cjs_t, J_t, xres_t, na_t, nuM_t, x8_t, coh_t, wt_t, nerr_t,
-            key_t):
+            key_t, anch_t):
         os_id = None if os_ids is None else (os_ids, os_nsub)
         return _group_update(cjs_t, (J_t, xres_t, na_t, nuM_t,
-                                     jnp.zeros((), jnp.int32)), x8_t,
+                                     jnp.zeros((2,), jnp.int32)), x8_t,
                              coh_t, sta1, sta2, chunk_idx, chunk_mask,
                              wt_t, n_stations, config, nerr_t, weighted,
                              last, key_t, None, os_id, total_iter,
-                             iter_bar)
+                             iter_bar, res_anchor=anch_t)
     return jax.vmap(one)(cjs, J, xres, nerr_acc, nuM, x8, coh, wt_base,
-                         nerr_prev, keys)
+                         nerr_prev, keys, res_anchor)
 
 
 def bfgsfit(x8, coh, sta1, sta2, chunk_idx, J0, n_stations: int,

@@ -138,14 +138,17 @@ class SageConfig(NamedTuple):
     # stays exact (group updates sum model deltas against one base
     # residual), but simultaneous updates overcorrect when a large
     # fraction of clusters move at once (measured: G=M diverges on a
-    # cold start), so the EFFECTIVE width is clamped (see _eff_inflight;
-    # the M >> G regime this exists for is north-star M=100 with G=4..8)
-    # and a COLD start additionally restricts the first EM sweep to
-    # width <= 2 — measured at M=32: G>=4 from identity Jones diverges
-    # (residual grows 10x+) while G=2 tracks sequential, and G=4 from a
-    # one-sweep warm start converges fine. Callers whose J0 is already
-    # near a solution (pipeline warm tiles, ADMM iterations > 0) set
-    # inflight_warm=True to skip the restriction.
+    # cold start). Three protections stack: the EFFECTIVE width is
+    # clamped (_eff_inflight; the M >> G regime this exists for is
+    # north-star M=100 with G=4..8); a COLD start restricts the first
+    # EM sweep to width <= 2 (measured at M=32: G>=4 from identity
+    # Jones diverges while G=2 tracks sequential); and every group step
+    # is a DAMPED trial — omega in (1, 1/2, 1/4), first safe step wins,
+    # else no-op (see _group_update; measured at M=64 warm: G=4 lands
+    # within 4% of sequential over 3 sweeps with zero rejections, G=8
+    # converges where undamped rejection stalls). Callers whose J0 is
+    # already near a solution (pipeline warm tiles, ADMM iterations
+    # > 0) set inflight_warm=True to skip the cold restriction.
     inflight: int = 1
     inflight_warm: bool = False
 
@@ -329,19 +332,28 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     (block-Jacobi); the group's model deltas then apply jointly:
     xres += sum_g (model(J_old_g) - model(J_new_g)).
 
-    Group-step safeguard: the joint update is REJECTED (state kept,
-    group becomes a no-op, tk[1] incremented) when it increases the
-    weighted residual L2 — strictly vs the entering value, OR past 5%
-    above ``res_anchor`` (the SWEEP-entry residual). The anchor keeps
-    the slack from compounding: per-step relative slack alone would
-    admit exponential growth at 1.05/step. Measured without the guard:
-    overlapping clusters make joint updates overcorrect — warm G=8 at
-    M=64 grows the residual 70x over one EM sweep while per-lane solves
-    all report cost decreases (each lane's decrease is against the
-    ENTRY residual; summed deltas double-subtract shared flux). The
-    test is plain weighted L2 (cheap, mode-independent); robust/ADMM
-    modes may legitimately trade a few percent of L2 for their own
-    cost decrease, hence the anchored slack.
+    Group-step safeguard (damped block-Jacobi): the joint update is
+    tried at step factors omega in (1, 1/2, 1/4) — J(omega) = J_old +
+    omega (J_solved - J_old), the classic under-relaxation — and the
+    FIRST factor whose joint weighted residual L2 is non-increasing (or
+    within 5% of ``res_anchor``, the SWEEP-entry residual) is applied;
+    if none passes the group is a no-op and tk[1] increments. The
+    anchor keeps the slack from compounding (per-step relative slack
+    alone would admit exponential growth at 1.05/step).
+
+    Why: overlapping clusters make full joint updates overcorrect —
+    measured warm G=8 at M=64 grows the residual 70x over one EM sweep
+    while per-lane solves all report cost decreases (each lane's
+    decrease is against the ENTRY residual; summed deltas
+    double-subtract shared flux). Rejection alone STALLS there (7/8
+    groups vetoed, and 0/8 by sweep 3); with the relaxed retry all
+    groups accept (measured 3 at omega=1, 5 at omega=1/2) and the
+    3-sweep residual reaches 0.0221 vs 0.0285 stalled. Each extra
+    candidate costs G model evaluations + a norm — small next to the
+    solves. The test metric is plain weighted L2 (cheap,
+    mode-independent); robust/ADMM modes may legitimately trade a few
+    percent of L2 for their own cost decrease, hence the anchored
+    slack.
     """
     J, xres, nerr_acc, nuM, tk = state
     M = chunk_mask.shape[0]
@@ -377,20 +389,50 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
             mode, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m, wt_base,
             J_m, n_stations, jnp.take(nuM, cj, mode="clip"), config,
             itermax, itcap, admm_m, os_cfg, last)
-        delta = (_model8(J_m, coh_m, sta1, sta2, cidx_m)
-                 - _model8(Jn, coh_m, sta1, sta2, cidx_m))
-        return Jn, nu_new, init_cost, final_cost, delta, its
+        return Jn, nu_new, init_cost, final_cost, its, xdummy
 
-    Jn_g, nu_g, ic_g, fc_g, delta_g, its_g = jax.vmap(solve_one)(cjs)
+    Jn_g, nu_g, ic_g, fc_g, its_g, xd_g = jax.vmap(solve_one)(cjs)
+    Jo_g = jnp.take(J, cjs, axis=0)              # entering Jones (clipped)
+    coh_g = jnp.take(coh, cjs, axis=0)
+    cidx_g = jnp.take(chunk_idx, cjs, axis=0)
+    # entering models fall out of the solves' add-back (xdummy - xres):
+    # no second RIME evaluation needed
+    model_old = xd_g - xres[None]
     vm = valid.astype(xres.dtype)
-    xres_new = xres + jnp.einsum("g,gbx->bx", vm, delta_g)
     res_old = jnp.sum((xres * wt_base) ** 2)
-    res_new = jnp.sum((xres_new * wt_base) ** 2)
     anchor = res_old if res_anchor is None else res_anchor
-    accept = (res_new <= res_old * (1.0 + 1e-9)) \
-        | (res_new <= 1.05 * anchor)
+
+    def try_omega(w):
+        Jr_g = Jo_g + w * (Jn_g - Jo_g)
+        model_new = jax.vmap(
+            lambda Jm, cm, cim: _model8(Jm, cm, sta1, sta2, cim)
+        )(Jr_g, coh_g, cidx_g)
+        xnew = xres + jnp.einsum("g,gbx->bx", vm, model_old - model_new)
+        rn = jnp.sum((xnew * wt_base) ** 2)
+        ok = (rn <= res_old * (1.0 + 1e-9)) | (rn <= 1.05 * anchor)
+        return ok, xnew, Jr_g
+
+    # first passing factor wins (largest safe step); the cond chain
+    # skips the smaller-step model evaluations when omega=1 passes —
+    # the common case (measured 3/8 at omega=1, 5/8 at 1/2)
+    ok1, x1, Jr1 = try_omega(1.0)
+
+    def fall1():
+        ok2, x2, Jr2 = try_omega(0.5)
+
+        def fall2():
+            return try_omega(0.25)
+
+        return jax.lax.cond(ok2, lambda: (ok2, x2, Jr2), fall2)
+
+    accept, xres_sel, Jr_sel = jax.lax.cond(
+        ok1, lambda: (ok1, x1, Jr1), fall1)
+
     init_res = jnp.sum(ic_g, axis=-1)
     final_res = jnp.sum(fc_g, axis=-1)
+    # dcost from the full-step solve costs: at omega < 1 this OVERSTATES
+    # the achieved reduction, but it only weights the next sweep's
+    # iteration allocation — acceptable
     dcost = jnp.where(init_res > 0,
                       jnp.maximum((init_res - final_res)
                                   / jnp.maximum(init_res, 1e-30), 0.0),
@@ -399,13 +441,13 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     # group keeps the entering state entirely
     nerr_acc = jnp.where(accept, nerr_acc.at[cjs].set(dcost), nerr_acc)
     nuM = jnp.where(accept, nuM.at[cjs].set(nu_g), nuM)
-    J = jnp.where(accept, J.at[cjs].set(Jn_g), J)
-    xres = jnp.where(accept, xres_new, xres)
+    J = jnp.where(accept, J.at[cjs].set(Jr_sel), J)
+    xres = jnp.where(accept, xres_sel, xres)
     # tk[0]: useful-work iterations, summed over live lanes (a lower
     # bound on executed trips — the G-wide batched loop runs until its
     # slowest lane finishes; rejected groups still executed them).
-    # tk[1]: rejected group steps — the observability hook for "groups
-    # are all vetoing" (info['rejected_groups']).
+    # tk[1]: fully-rejected group steps — the observability hook for
+    # "groups are all vetoing" (info['rejected_groups']).
     tk = tk.at[0].add(jnp.sum(jnp.where(valid, its_g, 0)).astype(jnp.int32))
     tk = tk.at[1].add((~accept).astype(jnp.int32))
     return J, xres, nerr_acc, nuM, tk
@@ -417,8 +459,9 @@ _COLD_INFLIGHT = 2      # widest group proven safe from an identity start
 def _eff_inflight(config: SageConfig, M: int) -> int:
     """Effective in-flight group width: the configured value clamped to
     min(M//4, max(2, M//8)) (see SageConfig.inflight — wider groups
-    overcorrect; the M//8 term is calibrated by the M=32 measurement
-    where warm G=4 converges and warm G=8 stalls)."""
+    overcorrect more often, costing damped half-steps/rejections; the
+    M//8 term marks where full-step acceptance drops off in the
+    M=32/M=64 measurements)."""
     G = int(config.inflight)
     if G <= 1:
         return 1

@@ -643,12 +643,13 @@ def _tiles_for(device, default: int) -> int:
     return _env_or_tpu_default("SAGECAL_BENCH_TILES", device, default)
 
 
-def _inflight_for(device, M: int, default: int = 2) -> tuple[int, int]:
+def _inflight_for(device, M: int, default: int = 4) -> tuple[int, int]:
     """(requested, effective) --inflight group width for the SAGE
-    configs (SAGECAL_BENCH_INFLIGHT override; default 2 on TPU — the
-    VERDICT r5 item-1 lever). The EFFECTIVE width after the solver's
-    clamp is what the record must say: attributing clamped-G numbers to
-    the requested G would make wider groups look free."""
+    configs (SAGECAL_BENCH_INFLIGHT override; default 4 on TPU — the
+    VERDICT r5 item-1 lever; the damped group trials keep any clamped
+    width convergent). The EFFECTIVE width after the solver's clamp is
+    what the record must say: attributing clamped-G numbers to the
+    requested G would make wider groups look free."""
     from sagecal_tpu.solvers import sage
     G = _env_or_tpu_default("SAGECAL_BENCH_INFLIGHT", device, default)
     return G, sage._eff_inflight(sage.SageConfig(inflight=G), M)

@@ -16,6 +16,20 @@ cd "$(dirname "$0")/.."
 echo "== probe =="
 timeout 75 python -c "import jax; print('PLATFORM='+jax.devices()[0].platform)" \
     | grep -q "PLATFORM=tpu" || { echo "chip not answering; abort"; exit 1; }
+
+# Sanity: the tunnel can die seconds after answering a device-list probe
+# (observed 2026-07-31: probe ok at 01:01, every execution dead by 01:03,
+# config-1 burned its full 570 s timeout). Require one real compile+step
+# round-trip before committing the bench budget to this window.
+echo "== sanity compile+step =="
+timeout 150 python - <<'PY' || { echo "tunnel died after probe; abort"; exit 1; }
+import time, jax, jax.numpy as jnp
+t0 = time.time()
+y = jax.jit(lambda a: (a @ a).sum())(jnp.ones((256, 256), jnp.bfloat16))
+y.block_until_ready()
+print(f"sanity ok: compile+step {time.time()-t0:.1f}s on "
+      f"{jax.devices()[0].platform}")
+PY
 python - <<'PY'
 import json, time
 json.dump({"tpu": True, "ts": time.time()},

@@ -46,6 +46,14 @@ PROBE_SRC = (
     "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform)"
 )
 
+SANITY_SRC = (
+    "import jax, jax.numpy as jnp; "
+    "assert jax.devices()[0].platform == 'tpu', jax.devices(); "
+    "y = jax.jit(lambda a: (a @ a).sum())"
+    "(jnp.ones((256, 256), jnp.bfloat16)); "
+    "y.block_until_ready(); print('SANITY=ok')"
+)
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
@@ -136,6 +144,26 @@ def probe_tpu(attempts: int = 3, timeout_s: int = 75,
             time.sleep(retry_sleep_s)
     _write_probe_cache(False)
     return False
+
+
+def sanity_tpu(timeout_s: int = 120) -> bool:
+    """One real compile+step round-trip on the chip, in a subprocess.
+
+    2026-07-31 incident: the device-list probe (PROBE_SRC) kept
+    answering while every *dispatch* hung, so ``probe_tpu`` cannot see a
+    half-dead tunnel. This is the stronger check the mid-run death
+    guards use. Deliberately never writes the probe cache: the failing
+    config just removed it so the NEXT bench run re-probes fresh.
+    """
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        r = subprocess.run([sys.executable, "-c", SANITY_SRC],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, env=env)
+        return r.returncode == 0 and "SANITY=ok" in (r.stdout or "")
+    except subprocess.TimeoutExpired:
+        return False
 
 
 # ---------------------------------------------------------------------------
@@ -1248,7 +1276,7 @@ def main():
     log(f"# bench platform: {em.platform} (timeout {timeout_s}s/config, "
         f"budget {budget_s}s)")
 
-    def run_and_record(name, cpu: bool):
+    def run_and_record(name, cpu: bool, allow_drift: bool = True):
         t0 = time.perf_counter()
         remaining = budget_s - (time.perf_counter() - t_start) - 30
         r = run_config_subprocess(name, timeout_s=int(
@@ -1258,14 +1286,21 @@ def main():
             log(f"# {name}: {r['value']:.1f} {r['unit']} "
                 f"(res {r.get('res_0', 0):.4g}->{r.get('res_1', 0):.4g}, "
                 f"total {r['total_s']}s)")
-            if r.get("platform"):
-                # record the platform the config ACTUALLY ran on
+            if r.get("platform") and allow_drift:
+                # record the platform the config ACTUALLY ran on —
+                # except deliberate CPU repair runs while the chip is
+                # alive (allow_drift=False): those must not relabel the
+                # record or write a negative probe cache
                 _write_probe_cache(r["platform"] == "tpu")
                 if r["platform"] != em.platform:
                     log(f"# {name}: platform drift -> {r['platform']}")
                     em.platform = r["platform"]
         else:
             log(f"# {name}: FAILED {r['error']}")
+            # which platform this attempt targeted — the downgrade pass
+            # only repairs chip-side failures (re-running a CPU timeout
+            # on CPU would just burn the leftover budget again)
+            r["attempted"] = "cpu" if cpu else "tpu"
             if not cpu:
                 # a failing TPU config invalidates the cached last-good
                 # answer so the NEXT bench run re-probes instead of
@@ -1295,11 +1330,26 @@ def main():
             # CPU-fallback run: keep trying to catch the tunnel coming
             # back (the round-3 official record was a stale CPU verdict)
             last_reprobe = time.perf_counter()
-            if probe_tpu(attempts=1, timeout_s=45, force=True):
+            # device-list answer alone is not enough to switch — the
+            # half-dead tunnel answers probes while dispatches hang
+            if (probe_tpu(attempts=1, timeout_s=45, force=True)
+                    and sanity_tpu()):
                 log("# tpu probe: chip came back mid-run; switching")
                 have_tpu = True
                 em.platform = "tpu"
-        run_and_record(name, cpu=not have_tpu)
+        r = run_and_record(name, cpu=not have_tpu)
+        if have_tpu and "error" in r:
+            # The tunnel can die between the probe and the first
+            # execution (observed 2026-07-31: device-list probes kept
+            # answering while every dispatch hung and config-1 burned
+            # its whole 570 s timeout). Before letting the NEXT config
+            # spend its timeout on a dead chip, demand one real
+            # compile+step round-trip.
+            if not sanity_tpu():
+                log("# tpu died mid-run; falling back to cpu for the "
+                    "remaining configs")
+                have_tpu = False
+                last_reprobe = time.perf_counter()
 
     # upgrade pass: if the run ended on TPU but earlier configs fell back
     # to CPU (or errored), re-run those on the chip with leftover budget —
@@ -1318,6 +1368,30 @@ def main():
             if "error" in r and "error" not in prev:
                 em.results[name] = prev     # keep the CPU number
                 write_table(em.results, em.platform)
+            if "error" in r and not sanity_tpu():
+                # same exposure as the main loop: a tunnel that died
+                # after its last success would otherwise eat every
+                # remaining upgrade slot at min(570s, remaining) each,
+                # starving the downgrade pass below
+                log("# tpu died during upgrade pass; stopping it")
+                have_tpu = False
+                break
+
+    # downgrade pass: configs that FAILED on the chip (tunnel death,
+    # kernel fault) get a CPU-small number with leftover budget — the
+    # scoreboard counts configs_ok, and a CPU row beats a FAILED row
+    failed = [n for n, _ in CONFIGS
+              if em.results.get(n, {}).get("attempted") == "tpu"]
+    for name in failed:
+        remaining = budget_s - (time.perf_counter() - t_start) - 30
+        if remaining < 120:
+            break
+        log(f"# downgrade pass: re-running {name} on cpu")
+        prev = em.results[name]
+        r = run_and_record(name, cpu=True, allow_drift=not have_tpu)
+        if "error" in r:
+            em.results[name] = prev     # keep the original error text
+            write_table(em.results, em.platform)
 
     head = em.results.get("1-fullbatch-lm", {})
     value = head.get("value", 0.0)

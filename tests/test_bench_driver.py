@@ -1,0 +1,140 @@
+"""bench.py driver control flow around a dying tunnel (round-5 chip
+watch). Observed 2026-07-31: the axon tunnel answered the opening probe,
+then every dispatch hung — config-1 burned its full per-config timeout
+and the loop would have fed each remaining config to the dead chip too.
+
+Guards (no subprocesses, no device work — run_config_subprocess and
+probe_tpu are stubbed):
+ 1. after a TPU config fails and a forced re-probe says dead, the
+    remaining configs run on CPU instead of burning their timeouts;
+ 2. the downgrade pass re-runs chip-failed configs on CPU with leftover
+    budget so the record ends 5/5 instead of carrying FAILED rows.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench  # noqa: E402
+
+
+@pytest.fixture
+def sandbox(monkeypatch, tmp_path):
+    """Redirect every file bench.main() touches into tmp_path."""
+    monkeypatch.setattr(bench, "HERE", str(tmp_path))
+    monkeypatch.setattr(bench, "PROBE_CACHE",
+                        str(tmp_path / ".bench_probe_cache.json"))
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--budget", "1700"])
+    monkeypatch.delenv("SAGECAL_BENCH_CPU", raising=False)
+    return tmp_path
+
+
+def _drive(monkeypatch, sandbox, *, initial_tpu, reprobe_answers,
+           tpu_result):
+    """Run bench.main() with stubbed probe + config subprocess.
+
+    reprobe_answers: answers for forced re-probes, consumed in order
+    (exhausted -> last value repeats).
+    tpu_result: dict returned for every cpu=False config run.
+    Returns (calls, results) where calls is [(name, cpu), ...].
+    """
+    calls = []
+    answers = list(reprobe_answers)
+
+    def fake_probe(attempts=3, timeout_s=75, force=False, **kw):
+        if not force:
+            return initial_tpu
+        return answers.pop(0) if len(answers) > 1 else answers[0]
+
+    def fake_sanity(timeout_s=120):
+        return answers.pop(0) if len(answers) > 1 else answers[0]
+
+    def fake_run(name, timeout_s=570, cpu=False):
+        calls.append((name, cpu))
+        if cpu:
+            return {"value": 100.0, "unit": "vis/s", "platform": "cpu",
+                    "res_0": 1.0, "res_1": 0.1}
+        return dict(tpu_result)
+
+    monkeypatch.setattr(bench, "probe_tpu", fake_probe)
+    monkeypatch.setattr(bench, "sanity_tpu", fake_sanity)
+    monkeypatch.setattr(bench, "run_config_subprocess", fake_run)
+    bench.main()
+    with open(sandbox / "bench_results.json") as f:
+        return calls, json.load(f)["results"]
+
+
+def test_tpu_death_falls_back_to_cpu(monkeypatch, sandbox, capsys):
+    calls, results = _drive(
+        monkeypatch, sandbox, initial_tpu=True, reprobe_answers=[False],
+        tpu_result={"error": "timeout after 570s"})
+    capsys.readouterr()
+    # config 1 tried the chip; the re-probe said dead, so configs 2-5
+    # must NOT have been fed to the tunnel
+    assert calls[0] == ("1-fullbatch-lm", False)
+    tpu_calls = [c for c in calls if not c[1]]
+    assert tpu_calls == [("1-fullbatch-lm", False)]
+    # downgrade pass recovered config 1 on cpu -> 5/5, no FAILED rows
+    assert all("error" not in r for r in results.values())
+    assert len(results) == 5
+
+
+def test_tpu_alive_but_config_fails_stays_on_tpu(monkeypatch, sandbox,
+                                                 capsys):
+    """A genuine per-config fault on a LIVE chip (re-probe ok) must not
+    demote the rest of the run — that was round-3's stale-CPU mistake in
+    the other direction."""
+    calls, results = _drive(
+        monkeypatch, sandbox, initial_tpu=True, reprobe_answers=[True],
+        tpu_result={"error": "rc=1: kernel fault"})
+    capsys.readouterr()
+    tpu_calls = [c for c in calls if not c[1]]
+    # all five configs were still attempted on the chip
+    assert [n for n, _ in tpu_calls][:5] == [n for n, _ in bench.CONFIGS]
+    # and the downgrade pass then filled them in on cpu
+    assert all(r.get("platform") == "cpu" for r in results.values())
+    # deliberate CPU repair runs beside a LIVE chip must not write a
+    # negative probe cache (next bench run would skip the chip) ...
+    assert not os.path.exists(bench.PROBE_CACHE) or json.load(
+        open(bench.PROBE_CACHE)).get("tpu", True)
+    # ... nor relabel the record's headline platform
+    with open(sandbox / "bench_results.json") as f:
+        assert json.load(f)["platform"] == "tpu"
+
+
+def test_cpu_failure_not_retried_on_cpu(monkeypatch, sandbox, capsys):
+    """The downgrade pass repairs CHIP-side failures only: a config that
+    already timed out on CPU would time out identically again, burning
+    the leftover budget for zero change to the record."""
+    calls = []
+
+    def fake_probe(attempts=3, timeout_s=75, force=False, **kw):
+        return False
+
+    def fake_run(name, timeout_s=570, cpu=False):
+        calls.append((name, cpu))
+        if name == "3-rtr-16cluster":
+            return {"error": "timeout after 570s"}
+        return {"value": 100.0, "unit": "vis/s", "platform": "cpu",
+                "res_0": 1.0, "res_1": 0.1}
+
+    monkeypatch.setattr(bench, "probe_tpu", fake_probe)
+    monkeypatch.setattr(bench, "sanity_tpu", lambda **kw: False)
+    monkeypatch.setattr(bench, "run_config_subprocess", fake_run)
+    bench.main()
+    capsys.readouterr()
+    assert calls.count(("3-rtr-16cluster", True)) == 1
+
+
+def test_cpu_run_unaffected(monkeypatch, sandbox, capsys):
+    calls, results = _drive(
+        monkeypatch, sandbox, initial_tpu=False, reprobe_answers=[False],
+        tpu_result={"error": "unused"})
+    capsys.readouterr()
+    assert all(cpu for _, cpu in calls)
+    assert len(results) == 5
+    assert all("error" not in r for r in results.values())

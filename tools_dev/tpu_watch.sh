@@ -10,7 +10,9 @@ cd "$(dirname "$0")/.."
 LOG="${1:-/tmp/tpu_watch.log}"
 echo "$(date -u +%FT%TZ) watcher start" >> "$LOG"
 while true; do
-    if timeout 75 python -c \
+    # env -u: an exported JAX_PLATFORMS=cpu (flaky-TPU workaround) must
+    # not make every probe report the chip dead through a healthy window
+    if timeout 75 env -u JAX_PLATFORMS python -c \
         "import jax; assert jax.devices()[0].platform == 'tpu'" \
         2>/dev/null; then
         echo "$(date -u +%FT%TZ) ALIVE -> wake playbook" >> "$LOG"

@@ -28,6 +28,9 @@ import time
 import numpy as np
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# repo root on the path up front: generate() imports sagecal_tpu before
+# main()'s bench import — an uninstalled fresh session must still work
+sys.path.insert(0, HERE)
 
 
 def generate(workdir, n_sta, n_dir, n_sub, tilesz, n_tiles, seed=5):
@@ -177,7 +180,7 @@ def main():
     per_iter = float(np.median(body)) if body else float("nan")
     shape = (f"N={args.stations} M={args.dirs} F={args.subbands} "
              f"hybrid-chunks tilesz={args.tilesz} -j{args.solver} "
-             f"block_f={args.block_f}")
+             f"block_f={args.block_f} G={args.inflight}")
     rec = {"metric": "ADMM wall-clock/iter (north-star shape)",
            "value": round(per_iter, 3), "unit": "s/ADMM-iter",
            "shape": shape, "per_tile_iters": per_tile_iters,

@@ -10,6 +10,18 @@ cd "$(dirname "$0")/.."
 LOG="${1:-/tmp/tpu_watch.log}"
 echo "$(date -u +%FT%TZ) watcher start" >> "$LOG"
 while true; do
+    # hold off while another measurement owns the chip (the driver's
+    # end-of-round bench, a manual northstar run, a second watcher):
+    # two concurrent clients of the single tunneled TPU starve both
+    # anchored to a python argv[0]: a bare substring match also hits the
+    # build driver's own process, whose prompt text mentions bench.py
+    if pgrep -f "^[^ ]*python[^ ]* ([^ ]*bench\.py|[^ ]*northstar\.py|-m sagecal_tpu\.cli_mpi)" \
+        > /dev/null 2>&1; then
+        echo "$(date -u +%FT%TZ) busy (another bench/solve owns the chip)" \
+            >> "$LOG"
+        sleep 120
+        continue
+    fi
     # env -u: an exported JAX_PLATFORMS=cpu (flaky-TPU workaround) must
     # not make every probe report the chip dead through a healthy window
     if timeout 75 env -u JAX_PLATFORMS python -c \

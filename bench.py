@@ -666,18 +666,32 @@ def _env_or_tpu_default(env_name: str, device, default: int) -> int:
     return default if device.platform == "tpu" else 1
 
 
-def _tiles_for(device, default: int) -> int:
-    """Tile-batch width (SAGECAL_BENCH_TILES override)."""
+def _tiles_for(device, default: int = 1) -> int:
+    """Tile-batch width (SAGECAL_BENCH_TILES override).
+
+    Default 1 everywhere, measured 2026-07-31 on the real chip:
+    T=8 on config-1 never finished inside 400 s (one fused 8-tile
+    program pays a multi-minute XLA compile and its single execution
+    approaches the tunnel's ~60 s kill), while T=1 completes the whole
+    config in ~100 s cold.  Per-execution time at T=1 is ~6.6 s, so
+    dispatch latency — the overhead tile-batching amortizes — is <1%
+    of the step; there is nothing for the lever to win here.  It stays
+    an env/CLI opt-in for pod-scale runs where executions are short."""
     return _env_or_tpu_default("SAGECAL_BENCH_TILES", device, default)
 
 
-def _inflight_for(device, M: int, default: int = 4) -> tuple[int, int]:
+def _inflight_for(device, M: int, default: int = 1) -> tuple[int, int]:
     """(requested, effective) --inflight group width for the SAGE
-    configs (SAGECAL_BENCH_INFLIGHT override; default 4 on TPU — the
-    VERDICT r5 item-1 lever; the damped group trials keep any clamped
-    width convergent). The EFFECTIVE width after the solver's clamp is
-    what the record must say: attributing clamped-G numbers to the
-    requested G would make wider groups look free."""
+    configs (SAGECAL_BENCH_INFLIGHT override).  Default 1, measured
+    2026-07-31 on the real chip: G(eff)=2 on config-1 is 0.68x the
+    G=1 throughput (1,961 vs 2,879 vis/s) and the north-star at G=4
+    is 0.69x (166.3 vs 114.0 s/ADMM-iter) — the group step's damped
+    retries add model evaluations and the vmapped G-lane solve runs
+    every lane to the slowest lane's trip count, which costs more
+    than the halved sweep length saves.  The EFFECTIVE width after
+    the solver's clamp is what the record must say: attributing
+    clamped-G numbers to the requested G would make wider groups look
+    free."""
     from sagecal_tpu.solvers import sage
     G = _env_or_tpu_default("SAGECAL_BENCH_INFLIGHT", device, default)
     return G, sage._eff_inflight(sage.SageConfig(inflight=G), M)
@@ -695,11 +709,12 @@ def _mfu_fields(out, device, flops_step, dt):
 
 def config1_fullbatch_lm(device, dtype):
     """BASELINE config 1: point sources, LM-family solver (smoke shape
-    scaled to LOFAR station count), batched over 8 solve intervals. On
+    scaled to LOFAR station count), one solve interval per execution
+    (T/G opt-in via SAGECAL_BENCH_TILES/_INFLIGHT). On
     TPU the Pallas coherency kernel is measured against the XLA path
     (kernel-on/off throughput both recorded)."""
     from sagecal_tpu.config import SolverMode
-    T = _tiles_for(device, 8)
+    T = _tiles_for(device)
     G, Ge = _inflight_for(device, 8)
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=8,
                                        tilesz=10, n_tiles=T)
@@ -883,8 +898,7 @@ def config2_stochastic(device, dtype):
 
 def config3_rtr16(device, dtype):
     """BASELINE config 3: robust Student's-t + RTR (-j 5), 16 clusters,
-    batched over 4 solve intervals (the round-3 ≥5x utilization target,
-    VERDICT item 1)."""
+    one solve interval per execution (T/G opt-in via env)."""
     from sagecal_tpu.config import SolverMode
     # 2 EM iterations: a 3-EM robust-RTR step at 16 clusters is ~150 s
     # on-chip and the subprocess must fit warmup + 1 timed rep in 570 s.
@@ -892,7 +906,7 @@ def config3_rtr16(device, dtype):
     # of the round-4 1700 s budget and starved config 5 (VERDICT weak 1)
     on_tpu = device.platform == "tpu"
     emi = 2 if on_tpu else 1
-    T = _tiles_for(device, 4)
+    T = _tiles_for(device)
     G, Ge = _inflight_for(device, 16)
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=16,
                                        tilesz=10, seed=SEED + 10,
@@ -911,13 +925,14 @@ def config3_rtr16(device, dtype):
 
 def config4_extended(device, dtype):
     """BASELINE config 4: shapelet + Gaussian sources, 3rd-order spectra,
-    64 stations, batched over 4 solve intervals. On TPU the hybrid
+    64 stations, one solve interval per execution (T/G opt-in via env).
+    On TPU the hybrid
     Pallas split (kernel for point+gaussian, XLA for shapelets) is
     measured against pure XLA."""
     from sagecal_tpu.config import SolverMode
     on_tpu = device.platform == "tpu"
     emi = 2 if on_tpu else 1      # CPU fallback: budget, see config 3
-    T = _tiles_for(device, 4)
+    T = _tiles_for(device)
     G, Ge = _inflight_for(device, 8)
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=64, n_clusters=8,
                                        tilesz=10, extended=True,

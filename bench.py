@@ -1064,6 +1064,17 @@ CONFIGS = [
 ]
 
 
+
+def _fmt_pct(v):
+    """Percentage with 2 significant digits: tiny utilizations on a
+    ~400 TFLOP/s chip must not round to an information-free 0.00%."""
+    if v is None or v != v:
+        return "—"
+    if v == 0 or v >= 0.1:
+        return f"{v:.2f}%"
+    from math import floor, log10
+    return f"{v:.{max(0, 1 - floor(log10(abs(v))))}f}%"
+
 def _fmt_s(r, key, fmt):
     v = r.get(key)
     return ("—" if v is None or (isinstance(v, float) and v != v)
@@ -1108,7 +1119,7 @@ def write_table(results, platform, date=None):
         gfs = r.get("flops_per_s")
         gfs_s = "—" if not gfs else f"{gfs / 1e9:.1f}"
         mfu = r.get("mfu_pct")
-        mfu_s = "—" if mfu is None else f"{mfu:.2f}%"
+        mfu_s = _fmt_pct(mfu)
         lines.append(
             f"| {name} | {r['value']:.1f} | {r['unit']} | {res} | "
             f"{_fmt_s(r, 'step_s', '.3f')} | {_fmt_s(r, 'compile_s', '.1f')}"
@@ -1124,7 +1135,7 @@ def write_table(results, platform, date=None):
             gfs = ns.get("flops_per_s")
             gfs_s = "—" if not gfs else f"{gfs / 1e9:.1f}"
             mfu = ns.get("mfu_pct")
-            mfu_s = "—" if mfu is None else f"{mfu:.2f}%"
+            mfu_s = _fmt_pct(mfu)
             lines.append(
                 f"| northstar | {ns['value']:.2f} | {ns['unit']} | — | — "
                 f"| — | {gfs_s} | {mfu_s} | {ns.get('shape', '')} "

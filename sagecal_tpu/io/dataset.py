@@ -289,12 +289,34 @@ class SimMS:
     Supports the reference's streaming tile iteration (MSIter analogue,
     fullbatch_mode.cpp:297) and write-back of residuals
     (Data::writeData, data.cpp:1259).
+
+    Column semantics follow the reference (data.cpp:43-44, -I/-O):
+    ``data_column`` (default DATA) is what :meth:`read_tile` returns in
+    ``VisTile.x``; :meth:`write_tile` lands in ``out_column`` (default
+    CORRECTED_DATA) and NEVER clobbers other columns — so calibrating a
+    dataset leaves its DATA intact and re-runs see pristine input,
+    exactly like a CASA MeasurementSet.
     """
 
     META = "meta.json"
 
-    def __init__(self, path: str):
+    @staticmethod
+    def _col_key(column: str) -> str:
+        """Column name -> npz key. DATA is the original ``x``; every
+        other column gets its own namespaced key in the same npz.
+        Names are case-folded (casacore columns are case-insensitive in
+        practice), so ``data``/``Data`` alias DATA rather than silently
+        naming a different key."""
+        norm = "".join(c if c.isalnum() else "_" for c in column.upper())
+        if norm == "DATA":
+            return "x"
+        return "x_" + norm.lower()
+
+    def __init__(self, path: str, data_column: str = "DATA",
+                 out_column: str = "CORRECTED_DATA"):
         self.path = path
+        self.data_column = data_column
+        self.out_column = out_column
         with open(os.path.join(path, self.META)) as f:
             self.meta = json.load(f)
 
@@ -314,7 +336,7 @@ class SimMS:
             json.dump(meta, f, indent=1)
         ms = cls(path)
         for i, t in enumerate(tiles):
-            ms.write_tile(i, t)
+            ms.write_tile(i, t, column="DATA")
         if beam_info is not None:
             from sagecal_tpu.rime import beam as bm
             bm.save_beaminfo(os.path.join(path, "beam.npz"), beam_info)
@@ -334,9 +356,15 @@ class SimMS:
 
     def read_tile(self, i: int) -> VisTile:
         z = np.load(os.path.join(self.path, f"tile{i:05d}.npz"))
+        key = self._col_key(self.data_column)
+        if key not in z.files:
+            have = [k for k in z.files if k == "x" or k.startswith("x_")]
+            raise ValueError(
+                f"{self.path}: column {self.data_column!r} not present "
+                f"in tile {i} (stored data keys: {have})")
         m = self.meta
         return VisTile(
-            u=z["u"], v=z["v"], w=z["w"], x=z["x"], flags=z["flags"],
+            u=z["u"], v=z["v"], w=z["w"], x=z[key], flags=z["flags"],
             sta1=z["sta1"], sta2=z["sta2"],
             freqs=np.asarray(m["freqs"]), freq0=m["freq0"],
             fdelta=m["fdelta"], tdelta=m["tdelta"], dec0=m["dec0"],
@@ -345,15 +373,34 @@ class SimMS:
             time_mjd=z["time_mjd"] if "time_mjd" in z.files else None,
             cflags=z["cflags"] if "cflags" in z.files else None)
 
-    def write_tile(self, i: int, tile: VisTile) -> None:
+    def write_tile(self, i: int, tile: VisTile,
+                   column: str | None = None) -> None:
+        """Write ``tile.x`` into ``column`` (default: this dataset's
+        ``out_column``). Any other data columns already stored in the
+        tile file are preserved (Data::writeData writes only OutField,
+        data.cpp:1259)."""
+        key = self._col_key(column or self.out_column)
         kw = {}
+        path = os.path.join(self.path, f"tile{i:05d}.npz")
+        if os.path.exists(path):
+            with np.load(path) as z:
+                # keep every other data column AND stored per-tile
+                # metadata the caller's VisTile may not carry
+                kw = {k: z[k] for k in z.files
+                      if ((k == "x" or k.startswith("x_")) and k != key)
+                      or k in ("time_mjd", "cflags")}
         if tile.time_mjd is not None:
             kw["time_mjd"] = tile.time_mjd
         if tile.cflags is not None:
             kw["cflags"] = tile.cflags
-        np.savez(os.path.join(self.path, f"tile{i:05d}.npz"),
-                 u=tile.u, v=tile.v, w=tile.w, x=tile.x, flags=tile.flags,
+        kw[key] = tile.x
+        # write-then-rename: a crash mid-writeback must not truncate the
+        # tile file and take the pristine DATA column with it (the tmp
+        # name ends in .npz so np.savez does not append a suffix)
+        tmp = path + ".tmp.npz"
+        np.savez(tmp, u=tile.u, v=tile.v, w=tile.w, flags=tile.flags,
                  sta1=tile.sta1, sta2=tile.sta2, **kw)
+        os.replace(tmp, path)
 
     def tiles(self):
         for i in range(self.n_tiles):
@@ -481,7 +528,7 @@ def open_part(path: str, tilesz: int = 10, data_column: str = "DATA",
                 f"installed; install it or convert to a SimMS directory")
         return casams.CasaMS(path, tilesz=tilesz, data_column=data_column,
                              out_column=out_column)
-    return SimMS(path)
+    return SimMS(path, data_column=data_column, out_column=out_column)
 
 
 def open_dataset(ms: str | None, ms_list: str | None = None,

@@ -247,8 +247,10 @@ def test_federated_mesh_matches_sequential(tmp_path):
 
     for p in paths:
         b = os.path.basename(p)
-        xs = ds.SimMS(str(seqdir / b)).read_tile(0).x
-        xm = ds.SimMS(str(meshdir / b)).read_tile(0).x
+        xs = ds.SimMS(str(seqdir / b),
+                      data_column="CORRECTED_DATA").read_tile(0).x
+        xm = ds.SimMS(str(meshdir / b),
+                      data_column="CORRECTED_DATA").read_tile(0).x
         np.testing.assert_allclose(xm, xs, rtol=1e-8, atol=1e-10)
     sol_s = (seqdir / "sol.txt").read_text()
     sol_m = (meshdir / "sol.txt").read_text()

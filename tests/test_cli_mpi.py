@@ -61,7 +61,8 @@ def test_mpi_cli_end_to_end(tmp_path):
     assert rc == 0
 
     # residuals written back: mean level far below raw data
-    raw = np.abs(ds.SimMS(paths[0]).read_tile(0).x).mean()
+    raw = np.abs(ds.SimMS(paths[0], data_column="CORRECTED_DATA")
+                 .read_tile(0).x).mean()
     assert raw < 1.0  # residual after subtract (raw data was ~5)
 
     # Z solution file parses
@@ -98,7 +99,7 @@ def test_mpi_cli_per_channel_flags(tmp_path):
                 cf = np.zeros((t.nrows, 3), np.uint8)
                 cf[:, 0] = 1                  # ... but flagged
                 t.cflags = cf
-            msx.write_tile(i, t)
+            msx.write_tile(i, t, column="DATA")
         msx.meta["freqs"] = [msx.meta["freqs"][0]] * 3
         with open(os.path.join(p, "meta.json"), "w") as f:
             json.dump(msx.meta, f)
@@ -112,7 +113,8 @@ def test_mpi_cli_per_channel_flags(tmp_path):
     assert rc == 0
     # with the garbage channel excluded the residual must be small;
     # averaging it in would leave residuals ~ 3e5
-    res = np.abs(ds.SimMS(paths[1]).read_tile(0).x).mean()
+    res = np.abs(ds.SimMS(paths[1], data_column="CORRECTED_DATA")
+                 .read_tile(0).x).mean()
     assert res < 1.0, res
 
 
@@ -135,7 +137,8 @@ def test_mpi_cli_uneven_subbands(tmp_path, monkeypatch):
         "-U", "1"])   # -U: exercise the real-basis BZ einsum under padding
     assert rc == 0
     for p in paths:
-        res = np.abs(ds.SimMS(p).read_tile(0).x).mean()
+        res = np.abs(ds.SimMS(p, data_column="CORRECTED_DATA")
+                     .read_tile(0).x).mean()
         assert np.isfinite(res) and res < 1.0, (p, res)
 
 

@@ -140,7 +140,42 @@ def test_multims_residual_writeback(bands):
     marker[:, 2:] = -2.0 + 1.0j    # hi.ms channels
     t.x = marker
     multi.write_tile(0, t)
-    lo = ds.SimMS(os.path.join(tmp, "lo.ms")).read_tile(0)
-    hi = ds.SimMS(os.path.join(tmp, "hi.ms")).read_tile(0)
+    lo = ds.SimMS(os.path.join(tmp, "lo.ms"),
+                  data_column="CORRECTED_DATA").read_tile(0)
+    hi = ds.SimMS(os.path.join(tmp, "hi.ms"),
+                  data_column="CORRECTED_DATA").read_tile(0)
     np.testing.assert_array_equal(lo.x, marker[:, :2])
     np.testing.assert_array_equal(hi.x, marker[:, 2:])
+
+
+def test_simms_columns_nondestructive(bands):
+    """Column semantics (-I/-O, data.cpp:43-44): write_tile lands in
+    out_column and must leave DATA byte-identical — a calibration run
+    may not destroy its input (CASA MeasurementSets keep DATA and
+    CORRECTED_DATA side by side; re-runs must see pristine DATA)."""
+    tmp, skyp, clup = bands
+    path = os.path.join(tmp, "lo.ms")
+    before = ds.SimMS(path).read_tile(0)
+    msx = ds.SimMS(path)                       # default out: CORRECTED
+    t = msx.read_tile(0)
+    t.x = np.full_like(t.x, 9.0 + 1.0j)
+    msx.write_tile(0, t)
+    after = ds.SimMS(path).read_tile(0)        # DATA again
+    np.testing.assert_array_equal(after.x, before.x)
+    corr = ds.SimMS(path, data_column="CORRECTED_DATA").read_tile(0)
+    np.testing.assert_array_equal(corr.x, t.x)
+    # a second write to another column keeps both existing columns
+    msx2 = ds.SimMS(path, out_column="MODEL_DATA")
+    t2 = msx2.read_tile(0)
+    t2.x = np.full_like(t2.x, -3.0 + 0.0j)
+    msx2.write_tile(0, t2)
+    np.testing.assert_array_equal(
+        ds.SimMS(path).read_tile(0).x, before.x)
+    np.testing.assert_array_equal(
+        ds.SimMS(path, data_column="CORRECTED_DATA").read_tile(0).x, t.x)
+    # reading a never-written column reports what exists
+    try:
+        ds.SimMS(path, data_column="WEIGHT_SPECTRUM").read_tile(0)
+        raise AssertionError("expected ValueError for missing column")
+    except ValueError as e:
+        assert "WEIGHT_SPECTRUM" in str(e)

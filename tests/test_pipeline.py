@@ -65,7 +65,7 @@ def test_fullbatch_pipeline(simdir):
         assert h["res_1"] < h["res_0"]
 
     # solutions file exists with 2 intervals
-    ms = ds.SimMS(msdir)
+    ms = ds.SimMS(msdir, data_column="CORRECTED_DATA")
     sky = skymodel.read_sky_cluster(sky_path, clus_path, ms.meta["ra0"],
                                     ms.meta["dec0"], ms.meta["freq0"])
     hdr, blocks = sol.read_solutions(solpath, sky.nchunk)
@@ -84,7 +84,7 @@ def test_simulation_mode(simdir):
     cfg = cli.config_from_args(args)
     assert cfg.simulation == SimulationMode.SIMULATE
     pipeline.run(cfg, log=lambda *a: None)
-    ms = ds.SimMS(msdir)
+    ms = ds.SimMS(msdir, data_column="CORRECTED_DATA")
     t0 = ms.read_tile(0)
     # replaced by the uncorrupted model: compare to direct predict
     sky = skymodel.read_sky_cluster(sky_path, clus_path, ms.meta["ra0"],
@@ -128,7 +128,7 @@ def test_per_channel_mode(simdir):
         assert np.isfinite(h["res_1"])
         assert h["res_1"] < h["res_0"]
     # written residuals shrink vs the raw corrupted data
-    ms = ds.SimMS(msdir)
+    ms = ds.SimMS(msdir, data_column="CORRECTED_DATA")
     t0 = ms.read_tile(0)
     assert t0.x.shape[1] == 2            # per-channel columns intact
     # raw corrupted data averages |x| ~ 2.3; the 6-iteration LBFGS
@@ -150,5 +150,6 @@ def test_fullbatch_shard_baselines(simdir):
     for h in history:
         assert np.isfinite(h["res_1"])
         assert h["res_1"] < 0.3 * h["res_0"]
-    t0 = ds.SimMS(msdir).read_tile(0)
+    t0 = ds.SimMS(msdir,
+                  data_column="CORRECTED_DATA").read_tile(0)
     assert np.abs(t0.x).mean() < 1.0

@@ -129,5 +129,6 @@ def test_per_channel_bandpass_mode(tmp_path):
     hdr, blocks = sol.read_solutions(solpath, sky.nchunk)
     assert len(blocks) == 1
     # residuals written back shrank the data
-    back = ds.SimMS(str(msdir)).read_tile(0)
+    back = ds.SimMS(str(msdir),
+                    data_column="CORRECTED_DATA").read_tile(0)
     assert np.abs(back.x).mean() < 0.3 * np.abs(tile.x).mean()

@@ -103,7 +103,7 @@ def test_run_minibatch_reduces_residual(simdir):
     assert blocks[0][0].shape == (2, 1, 8, 2, 2)
 
     # residuals were written back and are smaller than the data
-    ms = ds.SimMS(msdir)
+    ms = ds.SimMS(msdir, data_column="CORRECTED_DATA")
     tile = ms.read_tile(0)
     dsky = rp.sky_to_device(sky, jnp.float64)
     orig = ds.simulate_dataset(dsky, n_stations=8, tilesz=4,

@@ -61,7 +61,7 @@ def test_tile_batch_pipeline_matches_sequential(simdir5):
     for h in hist_b:
         assert np.isfinite(h["res_1"]) and h["res_1"] < h["res_0"]
     # solutions written for every interval
-    ms = ds.SimMS(msdir)
+    ms = ds.SimMS(msdir, data_column="CORRECTED_DATA")
     sky = skymodel.read_sky_cluster(sky_path, clus_path, ms.meta["ra0"],
                                     ms.meta["dec0"], ms.meta["freq0"])
     hdr, blocks = sol.read_solutions(sol_b, sky.nchunk)

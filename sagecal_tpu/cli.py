@@ -32,20 +32,33 @@ def build_parser() -> argparse.ArgumentParser:
       help="1: sky model has 3rd-order spectral indices")
     a("-t", "--tile-size", type=int, default=120)
     a("-e", "--max-em-iter", type=int, default=3)
-    a("-g", "--single-max-iter", type=int, default=2)
-    a("-l", "--max-iter", type=int, default=10)
-    a("-m", "--max-lbfgs", type=int, default=10)
-    a("-x", "--lbfgs-m", type=int, default=7)
+    a("-g", "--max-iter", type=int, default=10,
+      help="max iterations within single EM (main.cpp -g; reference "
+           "default 2 — the batched solvers converge per-sweep, so 10)")
+    a("-l", "--max-lbfgs", type=int, default=10,
+      help="max LBFGS iterations (main.cpp -l)")
+    a("-m", "--lbfgs-m", type=int, default=7,
+      help="LBFGS memory size (main.cpp -m)")
     a("-n", "--n-threads", type=int, default=4)
     a("-j", "--solver-mode", type=int, default=5,
       help="0 OSLM, 1 LM, 2 RLM, 3 OSRLM, 4 RTR, 5 RRTR (default), "
            "6 NSD (reference Dirac.h:1533 SM_* numbering)")
     a("-L", "--nulow", type=float, default=2.0)
     a("-H", "--nuhigh", type=float, default=30.0)
-    a("-y", "--linsolv", type=int, default=1)
+    a("--linsolv", type=int, default=1,
+      help="0 Cholesky 1 QR 2 SVD (no reference letter; Data::linsolv)")
     a("-R", "--randomize", type=int, default=1)
-    a("-I", "--uvmin", type=float, default=0.0)
-    a("-o", "--uvmax", type=float, default=1e9)
+    a("-x", "--uvmin", type=float, default=0.0,
+      help="exclude baselines shorter than this (lambda; main.cpp -x)")
+    a("-y", "--uvmax", type=float, default=1e9,
+      help="exclude baselines longer than this (lambda; main.cpp -y)")
+    a("-I", "--input-column", default="DATA",
+      help="data column to calibrate (Data::DataField)")
+    a("-O", "--output-column", default="CORRECTED_DATA",
+      help="column receiving residuals/sim output (Data::OutField)")
+    a("-o", "--mmse-rho", type=float, default=1e-9,
+      help="robust rho for MMSE inversion during correction "
+           "(Data::rho, residual.c)")
     a("-W", "--whiten", type=int, default=0)
     a("--profile", default=None, metavar="DIR",
       help="write a jax.profiler trace of the first solve interval")
@@ -100,8 +113,10 @@ def config_from_args(args) -> RunConfig:
         cluster_file=args.cluster_file, solutions_file=args.solutions_file,
         init_solutions=args.init_solutions, format_3=bool(args.format),
         tile_size=args.tile_size, max_em_iter=args.max_em_iter,
-        single_max_iter=args.single_max_iter, max_iter=args.max_iter,
+        max_iter=args.max_iter,
         max_lbfgs=args.max_lbfgs, lbfgs_m=args.lbfgs_m,
+        input_column=args.input_column, output_column=args.output_column,
+        mmse_rho=args.mmse_rho,
         n_threads=args.n_threads, solver_mode=SolverMode(args.solver_mode),
         robust_nulow=args.nulow, robust_nuhigh=args.nuhigh,
         linsolv=args.linsolv, randomize=bool(args.randomize),

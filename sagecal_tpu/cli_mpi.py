@@ -38,9 +38,16 @@ def build_parser() -> argparse.ArgumentParser:
     a("-F", "--format", type=int, default=0)
     a("-t", "--tile-size", type=int, default=120)
     a("-e", "--max-em-iter", type=int, default=3)
-    a("-l", "--max-iter", type=int, default=10)
-    a("-m", "--max-lbfgs", type=int, default=10)
-    a("-x", "--lbfgs-m", type=int, default=7)
+    a("-g", "--max-iter", type=int, default=10,
+      help="max iterations within single EM (MPI/main.cpp -g)")
+    a("-l", "--max-lbfgs", type=int, default=10,
+      help="max LBFGS iterations (MPI/main.cpp -l)")
+    a("-m", "--lbfgs-m", type=int, default=7,
+      help="LBFGS memory size (MPI/main.cpp -m)")
+    a("-x", "--uvmin", type=float, default=0.0,
+      help="exclude baselines shorter than this (lambda; -x)")
+    a("-y", "--uvmax", type=float, default=1e9,
+      help="exclude baselines longer than this (lambda; -y)")
     a("-j", "--solver-mode", type=int, default=5)
     a("-L", "--nulow", type=float, default=2.0)
     a("-H", "--nuhigh", type=float, default=30.0)
@@ -53,11 +60,13 @@ def build_parser() -> argparse.ArgumentParser:
     a("-T", "--max-timeslots", type=int, default=0)
     a("-K", "--skip-timeslots", type=int, default=0)
     a("-U", "--use-global-solution", type=int, default=0)
-    a("-M", "--mdl", action="store_true",
-      help="report MDL/AIC consensus-polynomial model order (mdl.c:42)")
+    a("--mdl", action="store_true",
+      help="report MDL/AIC consensus-polynomial model order (mdl.c:42; "
+           "the reference's disabled -M meaning)")
     a("-N", "--epochs", type=int, default=0,
       help=">0: stochastic federated mode (sagecal_stochastic_*.cpp)")
-    a("--minibatches", type=int, default=1)
+    a("-M", "--minibatches", type=int, default=1,
+      help="stochastic minibatches (MPI/main.cpp -M)")
     a("-w", "--bands", type=int, default=1,
       help="channels per mini-band in stochastic mode")
     a("-u", "--federated-alpha", type=float, default=0.0,
@@ -65,10 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
     a("-X", "--spatialreg", default=None,
       help="spatial regularization: l2,l1,order,fista_iters,cadence")
     a("-V", "--verbose", action="store_true")
-    a("--input-column", default="DATA",
-      help="MS data column to calibrate (CasaMS backend)")
-    a("--output-column", default="CORRECTED_DATA",
-      help="MS column receiving residuals (CasaMS backend)")
+    a("-I", "--input-column", default="DATA",
+      help="data column to calibrate (Data::DataField)")
+    a("-O", "--output-column", default="CORRECTED_DATA",
+      help="column receiving residuals (Data::OutField)")
     # multi-host execution (the mpirun analogue): same program on every
     # host, coordinated through jax.distributed; the mesh then spans all
     # hosts' devices and subband shards ride ICI/DCN
@@ -148,6 +157,10 @@ def main(argv=None) -> int:
 
     if args.epochs > 0:
         # stochastic federated mode (reference main.cpp:330-342 dispatch)
+        if args.uvmin > 0.0 or args.uvmax < 1e9:
+            print("Warning: -x/-y uv cuts are not applied in federated "
+                  "stochastic mode; calibrating all baselines",
+                  file=sys.stderr)
         from sagecal_tpu import federated
         from sagecal_tpu.config import RunConfig
         cfg = RunConfig(
@@ -350,12 +363,28 @@ def main(argv=None) -> int:
         # stay excluded from the solve; the downweight ratio is the GOOD
         # fraction (sagecal_slave.cpp:513)
         x8_l, wt_l, fr_l = [], [], []
+        uvcut_on = args.uvmin > 0.0 or args.uvmax < 1e9
+        orig_flags = [t.flags for t in tiles]
         for t in tiles:
+            if uvcut_on:
+                # uv-window rows -> flag 2: subtracted, excluded from
+                # the solve (predict.c:876 rule, as in the single-node
+                # pipeline). Solve-scoped only: the original flags are
+                # restored before write-back so the cut is never baked
+                # into the stored dataset.
+                t.flags = np.asarray(rp.uvcut_flags(
+                    jnp.asarray(t.flags, jnp.int32),
+                    jnp.asarray(t.u, rdt), jnp.asarray(t.v, rdt),
+                    jnp.asarray(t.freqs, rdt),
+                    args.uvmin, args.uvmax), np.int8)
             x8_t, flags_t, good = t.solve_input()
             fr_l.append(good)
             x8_l.append(x8_t)
             wt_l.append(np.asarray(lm_mod.make_weights(
                 jnp.asarray(flags_t, jnp.int32), rdt)))
+        if uvcut_on:
+            for t, fl in zip(tiles, orig_flags):
+                t.flags = fl
         x8F = np.stack(x8_l)
         uF = np.stack([t.u for t in tiles])
         vF = np.stack([t.v for t in tiles])

@@ -81,21 +81,21 @@ class RunConfig:
     # --- solve shape
     tile_size: int = 120               # -t : timeslots per solve interval
     max_em_iter: int = 3               # -e : EM iterations
-    single_max_iter: int = 2           # -g : iterations for single-cluster solves... (-g)
-    max_iter: int = 10                 # -l : LM/RTR iterations per cluster solve
-    max_lbfgs: int = 10                # -m : LBFGS iterations
-    lbfgs_m: int = 7                   # -x : LBFGS memory size
+    max_iter: int = 10                 # -g : LM/RTR iterations per cluster solve
+    max_lbfgs: int = 10                # -l : LBFGS iterations
+    lbfgs_m: int = 7                   # -m : LBFGS memory size
     gpu_threads: int = 64              # -S (unused on TPU; kept for parity)
     n_threads: int = 4                 # -n : host threads for IO
     solver_mode: SolverMode = SolverMode.RTR_OSRLM_RLBFGS  # -j
     robust_nulow: float = 2.0          # -L
     robust_nuhigh: float = 30.0        # -H
-    linsolv: int = 1                   # -y : 0 Cholesky 1 QR 2 SVD
+    linsolv: int = 1                   # --linsolv : 0 Cholesky 1 QR 2 SVD
     randomize: bool = True             # -R : ordered-subsets randomization
 
     # --- data selection / conditioning
-    uvmin: float = 0.0                 # -I (lambda)
-    uvmax: float = 1e9                 # -o
+    uvmin: float = 0.0                 # -x (lambda)
+    uvmax: float = 1e9                 # -y
+    mmse_rho: float = 1e-9             # -o : correction MMSE rho (Data::rho)
     uvtaper: float = 0.0               # -A (MS app meaning: taper)
     whiten: bool = False               # -W : uv-density whitening
     channel_avg_per_band: int = 1      # -w : mini-bands (bandpass)

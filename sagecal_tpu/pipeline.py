@@ -417,6 +417,7 @@ class FullBatchPipeline:
             self.dsky, ne.jones_r2c(J_r8), utils.r2c(x_r), u, v, w, freqs,
             meta["fdelta"] / len(meta["freqs"]), sta1, sta2,
             jnp.asarray(self.cidx), sub, correct_idx=self._correct_idx(),
+            rho=self.cfg.mmse_rho,
             beam=beam, dobeam=self.dobeam, tslot=jnp.asarray(self.tslot),
             phase_only=self.cfg.phase_only)
         return utils.c2r(res)

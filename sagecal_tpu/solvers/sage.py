@@ -114,9 +114,9 @@ def _jit_sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
 
 class SageConfig(NamedTuple):
     max_emiter: int = 3
-    max_iter: int = 10            # LM/RTR iterations per cluster solve (-l)
-    max_lbfgs: int = 10           # joint refine iterations (-m)
-    lbfgs_m: int = 7              # LBFGS memory (-x)
+    max_iter: int = 10            # LM/RTR iterations per cluster solve (-g)
+    max_lbfgs: int = 10           # joint refine iterations (-l)
+    lbfgs_m: int = 7              # LBFGS memory (-m)
     solver_mode: int = int(SolverMode.RTR_OSRLM_RLBFGS)  # -j
     nulow: float = 2.0
     nuhigh: float = 30.0

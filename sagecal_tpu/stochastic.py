@@ -449,7 +449,8 @@ class _StochasticRunner:
             res = rr.calculate_residuals_multifreq(
                 self.dsky, ne.jones_r2c(J_r8), _x8f_to_complex(x8F),
                 u, v, w, freqsF, self.fdelta_chan, sta1, sta2, cidx, sub,
-                correct_idx=correct_idx, beam=beam, dobeam=self.dobeam,
+                correct_idx=correct_idx, rho=self.cfg.mmse_rho,
+                beam=beam, dobeam=self.dobeam,
                 tslot=tslot)
             B, F = x8F.shape[0], x8F.shape[1]
             return utils.c2r(res.reshape(B, F, 4)).reshape(B, F, 8)

@@ -191,7 +191,7 @@ def test_federated_stochastic(tmp_path):
         "-f", str(lst), "-s", str(tmp_path / "sky.txt"),
         "-c", str(tmp_path / "sky.txt.cluster"),
         "-N", "2", "--minibatches", "1", "-A", "3", "-P", "2",
-        "-r", "1.0", "-u", "0.5", "-m", "10", "-l", "5"])
+        "-r", "1.0", "-u", "0.5", "-l", "10", "-g", "5"])
     assert rc == 0
 
 
@@ -203,7 +203,7 @@ def test_admm_spatialreg_runs(tmp_path):
         "-s", str(tmp_path / "sky.txt"),
         "-c", str(tmp_path / "sky.txt.cluster"),
         "-A", "4", "-P", "2", "-r", "1.0", "-j", "2", "-e", "2",
-        "-l", "4", "-m", "4", "-M",
+        "-g", "4", "-l", "4", "--mdl",
         "-u", "0.1", "-X", "0.01,0.001,2,20,2"])
     assert rc == 0
 

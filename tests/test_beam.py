@@ -234,7 +234,7 @@ def _run_beam_pipeline(tmp_path, msdir, extra_args):
     args = cli.build_parser().parse_args([
         "-d", str(msdir), "-s", str(tmp_path / "sky.txt"),
         "-c", str(tmp_path / "sky.txt.cluster"),
-        "-e", "2", "-m", "5", "-B", "2"] + extra_args)
+        "-e", "2", "-l", "5", "-B", "2"] + extra_args)
     cfg = cli.config_from_args(args)
     history = pipeline.run(cfg, log=lambda *a: None)
     assert len(history) == 1
@@ -248,7 +248,7 @@ def test_fullbatch_pipeline_withbeam(tmp_path):
     calibrate with -B FULL through the full pipeline; solver must
     converge and beat the initial residual."""
     msdir = _beam_pipeline_fixture(tmp_path)
-    _run_beam_pipeline(tmp_path, msdir, ["-j", "0", "-l", "10"])
+    _run_beam_pipeline(tmp_path, msdir, ["-j", "0", "-g", "10"])
 
 
 def test_fullbatch_pipeline_withbeam_sharded(tmp_path):
@@ -257,7 +257,7 @@ def test_fullbatch_pipeline_withbeam_sharded(tmp_path):
     unsharded beam run."""
     msdir = _beam_pipeline_fixture(tmp_path)
     _run_beam_pipeline(tmp_path, msdir,
-                       ["-j", "1", "-l", "8", "--shard-baselines"])
+                       ["-j", "1", "-g", "8", "--shard-baselines"])
 
 
 def test_stochastic_pipeline_withbeam(tmp_path):
@@ -293,7 +293,7 @@ def test_stochastic_pipeline_withbeam(tmp_path):
     args = cli.build_parser().parse_args([
         "-d", str(msdir), "-s", str(tmp_path / "sky.txt"),
         "-c", str(tmp_path / "sky.txt.cluster"),
-        "-N", "4", "-M", "2", "-l", "20", "-m", "7", "-B", "2"])
+        "-N", "4", "-M", "2", "-g", "20", "-l", "7", "-B", "2"])
     cfg = cli.config_from_args(args)
     history = stochastic.run_minibatch(cfg, log=lambda *a: None)
     h = history[0]

@@ -57,7 +57,7 @@ def test_mpi_cli_end_to_end(tmp_path):
     rc = cli_mpi.main([
         "-f", str(listfile), "-s", str(sky_path), "-c", str(clus_path),
         "-p", str(solfile), "-A", "4", "-P", "2", "-Q", "2", "-r", "2",
-        "-e", "2", "-l", "8", "-m", "4", "-j", "0", "-t", "3"])
+        "-e", "2", "-g", "8", "-l", "4", "-j", "0", "-t", "3"])
     assert rc == 0
 
     # residuals written back: mean level far below raw data
@@ -109,7 +109,7 @@ def test_mpi_cli_per_channel_flags(tmp_path):
     rc = cli_mpi.main([
         "-f", str(listfile), "-s", str(sky_path), "-c", str(clus_path),
         "-A", "3", "-P", "2", "-Q", "2", "-r", "2",
-        "-e", "2", "-l", "6", "-m", "4", "-j", "0", "-t", "3"])
+        "-e", "2", "-g", "6", "-l", "4", "-j", "0", "-t", "3"])
     assert rc == 0
     # with the garbage channel excluded the residual must be small;
     # averaging it in would leave residuals ~ 3e5
@@ -133,7 +133,7 @@ def test_mpi_cli_uneven_subbands(tmp_path, monkeypatch):
     rc = cli_mpi.main([
         "-f", str(listfile), "-s", str(sky_path), "-c", str(clus_path),
         "-p", str(solfile), "-A", "3", "-P", "2", "-Q", "2", "-r", "2",
-        "-e", "2", "-l", "6", "-m", "4", "-j", "0", "-t", "3",
+        "-e", "2", "-g", "6", "-l", "4", "-j", "0", "-t", "3",
         "-U", "1"])   # -U: exercise the real-basis BZ einsum under padding
     assert rc == 0
     for p in paths:
@@ -227,3 +227,31 @@ def test_admm_padded_subbands_match_unpadded():
                                rtol=1e-8, atol=1e-10)
     np.testing.assert_allclose(np.asarray(JF_p)[:nf], np.asarray(JF_u),
                                rtol=1e-8, atol=1e-10)
+
+
+def test_mpi_cli_uvcut_solve_scoped(tmp_path):
+    """-x/-y exclude baselines from the solve (flag 2, predict.c:876)
+    without persisting the cut: stored flags are untouched after the
+    run, so a later run without -x sees every baseline again."""
+    sky_path, clus_path, paths, sky = make_subbands(tmp_path, nf=2)
+    t0 = ds.SimMS(paths[0]).read_tile(0)
+    before = t0.flags.copy()
+    # a cut that provably bites: threshold at the median uv distance
+    # in the same lambda units uvcut_flags uses
+    uvd = np.sqrt(t0.u ** 2 + t0.v ** 2) * t0.freqs[0]
+    cut = float(np.median(uvd))
+    assert (uvd < cut).any() and (uvd >= cut).any()
+    listfile = tmp_path / "mslist.txt"
+    listfile.write_text("\n".join(paths) + "\n")
+    rc = cli_mpi.main([
+        "-f", str(listfile), "-s", str(sky_path), "-c", str(clus_path),
+        "-A", "2", "-P", "2", "-Q", "2", "-r", "2",
+        "-e", "1", "-g", "4", "-l", "2", "-j", "0", "-t", "3",
+        "-x", str(cut)])
+    assert rc == 0
+    after = ds.SimMS(paths[0]).read_tile(0).flags
+    np.testing.assert_array_equal(after, before)
+    # residuals were still written for every row (uv-cut rows are
+    # subtracted, not dropped)
+    res = ds.SimMS(paths[0], data_column="CORRECTED_DATA").read_tile(0)
+    assert np.isfinite(res.x).all()

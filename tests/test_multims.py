@@ -108,8 +108,8 @@ def test_joint_calibration_matches_merged_band(bands):
     """Calibrating two half-band datasets jointly via -f must equal
     calibrating the pre-merged band (VERDICT item 4 'done' criterion)."""
     tmp, skyp, clup = bands
-    common = ["-s", skyp, "-c", clup, "-t", "4", "-e", "2", "-l", "5",
-              "-m", "5", "-j", "0", "-R", "0"]
+    common = ["-s", skyp, "-c", clup, "-t", "4", "-e", "2", "-g", "5",
+              "-l", "5", "-j", "0", "-R", "0"]
     sol_joint = os.path.join(tmp, "sol_joint.txt")
     sol_full = os.path.join(tmp, "sol_full.txt")
     rc = cli.main(["-f", os.path.join(tmp, "[lh][oi].ms"),

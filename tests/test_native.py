@@ -140,7 +140,7 @@ def test_pipeline_with_channel_flags(tmp_path):
     args = cli.build_parser().parse_args([
         "-d", str(msdir), "-s", str(tmp_path / "sky.txt"),
         "-c", str(tmp_path / "sky.txt.cluster"),
-        "-j", "0", "-e", "2", "-l", "8", "-m", "5"])
+        "-j", "0", "-e", "2", "-g", "8", "-l", "5"])
     cfg = cli.config_from_args(args)
     history = pipeline.run(cfg, log=lambda *a: None)
     h = history[0]

@@ -56,7 +56,7 @@ def test_fullbatch_pipeline(simdir):
     solpath = str(tmp / "solutions.txt")
     args = cli.build_parser().parse_args([
         "-d", msdir, "-s", sky_path, "-c", clus_path, "-p", solpath,
-        "-j", "0", "-e", "2", "-l", "10", "-m", "5", "-t", "4"])
+        "-j", "0", "-e", "2", "-g", "10", "-l", "5", "-t", "4"])
     cfg = cli.config_from_args(args)
     history = pipeline.run(cfg, log=lambda *a: None)
     assert len(history) == 2
@@ -120,7 +120,7 @@ def test_per_channel_mode(simdir):
     tmp, msdir, sky_path, clus_path, Jtrue = simdir
     args = cli.build_parser().parse_args([
         "-d", msdir, "-s", sky_path, "-c", clus_path,
-        "-j", "0", "-e", "2", "-l", "8", "-m", "6", "-t", "4", "-b", "1"])
+        "-j", "0", "-e", "2", "-g", "8", "-l", "6", "-t", "4", "-b", "1"])
     cfg = cli.config_from_args(args)
     history = pipeline.run(cfg, log=lambda *a: None)
     assert len(history) == 2
@@ -142,7 +142,7 @@ def test_fullbatch_shard_baselines(simdir):
     tmp, msdir, sky_path, clus_path, Jtrue = simdir
     args = cli.build_parser().parse_args([
         "-d", msdir, "-s", sky_path, "-c", clus_path,
-        "-j", "1", "-e", "2", "-l", "8", "-m", "5", "-t", "4",
+        "-j", "1", "-e", "2", "-g", "8", "-l", "5", "-t", "4",
         "--shard-baselines"])
     cfg = cli.config_from_args(args)
     history = pipeline.run(cfg, log=lambda *a: None)

@@ -120,7 +120,7 @@ def test_per_channel_bandpass_mode(tmp_path):
     args = cli.build_parser().parse_args([
         "-d", str(msdir), "-s", str(tmp_path / "sky.txt"),
         "-c", str(tmp_path / "sky.txt.cluster"), "-p", solpath,
-        "-j", "0", "-e", "2", "-l", "8", "-m", "6", "-b", "1"])
+        "-j", "0", "-e", "2", "-g", "8", "-l", "6", "-b", "1"])
     cfg = cli.config_from_args(args)
     assert cfg.per_channel_bfgs
     history = pipeline.run(cfg, log=lambda *a: None)

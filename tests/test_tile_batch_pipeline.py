@@ -48,7 +48,7 @@ def _run(tmp, msdir, sky_path, clus_path, extra, solname):
     solpath = str(tmp / solname)
     args = cli.build_parser().parse_args([
         "-d", msdir, "-s", sky_path, "-c", clus_path, "-p", solpath,
-        "-j", "0", "-e", "2", "-l", "8", "-m", "4", "-t", "4"] + extra)
+        "-j", "0", "-e", "2", "-g", "8", "-l", "4", "-t", "4"] + extra)
     cfg = cli.config_from_args(args)
     return pipeline.run(cfg, log=lambda *a: None), solpath
 

@@ -128,7 +128,7 @@ def main():
     cmd = [sys.executable, "-m", "sagecal_tpu.cli_mpi",
            "-f", lst, "-s", skyp, "-c", clup,
            "-A", str(args.admm), "-P", "2", "-Q", "2", "-r", "5",
-           "-j", str(args.solver), "-e", "1", "-l", "3", "-m", "0",
+           "-j", str(args.solver), "-e", "1", "-g", "3", "-l", "0",
            "-t", str(args.tilesz), "-V",
            "--block-f", str(args.block_f),
            "--inflight", str(args.inflight)]

@@ -1,14 +1,22 @@
 #!/bin/bash
-# Chip-wake playbook (VERDICT r5 items 1+2): the moment the tunneled TPU
-# answers, bank the on-chip evidence in this order — the tunnel goes
-# through multi-hour dead phases, so the record must land on the FIRST
-# healthy window, not after iterating.
+# Chip-wake playbook (round 5, post-measurement revision): bank on-chip
+# evidence the moment the tunneled TPU answers. The tunnel goes through
+# multi-hour dead phases, so the record must land on the FIRST healthy
+# window.
 #
-#   1. full bench on the chip  -> BENCH_TPU_r05.json + commit
-#   2. north-star width sweep (G=4 then G=8; warm ADMM iterations use
-#      the group width; the G=1 baseline is 114.045 s/iter) ->
-#      NORTHSTAR.json + commit, never regressing a previously banked
-#      faster record
+# Measured 2026-07-31 on the real chip (this revision encodes those
+# results — do not re-sweep the known-bad settings):
+#   - bench lever defaults are T=1/G=1 (tile-batch T=8 never finishes a
+#     config; inflight G>=2 is 0.68-0.69x sequential);
+#   - north-star: block-f=2, G=1 is the optimum of everything tried
+#     (113.78 s/iter warm; block-f=1 ~ same, block-f=4 ~1.3x slower,
+#     G=4 1.46x slower). Only re-run the north-star if NORTHSTAR.json
+#     is not a TPU record (e.g. after a CPU fallback overwrote it).
+#   - SimMS write-back now lands in CORRECTED_DATA, so the shared
+#     dataset dir stays pristine across runs.
+#
+#   1. full bench on the chip -> BENCH_TPU_r05.json + commit
+#   2. north-star at the measured-best settings if no TPU record exists
 #
 # Usage: bash tools_dev/tpu_wake.sh   (from the repo root)
 set -e
@@ -66,7 +74,7 @@ then
     git add BENCH_TPU_r05.json BENCH_TABLE.md bench_results.json
     # a no-op commit (identical re-run) must NOT abort the playbook
     # before the north-star step under set -e
-    git commit -m "Archive the round-5 healthy-chip TPU bench record" \
+    git commit -m "Archive a round-5 healthy-chip TPU bench record" \
         || true
 else
     # window died without one TPU row: don't leave a zeroed/FAILED
@@ -77,64 +85,24 @@ else
     exit 1
 fi
 
-echo "== north-star sweep: width G=4,8 then block-f at the best width =="
-# commit after EVERY improving run — the tunnel can die any minute, and
-# an unbanked on-chip record is the round-4 failure all over again.
-# keep_if_faster: compare NORTHSTAR.json against the last committed
-# record; restore the committed one (json + table row) on regression.
-keep_if_faster() {
-    if ! $PY - <<'EOF'
-import json, subprocess, sys
-new = json.load(open("NORTHSTAR.json"))
-prev = json.loads(subprocess.run(
-    ["git", "show", "HEAD:NORTHSTAR.json"],
-    capture_output=True, text=True, check=True).stdout)
-if new.get("platform") != "tpu":
-    print(f"run landed on {new.get('platform')}, not tpu; keeping committed")
-    sys.exit(4)
-if (prev.get("platform") == "tpu"
-        and prev["value"] <= new.get("value", 1e18)):
-    print(f"committed record {prev['value']} beats this run's "
-          f"{new.get('value')}; keeping committed")
-    sys.exit(4)
-print(f"north-star improved: {new.get('value')} (was {prev.get('value')})")
-EOF
-    then
-        git checkout -- NORTHSTAR.json BENCH_TABLE.md 2>/dev/null || true
-        return 1
-    fi
-    git add NORTHSTAR.json BENCH_TABLE.md
-    git commit -m "North-star improved on chip: $1" || true
-}
-
-# shared dataset dir: generation costs minutes per run and the synthetic
-# observation is seeded/deterministic — generate once, reuse across
-# trials AND windows
-NS="$PY tools_dev/northstar.py --keep /tmp/northstar_data"
-
-if timeout 3000 $NS --inflight 4; then
-    keep_if_faster "inflight G=4" || true
-else
-    git checkout -- NORTHSTAR.json BENCH_TABLE.md 2>/dev/null || true
+# North-star: only if the committed record is not already an on-chip
+# measurement (the measured-best settings are hardcoded; sweeping was
+# done 2026-07-31 and the landscape is in MIGRATION.md).
+if $PY -c "import json,sys; sys.exit(0 if json.load(open('NORTHSTAR.json')).get('platform')=='tpu' else 1)"
+then
+    echo "north-star already a TPU record; done"
     exit 0
 fi
-if timeout 3000 $NS --inflight 8; then
-    keep_if_faster "inflight G=8" || true
+echo "== north-star at measured-best settings (block-f 2, G=1) =="
+NS="$PY tools_dev/northstar.py --keep /tmp/northstar_data"
+if timeout 3000 $NS --inflight 1 --block-f 2; then
+    if $PY -c "import json,sys; sys.exit(0 if json.load(open('NORTHSTAR.json')).get('platform')=='tpu' else 1)"
+    then
+        git add NORTHSTAR.json BENCH_TABLE.md
+        git commit -m "North-star re-banked on chip (block-f=2, G=1)" || true
+    else
+        git checkout -- NORTHSTAR.json BENCH_TABLE.md 2>/dev/null || true
+    fi
 else
     git checkout -- NORTHSTAR.json BENCH_TABLE.md 2>/dev/null || true
 fi
-# dispatch-latency lever: the default plan runs F/block_f bounded
-# executions per ADMM iteration over a latency-spiky tunnel; bigger
-# blocks halve the dispatch count while staying far under the ~60 s
-# per-execution kill. Try block_f 4 then 8 at the best width so far.
-GBEST=$($PY -c "import json; print(json.load(open('NORTHSTAR.json')).get('inflight', 4))")
-for BF in 4 8; do
-    if timeout 3000 $NS --inflight "$GBEST" --block-f "$BF"; then
-        keep_if_faster "block_f=$BF at G=$GBEST" || true
-    else
-        git checkout -- NORTHSTAR.json BENCH_TABLE.md 2>/dev/null || true
-        break
-    fi
-done
-echo "compare NORTHSTAR.json residuals vs the G=1 run's (stored in the"
-echo "json) before trusting the number."

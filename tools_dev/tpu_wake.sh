@@ -93,13 +93,13 @@ then
     echo "north-star already a TPU record; done"
     exit 0
 fi
-echo "== north-star at measured-best settings (block-f 2, G=1) =="
+echo "== north-star at measured-best settings (block-f 1, G=1) =="
 NS="$PY tools_dev/northstar.py --keep /tmp/northstar_data"
-if timeout 3000 $NS --inflight 1 --block-f 2; then
+if timeout 3000 $NS --inflight 1 --block-f 1; then
     if $PY -c "import json,sys; sys.exit(0 if json.load(open('NORTHSTAR.json')).get('platform')=='tpu' else 1)"
     then
         git add NORTHSTAR.json BENCH_TABLE.md
-        git commit -m "North-star re-banked on chip (block-f=2, G=1)" || true
+        git commit -m "North-star re-banked on chip (block-f=1, G=1)" || true
     else
         git checkout -- NORTHSTAR.json BENCH_TABLE.md 2>/dev/null || true
     fi

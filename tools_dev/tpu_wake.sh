@@ -8,8 +8,8 @@
 # results — do not re-sweep the known-bad settings):
 #   - bench lever defaults are T=1/G=1 (tile-batch T=8 never finishes a
 #     config; inflight G>=2 is 0.68-0.69x sequential);
-#   - north-star: block-f=2, G=1 is the optimum of everything tried
-#     (113.78 s/iter warm; block-f=1 ~ same, block-f=4 ~1.3x slower,
+#   - north-star: block-f=1, G=1 is the optimum of everything tried
+#     (107.8 s/iter warm; block-f=2 113.8, block-f=4 ~1.3x slower,
 #     G=4 1.46x slower). Only re-run the north-star if NORTHSTAR.json
 #     is not a TPU record (e.g. after a CPU fallback overwrote it).
 #   - SimMS write-back now lands in CORRECTED_DATA, so the shared

@@ -48,6 +48,20 @@ def build_parser() -> argparse.ArgumentParser:
       help="exclude baselines shorter than this (lambda; -x)")
     a("-y", "--uvmax", type=float, default=1e9,
       help="exclude baselines longer than this (lambda; -y)")
+    a("-n", "--n-threads", type=int, default=4,
+      help="accepted for reference parity; host threading is XLA's")
+    a("-R", "--randomize", type=int, default=1,
+      help="randomize cluster visiting order (MPI/main.cpp -R)")
+    a("-W", "--whiten", type=int, default=0,
+      help="uv-density whitening of the solve input (updatenu.c)")
+    a("-k", "--correct-cluster", type=int, default=None,
+      help="cluster id whose solutions correct the residual (-k)")
+    a("-o", "--mmse-rho", type=float, default=1e-9,
+      help="robust rho for MMSE inversion during correction (-o)")
+    a("-J", "--phase-only", type=int, default=0,
+      help=">0: phase-only correction (-J)")
+    a("-q", "--init-solutions",
+      help="warm-start J from this solution file (1 interval, J format)")
     a("-j", "--solver-mode", type=int, default=5)
     a("-L", "--nulow", type=float, default=2.0)
     a("-H", "--nuhigh", type=float, default=30.0)
@@ -278,6 +292,7 @@ def main(argv=None) -> int:
             max_lbfgs=args.max_lbfgs, lbfgs_m=args.lbfgs_m,
             solver_mode=int(SolverMode(args.solver_mode)),
             nulow=args.nulow, nuhigh=args.nuhigh,
+            randomize=bool(args.randomize),
             inflight=args.inflight))
 
     t0 = mss[0].read_tile(0)
@@ -300,14 +315,19 @@ def main(argv=None) -> int:
             Bpoly_pad, cfg, mesh, nf, spatial_coords=spatial_coords,
             host_loop=args.host_loop)
 
-    # residual program (per subband, local J)
+    # residual program (per subband, local J); -k correction uses the
+    # subband's own solutions (sagecal_slave.cpp residual path)
+    correct_idx = skymodel.correct_cluster_index(
+        sky, args.correct_cluster)
+
     def residual_fn(J_r8, x_r, u, v, w, freq):
         J = nesolver.jones_r2c(J_r8)
         x = utils.r2c(x_r)
         res = rr.calculate_residuals_multifreq(
             dsky, J, x, u, v, w, freq[None], meta0["fdelta"],
             jnp.asarray(t0.sta1), jnp.asarray(t0.sta2), jnp.asarray(cidx),
-            jnp.asarray(sky.subtract_mask()))
+            jnp.asarray(sky.subtract_mask()), correct_idx=correct_idx,
+            rho=args.mmse_rho, phase_only=bool(args.phase_only))
         return utils.c2r(res)
 
     res_jit = jax.jit(jax.vmap(residual_fn))
@@ -354,6 +374,13 @@ def main(argv=None) -> int:
 
     Jinit = utils.jones_c2r_np(np.tile(
         np.eye(2, dtype=complex), (nf, sky.n_clusters, kmax, n, 1, 1)))
+    if args.init_solutions:
+        # -q: warm-start every subband from one interval of J solutions
+        # (MPI/main.cpp -q; J format, not the Z/polynomial output file)
+        Jq = sol.read_warm_start(args.init_solutions, sky, n)
+        if Jq is not None:
+            Jinit = np.tile(utils.jones_c2r_np(np.asarray(Jq))[None],
+                            (nf, 1, 1, 1, 1))
     J0 = Jinit.copy()
 
     for ti in range(start, stop):
@@ -379,6 +406,11 @@ def main(argv=None) -> int:
                     args.uvmin, args.uvmax), np.int8)
             x8_t, flags_t, good = t.solve_input()
             fr_l.append(good)
+            if args.whiten:
+                from sagecal_tpu.solvers import robust as rb
+                x8_t = np.asarray(rb.whiten_data(
+                    jnp.asarray(x8_t, rdt), jnp.asarray(t.u, rdt),
+                    jnp.asarray(t.v, rdt), t.freq0))
             x8_l.append(x8_t)
             wt_l.append(np.asarray(lm_mod.make_weights(
                 jnp.asarray(flags_t, jnp.int32), rdt)))

@@ -123,6 +123,34 @@ class SolutionWriter:
         self.close()
 
 
+def read_warm_start(path: str, sky, n_stations: int):
+    """-q warm start: ONE interval of J-format solutions, validated
+    against the run's shape (main.cpp -q: "need to have the same format
+    as a solution file, only solutions for 1 timeslot needed").
+
+    Returns [M, Kmax, N, 2, 2] complex or None for an empty file; a
+    stochastic multi-band file warm-starts from band 0. Raises on a
+    station/cluster mismatch — including the Z/polynomial global file
+    this framework's distributed CLI writes with -p, whose column count
+    is n_eff_clusters * npoly and which would otherwise be silently
+    misread as Jones columns."""
+    header, blocks = read_solutions(path, sky.nchunk)
+    if not blocks:
+        return None
+    if header["n_stations"] != n_stations:
+        raise ValueError(
+            f"-q {path}: solution file is for {header['n_stations']} "
+            f"stations, run has {n_stations}")
+    if header["n_eff_clusters"] != sky.n_eff_clusters:
+        raise ValueError(
+            f"-q {path}: solution file has {header['n_eff_clusters']} "
+            f"effective clusters, run has {sky.n_eff_clusters} (a -p "
+            f"consensus Z file has n_eff_clusters x npoly columns and "
+            f"cannot seed -q; use a worker/J solution file)")
+    last = blocks[-1]
+    return last[0] if isinstance(last, list) else last
+
+
 def read_solutions(path: str, nchunk: np.ndarray):
     """Read a solution file -> (header dict, list of [M, Kmax, N, 2, 2]).
 

@@ -399,11 +399,9 @@ class FullBatchPipeline:
 
     def _correct_idx(self):
         """-k cluster id -> padded-array index (or None)."""
-        if self.cfg.correct_cluster is None:
-            return None
-        matches = np.where(self.sky.cluster_ids
-                           == self.cfg.correct_cluster)[0]
-        return int(matches[0]) if len(matches) else None
+        from sagecal_tpu import skymodel
+        return skymodel.correct_cluster_index(
+            self.sky, self.cfg.correct_cluster)
 
     def _residuals(self, J_r8, x_r, u, v, w, sta1, sta2, beam=None,
                    freqs=None):
@@ -471,12 +469,10 @@ class FullBatchPipeline:
         J0 = np.tile(np.eye(2, dtype=np.complex128),
                      (M, self.kmax, self.n, 1, 1))
         if self.cfg.init_solutions:
-            _, blocks = sol.read_solutions(self.cfg.init_solutions,
-                                           self.sky.nchunk)
-            if blocks:
-                last = blocks[-1]
-                # a stochastic multi-band file warm-starts from band 0
-                J0 = last[0] if isinstance(last, list) else last
+            Jq = sol.read_warm_start(self.cfg.init_solutions, self.sky,
+                                     self.n)
+            if Jq is not None:
+                J0 = Jq
         return J0
 
     def _run_batched(self, write_residuals, solution_path, max_tiles, log):

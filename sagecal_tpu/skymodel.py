@@ -421,3 +421,19 @@ def split_for_pallas(sky: ClusterSky):
         else:
             fields[f.name] = pack(a)
     return sky_pg, ClusterSky(**fields)
+
+
+def correct_cluster_index(sky, ccid, warn=None):
+    """-k cluster id -> padded-array index, or None (with a warning)
+    when the id is absent — an explicitly requested correction that
+    resolves to nothing must not be silent (residual.c correction
+    path picks the cluster by its id column)."""
+    if ccid is None:
+        return None
+    matches = np.where(sky.cluster_ids == ccid)[0]
+    if not len(matches):
+        (warn or print)(
+            f"Warning: -k cluster id {ccid} not in the cluster file; "
+            f"writing uncorrected residuals")
+        return None
+    return int(matches[0])

@@ -255,3 +255,41 @@ def test_mpi_cli_uvcut_solve_scoped(tmp_path):
     # subtracted, not dropped)
     res = ds.SimMS(paths[0], data_column="CORRECTED_DATA").read_tile(0)
     assert np.isfinite(res.x).all()
+
+
+def test_mpi_cli_parity_knobs(tmp_path):
+    """The reference-MPI advanced letters run end-to-end: -W whitening,
+    -R 0 fixed order, -k/-o/-J correction, -q warm start."""
+    sky_path, clus_path, paths, sky = make_subbands(tmp_path, nf=2)
+    listfile = tmp_path / "mslist.txt"
+    listfile.write_text("\n".join(paths) + "\n")
+    base = ["-f", str(listfile), "-s", str(sky_path),
+            "-c", str(clus_path), "-A", "2", "-P", "2", "-Q", "2",
+            "-r", "2", "-e", "1", "-g", "4", "-l", "2", "-j", "0",
+            "-t", "3"]
+    rc = cli_mpi.main(base + ["-W", "1", "-R", "0"])
+    assert rc == 0
+    # -k isolation: identical runs, correction on vs off — only the
+    # correction step may differ
+    rc = cli_mpi.main(base)
+    assert rc == 0
+    res_plain = ds.SimMS(paths[0],
+                         data_column="CORRECTED_DATA").read_tile(0).x
+    rc = cli_mpi.main(base + ["-k", "0", "-o", "1e-8", "-J", "1"])
+    assert rc == 0
+    res_corr = ds.SimMS(paths[0],
+                        data_column="CORRECTED_DATA").read_tile(0).x
+    assert np.isfinite(res_corr).all()
+    assert np.abs(res_corr - res_plain).max() > 1e-9
+
+    # -q: warm-start J from a one-interval J-format solution file
+    Jq = ds.random_jones(sky.n_clusters, sky.nchunk, 8, seed=9, scale=0.1)
+    kmax = int(sky.nchunk.max())
+    qfile = tmp_path / "warm.txt"
+    w = sol.SolutionWriter(str(qfile), 150e6, 3e6, 1.0, 8,
+                           sky.n_clusters, sky.n_eff_clusters)
+    w.write_interval(np.asarray(Jq).reshape(
+        sky.n_clusters, kmax, 8, 2, 2), sky.nchunk)
+    w.close()
+    rc = cli_mpi.main(base + ["-q", str(qfile)])
+    assert rc == 0

@@ -62,6 +62,9 @@ def build_parser() -> argparse.ArgumentParser:
       help=">0: phase-only correction (-J)")
     a("-q", "--init-solutions",
       help="warm-start J from this solution file (1 interval, J format)")
+    a("-B", "--beam", type=int, default=0,
+      help="0 none, 1 array factor, 2 array+element, 3 element "
+           "(MPI/main.cpp -B; beam tables fold into the slave predict)")
     a("-j", "--solver-mode", type=int, default=5)
     a("-L", "--nulow", type=float, default=2.0)
     a("-H", "--nuhigh", type=float, default=30.0)
@@ -231,6 +234,14 @@ def main(argv=None) -> int:
         args.sky_model, args.cluster_file, meta0["ra0"], meta0["dec0"],
         float(freqs.mean()), bool(args.format))
     dsky = rp.sky_to_device(sky, rdt)
+    dobeam = int(args.beam)
+    beams_static = None
+    if dobeam:
+        from sagecal_tpu.rime import beam as bm
+        beams_static = [
+            bm.beam_to_device(bm.resolve_beaminfo(dobeam, m, m.meta),
+                              m.meta["freq0"], rdt)
+            for m in mss]
     n = meta0["n_stations"]
     kmax = int(sky.nchunk.max())
     cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
@@ -308,26 +319,32 @@ def main(argv=None) -> int:
                              "plan; it needs a 1-device mesh")
         runner = cadmm.make_admm_runner_blocked(
             dsky, t0.sta1, t0.sta2, cidx, cmask, n, meta0["fdelta"],
-            Bpoly_pad, cfg, nf, block_f=args.block_f, timer=blk_timer)
+            Bpoly_pad, cfg, nf, block_f=args.block_f,
+            dobeam=dobeam, nbase=meta0["nbase"], timer=blk_timer)
     else:
         runner = cadmm.make_admm_runner(
             dsky, t0.sta1, t0.sta2, cidx, cmask, n, meta0["fdelta"],
             Bpoly_pad, cfg, mesh, nf, spatial_coords=spatial_coords,
-            host_loop=args.host_loop)
+            host_loop=args.host_loop,
+            dobeam=dobeam, nbase=meta0["nbase"])
 
     # residual program (per subband, local J); -k correction uses the
     # subband's own solutions (sagecal_slave.cpp residual path)
     correct_idx = skymodel.correct_cluster_index(
         sky, args.correct_cluster)
 
-    def residual_fn(J_r8, x_r, u, v, w, freq):
+    tslot_rows = jnp.asarray(t0.tslot)
+
+    def residual_fn(J_r8, x_r, u, v, w, freq, *beam_rest):
         J = nesolver.jones_r2c(J_r8)
         x = utils.r2c(x_r)
         res = rr.calculate_residuals_multifreq(
             dsky, J, x, u, v, w, freq[None], meta0["fdelta"],
             jnp.asarray(t0.sta1), jnp.asarray(t0.sta2), jnp.asarray(cidx),
             jnp.asarray(sky.subtract_mask()), correct_idx=correct_idx,
-            rho=args.mmse_rho, phase_only=bool(args.phase_only))
+            rho=args.mmse_rho, phase_only=bool(args.phase_only),
+            beam=beam_rest[0] if beam_rest else None, dobeam=dobeam,
+            tslot=tslot_rows)
         return utils.c2r(res)
 
     res_jit = jax.jit(jax.vmap(residual_fn))
@@ -428,6 +445,20 @@ def main(argv=None) -> int:
         padded, _, _ = cadmm.pad_subbands(
             (x8F, uF, vF, wF, freqs, wtF, fratioF, J0), Bpoly, nf, ndev)
         args_dev = [stage(np.asarray(a, np.dtype(rdt))) for a in padded]
+        if dobeam:
+            from sagecal_tpu import coords as _coords
+            # static tables staged once (beams_static below); per tile
+            # only the [tilesz] gmst leaf changes
+            beams = [b._replace(gmst=jnp.asarray(
+                         _coords.jd2gmst_np(t.time_jd), rdt))
+                     for b, t in zip(beams_static, tiles)]
+            beamF = jax.tree.map(lambda *xs: np.stack(
+                [np.asarray(x) for x in xs]), *beams)
+            fpad_b = args_dev[0].shape[0]
+            if fpad_b > nf:     # padded mesh slots reuse subband 0's beam
+                beamF = jax.tree.map(lambda a: np.concatenate(
+                    [a, np.repeat(a[:1], fpad_b - nf, axis=0)]), beamF)
+            args_dev.append(jax.tree.map(stage, beamF))
         if blk_timer is not None:
             blk_timer.clear()
         JF_r8, Z, rhoF, res0, res1, r1s, duals, Y0F = runner(*args_dev)
@@ -493,9 +524,15 @@ def main(argv=None) -> int:
                 J_res = np.asarray(JF_r8).reshape(
                     nf, sky.n_clusters, kmax, n, 8)
             xF_r = np.stack([utils.c2r(t.x) for t in tiles])
+            bargs = ()
+            if dobeam:
+                # residual beam: the UNPADDED nf subbands only
+                bargs = (jax.tree.map(
+                    lambda a: jnp.asarray(a[:nf]), beamF),)
             res_r = res_jit(jnp.asarray(J_res, rdt), jnp.asarray(xF_r, rdt),
                             jnp.asarray(uF, rdt), jnp.asarray(vF, rdt),
-                            jnp.asarray(wF, rdt), jnp.asarray(freqs, rdt))
+                            jnp.asarray(wF, rdt), jnp.asarray(freqs, rdt),
+                            *bargs)
             res_np = utils.r2c(np.asarray(res_r))
             for f, (msx, t) in enumerate(zip(mss, tiles)):
                 t.x = res_np[f].astype(np.complex128)

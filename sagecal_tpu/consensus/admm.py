@@ -139,6 +139,7 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                      fdelta: float, B_poly: np.ndarray, cfg: ADMMConfig,
                      mesh: Mesh, nf_total: int, with_shapelets: bool = False,
                      spatial_coords=None, host_loop: bool = False,
+                     dobeam: int = 0, nbase: int | None = None,
                      _return_parts: bool = False):
     """Build the jitted per-timeslot consensus-ADMM program.
 
@@ -203,18 +204,35 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
     sta1_j = jnp.asarray(sta1)
     sta2_j = jnp.asarray(sta2)
 
-    def coh_for(u, v, w, freq):
-        return rp.coherencies(dsky, u, v, w, freq[None], fdelta,
-                              with_shapelets=with_shapelets)[:, :, 0]
+    from sagecal_tpu.io import dataset as _dsmod
+    # sta1 is per ROW ([nbase*tilesz]); the caller supplies the true
+    # baseline count for the row->timeslot map the beam indexes with
+    tslot_j = None
+    if dobeam:
+        if nbase is None:
+            raise ValueError("dobeam needs nbase (the per-timeslot "
+                             "baseline count) for the row->tslot map")
+        tslot_j = jnp.asarray(
+            _dsmod.row_tslot(len(np.asarray(sta1)), nbase))
 
-    def local_solve_plain(x8, u, v, w, wt, J_r8, freq):
-        coh = coh_for(u, v, w, freq)
+    def coh_for(u, v, w, freq, beam=None):
+        # with -B: per-subband beam tables folded into the source sum
+        # (precalculate_coherencies_multifreq_withbeam, the slaves'
+        # predict path predict_withbeam.c:690)
+        return rp.coherencies(dsky, u, v, w, freq[None], fdelta,
+                              with_shapelets=with_shapelets,
+                              beam=beam, dobeam=dobeam, tslot=tslot_j,
+                              sta1=sta1_j, sta2=sta2_j)[:, :, 0]
+
+    def local_solve_plain(x8, u, v, w, wt, J_r8, freq, beam=None):
+        coh = coh_for(u, v, w, freq, beam)
         J, info = sage.sagefit(x8, coh, sta1_j, sta2_j, cidx_j, cmask_j,
                                ne.jones_r2c(J_r8), N, wt, config=cfg.sage)
         return ne.jones_c2r(J), info["res_0"], info["res_1"]
 
-    def local_solve_admm(x8, u, v, w, wt, J_r8, freq, Y_r8, BZ_r8, rho_m):
-        coh = coh_for(u, v, w, freq)
+    def local_solve_admm(x8, u, v, w, wt, J_r8, freq, Y_r8, BZ_r8, rho_m,
+                         beam=None):
+        coh = coh_for(u, v, w, freq, beam)
         # ADMM iterations k>0 always warm-start from the previous
         # iterate, so cluster groups (inflight>1) skip the cold-start
         # width restriction; iteration 0 (local_solve_plain, cfg.sage
@@ -334,10 +352,11 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                  Zbar, Xd, rhoF)
         return carry, res0, res1, Y0F
 
-    def iter0_local(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
+    def iter0_local(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F,
+                    beamF=None):
         """ADMM iteration 0 on the LOCAL shard: plain solve + post."""
         JF, res0, res1 = jax.vmap(local_solve_plain)(
-            x8F, uF, vF, wF, wtF, J0F, freqF)
+            x8F, uF, vF, wF, wtF, J0F, freqF, beamF)
         return iter0_post(JF, res0, res1, fratioF)
 
     def body_post(Jr, r0, r1, carry, it, ax=axis):
@@ -383,13 +402,14 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         return (Jr, YF, Z, rhoF, Yhat, J5, Zbar, Xd, rho_upper), \
             (r0, r1, dual)
 
-    def body_local(x8F, uF, vF, wF, freqF, wtF, carry, it):
+    def body_local(x8F, uF, vF, wF, freqF, wtF, carry, it, beamF=None):
         """One ADMM iteration k>0 on the LOCAL shard (slave :686-770)."""
         Fl = x8F.shape[0]
         Brow = _brow(Fl)
         BZ = jnp.einsum("fp,mpknr->fmknr", Brow, carry[2])
         Jr, r0, r1 = jax.vmap(local_solve_admm)(
-            x8F, uF, vF, wF, wtF, carry[0], freqF, carry[1], BZ, carry[3])
+            x8F, uF, vF, wF, wtF, carry[0], freqF, carry[1], BZ,
+            carry[3], beamF)
         return body_post(Jr, r0, r1, carry, it)
 
     if _return_parts:
@@ -400,13 +420,16 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                     iter0_post=iter0_post, body_post=body_post,
                     _brow=_brow)
 
-    def admm_program(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
+    def admm_program(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F,
+                     *beam_rest):
         # shapes here are the LOCAL shard: [Fl, ...]
+        beamF = beam_rest[0] if beam_rest else None
         carry, res0, res1, Y0F = iter0_local(x8F, uF, vF, wF, freqF, wtF,
-                                             fratioF, J0F)
+                                             fratioF, J0F, beamF)
 
         def body(carry, it):
-            return body_local(x8F, uF, vF, wF, freqF, wtF, carry, it)
+            return body_local(x8F, uF, vF, wF, freqF, wtF, carry, it,
+                              beamF)
 
         carry, (r0s, r1s, duals) = jax.lax.scan(
             body, carry, jnp.arange(1, max(cfg.n_admm, 1)))
@@ -416,10 +439,11 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
     from jax import shard_map
     spec_f = P(axis)
     spec_r = P()
+    nin = 8 + (1 if dobeam else 0)     # beam pytree rides a prefix spec
     if not host_loop:
         prog = shard_map(
             admm_program, mesh=mesh,
-            in_specs=(spec_f,) * 8,
+            in_specs=(spec_f,) * nin,
             out_specs=(spec_f, spec_r, spec_f, spec_f, spec_f,
                        P(None, axis), spec_r, spec_f),
             check_vma=False)
@@ -433,35 +457,40 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
     carry_specs = (spec_f, spec_f, spec_r, spec_f, spec_f, spec_f,
                    spec_r, spec_r, spec_f)
 
-    def iter0_flat(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
-        carry, res0, res1, Y0F = iter0_local(x8F, uF, vF, wF, freqF, wtF,
-                                             fratioF, J0F)
+    def iter0_flat(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F,
+                   *beam_rest):
+        carry, res0, res1, Y0F = iter0_local(
+            x8F, uF, vF, wF, freqF, wtF, fratioF, J0F,
+            beam_rest[0] if beam_rest else None)
         return carry + (res0, res1, Y0F)
 
     def body_flat(x8F, uF, vF, wF, freqF, wtF, JF, YF, Z, rhoF, Yhat,
-                  Jprev, Zbar, Xd, rho_upper, it):
+                  Jprev, Zbar, Xd, rho_upper, it, *beam_rest):
         carry = (JF, YF, Z, rhoF, Yhat, Jprev, Zbar, Xd, rho_upper)
-        carry, (r0, r1, dual) = body_local(x8F, uF, vF, wF, freqF, wtF,
-                                           carry, it)
+        carry, (r0, r1, dual) = body_local(
+            x8F, uF, vF, wF, freqF, wtF, carry, it,
+            beam_rest[0] if beam_rest else None)
         return carry + (r0, r1, dual)
 
+    beam_specs = (spec_f,) if dobeam else ()
     prog0 = jax.jit(shard_map(
-        iter0_flat, mesh=mesh, in_specs=(spec_f,) * 8,
+        iter0_flat, mesh=mesh, in_specs=(spec_f,) * 8 + beam_specs,
         out_specs=carry_specs + (spec_f, spec_f, spec_f),
         check_vma=False))
     progb = jax.jit(shard_map(
         body_flat, mesh=mesh,
-        in_specs=(spec_f,) * 6 + carry_specs + (spec_r,),
+        in_specs=(spec_f,) * 6 + carry_specs + (spec_r,) + beam_specs,
         out_specs=carry_specs + (spec_f, spec_f, spec_r),
         check_vma=False))
 
-    def run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
-        out = prog0(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F)
+    def run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F, *beam_rest):
+        out = prog0(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F,
+                    *beam_rest)
         carry, (res0, res1, Y0F) = out[:9], out[9:]
         r1s, duals = [], []
         for it in range(1, max(cfg.n_admm, 1)):
             out = progb(x8F, uF, vF, wF, freqF, wtF, *carry,
-                        jnp.asarray(it, jnp.int32))
+                        jnp.asarray(it, jnp.int32), *beam_rest)
             carry, (_, r1, dual) = out[:9], out[9:]
             r1s.append(r1)
             duals.append(dual)
@@ -481,6 +510,7 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
                              B_poly: np.ndarray, cfg: ADMMConfig,
                              nf_total: int, block_f: int,
                              with_shapelets: bool = False,
+                             dobeam: int = 0, nbase: int | None = None,
                              device=None, timer=None):
     """Single-device consensus ADMM with the J-update split into subband
     BLOCKS of ``block_f`` — one bounded device execution per block, tiny
@@ -508,6 +538,7 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
     parts = make_admm_runner(
         dsky, sta1, sta2, cidx, cmask, n_stations, fdelta, B_poly, cfg,
         mesh, nf_total, with_shapelets=with_shapelets,
+        dobeam=dobeam, nbase=nbase,
         _return_parts=True)
     local_solve_plain = parts["local_solve_plain"]
     local_solve_admm = parts["local_solve_admm"]
@@ -530,7 +561,8 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
             timer.append((label, _time.perf_counter() - t0))
         return out
 
-    def run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F):
+    def run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F, *beam_rest):
+        beamF = beam_rest[0] if beam_rest else None
         F = x8F.shape[0]
         Brow_full = _brow(F, None)          # eager: Bfull[:F]
         blocks = [slice(b, min(b + block_f, F))
@@ -553,14 +585,19 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
         const_blocks = [tuple(take(a, sl)
                               for a in (x8F, uF, vF, wF, wtF, freqF))
                         for sl in blocks]
+        beam_blocks = None
+        if beamF is not None:
+            beam_blocks = [jax.tree.map(lambda a: take(a, sl), beamF)
+                           for sl in blocks]
 
         def blockwise(fn, *per_iter):
             """fn(x8, u, v, w, wt, freq, *per-iteration block args)."""
             Js, r0s, r1s = [], [], []
             for i, sl in enumerate(blocks):
                 t0 = _time.perf_counter()
+                bb = (beam_blocks[i],) if beam_blocks is not None else ()
                 Jb, r0b, r1b = fn(*const_blocks[i],
-                                  *[take(a, sl) for a in per_iter])
+                                  *[take(a, sl) for a in per_iter], *bb)
                 _t(f"solve[{i}]", t0, Jb)
                 nreal = sl.stop - sl.start
                 Js.append(Jb[:nreal])
@@ -569,11 +606,11 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
             return (jnp.concatenate(Js), jnp.concatenate(r0s),
                     jnp.concatenate(r1s))
 
-        def solve0_re(x8, u, v, w, wt, freq, J0):
-            return solve0(x8, u, v, w, wt, J0, freq)
+        def solve0_re(x8, u, v, w, wt, freq, J0, *bb):
+            return solve0(x8, u, v, w, wt, J0, freq, *bb)
 
-        def solveb_re(x8, u, v, w, wt, freq, J, Y, BZ, rho):
-            return solveb(x8, u, v, w, wt, J, freq, Y, BZ, rho)
+        def solveb_re(x8, u, v, w, wt, freq, J, Y, BZ, rho, *bb):
+            return solveb(x8, u, v, w, wt, J, freq, Y, BZ, rho, *bb)
 
         JF, res0, res1 = blockwise(solve0_re, J0F)
         t0 = _time.perf_counter()

@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
       help="robust rho for MMSE inversion during correction "
            "(Data::rho, residual.c)")
     a("-W", "--whiten", type=int, default=0)
+    a("-D", "--diagnostics", type=int, default=0,
+      help="accepted for parity; the reference's Jacobian-leverage "
+           "call is disabled in v0.7.8 (fullbatch_mode.cpp:520)")
     a("--profile", default=None, metavar="DIR",
       help="write a jax.profiler trace of the first solve interval")
     a("--tile-batch", type=int, default=1,

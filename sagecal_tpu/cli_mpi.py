@@ -416,11 +416,8 @@ def main(argv=None) -> int:
                 # pipeline). Solve-scoped only: the original flags are
                 # restored before write-back so the cut is never baked
                 # into the stored dataset.
-                t.flags = np.asarray(rp.uvcut_flags(
-                    jnp.asarray(t.flags, jnp.int32),
-                    jnp.asarray(t.u, rdt), jnp.asarray(t.v, rdt),
-                    jnp.asarray(t.freqs, rdt),
-                    args.uvmin, args.uvmax), np.int8)
+                t.flags = rp.apply_uvcut(t.flags, t,
+                                         args.uvmin, args.uvmax)
             x8_t, flags_t, good = t.solve_input()
             fr_l.append(good)
             if args.whiten:

@@ -249,6 +249,22 @@ def uvcut_flags(flags, u, v, freqs, uvmin, uvmax):
     return jnp.where((flags == 0) & out, 2, flags)
 
 
+def apply_uvcut(rowflags, tile, uvmin: float, uvmax: float):
+    """Host-side uv-window on a COPY of a tile's row flags (the shared
+    gate for every mode: full window -> unchanged input). Returns int8
+    [nrows]; callers must never write the result back into the tile
+    (the cut is solve-scoped, Data::loadData semantics)."""
+    if not (uvmin > 0.0 or uvmax < 1e9):
+        return np.asarray(rowflags)
+    import numpy as _np
+    return _np.asarray(uvcut_flags(
+        jnp.asarray(_np.asarray(rowflags), jnp.int32),
+        jnp.asarray(_np.asarray(tile.u, _np.float64)),
+        jnp.asarray(_np.asarray(tile.v, _np.float64)),
+        jnp.asarray(_np.asarray(tile.freqs, _np.float64)),
+        uvmin, uvmax), _np.int8)
+
+
 def chunk_indices(tilesz: int, nbase: int, nchunk: np.ndarray) -> np.ndarray:
     """[M, B] map from data row to hybrid time-chunk per cluster.
 

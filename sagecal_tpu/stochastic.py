@@ -349,6 +349,11 @@ class _StochasticRunner:
         """Pad + upload every (minibatch, band) slice once per tile."""
         self._tile_inputs = {}
         rdt = self.rdt
+        # -x/-y uv window (Data::loadData applies it at load in the
+        # reference, so minibatch mode respects it too): solve-scoped
+        # flag-2 rows on a COPY — tile.flags is written back verbatim
+        self._rowflags = rp.apply_uvcut(tile.flags, tile,
+                                        self.cfg.uvmin, self.cfg.uvmax)
         if self.dobeam:
             if tile.time_mjd is None and not self._warned_no_times:
                 self.log("WARNING: dataset tiles carry no timestamps; beam "
@@ -369,7 +374,7 @@ class _StochasticRunner:
             sta1 = np.zeros(self.bmb, np.int32)
             sta2 = np.ones(self.bmb, np.int32)
             sta1[:nrow] = tile.sta1[sel]; sta2[:nrow] = tile.sta2[sel]
-            flags = np.asarray(tile.flags[sel])
+            flags = self._rowflags[sel]
             good = (flags == 0)[:, None]
             uj, vj, wj = (jnp.asarray(u, rdt), jnp.asarray(v, rdt),
                           jnp.asarray(w, rdt))

@@ -400,6 +400,21 @@ def main(argv=None) -> int:
                             (nf, 1, 1, 1, 1))
     J0 = Jinit.copy()
 
+    # per-subband worker files, written unconditionally like the
+    # reference slaves ("always create default solution file name
+    # MS+'.solutions'", sagecal_slave.cpp:167-168). Opened only AFTER
+    # -q is read: a previous run's worker file is a valid warm-start
+    # source and must not be truncated before read_warm_start sees it.
+    worker_writers = []
+    if is_writer:
+        interval_min = meta0["tilesz"] * meta0["tdelta"] / 60.0
+        worker_writers = [
+            sol.SolutionWriter(
+                m.path.rstrip("/") + ".solutions",
+                float(m.meta["freq0"]), float(m.meta["fdelta"]),
+                interval_min, n, sky.n_clusters, sky.n_eff_clusters)
+            for m in mss]
+
     for ti in range(start, stop):
         tiles = [m.read_tile(ti) for m in mss]
         # shared staging decision (VisTile.solve_input): per-channel
@@ -473,6 +488,11 @@ def main(argv=None) -> int:
                   f"{nblk} solve executions + 1 consensus each)")
         # slice padded subband rows off every per-subband output
         JF_r8 = fetch(JF_r8)[:nf]
+        JF_r8_5 = np.asarray(JF_r8).reshape(nf, sky.n_clusters, kmax, n, 8)
+        if worker_writers:
+            J_all = utils.jones_r2c_np(JF_r8_5)
+            for f, ww in enumerate(worker_writers):
+                ww.write_interval(J_all[f], sky.nchunk)
         Z = fetch(Z)
         res0, res1 = fetch(res0)[:nf], fetch(res1)[:nf]
         r1s = fetch(r1s)[:, :nf]
@@ -518,8 +538,7 @@ def main(argv=None) -> int:
                 BZ = np.einsum("fp,mpknr->fmknr", Bpoly, np.asarray(Z))
                 J_res = BZ.reshape(nf, sky.n_clusters, kmax, n, 8)
             else:
-                J_res = np.asarray(JF_r8).reshape(
-                    nf, sky.n_clusters, kmax, n, 8)
+                J_res = JF_r8_5
             xF_r = np.stack([utils.c2r(t.x) for t in tiles])
             bargs = ()
             if dobeam:
@@ -546,6 +565,8 @@ def main(argv=None) -> int:
 
     if writer:
         writer.close()
+    for ww in worker_writers:
+        ww.close()
     return 0
 
 

@@ -69,6 +69,15 @@ def test_mpi_cli_end_to_end(tmp_path):
     hdr, blocks = sol.read_solutions(str(solfile), sky.nchunk * 2)
     assert hdr["n_eff_clusters"] == sky.n_eff_clusters * 2
     assert len(blocks) == 1
+    # per-subband worker files (slave :167: always written): J format,
+    # usable to warm-start -q
+    for p in paths:
+        whdr, wblocks = sol.read_solutions(p.rstrip("/") + ".solutions",
+                                           sky.nchunk)
+        assert whdr["n_eff_clusters"] == sky.n_eff_clusters
+        assert len(wblocks) == 1
+        assert wblocks[0].shape == (sky.n_clusters,
+                                    int(sky.nchunk.max()), 8, 2, 2)
 
 
 def test_discover_datasets_glob(tmp_path):
@@ -257,7 +266,7 @@ def test_mpi_cli_uvcut_solve_scoped(tmp_path):
     assert np.isfinite(res.x).all()
 
 
-def test_mpi_cli_parity_knobs(tmp_path):
+def test_mpi_cli_parity_knobs(tmp_path, capsys):
     """The reference-MPI advanced letters run end-to-end: -W whitening,
     -R 0 fixed order, -k/-o/-J correction, -q warm start."""
     sky_path, clus_path, paths, sky = make_subbands(tmp_path, nf=2)
@@ -293,6 +302,27 @@ def test_mpi_cli_parity_knobs(tmp_path):
     w.close()
     rc = cli_mpi.main(base + ["-q", str(qfile)])
     assert rc == 0
+
+    # the worker file a run writes is itself a valid -q source for the
+    # NEXT run — and must be READ before the new run's writer truncates
+    # it (slave :167 files double as warm-start input)
+    wfile = paths[0].rstrip("/") + ".solutions"
+    Jw = sol.read_warm_start(wfile, sky, 8)
+    assert Jw is not None and np.isfinite(Jw).all()
+
+    def initial_residual(extra):
+        capsys.readouterr()
+        assert cli_mpi.main(base + ["-V"] + extra) == 0
+        out = capsys.readouterr().out
+        line = [l for l in out.splitlines() if "residual initial" in l][0]
+        return float(line.split("initial=")[1].split()[0])
+
+    cold = initial_residual([])
+    warm = initial_residual(["-q", wfile])
+    # a silently-dropped warm start (e.g. the file truncated by the
+    # writer before -q reads it) would reproduce the identity-start
+    # residual exactly
+    assert warm != cold and warm < cold
 
 
 def test_mpi_cli_beam(tmp_path):

@@ -400,6 +400,55 @@ def main(argv=None) -> int:
                             (nf, 1, 1, 1, 1))
     J0 = Jinit.copy()
 
+    # spatial-model solution file ("spatial_"+solfile,
+    # sagecal_master.cpp:472-498): header + two centroid-coordinate
+    # rows, then per interval the global SH coefficient matrix Zspat
+    # recomputed host-side from the final consensus Z (spatial_step's
+    # FISTA is a pure function of Z, so no extra runner state).
+    spatial_file = None
+    if spatialreg is not None and args.solutions_file and is_writer:
+        import os as _os
+        d, b = _os.path.split(args.solutions_file)
+        spatial_file = open(_os.path.join(d, "spatial_" + b), "w")
+        G_sp = int(spatialreg[2]) ** 2
+        rr_c, tt_c = spatial_coords
+        spatial_file.write(
+            "# spatial regularization solution file (Zspat)\n"
+            "# Top two rows are the polar coordinates of the "
+            "centroids (rad)\n"
+            "# reference_freq(MHz) polynomial_order(freq) "
+            "polynomial_order(spatial) stations clusters "
+            "effective_clusters\n")
+        spatial_file.write(
+            f"{float(freqs.mean()) * 1e-6:f} {args.npoly} {G_sp} {n} "
+            f"{sky.n_clusters} {sky.n_eff_clusters}\n")
+        spatial_file.write(
+            " ".join(f"{x:f}" for x in np.asarray(rr_c)) + "\n")
+        spatial_file.write(
+            " ".join(f"{x:f}" for x in np.asarray(tt_c)) + "\n")
+
+    spatial_phi = None
+    if spatial_file is not None:
+        from sagecal_tpu.consensus import spatial as sp
+        # loop-invariant basis: built once, closed over by the writer
+        spatial_phi = sp.phi_padded(cmask, *spatial_coords,
+                                    spatialreg[2], spatialreg[0])
+
+    def write_spatial_model(Z_np):
+        """One interval's Zspat rows (master :986-994 layout: row index
+        then the row's coefficients; complex written as re/im pairs)."""
+        from sagecal_tpu.consensus import spatial as sp
+        _l2, sh_mu, _n0, fista_iters, _cad = spatialreg
+        Phi, Phikk = spatial_phi
+        Zb = sp.z_r8_to_blocks(jnp.asarray(Z_np)).astype(jnp.complex64)
+        Zspat = np.asarray(sp.fista_spatialreg(
+            Zb, jnp.asarray(Phikk, jnp.complex64),
+            jnp.asarray(Phi, jnp.complex64), sh_mu, int(fista_iters)))
+        for p in range(Zspat.shape[0]):
+            spatial_file.write(
+                f"{p} " + " ".join(f"{z.real:e} {z.imag:e}"
+                                   for z in Zspat[p]) + "\n")
+
     # per-subband worker files, written unconditionally like the
     # reference slaves ("always create default solution file name
     # MS+'.solutions'", sagecal_slave.cpp:167-168). Opened only AFTER
@@ -554,6 +603,8 @@ def main(argv=None) -> int:
                 t.x = res_np[f].astype(np.complex128)
                 msx.write_tile(ti, t)
 
+        if spatial_file is not None:
+            write_spatial_model(np.asarray(Z))
         if writer:
             # Z coefficient columns: [M, P, K, N, 8] -> Jones-like blocks
             Zr = np.asarray(Z)
@@ -565,6 +616,8 @@ def main(argv=None) -> int:
 
     if writer:
         writer.close()
+    if spatial_file is not None:
+        spatial_file.close()
     for ww in worker_writers:
         ww.close()
     return 0

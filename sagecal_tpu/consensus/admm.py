@@ -179,19 +179,7 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         sh_l2, sh_mu, sh_n0, fista_iters, cadence = cfg.spatialreg
         rr_c, tt_c = spatial_coords
         G = int(sh_n0) * int(sh_n0)
-        cm_np = np.asarray(cmask)
-        r_pad = np.zeros((M, K))
-        t_pad = np.zeros((M, K))
-        idx = 0
-        for m in range(M):
-            for k in range(K):
-                if cm_np[m, k]:
-                    r_pad[m, k] = rr_c[idx]
-                    t_pad[m, k] = tt_c[idx]
-                    idx += 1
-        Phi, Phikk = sp.build_phi(int(sh_n0), r_pad.ravel(), t_pad.ravel(),
-                                  float(sh_l2))
-        Phi = Phi * cm_np.reshape(-1)[:, None, None]   # zero padded blocks
+        Phi, Phikk = sp.phi_padded(cmask, rr_c, tt_c, sh_n0, sh_l2)
         # stage complex as re/im pairs (no complex host<->device transfer)
         spat = dict(
             Phi_ri=jnp.asarray(np.stack([Phi.real, Phi.imag], -1)),

@@ -179,3 +179,33 @@ def blocks_to_z_r8(X, M: int, P: int, K: int, N: int):
     J = mf.blocks_to_jones(X)              # [M*K, P*N, 2, 2]
     J = J.reshape(M, K, P, N, 2, 2)
     return ne.jones_c2r(jnp.swapaxes(J, 1, 2))
+
+
+def phi_padded(sky_cmask, rr, tt, n0: int, sh_lambda: float):
+    """Phi/Phikk on the padded (m, k) chunk grid: live chunk slots get
+    their effective-cluster centroid basis rows, padded slots zero
+    blocks. Phikk is recomputed AFTER masking — a padded slot's basis
+    row evaluated at (r=0, theta=0) is nonzero for every m=0 mode and
+    would otherwise add spurious Phi_k Phi_k^H terms that inflate the
+    FISTA Lipschitz constant and penalize those modes (the reference
+    has no padded slots: master :371-397 builds Phi from real
+    centroids only). Shared by the ADMM runner and the host-side
+    spatial-model writer so both see the same basis."""
+    import numpy as np
+    cm_np = np.asarray(sky_cmask)
+    M, K = cm_np.shape
+    r_pad = np.zeros((M, K))
+    t_pad = np.zeros((M, K))
+    idx = 0
+    for m in range(M):
+        for k in range(K):
+            if cm_np[m, k]:
+                r_pad[m, k] = rr[idx]
+                t_pad[m, k] = tt[idx]
+                idx += 1
+    Phi, _ = build_phi(int(n0), r_pad.ravel(), t_pad.ravel(),
+                       float(sh_lambda))
+    Phi = Phi * cm_np.reshape(-1)[:, None, None]
+    Phikk = np.einsum("kgi,khi->gh", Phi, Phi.conj())
+    Phikk = Phikk + float(sh_lambda) * np.eye(Phikk.shape[0])
+    return Phi, Phikk

@@ -198,14 +198,31 @@ def test_federated_stochastic(tmp_path):
 def test_admm_spatialreg_runs(tmp_path):
     from sagecal_tpu import cli_mpi
     paths, sky = _make_subband_datasets(tmp_path)
+    solfile = tmp_path / "zsol.txt"
     rc = cli_mpi.main([
         "-f", str(tmp_path / "band*.ms"),
         "-s", str(tmp_path / "sky.txt"),
         "-c", str(tmp_path / "sky.txt.cluster"),
+        "-p", str(solfile),
         "-A", "4", "-P", "2", "-r", "1.0", "-j", "2", "-e", "2",
         "-g", "4", "-l", "4", "--mdl",
         "-u", "0.1", "-X", "0.01,0.001,2,20,2"])
     assert rc == 0
+    # spatial model file (master :472: "spatial_"+solfile): header,
+    # 2 centroid rows, then D rows of 2G re/im pairs per interval
+    spf = (tmp_path / "spatial_zsol.txt").read_text().splitlines()
+    data = [l for l in spf if not l.startswith("#")]
+    hdr = data[0].split()
+    G = int(hdr[2])
+    assert G == 4                      # n0=2 -> 4 spatial modes
+    assert len(data[1].split()) == sky.n_eff_clusters  # centroid r
+    assert len(data[2].split()) == sky.n_eff_clusters  # centroid theta
+    rows = data[3:]
+    vals = np.array([[float(x) for x in r.split()[1:]] for r in rows])
+    # Zspat columns span 2G complex entries (2-column Jones blocks x G
+    # modes) written as re/im pairs -> 4G reals
+    assert vals.shape[1] == 4 * G
+    assert np.isfinite(vals).all() and np.abs(vals).max() > 0
 
 
 def test_federated_mesh_matches_sequential(tmp_path):

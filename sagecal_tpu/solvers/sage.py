@@ -1075,12 +1075,25 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     kill unproven.
     """
     T, M = coh.shape[0], coh.shape[1]
+    if keys is None:
+        keys = tile_keys(T)
+    if T == 1:
+        # Measured on-chip (2026-07-31, bench config-3 shape): the
+        # vmapped UNIT tile axis alone costs ~40% (16.2 vs 11.5 s warm
+        # step) — every latency-bound solver op carries a [1, ...]
+        # leading dim that changes TPU layouts without adding work. A
+        # single tile takes the axis-free driver; PRNG stream matches
+        # (keys[0] is tile 0's stream either way).
+        J1, info1 = sagefit_host(x8[0], coh[0], sta1, sta2, chunk_idx,
+                                 chunk_mask, J0[0], n_stations,
+                                 wt_base[0], nu0=nu0, config=config,
+                                 os_id=os_id, key=keys[0])
+        info = {k: jnp.asarray(v)[None] for k, v in info1.items()}
+        return J1[None], info
     dtype = x8.dtype
     robust = _is_robust(config.solver_mode)
     if nu0 is None:
         nu0 = config.nulow
-    if keys is None:
-        keys = tile_keys(T)
 
     total_iter = M * config.max_iter
     iter_bar = int(-(-0.8 * total_iter // M))

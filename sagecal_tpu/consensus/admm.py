@@ -340,10 +340,26 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                  Zbar, Xd, rhoF)
         return carry, res0, res1, Y0F
 
+    def _per_subband(fn):
+        """vmap over the local subband axis — except at width 1, where
+        the axis-free call avoids the measured 25-40% unit-vmap layout
+        penalty on the latency-bound solver ops (see
+        sage.sagefit_host_tiles' T=1 fast path; same physics). Width is
+        a trace-time constant, so this is free."""
+        def call(*args):
+            lead = args[0].shape[0]
+            if lead != 1:
+                return jax.vmap(fn)(*args)
+            sq = [None if a is None
+                  else jax.tree.map(lambda x: x[0], a) for a in args]
+            out = fn(*sq)
+            return jax.tree.map(lambda x: x[None], out)
+        return call
+
     def iter0_local(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F,
                     beamF=None):
         """ADMM iteration 0 on the LOCAL shard: plain solve + post."""
-        JF, res0, res1 = jax.vmap(local_solve_plain)(
+        JF, res0, res1 = _per_subband(local_solve_plain)(
             x8F, uF, vF, wF, wtF, J0F, freqF, beamF)
         return iter0_post(JF, res0, res1, fratioF)
 
@@ -395,7 +411,7 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         Fl = x8F.shape[0]
         Brow = _brow(Fl)
         BZ = jnp.einsum("fp,mpknr->fmknr", Brow, carry[2])
-        Jr, r0, r1 = jax.vmap(local_solve_admm)(
+        Jr, r0, r1 = _per_subband(local_solve_admm)(
             x8F, uF, vF, wF, wtF, carry[0], freqF, carry[1], BZ,
             carry[3], beamF)
         return body_post(Jr, r0, r1, carry, it)
@@ -406,7 +422,7 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         return dict(local_solve_plain=local_solve_plain,
                     local_solve_admm=local_solve_admm,
                     iter0_post=iter0_post, body_post=body_post,
-                    _brow=_brow)
+                    _brow=_brow, _per_subband=_per_subband)
 
     def admm_program(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F,
                      *beam_rest):
@@ -534,8 +550,12 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
     body_post = parts["body_post"]
     _brow = parts["_brow"]
 
-    solve0 = jax.jit(jax.vmap(local_solve_plain))
-    solveb = jax.jit(jax.vmap(local_solve_admm))
+    # the shared unit-width wrapper: block_f == 1 (the north-star's
+    # best plan) takes the axis-free call, avoiding the unit-vmap
+    # layout penalty
+    _per_subband = parts["_per_subband"]
+    solve0 = jax.jit(_per_subband(local_solve_plain))
+    solveb = jax.jit(_per_subband(local_solve_admm))
     cons0 = jax.jit(lambda JF, res0, res1, fratioF: iter0_post(
         JF, res0, res1, fratioF, ax=None))
     consb = jax.jit(lambda Jr, r0, r1, carry, it: body_post(

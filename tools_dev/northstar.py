@@ -99,7 +99,9 @@ def generate(workdir, n_sta, n_dir, n_sub, tilesz, n_tiles, seed=5):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")
-    ap.add_argument("--block-f", type=int, default=2)
+    ap.add_argument("--block-f", type=int, default=1,
+                    help="subbands per solve execution (measured best: "
+                         "1 — PERF.md north-star landscape)")
     ap.add_argument("--admm", type=int, default=3)
     ap.add_argument("--stations", type=int, default=64)
     ap.add_argument("--dirs", type=int, default=100)

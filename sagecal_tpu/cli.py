@@ -65,6 +65,9 @@ def build_parser() -> argparse.ArgumentParser:
            "call is disabled in v0.7.8 (fullbatch_mode.cpp:520)")
     a("--profile", default=None, metavar="DIR",
       help="write a jax.profiler trace of the first solve interval")
+    a("--diag", default=None, metavar="PATH",
+      help="write a JSONL diagnostic trace (phase timers + per-iteration "
+           "convergence records, sagecal_tpu.diag.trace) to PATH")
     a("--tile-batch", type=int, default=1,
       help=">1: solve this many intervals as one batched device program "
            "(throughput lever; warm start becomes batch-granular)")
@@ -110,6 +113,32 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def warn_legacy_flags(args, err=sys.stderr) -> list:
+    """One-time startup warning for short-option values that suggest a
+    pre-remap command line. The reference-parity remap is silent by
+    design (same letters, same meanings), which also means a command
+    line written for a DIFFERENT tool or an old habit fails silently:
+    a ``-y`` under 10 lambda excludes essentially every baseline, and
+    an ``-o`` (MMSE rho) above 1 is far outside the regularization
+    regime (reference default 1e-9) — both almost certainly meant
+    something else. The run proceeds; the warning names the flag."""
+    warnings = []
+    if args.uvmax < 10.0:
+        warnings.append(
+            f"-y/--uvmax={args.uvmax:g} lambda excludes nearly all "
+            "baselines; the reference -y is an upper uv-distance cut in "
+            "lambda (default 1e9) — was this meant for another tool?")
+    if args.mmse_rho > 1.0:
+        warnings.append(
+            f"-o/--mmse-rho={args.mmse_rho:g} is far above the MMSE "
+            "regularization regime (reference default 1e-9); the "
+            "reference -o is the robust rho for residual correction — "
+            "not an output path or a solver knob")
+    for w in warnings:
+        print(f"WARNING: suspicious legacy option value: {w}", file=err)
+    return warnings
+
+
 def config_from_args(args) -> RunConfig:
     return RunConfig(
         ms=args.ms, ms_list=args.ms_list, sky_model=args.sky_model,
@@ -149,23 +178,34 @@ def main(argv=None) -> int:
         if args.platform:
             jax.config.update("jax_platforms", args.platform)
         if args.cpu_devices:
-            jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+            from sagecal_tpu.compat import set_cpu_device_count
+            set_cpu_device_count(args.cpu_devices)
     cfg = config_from_args(args)
     if (not cfg.ms and not cfg.ms_list) or not cfg.sky_model \
             or not cfg.cluster_file:
         print("need -d dataset (or -f list), -s sky model, -c cluster file",
               file=sys.stderr)
         return 2
+    warn_legacy_flags(args)
+
+    if args.diag:
+        from sagecal_tpu.diag import trace as dtrace
+        dtrace.enable(args.diag, entry="sagecal-tpu",
+                      argv=list(argv) if argv is not None else sys.argv[1:])
 
     from sagecal_tpu import pipeline
-    if cfg.n_epochs > 0:
-        from sagecal_tpu import stochastic
-        if cfg.n_admm > 1 and cfg.channel_avg_per_band > 1:
-            stochastic.run_minibatch_consensus(cfg)
+    try:
+        if cfg.n_epochs > 0:
+            from sagecal_tpu import stochastic
+            if cfg.n_admm > 1 and cfg.channel_avg_per_band > 1:
+                stochastic.run_minibatch_consensus(cfg)
+            else:
+                stochastic.run_minibatch(cfg)
         else:
-            stochastic.run_minibatch(cfg)
-    else:
-        pipeline.run(cfg)
+            pipeline.run(cfg)
+    finally:
+        if args.diag:
+            dtrace.disable()
     return 0
 
 

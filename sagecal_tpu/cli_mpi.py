@@ -121,6 +121,10 @@ def build_parser() -> argparse.ArgumentParser:
     a("--host-loop", action="store_true",
       help="one device execution per ADMM iteration instead of a fully "
            "traced n_admm-iteration program")
+    a("--diag", default=None, metavar="PATH",
+      help="write a JSONL diagnostic trace (phase timers, per-ADMM-"
+           "iteration convergence records, staging bytes-accounting; "
+           "sagecal_tpu.diag.trace) to PATH")
     return p
 
 
@@ -152,7 +156,8 @@ def main(argv=None) -> int:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
     if args.cpu_devices:
-        jax.config.update("jax_num_cpu_devices", args.cpu_devices)
+        from sagecal_tpu.compat import set_cpu_device_count
+        set_cpu_device_count(args.cpu_devices)
     if args.coordinator:
         # multi-host SPMD: every process runs this same program; jax
         # coordinates device enumeration and collectives across hosts
@@ -161,6 +166,20 @@ def main(argv=None) -> int:
             coordinator_address=args.coordinator,
             num_processes=args.num_processes,
             process_id=args.process_id)
+    from sagecal_tpu.diag import trace as dtrace
+
+    if args.diag:
+        dtrace.enable(args.diag, entry="sagecal-tpu-mpi",
+                      argv=list(argv) if argv is not None else sys.argv[1:])
+    try:
+        return _main_consensus(args, dtrace)
+    finally:
+        if args.diag:
+            dtrace.disable()
+
+
+def _main_consensus(args, dtrace) -> int:
+    import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from sagecal_tpu.consensus import admm as cadmm
@@ -435,8 +454,14 @@ def main(argv=None) -> int:
                                     spatialreg[2], spatialreg[0])
 
     def write_spatial_model(Z_np):
-        """One interval's Zspat rows (master :986-994 layout: row index
-        then the row's coefficients; complex written as re/im pairs)."""
+        """One interval's Zspat rows — DELIBERATE format deviation from
+        the reference (see MIGRATION.md "spatial_ solution files"):
+        the reference (master :986-994) dumps the complex Zspat buffer
+        column-major as N*8*Npoly rows of G raw doubles with centroid
+        rows in REVERSE cluster order; here each of the 2*Npoly*N rows
+        carries its row index then 2G re/im pairs in FORWARD cluster
+        order — self-describing text instead of a memory-layout dump.
+        tests/test_aux.py::test_admm_spatialreg_runs pins this format."""
         from sagecal_tpu.consensus import spatial as sp
         _l2, sh_mu, _n0, fista_iters, _cad = spatialreg
         Phi, Phikk = spatial_phi
@@ -449,11 +474,35 @@ def main(argv=None) -> int:
                 f"{p} " + " ".join(f"{z.real:e} {z.imag:e}"
                                    for z in Zspat[p]) + "\n")
 
+    # -B beam: the element/array-factor tables are tile-invariant, so
+    # the static leaves are stacked + staged ONCE here; inside the tile
+    # loop only the [tilesz] gmst time track is restaged (round-5
+    # ADVICE: the old loop re-transferred every leaf each interval).
+    # The diag stage_bytes records quantify the saving per tile.
+    beamF_static = None
+    beam_static_dev = None
+    if dobeam:
+        from sagecal_tpu import coords as _coords
+        beamF_static = jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]),
+            *beams_static)
+        beamF_pad = beamF_static
+        if fpad > nf:       # padded mesh slots reuse subband 0's beam
+            beamF_pad = jax.tree.map(lambda a: np.concatenate(
+                [a, np.repeat(a[:1], fpad - nf, axis=0)]), beamF_static)
+        beam_static_dev = jax.tree.map(stage, beamF_pad)
+        dtrace.emit("stage_bytes", what="beam_static",
+                    bytes=int(sum(np.asarray(l).nbytes
+                                  for l in jax.tree.leaves(beamF_pad))))
+
     # per-subband worker files, written unconditionally like the
     # reference slaves ("always create default solution file name
     # MS+'.solutions'", sagecal_slave.cpp:167-168). Opened only AFTER
     # -q is read: a previous run's worker file is a valid warm-start
     # source and must not be truncated before read_warm_start sees it.
+    # Multi-host note: unlike the reference's per-node slave writes,
+    # ONLY process 0 writes these files (shared-filesystem assumption;
+    # see MIGRATION.md "per-subband worker files").
     worker_writers = []
     if is_writer:
         interval_min = meta0["tilesz"] * meta0["tdelta"] / 60.0
@@ -506,20 +555,23 @@ def main(argv=None) -> int:
         padded, _, _ = cadmm.pad_subbands(
             (x8F, uF, vF, wF, freqs, wtF, fratioF, J0), Bpoly, nf, ndev)
         args_dev = [stage(np.asarray(a, np.dtype(rdt))) for a in padded]
+        if dtrace.active():
+            dtrace.emit("stage_bytes", what="tile_inputs", tile=ti,
+                        bytes=int(sum(np.asarray(a).size for a in padded)
+                                  * np.dtype(rdt).itemsize))
+        gmstF = None
         if dobeam:
-            from sagecal_tpu import coords as _coords
-            # static tables staged once (beams_static below); per tile
-            # only the [tilesz] gmst leaf changes
-            beams = [b._replace(gmst=jnp.asarray(
-                         _coords.jd2gmst_np(t.time_jd), rdt))
-                     for b, t in zip(beams_static, tiles)]
-            beamF = jax.tree.map(lambda *xs: np.stack(
-                [np.asarray(x) for x in xs]), *beams)
-            fpad_b = args_dev[0].shape[0]
-            if fpad_b > nf:     # padded mesh slots reuse subband 0's beam
-                beamF = jax.tree.map(lambda a: np.concatenate(
-                    [a, np.repeat(a[:1], fpad_b - nf, axis=0)]), beamF)
-            args_dev.append(jax.tree.map(stage, beamF))
+            # only the per-tile gmst time track crosses host->device
+            # here; the static tables were staged once before the loop
+            gmstF = np.stack(
+                [np.asarray(_coords.jd2gmst_np(t.time_jd))
+                 for t in tiles]).astype(np.dtype(rdt))
+            if fpad > nf:   # padded mesh slots reuse subband 0's track
+                gmstF = np.concatenate(
+                    [gmstF, np.repeat(gmstF[:1], fpad - nf, axis=0)])
+            args_dev.append(beam_static_dev._replace(gmst=stage(gmstF)))
+            dtrace.emit("stage_bytes", what="beam_gmst", tile=ti,
+                        bytes=int(gmstF.nbytes))
         if blk_timer is not None:
             blk_timer.clear()
         JF_r8, Z, rhoF, res0, res1, r1s, duals, Y0F = runner(*args_dev)
@@ -562,6 +614,27 @@ def main(argv=None) -> int:
         res1 = np.asarray(r1s)[-1] if cfg.n_admm > 1 else np.asarray(res1)
         duals = np.asarray(duals)
 
+        if dtrace.active():
+            # per-ADMM-iteration convergence records from the fetched
+            # telemetry. The host-loop and blocked runners already emit
+            # live per-iteration records (admm.py), so only the fully
+            # traced mesh program needs the post-hoc emission.
+            if not args.host_loop and not args.block_f:
+                for k in range(np.asarray(r1s).shape[0]):
+                    dtrace.emit(
+                        "admm_iter", interval=ti, iter=k + 1,
+                        r1_mean=float(np.asarray(r1s)[k].mean()),
+                        dual=float(duals[k]) if len(duals) else 0.0)
+            # interval summary with the consensus primal residual
+            # ||J - BZ|| (the reference master's convergence axis)
+            BZf = np.einsum("fp,mpknr->fmknr", Bpoly, np.asarray(Z))
+            primal = float(
+                np.linalg.norm(JF_r8_5 - BZf) / np.sqrt(BZf.size))
+            dtrace.emit("tile", tile=ti, res_0=float(res0.mean()),
+                        res_1=float(res1.mean()), primal=primal,
+                        rho_mean=float(np.asarray(fetch(rhoF))[:nf]
+                                       .mean()))
+
         # warm-start the next interval; per-subband divergence reset
         # (slave :680-683 res_ratio check; fullbatch warm-start analogue)
         J_new = np.asarray(JF_r8)
@@ -591,9 +664,11 @@ def main(argv=None) -> int:
             xF_r = np.stack([utils.c2r(t.x) for t in tiles])
             bargs = ()
             if dobeam:
-                # residual beam: the UNPADDED nf subbands only
+                # residual beam: the UNPADDED nf subbands with this
+                # tile's gmst track
                 bargs = (jax.tree.map(
-                    lambda a: jnp.asarray(a[:nf]), beamF),)
+                    lambda a: jnp.asarray(a),
+                    beamF_static._replace(gmst=gmstF[:nf])),)
             res_r = res_jit(jnp.asarray(J_res, rdt), jnp.asarray(xF_r, rdt),
                             jnp.asarray(uF, rdt), jnp.asarray(vF, rdt),
                             jnp.asarray(wF, rdt), jnp.asarray(freqs, rdt),

@@ -1,0 +1,38 @@
+"""Small cross-version jax shims.
+
+The codebase targets current jax spellings; containers pinned to older
+jaxlibs (0.4.x) get the equivalent older entry points here so a version
+skew never takes out whole subsystems (seed failure: ``from jax import
+shard_map`` killed every consensus/federated test on jax 0.4.37).
+"""
+
+from __future__ import annotations
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Request ``n`` virtual CPU devices. jax >= 0.5 spells this as the
+    ``jax_num_cpu_devices`` config option; older versions only honor
+    the XLA_FLAGS route, which must land before the backend
+    initializes (both CLIs call this before first device use)."""
+    import jax
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        import os
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the modern keyword set; falls back to
+    ``jax.experimental.shard_map.shard_map`` (jax < 0.6), where the
+    replication-check keyword is spelled ``check_rep``."""
+    try:
+        from jax import shard_map as sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma)
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_vma=check_vma)

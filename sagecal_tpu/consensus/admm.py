@@ -44,6 +44,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sagecal_tpu.consensus import manifold as mf
 from sagecal_tpu.consensus import poly as cpoly
+from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.solvers import sage
 
@@ -440,7 +441,7 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         JF, YF, Z, rhoF = carry[0], carry[1], carry[2], carry[3]
         return JF, Z, rhoF, res0, res1, r1s, duals, Y0F
 
-    from jax import shard_map
+    from sagecal_tpu.compat import shard_map
     spec_f = P(axis)
     spec_r = P()
     nin = 8 + (1 if dobeam else 0)     # beam pytree rides a prefix spec
@@ -487,10 +488,20 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         out_specs=carry_specs + (spec_f, spec_f, spec_r),
         check_vma=False))
 
+    n_runs = [0]    # runner invocation ordinal = interval, for traces
+
     def run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F, *beam_rest):
+        interval = n_runs[0]
+        n_runs[0] += 1
         out = prog0(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F,
                     *beam_rest)
         carry, (res0, res1, Y0F) = out[:9], out[9:]
+        if dtrace.active():
+            # per-iteration convergence records; the float() syncs are
+            # behind the gate so untraced runs keep async dispatch
+            dtrace.emit("admm_iter", interval=interval, iter=0,
+                        r1_mean=float(jnp.mean(res1)),
+                        dual=0.0, rho_mean=float(jnp.mean(carry[3])))
         r1s, duals = [], []
         for it in range(1, max(cfg.n_admm, 1)):
             out = progb(x8F, uF, vF, wF, freqF, wtF, *carry,
@@ -498,6 +509,11 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
             carry, (_, r1, dual) = out[:9], out[9:]
             r1s.append(r1)
             duals.append(dual)
+            if dtrace.active():
+                dtrace.emit("admm_iter", interval=interval, iter=it,
+                            r1_mean=float(jnp.mean(r1)),
+                            dual=float(dual),
+                            rho_mean=float(jnp.mean(carry[3])))
         JF, Z, rhoF = carry[0], carry[2], carry[3]
         F = x8F.shape[0]
         r1s_a = (jnp.stack(r1s) if r1s
@@ -569,7 +585,11 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
             timer.append((label, _time.perf_counter() - t0))
         return out
 
+    n_runs = [0]    # runner invocation ordinal = interval, for traces
+
     def run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F, *beam_rest):
+        interval = n_runs[0]
+        n_runs[0] += 1
         beamF = beam_rest[0] if beam_rest else None
         F = x8F.shape[0]
         Brow_full = _brow(F, None)          # eager: Bfull[:F]
@@ -635,6 +655,11 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
             _t(f"cons[{it}]", t0, carry[2])
             r1h.append(r1)
             dualh.append(dual)
+            if dtrace.active():
+                dtrace.emit("admm_iter", interval=interval, iter=it,
+                            r1_mean=float(jnp.mean(r1)),
+                            dual=float(dual),
+                            rho_mean=float(jnp.mean(carry[3])))
         JF, Z, rhoF = carry[0], carry[2], carry[3]
         r1s_a = (jnp.stack(r1h) if r1h
                  else jnp.zeros((0, F), x8F.dtype))

@@ -1,0 +1,182 @@
+"""Structured JSONL tracing: phase timers + convergence records.
+
+Zero-dependency by design (stdlib only — no jax, no numpy): the solver
+and pipeline layers import this module unconditionally, and an import
+that pulled in jax from inside ``sagecal_tpu.solvers.sage`` would be a
+layering inversion. Emitters call :func:`emit`/:func:`phase` freely;
+until :func:`enable` installs a live :class:`Tracer` both are no-ops
+costing one attribute load and one ``is None`` test.
+
+File format: one JSON object per line. Every record carries
+
+- ``t``   — unix epoch seconds (float) at emit time,
+- ``ev``  — the event name (str),
+
+plus event-specific fields. The emitting sites keep a small stable
+vocabulary so downstream tooling can rely on it:
+
+===============  ============================================================
+event            meaning / required extra fields
+===============  ============================================================
+``run_start``    first record; run metadata (argv, entry point)
+``phase``        a timed host phase: ``name`` (io/stage/solve/residual/
+                 write/consensus), ``dur_s``; optional ``tile``
+``em_sweep``     one SAGE EM sweep (solvers/sage.py host driver):
+                 ``sweep``, ``wall_s``, ``fused``, ``err_reduction``,
+                 ``solver_iters`` (cumulative executed inner trips)
+``tile``         one solve interval's convergence summary (pipeline.py /
+                 cli_mpi.py): ``tile``, ``res_0``, ``res_1``; optional
+                 ``mean_nu``, ``solver_iters``, ``lbfgs_iters``,
+                 ``minutes``, ``primal``, ``rho_mean``
+``admm_iter``    one consensus-ADMM iteration: ``iter``, ``r1_mean``,
+                 ``dual``; optional ``interval``, ``rho_mean``, ``primal``
+``minibatch``    one stochastic minibatch solve: ``epoch``, ``minibatch``,
+                 ``res_0``, ``res_1``; optional ``admm``, ``iters``
+``stage_bytes``  host->device staging accounting: ``bytes``, ``what``;
+                 optional ``tile``
+``run_end``      last record; ``wall_s`` for the whole run
+===============  ============================================================
+
+Values must be JSON-serializable scalars/strings (callers convert device
+arrays with ``float(...)``/``int(...)`` *after* checking :func:`active`,
+so the disabled path never forces a device sync).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+# record fields guaranteed on every line (the schema tests key on this)
+REQUIRED_FIELDS = ("t", "ev")
+
+_TRACER = None          # module-level singleton; None = disabled
+
+
+class Tracer:
+    """Append-only JSONL event writer with monotonic phase timers."""
+
+    def __init__(self, path, **run_meta):
+        self.path = path
+        self._f = open(path, "a", buffering=1)   # line-buffered
+        self._t0 = time.time()
+        self.emit("run_start", **run_meta)
+
+    def emit(self, ev: str, **fields) -> None:
+        rec = {"t": time.time(), "ev": ev}
+        rec.update(fields)
+        try:
+            self._f.write(json.dumps(rec) + "\n")
+        except (TypeError, ValueError):
+            # a non-serializable field must not kill a calibration run;
+            # keep the record with offenders stringified
+            rec = {k: (v if isinstance(v, (int, float, str, bool,
+                                           type(None))) else repr(v))
+                   for k, v in rec.items()}
+            self._f.write(json.dumps(rec) + "\n")
+
+    def phase(self, name: str, **fields):
+        return _Phase(self, name, fields)
+
+    def close(self) -> None:
+        if self._f.closed:
+            return
+        self.emit("run_end", wall_s=time.time() - self._t0)
+        self._f.close()
+
+
+class _Phase:
+    """Context manager timing one host phase; emits on exit."""
+
+    __slots__ = ("_tr", "_name", "_fields", "_t0")
+
+    def __init__(self, tracer, name, fields):
+        self._tr = tracer
+        self._name = name
+        self._fields = fields
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tr.emit("phase", name=self._name,
+                      dur_s=time.perf_counter() - self._t0, **self._fields)
+        return False
+
+
+class _NullPhase:
+    """Shared do-nothing context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_PHASE = _NullPhase()
+
+
+def enable(path, **run_meta) -> Tracer:
+    """Open ``path`` for appending and make it the process tracer."""
+    global _TRACER
+    if _TRACER is not None:
+        _TRACER.close()
+    _TRACER = Tracer(path, **run_meta)
+    return _TRACER
+
+
+def disable() -> None:
+    """Close and uninstall the process tracer (no-op when disabled)."""
+    global _TRACER
+    if _TRACER is not None:
+        _TRACER.close()
+        _TRACER = None
+
+
+def get() -> Tracer | None:
+    return _TRACER
+
+
+def active() -> bool:
+    """True when a tracer is installed. Emitting sites whose field
+    conversion is itself costly (device->host syncs) gate on this."""
+    return _TRACER is not None
+
+
+def emit(ev: str, **fields) -> None:
+    """Module-level emit: one line when enabled, no-op otherwise."""
+    if _TRACER is not None:
+        _TRACER.emit(ev, **fields)
+
+
+def phase(name: str, **fields):
+    """Module-level phase timer; a shared null context when disabled."""
+    if _TRACER is None:
+        return _NULL_PHASE
+    return _TRACER.phase(name, **fields)
+
+
+def read(path) -> list:
+    """Parse a trace file back into a list of records (for tests and
+    post-run analysis). Raises ValueError on a malformed line or a
+    record missing the required fields."""
+    out = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i + 1}: malformed JSONL: {e}")
+            for k in REQUIRED_FIELDS:
+                if k not in rec:
+                    raise ValueError(
+                        f"{path}:{i + 1}: record missing '{k}': {rec}")
+            out.append(rec)
+    return out

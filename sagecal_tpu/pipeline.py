@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from sagecal_tpu import coords, skymodel, utils
 from sagecal_tpu.config import RunConfig, SimulationMode, SolverMode
+from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.io import dataset as ds
 from sagecal_tpu.io import solutions as sol
@@ -45,6 +46,33 @@ _jones_c2r_j = jax.jit(ne.jones_c2r)
 
 LMCUT = 40      # sagecalmain.h:24
 RES_RATIO = 5.0  # fullbatch_mode.cpp:239
+
+
+def _traced_tiles(gen):
+    """Yield from a tile iterator, timing the host wait for each tile as
+    the diag "io" phase (a no-op without an active tracer)."""
+    gen = iter(gen)
+    while True:
+        with dtrace.phase("io"):
+            try:
+                item = next(gen)
+            except StopIteration:
+                return
+        yield item
+
+
+def _emit_tile_record(ti, res_0, res_1, mean_nu, info, minutes):
+    """Per-solve-interval convergence record (gated on an active tracer
+    so the extra device->host syncs never run otherwise)."""
+    if not dtrace.active():
+        return
+    rec = dict(tile=ti, res_0=res_0, res_1=res_1, mean_nu=mean_nu,
+               minutes=minutes)
+    # host-driver extras (the sharded solver reports only residuals)
+    for k in ("solver_iters", "lbfgs_iters"):
+        if isinstance(info, dict) and k in info:
+            rec[k] = int(np.asarray(info[k]).sum())
+    dtrace.emit("tile", **rec)
 
 
 def effective_solver_mode(mode: int, n_stations: int) -> int:
@@ -500,6 +528,7 @@ class FullBatchPipeline:
         pending = []
 
         def stage(ti, tile):
+            t_stage = time.perf_counter()
             u = jnp.asarray(tile.u, self.rdt)
             v = jnp.asarray(tile.v, self.rdt)
             w = jnp.asarray(tile.w, self.rdt)
@@ -510,12 +539,15 @@ class FullBatchPipeline:
                                    cfg.uvmin, cfg.uvmax)
             if cfg.whiten:
                 x8 = rb.whiten_data(x8, u, v, meta["freq0"])
-            return dict(ti=ti, tile=tile, u=u, v=v, w=w, x8=x8,
-                        wt=lm_mod.make_weights(flags, self.rdt),
-                        sta1=jnp.asarray(tile.sta1),
-                        sta2=jnp.asarray(tile.sta2),
-                        # staged once: solve + residual write reuse it
-                        beam=self._tile_beam(tile))
+            out = dict(ti=ti, tile=tile, u=u, v=v, w=w, x8=x8,
+                       wt=lm_mod.make_weights(flags, self.rdt),
+                       sta1=jnp.asarray(tile.sta1),
+                       sta2=jnp.asarray(tile.sta2),
+                       # staged once: solve + residual write reuse it
+                       beam=self._tile_beam(tile))
+            dtrace.emit("phase", name="stage", tile=ti,
+                        dur_s=time.perf_counter() - t_stage)
+            return out
 
         def post(stg, res_0, res_1, mean_nu, Jnew, minutes):
             ti, tile = stg["ti"], stg["tile"]
@@ -536,6 +568,7 @@ class FullBatchPipeline:
                 writer.write_interval(state["J"] if state["first"]
                                       else Jnew, sky.nchunk)
             if write_residuals:
+                t_res = time.perf_counter()
                 res_r = self._residual_fn(
                     jnp.asarray(utils.jones_c2r_np(
                         state["J"] if state["first"] else Jnew), self.rdt),
@@ -543,12 +576,16 @@ class FullBatchPipeline:
                     stg["u"], stg["v"], stg["w"], stg["sta1"], stg["sta2"],
                     stg["beam"])
                 tile.x = utils.r2c(np.asarray(res_r)).astype(np.complex128)
-                ms.write_tile(ti, tile)
+                dtrace.emit("phase", name="residual", tile=ti,
+                            dur_s=time.perf_counter() - t_res)
+                with dtrace.phase("write", tile=ti):
+                    ms.write_tile(ti, tile)
             log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
                 f"final={res_1:.6g}, Time spent={minutes:.3g} minutes, "
                 f"nu={mean_nu:.2f}")
             history.append({"tile": ti, "res_0": res_0, "res_1": res_1,
                             "mean_nu": mean_nu, "minutes": minutes})
+            _emit_tile_record(ti, res_0, res_1, mean_nu, None, minutes)
 
         def solve_solo(stg, boosted):
             t0 = time.time()
@@ -557,6 +594,8 @@ class FullBatchPipeline:
             Jd_r8, info = solver(stg["x8"], stg["u"], stg["v"], stg["w"],
                                  stg["sta1"], stg["sta2"], stg["wt"],
                                  J_r8, stg["beam"], tile_idx=stg["ti"])
+            dtrace.emit("phase", name="solve", tile=stg["ti"],
+                        dur_s=time.time() - t0)
             state["first"] = False
             post(stg, float(info["res_0"]), float(info["res_1"]),
                  float(info["mean_nu"]),
@@ -590,13 +629,15 @@ class FullBatchPipeline:
             r0 = np.asarray(info["res_0"])
             r1 = np.asarray(info["res_1"])
             mnu = np.asarray(info["mean_nu"])
+            dtrace.emit("phase", name="solve", tiles=T,
+                        dur_s=time.time() - t0)
             minutes = (time.time() - t0) / 60.0 / T
             for t, stg in enumerate(group):
                 post(stg, float(r0[t]), float(r1[t]), float(mnu[t]),
                      utils.jones_r2c_np(Jd[t]), minutes)
 
         try:
-            for ti, tile in ms.tiles_prefetch():
+            for ti, tile in _traced_tiles(ms.tiles_prefetch()):
                 if max_tiles is not None and ti >= max_tiles:
                     break
                 stg = stage(ti, tile)
@@ -646,10 +687,11 @@ class FullBatchPipeline:
         first = True
         history = []
         try:
-            for ti, tile in ms.tiles_prefetch():
+            for ti, tile in _traced_tiles(ms.tiles_prefetch()):
                 if max_tiles is not None and ti >= max_tiles:
                     break
                 t0 = time.time()
+                t_stage = time.perf_counter()
                 u = jnp.asarray(tile.u, self.rdt)
                 v = jnp.asarray(tile.v, self.rdt)
                 w = jnp.asarray(tile.w, self.rdt)
@@ -675,6 +717,9 @@ class FullBatchPipeline:
                 solver = self._solve_first if first else self._solve_rest
                 J_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
                 tile_beam = self._tile_beam(tile)
+                dtrace.emit("phase", name="stage", tile=ti,
+                            dur_s=time.perf_counter() - t_stage)
+                t_solve = time.perf_counter()
                 Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8,
                                      tile_beam, tile_idx=ti)
                 first = False
@@ -682,6 +727,8 @@ class FullBatchPipeline:
                 res_1 = float(info["res_1"])
                 mean_nu = float(info["mean_nu"])
                 J = utils.jones_r2c_np(np.asarray(Jd_r8))
+                dtrace.emit("phase", name="solve", tile=ti,
+                            dur_s=time.perf_counter() - t_solve)
 
                 # divergence reset (fullbatch_mode.cpp:605-621)
                 if res_1 == 0.0 or not np.isfinite(res_1) or (
@@ -786,13 +833,17 @@ class FullBatchPipeline:
                         writer.write_interval(J, sky.nchunk)
 
                     if write_residuals:
+                        t_res = time.perf_counter()
                         res_r = self._residual_fn(
                             jnp.asarray(utils.jones_c2r_np(J), self.rdt),
                             jnp.asarray(utils.c2r(tile.x), self.rdt),
                             u, v, w, sta1, sta2, tile_beam)
                         tile.x = utils.r2c(np.asarray(res_r)).astype(
                             np.complex128)
-                        ms.write_tile(ti, tile)
+                        dtrace.emit("phase", name="residual", tile=ti,
+                                    dur_s=time.perf_counter() - t_res)
+                        with dtrace.phase("write", tile=ti):
+                            ms.write_tile(ti, tile)
 
                 dt = (time.time() - t0) / 60.0
                 log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
@@ -800,6 +851,7 @@ class FullBatchPipeline:
                     f"nu={mean_nu:.2f}")
                 history.append({"tile": ti, "res_0": res_0, "res_1": res_1,
                                 "mean_nu": mean_nu, "minutes": dt})
+                _emit_tile_record(ti, res_0, res_1, mean_nu, info, dt)
                 if prof_live:
                     import jax.profiler
                     jax.profiler.stop_trace()

@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from sagecal_tpu.config import SolverMode
+from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.solvers import lbfgs as lbfgs_mod
 from sagecal_tpu.solvers import lm as lm_mod
 from sagecal_tpu.solvers import normal_eq as ne
@@ -863,6 +864,8 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
         # the device programs see the EXACT width via config.inflight
         Gi = G0_w if ci == 0 else Gs_w
         cfg_i = dev_config._replace(inflight=Gi)
+        ran_fused = fused   # the mode THIS sweep executes (the auto
+        #                     verdict below may flip `fused` for the next)
         if fused:
             t_sweep = time.perf_counter()
             J, xres, nerr_acc, nuM, tk = _call("em_sweep", _jit_em_sweep,
@@ -909,6 +912,14 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                 _FUSION_CACHE[fuse_key] = fused
                 _learned("fuse", fuse_key, fused)
         total = float(jnp.sum(nerr_acc))
+        if dtrace.active():
+            # convergence record per EM sweep; tk_total sync is behind
+            # the active() gate so disabled runs pay nothing
+            dtrace.emit("em_sweep", sweep=ci,
+                        wall_s=time.perf_counter() - t_sweep,
+                        fused=bool(ran_fused), groups=int(Gi),
+                        err_reduction=total,
+                        solver_iters=int(tk_total[0]))
         nerr = nerr_acc / total if total > 0 else nerr_acc
 
     # promote: non-first fused sweeps are warm device executions, so
@@ -1146,6 +1157,7 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
         t_sweep = time.perf_counter()
         Gi = G0_w if ci == 0 else Gs_w      # cold-start width restriction
         cfg_i = dev_config._replace(inflight=Gi)
+        ran_fused = fused   # the mode THIS sweep executes (see sagefit_host)
         if fused:
             J, xres, nerr_acc, nuM, tk = _call(
                 "em_sweep_tiles", _jit_em_sweep_tiles,
@@ -1189,6 +1201,12 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                 _FUSION_CACHE[fuse_key] = fused
                 _learned("fuse", fuse_key, fused)
         total = jnp.sum(nerr_acc, axis=1, keepdims=True)
+        if dtrace.active():
+            dtrace.emit("em_sweep", sweep=ci,
+                        wall_s=time.perf_counter() - t_sweep,
+                        fused=bool(ran_fused), groups=int(Gi), tiles=T,
+                        err_reduction=float(jnp.sum(total)),
+                        solver_iters=int(jnp.sum(tk_total[:, 0])))
         nerr = jnp.where(total > 0, nerr_acc / jnp.maximum(total, 1e-30),
                          nerr_acc)
 

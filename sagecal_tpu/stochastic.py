@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from sagecal_tpu import skymodel, utils
 from sagecal_tpu.config import RunConfig
 from sagecal_tpu.consensus import poly as cpoly
+from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.io import dataset as ds
 from sagecal_tpu.io import solutions as sol
 from sagecal_tpu.rime import beam as bm
@@ -529,6 +530,8 @@ class _StochasticRunner:
                  f"final={res_1:.6g}, Time spent={dt:.3g} minutes")
         history.append({"tile": ti, "res_0": res_0, "res_1": res_1,
                         "minutes": dt})
+        dtrace.emit("tile", tile=ti, res_0=res_0, res_1=res_1,
+                    minutes=dt)
 
 
 def _open(cfg: RunConfig, log):
@@ -583,6 +586,10 @@ def run_minibatch(cfg: RunConfig, log=print):
                         log(f"epoch={nepch} minibatch={nmb} band={b} "
                             f"{r0s[b]:.6f} {r1s[b]:.6f}")
                 res_0, res_1 = float(np.mean(r0s)), float(np.mean(r1s))
+                if dtrace.active():
+                    dtrace.emit("minibatch", tile=ti, epoch=nepch,
+                                minibatch=nmb, res_0=res_0, res_1=res_1,
+                                iters=int(np.asarray(out.iters).sum()))
         rn.unstack_state(pstack, memstack, pfreq, mems)
 
         rn.end_of_tile(tile, ti, state, resband, res_0, res_1, t0,
@@ -670,6 +677,15 @@ def run_minibatch_consensus(cfg: RunConfig, log=print):
                                 f"minibatch={nmb} band={b} primal "
                                 f"{primal:.6f} {r0s[b]:.6f} {r1s[b]:.6f}")
                     res_0, res_1 = float(np.mean(r0s)), float(np.mean(r1s))
+                    if dtrace.active():
+                        primal = float(np.linalg.norm(
+                            (p_np - BZ_all) * cmask4[None])
+                            / np.sqrt(p_np.size))
+                        dtrace.emit("minibatch", tile=ti, admm=nadmm,
+                                    epoch=nepch, minibatch=nmb,
+                                    res_0=res_0, res_1=res_1,
+                                    primal=primal,
+                                    iters=int(np.asarray(out.iters).sum()))
                     # flag diverged bands out of the Z update (:528-546)
                     fband = resband > RES_RATIO * res_1
 
@@ -685,6 +701,10 @@ def run_minibatch_consensus(cfg: RunConfig, log=print):
                     dual = np.linalg.norm(Z - Zold) / np.sqrt(Z.size)
                     if cfg.verbose:
                         log(f"ADMM : {nadmm} dual residual={dual:.6f}")
+                    if dtrace.active():
+                        dtrace.emit("admm_iter", interval=ti, iter=nadmm,
+                                    r1_mean=res_1, dual=float(dual),
+                                    rho_mean=float(np.mean(rhok)))
                     for b in np.where(good)[0]:
                         BZb = np.einsum("p,mpkns->mkns", B[b], Z)
                         Y[b] -= rhok[b][:, None, None, None] * BZb

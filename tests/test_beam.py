@@ -9,6 +9,7 @@ from sagecal_tpu.io import dataset as ds
 from sagecal_tpu.rime import beam as bm
 from sagecal_tpu.rime import predict as rp
 from sagecal_tpu.rime import residual as rr
+import pytest
 
 RA0, DEC0 = 0.35, 0.95
 F0 = 60e6
@@ -243,6 +244,7 @@ def _run_beam_pipeline(tmp_path, msdir, extra_args):
     assert h["res_1"] < 0.5 * h["res_0"]
 
 
+@pytest.mark.slow
 def test_fullbatch_pipeline_withbeam(tmp_path):
     """dosage.sh-with-beam analogue: simulate beam-corrupted data, then
     calibrate with -B FULL through the full pipeline; solver must
@@ -251,6 +253,7 @@ def test_fullbatch_pipeline_withbeam(tmp_path):
     _run_beam_pipeline(tmp_path, msdir, ["-j", "0", "-g", "10"])
 
 
+@pytest.mark.slow
 def test_fullbatch_pipeline_withbeam_sharded(tmp_path):
     """--shard-baselines with -B: beam tables replicate, row-indexed
     gathers shard — the sharded GSPMD solve must converge like the
@@ -260,6 +263,7 @@ def test_fullbatch_pipeline_withbeam_sharded(tmp_path):
                        ["-j", "1", "-g", "8", "--shard-baselines"])
 
 
+@pytest.mark.slow
 def test_stochastic_pipeline_withbeam(tmp_path):
     """-N (stochastic) with -B: the minibatch LBFGS solver must see the
     beam-corrupted model too (beam plumbed through make_band_solver)."""

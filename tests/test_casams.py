@@ -381,6 +381,7 @@ def test_open_dataset_dispatch(tmp_path):
     assert not casams.is_ms_path(str(tmp_path))
 
 
+@pytest.mark.slow
 def test_pipeline_over_casams(tmp_path, monkeypatch):
     """Integration: the fullbatch pipeline calibrates a (fake-tables)
     MeasurementSet end-to-end — tile streaming, solve_input packing,

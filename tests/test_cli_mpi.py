@@ -9,6 +9,7 @@ import math
 
 import numpy as np
 import jax.numpy as jnp
+import pytest
 
 from sagecal_tpu import cli_mpi, skymodel
 from sagecal_tpu.io import dataset as ds, solutions as sol
@@ -90,6 +91,7 @@ def test_discover_datasets_glob(tmp_path):
         cli_mpi.discover_datasets(str(tmp_path / "nope*.ms"))
 
 
+@pytest.mark.slow
 def test_mpi_cli_per_channel_flags(tmp_path):
     """A garbage channel that is per-channel FLAGGED must be excluded
     from the solve input via the native pack path (VERDICT weak item:
@@ -127,6 +129,7 @@ def test_mpi_cli_per_channel_flags(tmp_path):
     assert res < 1.0, res
 
 
+@pytest.mark.slow
 def test_mpi_cli_uneven_subbands(tmp_path, monkeypatch):
     """F=5 subbands on a 2-device mesh: the subband axis pads to 6 with
     masked zero-weight slots instead of shrinking the mesh to the largest
@@ -151,6 +154,7 @@ def test_mpi_cli_uneven_subbands(tmp_path, monkeypatch):
         assert np.isfinite(res) and res < 1.0, (p, res)
 
 
+@pytest.mark.slow
 def test_admm_padded_subbands_match_unpadded():
     """The masked padding is exact: 5 real subbands on a 5-device mesh ==
     the same 5 padded to 8 on the 8-device mesh (padded slots replicate
@@ -238,6 +242,7 @@ def test_admm_padded_subbands_match_unpadded():
                                rtol=1e-8, atol=1e-10)
 
 
+@pytest.mark.slow
 def test_mpi_cli_uvcut_solve_scoped(tmp_path):
     """-x/-y exclude baselines from the solve (flag 2, predict.c:876)
     without persisting the cut: stored flags are untouched after the
@@ -266,6 +271,7 @@ def test_mpi_cli_uvcut_solve_scoped(tmp_path):
     assert np.isfinite(res.x).all()
 
 
+@pytest.mark.slow
 def test_mpi_cli_parity_knobs(tmp_path, capsys):
     """The reference-MPI advanced letters run end-to-end: -W whitening,
     -R 0 fixed order, -k/-o/-J correction, -q warm start."""
@@ -325,6 +331,7 @@ def test_mpi_cli_parity_knobs(tmp_path, capsys):
     assert warm != cold and warm < cold
 
 
+@pytest.mark.slow
 def test_mpi_cli_beam(tmp_path):
     """-B on the distributed CLI: beam tables fold into every subband's
     predict (slave predict_withbeam path) and into the residual write;
@@ -339,11 +346,33 @@ def test_mpi_cli_beam(tmp_path):
     assert cli_mpi.main(base) == 0
     res_off = ds.SimMS(paths[0],
                        data_column="CORRECTED_DATA").read_tile(0).x
-    assert cli_mpi.main(base + ["-B", "1"]) == 0
+    tr = tmp_path / "beam_diag.jsonl"
+    assert cli_mpi.main(base + ["-B", "1", "--diag", str(tr)]) == 0
     res_on = ds.SimMS(paths[0],
                       data_column="CORRECTED_DATA").read_tile(0).x
     assert np.isfinite(res_on).all()
     assert np.abs(res_on - res_off).max() > 1e-9
+    # staging bytes-accounting (diag subsystem): the static beam tables
+    # cross host->device exactly ONCE; each tile restages only the gmst
+    # time track, which must be much smaller than the static tables
+    from sagecal_tpu.diag import trace as dtrace
+    recs = dtrace.read(str(tr))
+    static_ev = [r for r in recs if r["ev"] == "stage_bytes"
+                 and r["what"] == "beam_static"]
+    gmst_ev = [r for r in recs if r["ev"] == "stage_bytes"
+               and r["what"] == "beam_gmst"]
+    assert len(static_ev) == 1
+    assert len(gmst_ev) >= 1           # one per solved tile
+    assert all(g["bytes"] < static_ev[0]["bytes"] for g in gmst_ev)
+    assert all(g["bytes"] > 0 for g in gmst_ev)
+    # per-ADMM-iteration convergence records + the interval summary
+    # with the consensus primal residual
+    admm_recs = [r for r in recs if r["ev"] == "admm_iter"]
+    assert len(admm_recs) >= 1 and all(
+        np.isfinite(r["r1_mean"]) and np.isfinite(r["dual"])
+        for r in admm_recs)
+    tile_recs = [r for r in recs if r["ev"] == "tile"]
+    assert tile_recs and all(np.isfinite(r["primal"]) for r in tile_recs)
     # blocked single-device plan (the north-star execution path) agrees
     # with the mesh path under the beam
     import jax as _jax

@@ -153,6 +153,7 @@ def _subband_problem(nf=4, n_stations=6, tilesz=2, seed=0):
 
 
 @pytest.mark.parametrize("ndev", [4])
+@pytest.mark.slow
 def test_mesh_admm_roundtrip(ndev):
     nf = 4
     sky, dsky, freqs, tiles, Jtrue = _subband_problem(nf=nf)
@@ -206,6 +207,7 @@ def test_mesh_admm_roundtrip(ndev):
             assert err < 0.2, (f, m, err)
 
 
+@pytest.mark.slow
 def test_host_loop_admm_matches_traced():
     """host_loop=True (one bounded execution per ADMM iteration, the
     single-chip bench path) must reproduce the fully traced runner."""
@@ -252,6 +254,7 @@ def test_host_loop_admm_matches_traced():
                                    rtol=1e-6, atol=1e-8, err_msg=nm)
 
 
+@pytest.mark.slow
 def test_blocked_admm_matches_host_loop():
     """make_admm_runner_blocked (J-update split into subband blocks, one
     bounded execution each — the north-star single-chip path) must

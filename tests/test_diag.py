@@ -1,0 +1,291 @@
+"""sagecal_tpu.diag: trace schema round-trip, roofline cost extraction,
+staging bytes-accounting, and the no-retrace guard.
+
+The no-retrace guard is the subsystem's core promise: telemetry-off adds
+zero jit compiles (the hooks are no-ops), and telemetry-ON also adds
+zero jit compiles (the hooks are host-side emits, never traced code).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from sagecal_tpu.diag import guard, roofline, trace  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer():
+    """Every test leaves the module-level tracer disabled."""
+    yield
+    trace.disable()
+
+
+# ---------------------------------------------------------------------------
+# trace.py
+# ---------------------------------------------------------------------------
+
+def test_trace_schema_round_trip(tmp_path):
+    path = tmp_path / "run.jsonl"
+    trace.enable(str(path), entry="test", argv=["-d", "x"])
+    assert trace.active()
+    trace.emit("tile", tile=0, res_0=2.5, res_1=1.25, mean_nu=3.0,
+               solver_iters=17)
+    with trace.phase("solve", tile=0):
+        pass
+    trace.emit("admm_iter", iter=1, r1_mean=0.5, dual=0.01, rho_mean=5.0)
+    trace.disable()
+    assert not trace.active()
+
+    recs = trace.read(str(path))
+    evs = [r["ev"] for r in recs]
+    assert evs == ["run_start", "tile", "phase", "admm_iter", "run_end"]
+    for r in recs:                       # required fields on every line
+        assert isinstance(r["t"], float) and isinstance(r["ev"], str)
+    tile = recs[1]
+    assert tile["res_0"] == 2.5 and tile["solver_iters"] == 17
+    ph = recs[2]
+    assert ph["name"] == "solve" and ph["dur_s"] >= 0.0
+    assert recs[-1]["wall_s"] >= 0.0
+    # raw file is line-delimited JSON (parseable without the reader)
+    for line in path.read_text().splitlines():
+        json.loads(line)
+
+
+def test_trace_noop_when_disabled(tmp_path):
+    # module-level emit/phase must be safe (and do nothing) untraced
+    trace.emit("tile", tile=0)
+    with trace.phase("solve"):
+        pass
+    assert trace.get() is None
+
+
+def test_trace_survives_unserializable_field(tmp_path):
+    path = tmp_path / "run.jsonl"
+    trace.enable(str(path))
+    trace.emit("tile", arr=object())     # must not raise
+    trace.disable()
+    recs = trace.read(str(path))
+    assert recs[1]["ev"] == "tile" and isinstance(recs[1]["arr"], str)
+
+
+def test_trace_read_rejects_malformed(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"t": 1.0, "ev": "x"}\nnot json\n')
+    with pytest.raises(ValueError):
+        trace.read(str(p))
+    p.write_text('{"t": 1.0}\n')         # missing required "ev"
+    with pytest.raises(ValueError):
+        trace.read(str(p))
+
+
+# ---------------------------------------------------------------------------
+# roofline.py
+# ---------------------------------------------------------------------------
+
+def test_program_cost_and_classification():
+    dev = jax.devices()[0]
+    f = jax.jit(lambda a, b: (a @ b).sum())
+    x = jnp.ones((128, 128), jnp.float32)
+    cost = roofline.program_cost(f, (x, x))
+    assert cost["flops"] > 0 and cost["bytes_accessed"] > 0
+    rec = roofline.roofline_fields(cost, 1e-3, dev)
+    for k in ("flops", "bytes_accessed", "achieved_gbps",
+              "achieved_flops_per_s", "intensity", "bound"):
+        assert k in rec, k
+        assert rec[k] is not None
+    assert rec["bound"] in ("compute", "bandwidth")
+    assert np.isfinite(rec["achieved_gbps"]) and rec["achieved_gbps"] > 0
+
+    # an elementwise program is bandwidth-bound, a big matmul is
+    # compute-bound — on any device whose ridge sits between ~0.25
+    # (copy) and ~n/12 (matmul at n=2048) FLOP/byte
+    ew = roofline.lower_cost(lambda a: a + 1.0,
+                             jax.ShapeDtypeStruct((1 << 16,), jnp.float32))
+    mm = roofline.lower_cost(
+        lambda a, b: a @ b,
+        jax.ShapeDtypeStruct((2048, 2048), jnp.float32),
+        jax.ShapeDtypeStruct((2048, 2048), jnp.float32))
+    assert roofline.roofline_fields(ew, 1.0, dev)["bound"] == "bandwidth"
+    assert roofline.roofline_fields(mm, 1.0, dev)["bound"] == "compute"
+
+
+def test_cost_algebra():
+    a = {"flops": 2.0, "bytes_accessed": 10.0}
+    b = {"flops": 3.0, "bytes_accessed": 5.0}
+    c = roofline.combine(a, None, b)
+    assert c == {"flops": 5.0, "bytes_accessed": 15.0}
+    assert roofline.scale(a, 3) == {"flops": 6.0, "bytes_accessed": 30.0}
+    assert roofline.scale(None, 3) is None
+
+
+def test_device_peaks_table():
+    class FakeDev:
+        platform = "tpu"
+        device_kind = "TPU v5p"
+    pf, pb, nominal = roofline.device_peaks(FakeDev())
+    assert pf == 459e12 and pb == 2765e9 and not nominal
+    # the CPU fallback is nominal but present (the bench's bound column
+    # must classify on the CPU fallback too)
+    pf, pb, nominal = roofline.device_peaks(jax.devices()[0])
+    if jax.devices()[0].platform == "cpu":
+        assert nominal and pf and pb
+    assert roofline.nbytes_of({"a": np.zeros((4, 2), np.float64),
+                               "b": np.zeros(3, np.float32)}) == 76
+
+
+# ---------------------------------------------------------------------------
+# guard.py: the no-retrace contract
+# ---------------------------------------------------------------------------
+
+def _tiny_solve(tmp_trace=None):
+    """One host-driven SAGE solve (the jitted hot path the tracer hooks
+    into), optionally traced."""
+    from sagecal_tpu.config import SolverMode
+    from sagecal_tpu.solvers import sage
+
+    if tmp_trace is not None:
+        trace.enable(str(tmp_trace))
+    try:
+        rng = np.random.default_rng(3)
+        N, M, K, tsz = 5, 2, 1, 4
+        pairs = [(i, j) for i in range(N) for j in range(i + 1, N)]
+        B = len(pairs) * tsz
+        sta1 = jnp.asarray(np.tile([p[0] for p in pairs], tsz), jnp.int32)
+        sta2 = jnp.asarray(np.tile([p[1] for p in pairs], tsz), jnp.int32)
+        coh = jnp.asarray(rng.normal(size=(M, B, 2, 2))
+                          + 1j * rng.normal(size=(M, B, 2, 2)))
+        cidx = jnp.zeros((M, B), jnp.int32)
+        cmask = jnp.ones((M, K), bool)
+        J0 = jnp.asarray(np.tile(np.eye(2, dtype=np.complex128),
+                                 (M, K, N, 1, 1)))
+        x8 = sage.full_model8(J0, coh, sta1, sta2, cidx)
+        wt = jnp.ones((B, 8), jnp.float64)
+        cfg = sage.SageConfig(max_emiter=1, max_iter=2, max_lbfgs=2,
+                              solver_mode=int(SolverMode.OSLM_LBFGS),
+                              promote="off")
+        J, info = sage.sagefit_host(x8, coh, sta1, sta2, cidx, cmask, J0,
+                                    N, wt, config=cfg)
+        jax.block_until_ready(J)
+        return float(info["res_1"])
+    finally:
+        if tmp_trace is not None:
+            trace.disable()
+
+
+def test_no_retrace_with_diag_on(tmp_path):
+    """jit compile counts must be IDENTICAL across diag off / on / off
+    for the same workload — the telemetry hooks live outside every
+    traced program."""
+    # absorb cold compiles AND the execution-plan learning: run 1
+    # learns the sweep-fusion verdict, run 2 compiles the fused sweep
+    # program; from run 3 the per-shape program set is steady
+    _tiny_solve()
+    _tiny_solve()
+    with guard.CompileGuard() as g_off:
+        _tiny_solve()
+    with guard.CompileGuard() as g_on:
+        _tiny_solve(tmp_trace=tmp_path / "t.jsonl")
+    with guard.CompileGuard() as g_off2:
+        _tiny_solve()
+    assert g_on.compiles == g_off.compiles == g_off2.compiles, (
+        g_off.compiles, g_on.compiles, g_off2.compiles)
+    # and the traced run actually produced convergence records
+    recs = trace.read(str(tmp_path / "t.jsonl"))
+    assert any(r["ev"] == "em_sweep" for r in recs)
+    sweep = next(r for r in recs if r["ev"] == "em_sweep")
+    assert sweep["solver_iters"] > 0 and sweep["wall_s"] >= 0
+
+
+def test_compile_guard_counts_compiles():
+    guard.install()
+    c0 = guard.compile_count()
+    f = jax.jit(lambda a: a * 3 + 1)
+    f(jnp.ones((7,))).block_until_ready()        # new program: compiles
+    assert guard.compile_count() > c0
+    c1 = guard.compile_count()
+    f(jnp.ones((7,))).block_until_ready()        # cached: no compile
+    assert guard.compile_count() == c1
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: CLI --diag produces a parseable convergence trace
+# ---------------------------------------------------------------------------
+
+def _make_sim_dataset(tmp_path, n_stations=6, tilesz=4, n_tiles=2):
+    import math
+
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.rime import predict as rp
+    from sagecal_tpu import skymodel
+
+    sky_file = tmp_path / "sky.txt"
+    sky_file.write_text(
+        "P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6\n")
+    (tmp_path / "sky.txt.cluster").write_text("0 1 P0A\n")
+    ra0 = (41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(sky_file), ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs,
+        skymodel.parse_cluster_file(str(tmp_path / "sky.txt.cluster")))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jt = ds.random_jones(1, sky.nchunk, n_stations, seed=5, scale=0.1)
+    tiles = [ds.simulate_dataset(dsky, n_stations=n_stations,
+                                 tilesz=tilesz, freqs=np.array([150e6]),
+                                 ra0=ra0, dec0=dec0, jones=Jt,
+                                 nchunk=sky.nchunk, noise_sigma=0.01,
+                                 seed=11 + t)
+             for t in range(n_tiles)]
+    msdir = tmp_path / "sim.ms"
+    ds.SimMS.create(str(msdir), tiles)
+    return msdir, sky_file
+
+
+def test_cli_diag_trace_end_to_end(tmp_path):
+    from sagecal_tpu import cli
+
+    msdir, sky_file = _make_sim_dataset(tmp_path)
+    tr = tmp_path / "diag.jsonl"
+    rc = cli.main([
+        "-d", str(msdir), "-s", str(sky_file),
+        "-c", str(sky_file) + ".cluster",
+        "-e", "2", "-g", "3", "-l", "2", "-j", "1", "-B", "0",
+        "--diag", str(tr)])
+    assert rc == 0
+    recs = trace.read(str(tr))
+    evs = {r["ev"] for r in recs}
+    assert recs[0]["ev"] == "run_start"
+    assert recs[-1]["ev"] == "run_end"
+    # per-iteration convergence records + phase timers made it out
+    assert "em_sweep" in evs and "tile" in evs and "phase" in evs
+    tiles = [r for r in recs if r["ev"] == "tile"]
+    assert len(tiles) == 2
+    for r in tiles:
+        assert np.isfinite(r["res_0"]) and np.isfinite(r["res_1"])
+        assert r["res_1"] <= r["res_0"]
+    phases = {r["name"] for r in recs if r["ev"] == "phase"}
+    assert {"io", "stage", "solve", "residual", "write"} <= phases
+    # tracer is closed and uninstalled after main()
+    assert not trace.active()
+
+
+def test_cli_legacy_flag_warning(capsys):
+    from sagecal_tpu import cli
+
+    p = cli.build_parser()
+    args = p.parse_args(["-d", "x", "-s", "s", "-c", "c", "-y", "1",
+                         "-o", "2.0"])
+    warnings = cli.warn_legacy_flags(args, err=sys.stderr)
+    assert len(warnings) == 2
+    err = capsys.readouterr().err
+    assert "uvmax" in err and "mmse" in err.lower()
+    # sane values warn about nothing
+    args = p.parse_args(["-d", "x", "-s", "s", "-c", "c"])
+    assert cli.warn_legacy_flags(args, err=sys.stderr) == []

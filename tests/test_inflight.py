@@ -68,6 +68,7 @@ def test_inflight_widths_cold_vs_warm():
     assert sage._inflight_widths(sage.SageConfig(inflight=1), 100) == (1, 1)
 
 
+@pytest.mark.slow
 def test_inflight_converges_like_sequential():
     """M=8, G=2 (the clamped regime): group solving tracks sequential."""
     sky, dsky, Jtrue, tile = _problem(8)
@@ -78,6 +79,7 @@ def test_inflight_converges_like_sequential():
     assert r1_g < 3.0 * r1_seq + 1e-9
 
 
+@pytest.mark.slow
 def test_inflight_clamped_matches_sequential_exactly():
     """M=4 with any G clamps to 1: bit-identical code path."""
     sky, dsky, Jtrue, tile = _problem(4)
@@ -87,6 +89,7 @@ def test_inflight_clamped_matches_sequential_exactly():
     assert r1a == pytest.approx(r1b, rel=1e-12)
 
 
+@pytest.mark.slow
 def test_inflight_robust_rtr():
     sky, dsky, Jtrue, tile = _problem(8, seed=3)
     _, r0, r1 = _solve(sky, dsky, tile, 2,
@@ -94,6 +97,7 @@ def test_inflight_robust_rtr():
     assert r1 < 0.25 * r0
 
 
+@pytest.mark.slow
 def test_inflight_host_driver_ragged():
     """sagefit_host honors inflight on the unfused and fused paths;
     M=9 with G=2 exercises the sentinel-padded ragged group."""
@@ -111,6 +115,7 @@ def test_inflight_host_driver_ragged():
         assert r1 < 0.25 * r0
 
 
+@pytest.mark.slow
 def test_inflight_admm_runner():
     """inflight rides through the consensus-ADMM solve path (M=8 so the
     clamp leaves G=2 active)."""
@@ -160,6 +165,7 @@ def test_inflight_admm_runner():
     assert (res1 < res0).all()
 
 
+@pytest.mark.slow
 def test_inflight_residual_parity_at_scale():
     """VERDICT r5 item 6: at M>=32 with G=M//4 (the width the north-star
     regime actually uses) the grouped solve must land within a residual

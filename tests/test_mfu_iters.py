@@ -43,6 +43,7 @@ def problem():
             jnp.asarray(cmask), jnp.asarray(J0), N, jnp.asarray(wt))
 
 
+@pytest.mark.slow
 def test_iters_traced_vs_host(problem):
     cfg = sage.SageConfig(max_emiter=2, max_iter=5, max_lbfgs=4,
                           solver_mode=int(SolverMode.OSLM_OSRLM_RLBFGS))
@@ -67,6 +68,7 @@ def test_iters_rtr_bounded(problem):
     assert int(info["lbfgs_iters"]) == 0
 
 
+@pytest.mark.slow
 def test_iters_tiles_per_tile(problem):
     cfg = sage.SageConfig(max_emiter=1, max_iter=3, max_lbfgs=2,
                           solver_mode=int(SolverMode.LM_LBFGS))

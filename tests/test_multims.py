@@ -104,6 +104,7 @@ def test_multisimms_glob_and_listfile(bands):
     assert isinstance(ds.open_dataset(None, one), ds.SimMS)
 
 
+@pytest.mark.slow
 def test_joint_calibration_matches_merged_band(bands):
     """Calibrating two half-band datasets jointly via -f must equal
     calibrating the pre-merged band (VERDICT item 4 'done' criterion)."""

@@ -110,6 +110,7 @@ def test_prefetch_iterator(tmp_path):
     assert seen == [(i, 12) for i in range(5)]
 
 
+@pytest.mark.slow
 def test_pipeline_with_channel_flags(tmp_path):
     """Fullbatch pipeline over a dataset with per-channel flags routes
     through the native pack path and still converges."""

@@ -102,6 +102,7 @@ def test_oslm_no_longer_aliases_plain_lm():
     assert not np.allclose(np.asarray(J_os), np.asarray(J_lm))
 
 
+@pytest.mark.slow
 def test_osrlm_no_longer_aliases_rlm():
     sky, tile, *arrs = _problem()
     x8, coh, sta1, sta2, cidx, cmask, wt, J0 = arrs
@@ -127,6 +128,7 @@ def test_os_deterministic_rotation():
     assert float(i1["res_1"]) < 0.5 * float(i1["res_0"])
 
 
+@pytest.mark.slow
 def test_os_reaches_full_lm_quality():
     """OS-robust mode 2 must reach (near) the residual of full robust
     mode 3 — the point of P4 is same quality from cheaper iterations
@@ -143,6 +145,7 @@ def test_os_reaches_full_lm_quality():
     assert r_os < 2.0 * max(r_full, 1e-6), (r_os, r_full)
 
 
+@pytest.mark.slow
 def test_sagefit_host_matches_traced():
     """sagefit_host is the same algorithm as sagefit, chunked into
     bounded device executions; with randomize=False the trajectories are
@@ -165,6 +168,7 @@ def test_sagefit_host_matches_traced():
                                rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_sagefit_host_randomized_converges():
     """Randomized cluster permutation + OS subsets still converge through
     the host driver (the production fullbatch path)."""
@@ -180,6 +184,7 @@ def test_sagefit_host_randomized_converges():
     assert float(info["res_1"]) < 0.3 * float(info["res_0"])
 
 
+@pytest.mark.slow
 def test_sagefit_host_promotion_consistent():
     """After timed fused sweeps prove the whole solve fits under the
     per-execution budget, sagefit_host promotes to ONE traced program —

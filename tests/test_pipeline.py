@@ -114,6 +114,7 @@ def test_graft_entry_compiles():
     mod.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_per_channel_mode(simdir):
     """-b 1 bandpass mode: vmapped per-channel solve + residual
     write-back (fullbatch_mode.cpp:442-488)."""
@@ -136,6 +137,7 @@ def test_per_channel_mode(simdir):
     assert np.abs(t0.x).mean() < 1.0
 
 
+@pytest.mark.slow
 def test_fullbatch_shard_baselines(simdir):
     """--shard-baselines (P1): the fullbatch pipeline with the row axis
     sharded over the 8-device mesh converges and writes residuals."""

@@ -12,6 +12,7 @@ from sagecal_tpu.consensus import manifold as mf
 from sagecal_tpu.io import dataset as ds, solutions as sol
 from sagecal_tpu.rime import predict as rp
 from sagecal_tpu.rime import residual as rr
+import pytest
 
 
 def test_extract_phases_recovers_diag_phases():
@@ -111,6 +112,7 @@ def test_phase_only_correction_runs(tmp_path):
     assert np.abs(full - ph).max() > 1e-6
 
 
+@pytest.mark.slow
 def test_per_channel_bandpass_mode(tmp_path):
     """-b 1 CLI end-to-end: per-channel solve converges and writes
     solutions + residuals."""

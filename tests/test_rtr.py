@@ -9,6 +9,7 @@ from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.solvers import rtr as rtr_mod
 
 from test_lm import _toy_problem
+import pytest
 
 
 def _toy_problem_scalar(N=8, T=4, K=1, seed=0, noise=0.0, nu=None):
@@ -162,6 +163,7 @@ def test_nsd_reduces_cost():
     assert float(info["final_cost"][0]) < 0.2 * float(info["init_cost"][0])
 
 
+@pytest.mark.slow
 def test_sage_dispatches_rtr_modes():
     from sagecal_tpu.config import SolverMode
     from sagecal_tpu.solvers import sage

@@ -13,6 +13,7 @@ from sagecal_tpu.io import dataset as ds
 from sagecal_tpu.rime import predict as rp
 from sagecal_tpu.solvers import lm as lm_mod
 from sagecal_tpu.solvers import sage
+import pytest
 
 
 def _calib_problem(n_stations=8, tilesz=6, n_clusters=2, nchunk=(1, 2),
@@ -129,6 +130,7 @@ def test_sage_warm_start_is_fixed_point():
     assert np.abs(np.asarray(J) - Jtrue).max() < 1e-10
 
 
+@pytest.mark.slow
 def test_sage_robust_with_outliers():
     sky, dsky, Jtrue, tile = _calib_problem(noise=0.01, seed=3)
     # inject unflagged gross outliers into 5% of rows
@@ -155,6 +157,7 @@ def test_sage_robust_with_outliers():
     assert 2.0 <= float(info_r["mean_nu"]) <= 30.0
 
 
+@pytest.mark.slow
 def test_sage_residual_never_catastrophic():
     sky, dsky, Jtrue, tile = _calib_problem(noise=0.05, seed=5)
     J, info, _, _ = _solve(sky, dsky, tile, SolverMode.RLM_RLBFGS,

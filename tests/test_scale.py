@@ -18,6 +18,7 @@ from sagecal_tpu.rime import predict as rp
 from sagecal_tpu.solvers import lm as lm_mod
 from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.solvers import sage
+import pytest
 
 
 def _big_sky(n_clusters=32, seed=21):
@@ -41,6 +42,7 @@ def _big_sky(n_clusters=32, seed=21):
     return skymodel.build_cluster_sky(srcs, clusters)
 
 
+@pytest.mark.slow
 def test_lofar_scale_62_stations_32_directions():
     """One EM pass at 62 stations x 32 directions x hybrid chunks: the
     [K, 8N, 8N] normal systems (K<=4, 8N=496) and padded [M, B] predict
@@ -86,6 +88,7 @@ def test_lofar_scale_62_stations_32_directions():
                                           np.asarray(J0)[m, k])
 
 
+@pytest.mark.slow
 def test_mesh_admm_subband_folding():
     """F = 2 x n_devices subbands folded onto the mesh (admm.py local
     leading axis): the consensus Z-update must see ALL F subbands, and

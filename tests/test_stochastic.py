@@ -201,6 +201,7 @@ def test_huber_loss_band_solver(simdir):
                            np.asarray(outs["huber"].p))
 
 
+@pytest.mark.slow
 def test_stochastic_uvcut_solve_scoped(simdir):
     """-x/-y apply in minibatch mode (loadData applies the uv window at
     load in the reference) without persisting flag changes."""

@@ -53,6 +53,7 @@ def _run(tmp, msdir, sky_path, clus_path, extra, solname):
     return pipeline.run(cfg, log=lambda *a: None), solpath
 
 
+@pytest.mark.slow
 def test_tile_batch_pipeline_matches_sequential(simdir5):
     tmp, msdir, sky_path, clus_path = simdir5
     hist_b, sol_b = _run(tmp, msdir, sky_path, clus_path,
@@ -71,6 +72,7 @@ def test_tile_batch_pipeline_matches_sequential(simdir5):
     assert np.isfinite(np.abs(t1.x)).all()
 
 
+@pytest.mark.slow
 def test_tile_batch_close_to_sequential(tmp_path):
     """Same dataset calibrated twice (fresh copies): batched residuals
     track sequential ones tile for tile (only warm-start granularity
@@ -111,6 +113,7 @@ def test_tile_batch_close_to_sequential(tmp_path):
         assert hb["res_1"] < 1.5 * hs["res_1"] + 1e-6
 
 
+@pytest.mark.slow
 def test_solve_knobs_force_modes():
     """fuse/promote force knobs select the intended execution paths."""
     from test_sage import _calib_problem

@@ -18,6 +18,7 @@ from sagecal_tpu.solvers import lm as lm_mod
 from sagecal_tpu.solvers import sage
 
 from test_sage import _calib_problem
+import pytest
 
 
 def _tiles_problem(n_tiles=3, n_stations=8, tilesz=6, noise=0.01):
@@ -80,6 +81,7 @@ def _run_both(solver_mode, os_mode=False, max_emiter=2, max_iter=6,
             np.asarray(r1s))
 
 
+@pytest.mark.slow
 def test_tiles_match_lm():
     J_b, r0_b, r1_b, J_s, r0_s, r1_s = _run_both(SolverMode.LM_LBFGS)
     np.testing.assert_allclose(r0_b, r0_s, rtol=1e-9)
@@ -87,6 +89,7 @@ def test_tiles_match_lm():
     np.testing.assert_allclose(J_b, J_s, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_tiles_match_oslm_robust():
     # mode 3 exercises OS subsets + robust IRLS + per-tile PRNG draws
     J_b, r0_b, r1_b, J_s, r0_s, r1_s = _run_both(
@@ -96,6 +99,7 @@ def test_tiles_match_oslm_robust():
     np.testing.assert_allclose(J_b, J_s, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_tiles_match_rtr_robust():
     # mode 5 exercises the RTR while-loop budget freeze + tCG under vmap
     J_b, r0_b, r1_b, J_s, r0_s, r1_s = _run_both(
@@ -114,6 +118,7 @@ def test_tile_keys_tile0_default():
     assert len(flat) == 4
 
 
+@pytest.mark.slow
 def test_tiles_residuals_decrease():
     J_b, r0_b, r1_b, _, _, _ = _run_both(SolverMode.LM_LBFGS,
                                          max_emiter=3, max_iter=10,
@@ -121,6 +126,7 @@ def test_tiles_residuals_decrease():
     assert (r1_b < 0.2 * r0_b).all()
 
 
+@pytest.mark.slow
 def test_tiles_t1_fast_path_contract():
     """T=1 takes the axis-free driver (measured ~40% faster on the
     latency-bound chip path) but must keep the batched contract: every

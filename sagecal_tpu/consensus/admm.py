@@ -141,7 +141,7 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                      mesh: Mesh, nf_total: int, with_shapelets: bool = False,
                      spatial_coords=None, host_loop: bool = False,
                      dobeam: int = 0, nbase: int | None = None,
-                     _return_parts: bool = False):
+                     donate: bool = True, _return_parts: bool = False):
     """Build the jitted per-timeslot consensus-ADMM program.
 
     Returns ``run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F_r8)`` operating
@@ -162,6 +162,10 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
     jitted execution per iteration (identical math; required on the
     tunneled single chip whose runtime kills long executions, and
     cheaper to compile: the scan body becomes a reusable program).
+    donate: host-loop only — donate the ADMM carry buffers to each body
+    execution (in-place reuse; bit-identical results, gated by
+    tests/test_donation.py). False keeps every input buffer alive, for
+    embedders that hold references across iterations.
     """
     from sagecal_tpu.consensus import spatial as sp
     from sagecal_tpu.rime import predict as rp
@@ -213,10 +217,15 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                               beam=beam, dobeam=dobeam, tslot=tslot_j,
                               sta1=sta1_j, sta2=sta2_j)[:, :, 0]
 
+    # rows are [tilesz, nbase] per subband: forward the baseline period
+    # to the solvers' normal-equation assembly (normal_eq row_period)
+    sage_cfg = (cfg.sage if not nbase
+                else cfg.sage._replace(nbase=int(nbase)))
+
     def local_solve_plain(x8, u, v, w, wt, J_r8, freq, beam=None):
         coh = coh_for(u, v, w, freq, beam)
         J, info = sage.sagefit(x8, coh, sta1_j, sta2_j, cidx_j, cmask_j,
-                               ne.jones_r2c(J_r8), N, wt, config=cfg.sage)
+                               ne.jones_r2c(J_r8), N, wt, config=sage_cfg)
         return ne.jones_c2r(J), info["res_0"], info["res_1"]
 
     def local_solve_admm(x8, u, v, w, wt, J_r8, freq, Y_r8, BZ_r8, rho_m,
@@ -224,9 +233,9 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         coh = coh_for(u, v, w, freq, beam)
         # ADMM iterations k>0 always warm-start from the previous
         # iterate, so cluster groups (inflight>1) skip the cold-start
-        # width restriction; iteration 0 (local_solve_plain, cfg.sage
+        # width restriction; iteration 0 (local_solve_plain, sage_cfg
         # unmodified) keeps it
-        scfg = cfg.sage._replace(max_lbfgs=0, inflight_warm=True)
+        scfg = sage_cfg._replace(max_lbfgs=0, inflight_warm=True)
         J, info = sage.sagefit(x8, coh, sta1_j, sta2_j, cidx_j, cmask_j,
                                ne.jones_r2c(J_r8), N, wt, config=scfg,
                                admm=(Y_r8, BZ_r8, rho_m))
@@ -482,11 +491,16 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         iter0_flat, mesh=mesh, in_specs=(spec_f,) * 8 + beam_specs,
         out_specs=carry_specs + (spec_f, spec_f, spec_f),
         check_vma=False))
+    # the ADMM carry (J/Y/Z/rho accumulators + BB state) is DONATED to
+    # each body execution: every iteration rebinds the carry from the
+    # program's outputs, so XLA reuses the buffers in place instead of
+    # allocating a fresh accumulator set per ADMM iteration
     progb = jax.jit(shard_map(
         body_flat, mesh=mesh,
         in_specs=(spec_f,) * 6 + carry_specs + (spec_r,) + beam_specs,
         out_specs=carry_specs + (spec_f, spec_f, spec_r),
-        check_vma=False))
+        check_vma=False),
+        donate_argnums=tuple(range(6, 15)) if donate else ())
 
     n_runs = [0]    # runner invocation ordinal = interval, for traces
 
@@ -572,10 +586,13 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
     _per_subband = parts["_per_subband"]
     solve0 = jax.jit(_per_subband(local_solve_plain))
     solveb = jax.jit(_per_subband(local_solve_admm))
+    # donate the block-solved Jones and the ADMM carry into the
+    # consensus steps (same in-place reuse as make_admm_runner's
+    # host-loop donation; callers rebind both from the outputs)
     cons0 = jax.jit(lambda JF, res0, res1, fratioF: iter0_post(
-        JF, res0, res1, fratioF, ax=None))
+        JF, res0, res1, fratioF, ax=None), donate_argnums=(0,))
     consb = jax.jit(lambda Jr, r0, r1, carry, it: body_post(
-        Jr, r0, r1, carry, it, ax=None))
+        Jr, r0, r1, carry, it, ax=None), donate_argnums=(0, 3))
     bz_prog = jax.jit(
         lambda Z, Brow: jnp.einsum("fp,mpknr->fmknr", Brow, Z))
 

@@ -171,7 +171,11 @@ class FullBatchPipeline:
             linsolv=cfg.linsolv,
             fuse=getattr(cfg, "solve_fuse", "auto"),
             promote=getattr(cfg, "solve_promote", "auto"),
-            inflight=max(1, int(getattr(cfg, "cluster_inflight", 1))))
+            inflight=max(1, int(getattr(cfg, "cluster_inflight", 1))),
+            # rows are [tilesz, nbase] (io.dataset layout): lets the
+            # solvers' normal-equation assembly take the baseline-major
+            # aggregation for single-chunk clusters
+            nbase=int(meta["nbase"]))
         self.boost = first_tile_boost(self.n)
 
         # --tile-batch: T>1 solves T intervals as one vmapped program
@@ -191,7 +195,12 @@ class FullBatchPipeline:
 
         self._solve_first = self._build_solver(self.boost)
         self._solve_rest = self._build_solver(1, warm=True)
-        self._residual_fn = jax.jit(self._residuals)
+        # the staged per-tile visibility buffer is DONATED: the residual
+        # program writes the subtracted visibilities in place of its
+        # input (same [B, F, ..] real shape) instead of allocating a
+        # second tile-sized buffer per interval — callers stage x_r
+        # fresh from tile.x and only ever read the output back
+        self._residual_fn = jax.jit(self._residuals, donate_argnums=(1,))
         self._chan_solver = None
         self._chan_residual_fn = None
         if cfg.per_channel_bfgs:
@@ -346,6 +355,10 @@ class FullBatchPipeline:
         ndev = mesh.devices.size
         os_ids_np, os_nsub = lm_mod.os_subset_ids(meta["tilesz"],
                                                   meta["nbase"])
+        # row-sharding (+ zero-weight padding) breaks the [tilesz,
+        # nbase] period a shard-local normal-equation assembly would
+        # assume — disable the baseline-major path here
+        scfg = scfg._replace(nbase=0)
         solve_j = parallel.sharded_sagefit(mesh, self.dsky, fdelta,
                                            self.cmask, self.n,
                                            config=scfg, os_nsub=os_nsub,

@@ -43,6 +43,8 @@ class LMState(NamedTuple):
     nu: jax.Array       # [K]
     cost: jax.Array     # [K] current weighted cost
     stop: jax.Array     # [K] bool
+    live: jax.Array     # [K] bool: carried JTJ/JTe built from >=1 usable
+                        # row of this chunk (always True outside OS)
     k: jax.Array        # iteration counter
 
 
@@ -52,12 +54,15 @@ class OSConfig(NamedTuple):
     time-tile subset; acceptance still tests the FULL-data cost
     (clmfit.c:1404 computes pDp_eL2 over all N rows).
 
-    Documented behavioral deviation: on a rejected step the reference
-    retries the SAME subset with increased damping (clmfit.c:1449 inner
-    while loop); this solver advances to the next subset instead — the
-    damping increase carries over, so the retry happens against fresh
-    data (batched lax control flow keeps every chunk on the same
-    schedule)."""
+    Rejected-step semantics now match the reference: a rejected chunk
+    keeps the SAME subset's normal equations with increased damping
+    (clmfit.c:1449 inner while loop) — it simply holds on to the
+    entering JTJ/JTe instead of re-evaluating them. Accepted chunks
+    advance to the next subset at the new point. (Rounds <= PR 1 had a
+    documented deviation here: rejection advanced the subset too.)
+    A carried subset with NO usable rows of a chunk (fully flagged, or a
+    time block outside the chunk) is never retried and its zero gradient
+    never reads as convergence — see the ``live`` carry in lm_solve."""
 
     os_id: jax.Array       # [B] subset id per data row (os_subset_ids)
     n_subsets: int         # static subset count (<= 10, reference default)
@@ -92,7 +97,8 @@ def _solve_damped(JTJ, JTe, mu, jitter):
 
 def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
              chunk_mask=None, config: LMConfig = LMConfig(),
-             itmax_dynamic=None, admm=None, os: OSConfig | None = None):
+             itmax_dynamic=None, admm=None, os: OSConfig | None = None,
+             row_period: int = 0):
     """Levenberg-Marquardt solve of all chunks of one cluster.
 
     Args:
@@ -112,12 +118,22 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         data term).
       os: optional ordered-subsets acceleration (clmfit.c:1074): each
         iteration's JTJ/JTe come from one random (or rotating) time-tile
-        subset while acceptance tests the full cost. One behavioral
-        difference vs the reference is documented on OSConfig: a rejected
-        step moves on to the next subset with increased damping instead
-        of retrying the same subset.
+        subset while acceptance tests the full cost; a rejected chunk
+        retries the SAME subset with increased damping (see OSConfig).
+      row_period: the rows' baseline period (nbase) when the caller's
+        layout is [tilesz, nbase] — enables normal_eq's baseline-major
+        aggregation for single-chunk clusters; 0 = generic path.
 
     Returns (J [K,N,2,2], info dict with init_cost/final_cost [K]).
+
+    Traffic note: each damping iteration makes exactly ONE pass over the
+    visibility rows — the normal equations, the gradient, and the
+    acceptance cost all come out of a single model/residual evaluation
+    at the trial point (normal_eq's cost_wt sharing), and rejected
+    chunks keep their entering JTJ/JTe by a per-chunk select instead of
+    a re-evaluation at the old point (same values: the old point's
+    equations ARE the entering ones). Rounds <= PR 1 paid a separate
+    full-data cost pass plus a conditional rebuild per iteration.
     """
     kmax = J0.shape[0]
     dtype = x8.dtype
@@ -139,12 +155,16 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         return cost_data + 2.0 * jnp.sum(admm_y * d, axis=-1) \
             + admm_rho * jnp.sum(d * d, axis=-1)
 
-    def nrm_eq(p, w=None):
+    def nrm_eq(p, w=None, cw=None):
+        """Normal equations + acceptance cost from ONE row pass: ``w``
+        weights JTJ/JTe (subset weights under OS), ``cw`` the cost
+        (full-data weights under OS; defaults to ``w``)."""
         J = ne.jones_r2c(p.reshape(kmax, n_stations, 8))
         JTJ, JTe, cost = ne.normal_equations(x8, J, coh, sta1, sta2,
                                              chunk_id,
                                              wt if w is None else w,
-                                             n_stations, kmax)
+                                             n_stations, kmax, cost_wt=cw,
+                                             row_period=row_period)
         if admm is not None:
             d = p - admm_bz
             JTe = JTe - admm_y - admm_rho * d
@@ -167,12 +187,20 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         def os_wt(l):
             return wt * (os.os_id == l).astype(wt.dtype)[:, None]
 
-        JTJ0, JTe0, _ = nrm_eq(p0, os_wt(subset_for(jnp.zeros((), jnp.int32))))
-        cost0 = aug_cost(p0, ne.weighted_cost(
-            x8, ne.jones_r2c(p0.reshape(kmax, n_stations, 8)),
-            coh, sta1, sta2, chunk_id, wt, kmax))
+        def os_live(w):
+            """[K] per-chunk: subset contributes >=1 usable row to chunk
+            k. A subset is a contiguous time block, so it can miss a
+            hybrid chunk entirely (or be fully flagged) — that chunk's
+            equations are identically zero and must not drive the solve."""
+            row = jnp.any(w > 0, axis=1).astype(x8.dtype)
+            return jnp.zeros((kmax,), x8.dtype).at[chunk_id].max(row) > 0
+
+        wt0 = os_wt(subset_for(jnp.zeros((), jnp.int32)))
+        JTJ0, JTe0, cost0 = nrm_eq(p0, wt0, cw=wt)
+        live0 = os_live(wt0)
     else:
         JTJ0, JTe0, cost0 = nrm_eq(p0)
+        live0 = jnp.ones((kmax,), bool)
     diag_max = jnp.max(jnp.abs(jnp.diagonal(JTJ0, axis1=-2, axis2=-1)),
                        axis=-1)
     mu0 = config.tau * jnp.maximum(diag_max, 1e-30)
@@ -186,9 +214,17 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     def body(s: LMState):
         dp, ok = _solve_damped(s.JTJ, s.JTe, s.mu, config.jitter)
         pnew = s.p + dp
-        cost_new = aug_cost(pnew, ne.weighted_cost(
-            x8, ne.jones_r2c(pnew.reshape(kmax, n_stations, 8)),
-            coh, sta1, sta2, chunk_id, wt, kmax))
+        # ONE row pass per iteration: normal equations AND acceptance
+        # cost at the trial point (OS: subset equations + full-data
+        # cost, sharing the same model/residual evaluation)
+        if os is not None:
+            wt_next = os_wt(subset_for(s.k + 1))
+            JTJn, JTen, cost_new = nrm_eq(pnew, wt_next, cw=wt)
+            # a subset with no usable rows of chunk k gives zero
+            # equations there; that is not convergence (per-chunk)
+            sub_live = os_live(wt_next)
+        else:
+            JTJn, JTen, cost_new = nrm_eq(pnew)
         # gain ratio: dL = dp^T (mu dp + JTe)
         dL = jnp.sum(dp * (s.mu[:, None] * dp + s.JTe), axis=-1)
         dF = s.cost - cost_new
@@ -200,25 +236,28 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         nu = jnp.where(accept, 2.0, s.nu * 2.0)
         p = jnp.where(accept[:, None], pnew, s.p)
         cost = jnp.where(accept, cost_new, s.cost)
-        if os is not None:
-            # OS: next iteration always sees a fresh subset's normal
-            # equations at the (possibly unchanged) parameters
-            wt_next = os_wt(subset_for(s.k + 1))
-            JTJ, JTe = nrm_eq(p, wt_next)[:2]
-            # an all-flagged subset has JTe == 0; that is not convergence
-            sub_live = jnp.any(wt_next > 0)
+        # rejected chunks keep their entering equations: numerically the
+        # old point's equations ARE the carried ones (non-OS), and under
+        # OS this is the reference's retry-same-subset (clmfit.c:1449).
+        # Exception: a DEAD carried subset (zero equations for this
+        # chunk) must not be retried — data-only its dp is exactly 0, so
+        # pnew == p and the new subset's equations at pnew are the old
+        # point's; adopting them on rejection un-freezes the chunk.
+        # (Under ADMM the prior terms make dp != 0, so adoption stays
+        # accept-only there; the live gate below still blocks the zero
+        # data gradient from reading as convergence.)
+        if os is not None and admm is None:
+            adopt = accept | (~s.live & chunk_mask)
         else:
-            # rebuild the normal equations only when some chunk moved; on an
-            # all-reject iteration just re-damp (clmfit.c retry loop
-            # semantics)
-            JTJ, JTe = jax.lax.cond(
-                jnp.any(accept),
-                lambda: nrm_eq(p)[:2],
-                lambda: (s.JTJ, s.JTe))
+            adopt = accept
+        JTJ = jnp.where(adopt[:, None, None], JTJn, s.JTJ)
+        JTe = jnp.where(adopt[:, None], JTen, s.JTe)
+        live = jnp.where(adopt, sub_live, s.live) if os is not None \
+            else s.live
         # convergence tests (levmar-style)
         small_grad = jnp.max(jnp.abs(JTe), axis=-1) <= config.eps1
         if os is not None:
-            small_grad = small_grad & sub_live
+            small_grad = small_grad & live
         small_dp = (jnp.linalg.norm(dp, axis=-1)
                     <= config.eps2 * (jnp.linalg.norm(s.p, axis=-1) + 1e-30))
         # eps3 applies to the (nonnegative) data cost only: the augmented-
@@ -233,12 +272,12 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         stop = s.stop | small_grad | (accept & small_dp) | small_cost \
             | (s.k + 1 >= itmax)
         return LMState(p=p, JTJ=JTJ, JTe=JTe, mu=mu, nu=nu, cost=cost,
-                       stop=stop, k=s.k + 1)
+                       stop=stop, live=live, k=s.k + 1)
 
     init = LMState(p=p0, JTJ=JTJ0, JTe=JTe0, mu=mu0,
                    nu=jnp.full((kmax,), 2.0, dtype),
                    cost=cost0, stop=jnp.zeros((kmax,), bool),
-                   k=jnp.zeros((), jnp.int32))
+                   live=live0, k=jnp.zeros((), jnp.int32))
     final = jax.lax.while_loop(cond, body, init)
     J = ne.jones_r2c(final.p.reshape(kmax, n_stations, 8))
     J = jnp.where(chunk_mask[:, None, None, None], J, J0)

@@ -83,13 +83,13 @@ def baseline_jacobians(J, coh, sta1, sta2, chunk_id):
     return _real_jac(Dp, conj_param=False), _real_jac(Dq, conj_param=True)
 
 
-def normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
-                     kmax: int):
-    """Weighted Gauss-Newton normal equations, batched over time chunks.
+def _normal_equations_dense(x8, J, coh, sta1, sta2, chunk_id, wt,
+                            n_stations: int, kmax: int):
+    """Reference assembly via materialized [B, 8, 8] Jacobian blocks.
 
-    Returns (JTJ [K, 8N, 8N], JTe [K, 8N], cost [K]) where the weighted cost
-    is sum_b ||wt_b * r_b||^2. ``wt`` [B, 8] are sqrt-weights (0 for flagged
-    rows; robust sqrt(w) for Student's-t IRLS, robustlm.c weighting).
+    Kept as the ground truth the traffic-lean :func:`normal_equations`
+    is equivalence-tested against (tests/test_lm.py); not used on any
+    hot path — it moves ~3x the bytes of the structured assembly.
     """
     N = n_stations
     r = residual8(x8, J, coh, sta1, sta2, chunk_id)
@@ -119,6 +119,149 @@ def normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
     cost = jnp.zeros((kmax,), Gp.dtype).at[chunk_id].add(
         jnp.sum(rw * rw, axis=1))
     return JTJ, JTe, cost
+
+
+def _ma_factor(A):
+    """[B, 2, 2] complex A (dV_ao/d(J_p)_ad = A_do) -> MA [B, 2, 2, 4]
+    real with MA[b, o, ri, (d, ci)] = Gp[b, (a, o, ri), (a, d, ci)]:
+    the 4x4 block every station-p Jacobian row block repeats (Gp is
+    block-diagonal over a == c)."""
+    Ar = jnp.swapaxes(A.real, -1, -2)              # [B, o, d]
+    Ai = jnp.swapaxes(A.imag, -1, -2)
+    # ci columns: (Re, Im) params; ri=Re row (Ar, -Ai), ri=Im row (Ai, Ar)
+    MA = jnp.stack([jnp.stack([Ar, -Ai], -1),      # ri = Re
+                    jnp.stack([Ai, Ar], -1)], 2)   # ri = Im
+    return MA.reshape(A.shape[0], 2, 2, 4)         # [B, o, ri, (d, ci)]
+
+
+def _mb_factor(Bm):
+    """[B, 2, 2] complex Bm (dV_ao/d(conj J_q)_od = Bm_ad) -> MB
+    [B, 2, 2, 4] real with MB[b, a, ri, (d, ci)] =
+    Gq[b, (a, o, ri), (o, d, ci)] (Gq is block-diagonal over o == c;
+    conjugate-linear, so the Im-param column flips sign)."""
+    Br, Bi = Bm.real, Bm.imag                      # [B, a, d]
+    MB = jnp.stack([jnp.stack([Br, Bi], -1),       # ri = Re
+                    jnp.stack([Bi, -Br], -1)], 2)  # ri = Im
+    return MB.reshape(Bm.shape[0], 2, 2, 4)        # [B, a, ri, (d, ci)]
+
+
+def normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
+                     kmax: int, cost_wt=None, row_period: int = 0):
+    """Weighted Gauss-Newton normal equations, batched over time chunks.
+
+    Returns (JTJ [K, 8N, 8N], JTe [K, 8N], cost [K]) where the weighted cost
+    is sum_b ||wt_b * r_b||^2. ``wt`` [B, 8] are sqrt-weights (0 for flagged
+    rows; robust sqrt(w) for Student's-t IRLS, robustlm.c weighting).
+
+    ``cost_wt``: optional second sqrt-weight set the COST output uses
+    instead of ``wt`` while JTJ/JTe keep ``wt`` — the ordered-subsets LM
+    body needs full-data acceptance costs next to subset normal
+    equations (clmfit.c:1404), and sharing one residual/model evaluation
+    between them is a full [B]-pass cheaper than two calls.
+
+    ``row_period``: the visibility rows' baseline period — callers lay
+    rows out as [tilesz, nbase] with sta1/sta2 repeating every ``nbase``
+    rows (the same invariant :func:`lm.os_subset_ids` builds on). When
+    set and a cluster has a single hybrid chunk (kmax == 1, every
+    timeslot in chunk 0), the station aggregation becomes a clean
+    contraction over the time axis straight into [nbase, ...] blocks.
+    0 disables the fast path (generic scatter aggregation).
+
+    Traffic-lean assembly: the per-baseline real Jacobians are never
+    materialized. The Wirtinger blocks have only 16 independent reals
+    each — Gp = I_2 (x) MA(A) over a == c and Gq = I_2 (x) MB(B) over
+    o == c (A = C J_q^H, B = J_p C) — so all Gram products reduce to
+    4x4 contractions of the [B, 2, 2, 4] factors with the per-component
+    sqrt-weights folded in, and the station-pair cross blocks are
+    aggregated ONCE and symmetrized densely afterwards. Measured at the
+    bench config-1 shape (K=1, N=62, B=18910, f32, XLA cost analysis):
+    dense assembly 93 MB accessed per evaluation, structured scatter
+    path 88 MB, baseline-major path 56 MB (tests/test_lm.py gates all
+    three for equivalence).
+    """
+    N = n_stations
+    B = x8.shape[0]
+    Jp = J[chunk_id, sta1]                         # [B, 2, 2]
+    Jq = J[chunk_id, sta2]
+    A = coh @ jnp.conj(jnp.swapaxes(Jq, -1, -2))   # dV/dJp factor
+    Bm = Jp @ coh                                  # dV/dconj(Jq) factor
+    V = Jp @ A                                     # = Jp C Jq^H
+    vf = V.reshape(-1, 4)
+    r = x8 - jnp.stack([vf.real, vf.imag], -1).reshape(-1, 8)
+    rw = r * wt
+    MA = _ma_factor(A)                             # [B, o, ri, 4]
+    MB = _mb_factor(Bm)                            # [B, a, ri, 4]
+    rc = rw if cost_wt is None else r * cost_wt
+
+    if kmax == 1 and row_period > 0 and B % row_period == 0:
+        # baseline-major path: sqrt-weighted factors carried per
+        # residual component; every Gram product is then one
+        # dot_general over (time, shared complex/ri axes) landing
+        # directly on [nbase, ...] station-pair blocks — no [B, .., 4,
+        # 4] per-row Gram materialization and no B-length scatters.
+        T = B // row_period
+        nb = row_period
+        wv = wt.reshape(T, nb, 2, 2, 2)            # [T, nb, a, o, ri]
+        WMAh = wv[..., None] * MA.reshape(T, nb, 1, 2, 2, 4)
+        WMBh = wv[..., None] * MB.reshape(T, nb, 2, 1, 2, 4)
+        rwv = rw.reshape(T, nb, 2, 2, 2)
+        pp = jnp.einsum("tnaori,tnaorj->naij", WMAh, WMAh)
+        qq = jnp.einsum("tnaori,tnaorj->noij", WMBh, WMBh)
+        pq = jnp.einsum("tnaori,tnaorj->naoij", WMAh, WMBh)
+        jtep = jnp.einsum("tnaori,tnaor->nai", WMAh, rwv)
+        jteq = jnp.einsum("tnaori,tnaor->noi", WMBh, rwv)
+        s1b, s2b = sta1[:nb], sta2[:nb]
+        D = jnp.zeros((1, N, 2, 4, 4), rw.dtype)
+        D = D.at[0, s1b].add(pp).at[0, s2b].add(qq)
+        O = jnp.zeros((1, N, N, 2, 2, 4, 4), rw.dtype)
+        O = O.at[0, s1b, s2b].add(pq)
+        JTe = jnp.zeros((1, N, 2, 4), rw.dtype)
+        JTe = JTe.at[0, s1b].add(jtep).at[0, s2b].add(jteq)
+        cost = jnp.sum(rc * rc).reshape(1)
+    else:
+        w2 = (wt * wt).reshape(B, 2, 2, 2)         # [B, a, o, ri]
+        rw2 = (rw * wt).reshape(B, 2, 2, 2)        # w^2 r
+        # Gram blocks: station-diagonal [4, 4] sub-blocks (block-diag
+        # over the first complex index) + the full [2, 2, 4, 4] cross
+        # block. The weights are folded into ONE [B, 2, 2, 2, 4]
+        # product each so every contraction below is a plain batched
+        # dot_general — a naive 3-operand einsum materializes
+        # [B, .., 4, 4] broadcast intermediates that double the traffic
+        # of this whole function.
+        WMA = w2[..., None] * MA[:, None]          # [B, a, o, ri, 4]
+        WMB = w2[..., None] * MB[:, :, None]       # [B, a, o, ri, 4]
+        pp = jnp.einsum("baori,borj->baij", WMA, MA)   # [B, 2, 4, 4]
+        qq = jnp.einsum("baorj,bari->boij", WMB, MB)
+        pq = jnp.einsum("baori,barj->baoij", WMA, MB)  # [B,2,2,4,4]
+        jtep = jnp.einsum("baor,bori->bai", rw2, MA)   # [B, 2, 4]
+        jteq = jnp.einsum("baor,bari->boi", rw2, MB)
+
+        # aggregate per (chunk, station[, station]) BEFORE the 8x8
+        # expansion
+        D = jnp.zeros((kmax, N, 2, 4, 4), rw.dtype)
+        D = D.at[chunk_id, sta1].add(pp)
+        D = D.at[chunk_id, sta2].add(qq)
+        O = jnp.zeros((kmax, N, N, 2, 2, 4, 4), rw.dtype)
+        O = O.at[chunk_id, sta1, sta2].add(pq)
+        JTe = jnp.zeros((kmax, N, 2, 4), rw.dtype)
+        JTe = JTe.at[chunk_id, sta1].add(jtep)
+        JTe = JTe.at[chunk_id, sta2].add(jteq)
+        cost = jnp.zeros((kmax,), rw.dtype).at[chunk_id].add(
+            jnp.sum(rc * rc, axis=1))
+
+    # dense expansion (tiny next to the [B]-length passes above):
+    # off-diagonal station blocks [8, 8] = pq blocks at (row c, col c'),
+    # symmetrized from the single aggregated scatter; station-diagonal
+    # blocks are block-diag embeddings of D
+    Off = O.transpose(0, 1, 2, 3, 5, 4, 6).reshape(kmax, N, N, 8, 8)
+    JTJ = Off + jnp.swapaxes(jnp.swapaxes(Off, 1, 2), -1, -2)
+    eye2 = jnp.eye(2, dtype=rw.dtype)
+    Dfull = jnp.einsum("knaij,ab->knaibj", D, eye2).reshape(kmax, N, 8, 8)
+    idx = jnp.arange(N)
+    JTJ = JTJ.at[:, idx, idx].add(Dfull)
+    JTJ = JTJ.transpose(0, 1, 3, 2, 4).reshape(kmax, 8 * N, 8 * N)
+
+    return JTJ, JTe.reshape(kmax, 8 * N), cost
 
 
 def weighted_cost(x8, J, coh, sta1, sta2, chunk_id, wt, kmax: int):

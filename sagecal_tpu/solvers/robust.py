@@ -72,7 +72,7 @@ def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
                     n_stations: int, nu0=2.0, nulow=2.0, nuhigh=30.0,
                     chunk_mask=None, config=lm_mod.LMConfig(),
                     wt_rounds: int = 3, itmax_dynamic=None, admm=None,
-                    os=None):
+                    os=None, row_period: int = 0):
     """Student's-t IRLS-LM: parity with rlevmar_der_single_nocuda
     (robustlm.c:2008); with ``os`` set it is the ordered-subsets variant
     osrlevmar_der_single_nocuda (robustlm.c:2607) — the weighted inner LM
@@ -98,7 +98,7 @@ def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         Jn, info = lm_mod.lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J,
                                    n_stations, chunk_mask, config,
                                    itmax_dynamic=itmax_dynamic, admm=admm,
-                                   os=os_r)
+                                   os=os_r, row_period=row_period)
         # ML nu update from post-solve residuals
         e2 = ne.residual8(x8, Jn, coh, sta1, sta2, chunk_id)
         w2 = update_weights(e2, nu)

@@ -222,7 +222,8 @@ class _RTRState(NamedTuple):
 
 def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
               chunk_mask=None, config: RTRConfig = RTRConfig(),
-              itmax_dynamic=None, admm=None, robust_nu=None):
+              itmax_dynamic=None, admm=None, robust_nu=None,
+              row_period: int = 0):
     """Trust-region solve of all chunks of one cluster (rtr_solve.c:1208).
 
     Same call convention as lm.lm_solve; ``robust_nu`` switches the
@@ -277,7 +278,8 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
             e = ne.residual8(x8, Jm, coh, sta1, sta2, chunk_id) * wt
             wt_eff = wt * jnp.sqrt(robust_nu) / (robust_nu + e * e)
         JTJ, _, _ = ne.normal_equations(x8, Jm, coh, sta1, sta2, chunk_id,
-                                        wt_eff, n_stations, kmax)
+                                        wt_eff, n_stations, kmax,
+                                        row_period=row_period)
 
         def hv(v):
             Hv = 2.0 * jnp.einsum("kij,kj->ki", JTJ, v)
@@ -341,7 +343,8 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
 def rtr_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
                      n_stations: int, nu0=2.0, nulow=2.0, nuhigh=30.0,
                      chunk_mask=None, config: RTRConfig = RTRConfig(),
-                     wt_rounds: int = 2, itmax_dynamic=None, admm=None):
+                     wt_rounds: int = 2, itmax_dynamic=None, admm=None,
+                     row_period: int = 0):
     """Student's-t robust RTR (rtr_solve_nocuda_robust,
     rtr_solve_robust.c:1441; ADMM variant rtr_solve_robust_admm.c:1425):
     IRLS rounds of {fixed-nu robust RTR -> weight E-step -> nu grid update}.
@@ -354,7 +357,7 @@ def rtr_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         Jn, info = rtr_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J,
                              n_stations, chunk_mask, config,
                              itmax_dynamic=itmax_dynamic, admm=admm,
-                             robust_nu=nu)
+                             robust_nu=nu, row_period=row_period)
         e = ne.residual8(x8, Jn, coh, sta1, sta2, chunk_id) * wt_base
         w = rb.update_weights(e, nu)
         # AECM nu update with p=2, matching the robust-RTR family

@@ -152,6 +152,29 @@ class SageConfig(NamedTuple):
     # > 0) set inflight_warm=True to skip the cold restriction.
     inflight: int = 1
     inflight_warm: bool = False
+    # row baseline period of the [tilesz, nbase] visibility layout
+    # (io.dataset / rime.predict build all rows this way — the same
+    # invariant lm.os_subset_ids hard-codes). Forwarded to the solvers'
+    # normal-equation assembly, whose baseline-major aggregation needs
+    # it for single-chunk clusters; 0 = unknown (generic scatter path,
+    # identical results).
+    nbase: int = 0
+    # fold each cluster visit's residual re-subtract and the NEXT
+    # visit's add-back into ONE pass over the [B, 8] running residual
+    # (the augmented residual rides the sweep carry), instead of a
+    # write-back to xres and a fresh add-back per visit. Identical
+    # math — the +/- association order is preserved, so the residual
+    # stream is bit-identical (parity-gated in tests/test_sage.py).
+    # Measured 2026-08-03 at the bench config-1 shape on the host CPU
+    # (M=8, B=18910, -j3, interleaved warm sweeps): median 7.96 s/sweep
+    # fused vs 8.01 unfused — a wall-clock wash on a latency-rich CPU —
+    # while the fused program runs one [B, 8] traversal less per
+    # cluster visit, so it defaults ON along the traffic axis the
+    # roofline gates (PERF.md: the hot path is bandwidth-bound; the
+    # TPU wall-clock verdict lands with the next healthy chip window).
+    # G>1 group sweeps ignore the flag (their block-Jacobi update
+    # needs the plain residual).
+    fuse_residual: bool = True
 
 
 _OS_MODES = (int(SolverMode.OSLM_LBFGS),
@@ -197,12 +220,13 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
     MFU trip accounting).
     """
     lm_cfg = lm_mod.LMConfig(itmax=itcap)
+    nbase = int(config.nbase)
 
     def plain_lm(os=None):
         Jn, info = lm_mod.lm_solve(
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             chunk_mask=cmask_m, config=lm_cfg, itmax_dynamic=itermax,
-            admm=admm_m, os=os)
+            admm=admm_m, os=os, row_period=nbase)
         return (Jn, nu_cj, info["init_cost"], info["final_cost"],
                 info["iters"])
 
@@ -211,7 +235,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             nu0=nu_cj, nulow=config.nulow, nuhigh=config.nuhigh,
             chunk_mask=cmask_m, config=lm_cfg, wt_rounds=3,  # wt_itmax=3,
-            itmax_dynamic=itermax, admm=admm_m, os=os)       # robustlm.c:103
+            itmax_dynamic=itermax, admm=admm_m, os=os,       # robustlm.c:103
+            row_period=nbase)
         return (Jn, nu_new, info["init_cost"], info["final_cost"],
                 info["iters"])
 
@@ -220,7 +245,7 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
         Jn, info = rtr_mod.rtr_solve(
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             chunk_mask=cmask_m, config=rtr_cfg, itmax_dynamic=itermax,
-            admm=admm_m)
+            admm=admm_m, row_period=nbase)
         return (Jn, nu_cj, info["init_cost"], info["final_cost"],
                 info["iters"])
 
@@ -233,7 +258,7 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
             # before and once after the TR loop (rtr_solve_robust.c:1625,
             # :1842), not the LM path's wt_itmax=3
             chunk_mask=cmask_m, config=rtr_cfg, wt_rounds=2,
-            itmax_dynamic=itermax, admm=admm_m)
+            itmax_dynamic=itermax, admm=admm_m, row_period=nbase)
         return (Jn, nu_new, info["init_cost"], info["final_cost"],
                 info["iters"])
 
@@ -267,21 +292,15 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
                         lambda: plain_lm(os_cfg))
 
 
-def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
-                    wt_base, n_stations: int, config: SageConfig,
-                    nerr_prev, weighted, last, key, admm, os_id,
-                    total_iter: int, iter_bar: int):
-    """Visit one cluster: add model back to residual, solve, re-subtract
-    (lmfit.c:890-981). ``state`` = (J, xres, nerr_acc, nuM, tk) with
-    ``tk`` an i32[2] counter pair: [0] executed inner-solver iterations
-    (MFU accounting), [1] rejected group steps (always 0 here — only
-    :func:`_group_update` can reject)."""
-    J, xres, nerr_acc, nuM, tk = state
+def _visit_solve(cj, xdummy, coh_m, cidx_m, cmask_m, J_m, nu_cj,
+                 sta1, sta2, wt_base, n_stations: int,
+                 config: SageConfig, nerr_prev, weighted, last, key, admm,
+                 os_id, total_iter: int, iter_bar: int):
+    """The solve half of one cluster visit (shared by the plain and the
+    residual-fused sweeps): per-cluster gathers already done, ``xdummy``
+    = residual + this cluster's model. Returns (Jn, nu_new, dcost,
+    its)."""
     mode = int(config.solver_mode)
-    coh_m = jnp.take(coh, cj, axis=0)
-    cidx_m = jnp.take(chunk_idx, cj, axis=0)
-    cmask_m = jnp.take(chunk_mask, cj, axis=0)
-    J_m = jnp.take(J, cj, axis=0)
     itermax = jnp.where(
         weighted,
         (0.2 * jnp.take(nerr_prev, cj) * total_iter).astype(jnp.int32)
@@ -300,24 +319,112 @@ def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
             os_id=ids, n_subsets=int(n_sub),   # bound to the partition
             key=jax.random.fold_in(key, cj), randomize=config.randomize)
 
-    xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
-
     itcap = int(config.max_iter) + iter_bar  # static while-loop cap
     Jn, nu_new, init_cost, final_cost, its = _cluster_solve(
         mode, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m, wt_base, J_m,
-        n_stations, jnp.take(nuM, cj), config, itermax, itcap, admm_m,
+        n_stations, nu_cj, config, itermax, itcap, admm_m,
         os_cfg, last)
-    nuM = nuM.at[cj].set(nu_new)
-
     init_res = jnp.sum(init_cost)
     final_res = jnp.sum(final_cost)
     dcost = jnp.where(init_res > 0,
                       jnp.maximum((init_res - final_res) / init_res, 0.0),
                       0.0)
+    return Jn, nu_new, dcost, its
+
+
+def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
+                    wt_base, n_stations: int, config: SageConfig,
+                    nerr_prev, weighted, last, key, admm, os_id,
+                    total_iter: int, iter_bar: int):
+    """Visit one cluster: add model back to residual, solve, re-subtract
+    (lmfit.c:890-981). ``state`` = (J, xres, nerr_acc, nuM, tk) with
+    ``tk`` an i32[2] counter pair: [0] executed inner-solver iterations
+    (MFU accounting), [1] rejected group steps (always 0 here — only
+    :func:`_group_update` can reject)."""
+    J, xres, nerr_acc, nuM, tk = state
+    coh_m = jnp.take(coh, cj, axis=0)
+    cidx_m = jnp.take(chunk_idx, cj, axis=0)
+    cmask_m = jnp.take(chunk_mask, cj, axis=0)
+    J_m = jnp.take(J, cj, axis=0)
+
+    xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
+    Jn, nu_new, dcost, its = _visit_solve(
+        cj, xdummy, coh_m, cidx_m, cmask_m, J_m, jnp.take(nuM, cj),
+        sta1, sta2, wt_base, n_stations, config, nerr_prev, weighted,
+        last, key, admm, os_id, total_iter, iter_bar)
+    nuM = nuM.at[cj].set(nu_new)
     nerr_acc = nerr_acc.at[cj].set(dcost)
     xres = xdummy - _model8(Jn, coh_m, sta1, sta2, cidx_m)
     J = J.at[cj].set(Jn)
     return J, xres, nerr_acc, nuM, tk.at[0].add(its)
+
+
+def _sweep_g1(perm, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
+              wt_base, n_stations: int, config: SageConfig, nerr_prev,
+              weighted, last, key, admm, os_id, total_iter: int,
+              iter_bar: int):
+    """One EM sweep over all M clusters at group width 1.
+
+    With ``config.fuse_residual`` the loop carries the AUGMENTED
+    residual xd = xres + model(current cluster): each visit solves on
+    xd, then one fused pass replaces it by
+    (xd - model_new) + model(next cluster) — the re-subtract and the
+    next add-back become a single read+write of the [B, 8] buffer
+    instead of two (and the final visit's masked add costs nothing).
+    The +/- association order matches the unfused path exactly, so the
+    residual stream is bit-preserving; see SageConfig.fuse_residual for
+    the measured defaults. ``perm`` may be None (natural order)."""
+    J0_, xres, nerr_acc0, nuM0, tk0 = state
+    M = chunk_mask.shape[0]
+
+    if not config.fuse_residual:
+        def cluster_step(cj, inner):
+            cj_eff = cj if perm is None else jnp.take(perm, cj)
+            return _cluster_update(
+                cj_eff, inner, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
+                wt_base, n_stations, config, nerr_prev, weighted, last,
+                key, admm, os_id, total_iter, iter_bar)
+        return jax.lax.fori_loop(0, M, cluster_step, state)
+
+    def cl_of(j):
+        jc = jnp.minimum(j, M - 1)
+        return jc if perm is None else jnp.take(perm, jc)
+
+    def gather(cm):
+        return (jnp.take(coh, cm, axis=0), jnp.take(chunk_idx, cm, axis=0),
+                jnp.take(chunk_mask, cm, axis=0))
+
+    c0 = cl_of(0)
+    coh0, cidx0, _ = gather(c0)
+    xd = xres + _model8(jnp.take(J0_, c0, axis=0), coh0, sta1, sta2, cidx0)
+
+    def body(j, inner):
+        J, xd, nerr_acc, nuM, tk = inner
+        cj = cl_of(j)
+        coh_m, cidx_m, cmask_m = gather(cj)
+        J_m = jnp.take(J, cj, axis=0)
+        Jn, nu_new, dcost, its = _visit_solve(
+            cj, xd, coh_m, cidx_m, cmask_m, J_m, jnp.take(nuM, cj),
+            sta1, sta2, wt_base, n_stations, config, nerr_prev,
+            weighted, last, key, admm, os_id, total_iter, iter_bar)
+        nuM = nuM.at[cj].set(nu_new)
+        nerr_acc = nerr_acc.at[cj].set(dcost)
+        J = J.at[cj].set(Jn)
+        # next cluster's model from the UPDATED J (cl_of(j+1) != cj for
+        # j < M-1, so the update never aliases; the clamped last step's
+        # self-model is dropped by the where)
+        cn = cl_of(j + 1)
+        coh_n, cidx_n, _ = gather(cn)
+        model_next = _model8(jnp.take(J, cn, axis=0), coh_n, sta1, sta2,
+                             cidx_n)
+        model_new = _model8(Jn, coh_m, sta1, sta2, cidx_m)
+        xd = (xd - model_new) + jnp.where(j + 1 < M, model_next, 0.0)
+        return J, xd, nerr_acc, nuM, tk.at[0].add(its)
+
+    J, xd, nerr_acc, nuM, tk = jax.lax.fori_loop(
+        0, M, body, (J0_, xd, nerr_acc0, nuM0, tk0))
+    # after the last visit the masked add left xd == the plain residual
+    return J, xd, nerr_acc, nuM, tk
 
 
 def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
@@ -574,17 +681,11 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
         kci = jax.random.fold_in(key, ci)
 
         if Gi == 1:
-            def cluster_step(cj, inner):
-                cj_eff = cj if perm is None else jnp.take(perm, cj)
-                return _cluster_update(
-                    cj_eff, inner, x8, coh, sta1, sta2, chunk_idx,
-                    chunk_mask, wt_base, n_stations, config, nerr,
-                    weighted, last, kci, admm, os_id, total_iter,
-                    iter_bar)
-
-            J, xres, nerr_new, nuM, tk = jax.lax.fori_loop(
-                0, M, cluster_step, (J, xres, jnp.zeros((M,), dtype),
-                                     nuM, tk))
+            J, xres, nerr_new, nuM, tk = _sweep_g1(
+                perm, (J, xres, jnp.zeros((M,), dtype), nuM, tk),
+                x8, coh, sta1, sta2, chunk_idx, chunk_mask, wt_base,
+                n_stations, config, nerr, weighted, last, kci, admm,
+                os_id, total_iter, iter_bar)
         else:
             base = (perm if perm is not None
                     else jnp.arange(M, dtype=jnp.int32))
@@ -653,7 +754,8 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
 
 @functools.partial(jax.jit,
                    static_argnames=("n_stations", "config", "total_iter",
-                                    "iter_bar", "os_nsub"))
+                                    "iter_bar", "os_nsub"),
+                   donate_argnums=(1, 2, 3, 4))
 def _jit_cluster_update(cj, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
                         chunk_idx, chunk_mask, wt_base, nerr_prev, weighted,
                         last, key, admm, os_ids, n_stations, config,
@@ -669,7 +771,8 @@ def _jit_cluster_update(cj, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
 
 @functools.partial(jax.jit,
                    static_argnames=("n_stations", "config", "total_iter",
-                                    "iter_bar", "os_nsub"))
+                                    "iter_bar", "os_nsub"),
+                   donate_argnums=(1, 2, 3, 4))
 def _jit_group_update(cjs, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
                       chunk_idx, chunk_mask, wt_base, nerr_prev, weighted,
                       last, key, os_ids, n_stations, config, total_iter,
@@ -690,7 +793,8 @@ def _jit_group_update(cjs, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
 
 @functools.partial(jax.jit,
                    static_argnames=("n_stations", "config", "total_iter",
-                                    "iter_bar", "os_nsub"))
+                                    "iter_bar", "os_nsub"),
+                   donate_argnums=(0, 1, 2))
 def _jit_em_sweep(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                   wt_base, nerr_prev, weighted, last, kci, perm, os_ids,
                   n_stations, config, total_iter, iter_bar, os_nsub):
@@ -702,18 +806,12 @@ def _jit_em_sweep(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     G = _eff_inflight(config, M)
 
     if G == 1:
-        def cluster_step(cj, inner):
-            cj_eff = jnp.take(perm, cj)
-            return _cluster_update(cj_eff, inner, x8, coh, sta1, sta2,
-                                   chunk_idx, chunk_mask, wt_base,
-                                   n_stations, config, nerr_prev,
-                                   weighted, last, kci, None, os_id,
-                                   total_iter, iter_bar)
-
-        return jax.lax.fori_loop(
-            0, M, cluster_step,
-            (J, xres, jnp.zeros((M,), x8.dtype), nuM,
-             jnp.zeros((2,), jnp.int32)))
+        return _sweep_g1(
+            perm, (J, xres, jnp.zeros((M,), x8.dtype), nuM,
+                   jnp.zeros((2,), jnp.int32)),
+            x8, coh, sta1, sta2, chunk_idx, chunk_mask, wt_base,
+            n_stations, config, nerr_prev, weighted, last, kci, None,
+            os_id, total_iter, iter_bar)
 
     order_pad, n_groups = _pad_order(perm, M, G)
     anchor = jnp.sum((xres * wt_base) ** 2)   # sweep-entry safeguard ref
@@ -738,7 +836,8 @@ def _jit_prelude(x8, coh, sta1, sta2, chunk_idx, J0, wt_base):
 
 
 @functools.partial(jax.jit, static_argnames=("n_stations", "config",
-                                             "robust"))
+                                             "robust"),
+                   donate_argnums=(5,))
 def _jit_refine(x8, coh, sta1, sta2, chunk_idx, J, wt_base, mean_nu,
                 n_stations, config, robust):
     M, kmax = J.shape[0], J.shape[1]
@@ -841,7 +940,12 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                      os_nsub, key)
     xres, res_0 = _call("prelude", _jit_prelude, x8, coh, sta1, sta2,
                         chunk_idx, J0, wt_base)
-    J = J0
+    # the per-sweep/per-cluster programs DONATE their state carries
+    # (J, xres, nerr_acc, nuM) so XLA reuses the buffers in place
+    # instead of allocating fresh HBM every dispatch; the first sweep
+    # would otherwise consume the CALLER's J0 buffer, so hand it a copy
+    # (one small transfer per solve vs ~max_emiter donated round trips)
+    J = J0.copy() if isinstance(J0, jax.Array) else J0
     nerr = jnp.zeros((M,), dtype)
     nuM = jnp.full((M,), jnp.asarray(nu0, dtype))
     fused = (fuse_mode == "on" or
@@ -986,7 +1090,8 @@ def _jit_sagefit_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
 
 @functools.partial(jax.jit,
                    static_argnames=("n_stations", "config", "total_iter",
-                                    "iter_bar", "os_nsub"))
+                                    "iter_bar", "os_nsub"),
+                   donate_argnums=(0, 1, 2))
 def _jit_em_sweep_tiles(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx,
                         chunk_mask, wt_base, nerr_prev, weighted, last,
                         keys, perm, os_ids, n_stations, config, total_iter,
@@ -999,17 +1104,12 @@ def _jit_em_sweep_tiles(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx,
         G = _eff_inflight(config, M)
 
         if G == 1:
-            def cluster_step(cj, inner):
-                cj_eff = jnp.take(perm_t, cj)
-                return _cluster_update(cj_eff, inner, x8_t, coh_t, sta1,
-                                       sta2, chunk_idx, chunk_mask, wt_t,
-                                       n_stations, config, nerr_t,
-                                       weighted, last, key_t, None, os_id,
-                                       total_iter, iter_bar)
-            return jax.lax.fori_loop(
-                0, M, cluster_step,
-                (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t,
-                 jnp.zeros((2,), jnp.int32)))
+            return _sweep_g1(
+                perm_t, (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t,
+                         jnp.zeros((2,), jnp.int32)),
+                x8_t, coh_t, sta1, sta2, chunk_idx, chunk_mask, wt_t,
+                n_stations, config, nerr_t, weighted, last, key_t, None,
+                os_id, total_iter, iter_bar)
 
         order_pad, n_groups = _pad_order(perm_t, M, G)
         anchor = jnp.sum((xres_t * wt_t) ** 2)   # per-tile sweep anchor
@@ -1038,7 +1138,8 @@ def _jit_prelude_tiles(x8, coh, sta1, sta2, chunk_idx, J0, wt_base):
 
 
 @functools.partial(jax.jit, static_argnames=("n_stations", "config",
-                                             "robust"))
+                                             "robust"),
+                   donate_argnums=(5,))
 def _jit_refine_tiles(x8, coh, sta1, sta2, chunk_idx, J, wt_base, mean_nu,
                       n_stations, config, robust):
     return jax.vmap(
@@ -1132,7 +1233,9 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                      os_nsub, keys)
     xres, res_0 = _call("prelude_tiles", _jit_prelude_tiles, x8, coh,
                         sta1, sta2, chunk_idx, J0, wt_base)
-    J = J0
+    # donation guard: see sagefit_host — the sweep programs consume
+    # their state-carry buffers in place
+    J = J0.copy() if isinstance(J0, jax.Array) else J0
     nerr = jnp.zeros((T, M), dtype)
     nuM = jnp.full((T, M), jnp.asarray(nu0, dtype))
     fused = (fuse_mode == "on" or
@@ -1237,7 +1340,8 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
 
 @functools.partial(jax.jit,
                    static_argnames=("n_stations", "config", "total_iter",
-                                    "iter_bar", "os_nsub"))
+                                    "iter_bar", "os_nsub"),
+                   donate_argnums=(1, 2, 3, 4))
 def _jit_cluster_update_tiles(cj, J, xres, nerr_acc, nuM, x8, coh, sta1,
                               sta2, chunk_idx, chunk_mask, wt_base,
                               nerr_prev, weighted, last, keys, os_ids,
@@ -1260,7 +1364,8 @@ def _jit_cluster_update_tiles(cj, J, xres, nerr_acc, nuM, x8, coh, sta1,
 
 @functools.partial(jax.jit,
                    static_argnames=("n_stations", "config", "total_iter",
-                                    "iter_bar", "os_nsub"))
+                                    "iter_bar", "os_nsub"),
+                   donate_argnums=(1, 2, 3, 4))
 def _jit_group_update_tiles(cjs, J, xres, nerr_acc, nuM, x8, coh, sta1,
                             sta2, chunk_idx, chunk_mask, wt_base,
                             nerr_prev, weighted, last, keys, os_ids,

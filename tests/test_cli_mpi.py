@@ -58,7 +58,7 @@ def test_mpi_cli_end_to_end(tmp_path):
     rc = cli_mpi.main([
         "-f", str(listfile), "-s", str(sky_path), "-c", str(clus_path),
         "-p", str(solfile), "-A", "4", "-P", "2", "-Q", "2", "-r", "2",
-        "-e", "2", "-g", "8", "-l", "4", "-j", "0", "-t", "3"])
+        "-e", "2", "-g", "6", "-l", "3", "-j", "0", "-t", "3"])
     assert rc == 0
 
     # residuals written back: mean level far below raw data

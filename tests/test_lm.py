@@ -99,6 +99,32 @@ def test_flagged_rows_do_not_bias():
     assert float(info["final_cost"][0]) < 1e-14
 
 
+def test_os_dead_subset_no_false_convergence():
+    """A fully-flagged time-tile subset yields identically-zero normal
+    equations for the chunk; the carried-equation LM body must neither
+    read that zero gradient as convergence nor retry the dead subset
+    forever (it adopts the next subset's equations — dp is exactly 0 on
+    a dead carry, so they are the old point's)."""
+    x8, coh, sta1, sta2, chunk_id, Jtrue = _toy_problem(N=8, T=4, K=1,
+                                                        seed=6)
+    B = x8.shape[0]
+    nbase = B // 4
+    os_id, ns = lm_mod.os_subset_ids(4, nbase)   # 4 subsets, 1 slot each
+    # timeslot 0 entirely flagged -> subset 0 dead; the deterministic
+    # rotation starts the solve ON the dead subset (worst case)
+    flags = np.zeros(B, np.int32)
+    flags[os_id == 0] = 1
+    wt = lm_mod.make_weights(jnp.asarray(flags), x8.dtype)
+    J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex128), (1, 8, 1, 1))
+    os_cfg = lm_mod.OSConfig(os_id=jnp.asarray(os_id), n_subsets=ns,
+                             key=jax.random.PRNGKey(0), randomize=False)
+    J, info = lm_mod.lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, 8,
+                              config=lm_mod.LMConfig(itmax=40), os=os_cfg)
+    # false convergence stops at J0 with final_cost == init_cost
+    assert float(info["final_cost"][0]) \
+        < 1e-10 * float(info["init_cost"][0]) + 1e-18, dict(info)
+
+
 def test_robust_lm_downweights_outliers():
     x8, coh, sta1, sta2, chunk_id, Jtrue = _toy_problem(N=8, T=6, seed=5)
     B = x8.shape[0]
@@ -176,3 +202,60 @@ def test_fletcher_linesearch_on_flat_gradient():
     p0 = jnp.ones(4)
     p1 = lb.lbfgs_fit(cost, g, p0, itmax=3)
     assert np.all(np.isfinite(np.asarray(p1)))
+
+
+def test_normal_equations_assembly_paths_agree():
+    """The traffic-lean structured assembly and the baseline-major
+    fast path (row_period, single-chunk clusters) must match the dense
+    materialized-Jacobian reference, including per-component (robust
+    IRLS-style) weights and a separate cost weight set (cost_wt)."""
+    x8, coh, sta1, sta2, chunk_id, _ = _toy_problem(N=6, T=5, K=1, seed=3)
+    N, K = 6, 1
+    nbase = N * (N - 1) // 2
+    rng = np.random.default_rng(4)
+    p = jnp.asarray(rng.normal(size=(K, N, 8)))
+    J = ne.jones_r2c(p)
+    wt = jnp.asarray(rng.random(x8.shape)
+                     * (rng.random((x8.shape[0], 1)) > 0.1))
+    cwt = jnp.asarray(rng.random(x8.shape))
+    dense = ne._normal_equations_dense(x8, J, coh, sta1, sta2, chunk_id,
+                                       wt, N, K)
+    generic = ne.normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt,
+                                  N, K)
+    fast = ne.normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt,
+                               N, K, row_period=nbase)
+    for name, d, g, f in zip(("JTJ", "JTe", "cost"), dense, generic, fast):
+        scale = np.abs(np.asarray(d)).max() + 1e-30
+        np.testing.assert_allclose(np.asarray(g), np.asarray(d),
+                                   atol=5e-9 * scale, err_msg=name)
+        np.testing.assert_allclose(np.asarray(f), np.asarray(d),
+                                   atol=5e-9 * scale, err_msg=name)
+    # cost_wt: JTJ/JTe keep wt, the cost output uses cwt (the OS body's
+    # subset-equations + full-data-acceptance sharing)
+    dref = ne._normal_equations_dense(x8, J, coh, sta1, sta2, chunk_id,
+                                      cwt, N, K)[2]
+    for rp_ in (0, nbase):
+        JTJc, JTec, costc = ne.normal_equations(
+            x8, J, coh, sta1, sta2, chunk_id, wt, N, K, cost_wt=cwt,
+            row_period=rp_)
+        np.testing.assert_allclose(np.asarray(costc), np.asarray(dref),
+                                   atol=5e-9 * float(np.abs(dref).max()))
+        np.testing.assert_allclose(np.asarray(JTJc), np.asarray(dense[0]),
+                                   atol=5e-9 * float(
+                                       np.abs(np.asarray(dense[0])).max()))
+
+
+def test_normal_equations_generic_for_multichunk():
+    """row_period must be ignored (generic path, same answer) when a
+    cluster spans several hybrid chunks."""
+    x8, coh, sta1, sta2, chunk_id, _ = _toy_problem(N=5, T=4, K=2, seed=5)
+    N, K = 5, 2
+    nbase = N * (N - 1) // 2
+    rng = np.random.default_rng(6)
+    J = ne.jones_r2c(jnp.asarray(rng.normal(size=(K, N, 8))))
+    wt = jnp.asarray(rng.random(x8.shape))
+    a = ne.normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt, N, K)
+    b = ne.normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt, N, K,
+                            row_period=nbase)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

@@ -35,7 +35,7 @@ def test_os_subset_ids_partition():
     assert ns == 4
 
 
-def _problem(n_stations=12, n_clusters=3, tilesz=10, seed=5):
+def _problem(n_stations=10, n_clusters=3, tilesz=8, seed=5):
     rng = np.random.default_rng(seed)
     srcs, clusters = {}, []
     for m in range(n_clusters):

@@ -111,7 +111,11 @@ def test_graft_entry_compiles():
     fn, args = mod.entry()
     J, res = jax.jit(fn)(*args)
     assert np.isfinite(float(res))
-    mod.dryrun_multichip(8)
+    # small shape: the 8-device mesh / uneven-F padding / collective
+    # structure under test is shape-independent, and the N=32 M=8
+    # judged-artifact default costs ~90 s of compile on this host
+    # (pytest --durations round-6 shrink)
+    mod.dryrun_multichip(8, n_stations=12, n_clusters=4)
 
 
 @pytest.mark.slow

@@ -164,3 +164,44 @@ def test_sage_residual_never_catastrophic():
                            max_emiter=2, max_iter=8, max_lbfgs=5)
     assert np.isfinite(float(info["res_1"]))
     assert float(info["res_1"]) <= float(info["res_0"])
+
+
+def test_fused_residual_sweep_parity():
+    """SageConfig.fuse_residual folds each visit's re-subtract and the
+    next visit's add-back into one pass over the running residual; the
+    +/- association order is preserved, so the whole solve must be BIT
+    IDENTICAL to the plain write-back sweep (both with and without the
+    baseline-major normal-equation aggregation)."""
+    sky, dsky, Jtrue, tile = _calib_problem(tilesz=4, noise=0.005, seed=11)
+    coh = rp.coherencies(dsky, jnp.asarray(tile.u), jnp.asarray(tile.v),
+                         jnp.asarray(tile.w), jnp.asarray([tile.freq0]),
+                         tile.fdelta)[:, :, 0]
+    xa = tile.averaged()
+    x8 = np.stack([xa.reshape(-1, 4).real, xa.reshape(-1, 4).imag],
+                  -1).reshape(-1, 8)
+    cidx = rp.chunk_indices(tile.tilesz, tile.nbase, sky.nchunk)
+    kmax = int(sky.nchunk.max())
+    cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
+    J0 = np.tile(np.eye(2, dtype=complex), (sky.n_clusters, kmax,
+                                            tile.n_stations, 1, 1))
+    wt = lm_mod.make_weights(jnp.asarray(tile.flags, jnp.int32),
+                             jnp.float64)
+    outs = {}
+    for fused in (True, False):
+        for nbase in (0, tile.nbase):
+            cfg = sage.SageConfig(max_emiter=2, max_iter=4, max_lbfgs=2,
+                                  solver_mode=int(SolverMode.OSLM_LBFGS),
+                                  fuse_residual=fused, nbase=nbase)
+            J, info = sage.sagefit(
+                jnp.asarray(x8), coh, jnp.asarray(tile.sta1),
+                jnp.asarray(tile.sta2), jnp.asarray(cidx),
+                jnp.asarray(cmask), jnp.asarray(J0), tile.n_stations,
+                wt, config=cfg)
+            outs[(fused, nbase)] = (np.asarray(J), float(info["res_1"]))
+    for nbase in (0, tile.nbase):
+        a, b = outs[(True, nbase)], outs[(False, nbase)]
+        np.testing.assert_array_equal(a[0], b[0])
+        assert a[1] == b[1]
+    # the two assembly paths differ only by summation order
+    np.testing.assert_allclose(outs[(True, 0)][1],
+                               outs[(True, tile.nbase)][1], rtol=1e-5)

@@ -96,6 +96,129 @@ def generate(workdir, n_sta, n_dir, n_sub, tilesz, n_tiles, seed=5):
     return skyp, clup, lst
 
 
+def b_scaling(args):
+    """The round-5 VERDICT's missing experiment: the north-star
+    per-cluster sweep cost at B, B/2, B/4 data rows (tilesz 4/2/1 at
+    N=64, M=100, robust-RTR -g 3 — the exact shape whose 31 ms/cluster
+    plateaus the single-chip target). If ms/cluster scales ~linearly
+    with B the sweep is data-traffic-bound (fusion/dtype wins ride on
+    it); if it barely moves, the floor is per-cluster dispatch/latency
+    overhead and more traffic shrinking cannot cut it. Runs in-process
+    (one subband, one EM sweep per shape, warm-timed); writes
+    BSCALING.json and prints the table."""
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from sagecal_tpu import skymodel
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.rime import predict as rp
+    from sagecal_tpu.solvers import normal_eq as nesolv
+    from sagecal_tpu.solvers import sage
+
+    rng = np.random.default_rng(5)
+    n_sta, n_dir = args.stations, args.dirs
+    srcs, clusters = {}, []
+    for m in range(n_dir):
+        names = []
+        for s in range(2):
+            nm = f"P{m:03d}_{s}"
+            ll, mm = rng.normal(0, 0.03, 2)
+            nn = np.sqrt(max(1 - ll * ll - mm * mm, 0.0))
+            flux = float(np.exp(rng.normal(0.5, 0.8)))
+            srcs[nm] = skymodel.Source(
+                name=nm, ra=0, dec=0, ll=ll, mm=mm, nn=nn - 1, sI=flux,
+                sQ=0.0, sU=0.0, sV=0.0, sI0=flux, sQ0=0, sU0=0, sV0=0,
+                spec_idx=-0.7, spec_idx1=0.0, spec_idx2=0.0, f0=150e6)
+            names.append(nm)
+        clusters.append((m, 1 + m % 2, names))    # hybrid chunks 1/2
+    sky = skymodel.build_cluster_sky(srcs, clusters)
+    dsky = rp.sky_to_device(sky, jnp.float32)
+    kmax = int(sky.nchunk.max())
+    cmask = jnp.asarray(
+        np.arange(kmax)[None, :] < sky.nchunk[:, None])
+    Jtrue = ds.random_jones(n_dir, sky.nchunk, n_sta, seed=6, scale=0.15)
+    M = n_dir
+    rows = []
+    for tilesz in (args.tilesz, args.tilesz // 2, args.tilesz // 4):
+        if tilesz < 1:
+            continue
+        tile = ds.simulate_dataset(dsky, n_stations=n_sta, tilesz=tilesz,
+                                   freqs=[150e6], ra0=1.2, dec0=0.7,
+                                   jones=Jtrue, nchunk=sky.nchunk,
+                                   noise_sigma=0.02, seed=23)
+        B = tile.nrows
+        cidx = jnp.asarray(rp.chunk_indices(tilesz, tile.nbase,
+                                            sky.nchunk))
+        u = jnp.asarray(tile.u, jnp.float32)
+        v = jnp.asarray(tile.v, jnp.float32)
+        w = jnp.asarray(tile.w, jnp.float32)
+        coh = rp.coherencies(dsky, u, v, w,
+                             jnp.asarray([150e6], jnp.float32),
+                             tile.fdelta)[:, :, 0]
+        xa = np.asarray(tile.averaged())
+        x8 = jnp.asarray(np.stack([xa.reshape(-1, 4).real,
+                                   xa.reshape(-1, 4).imag],
+                                  -1).reshape(-1, 8), jnp.float32)
+        wt = jnp.asarray((np.asarray(tile.flags) == 0)[:, None]
+                         * np.ones((1, 8)), jnp.float32)
+        s1 = jnp.asarray(tile.sta1, jnp.int32)
+        s2 = jnp.asarray(tile.sta2, jnp.int32)
+        J0 = jnp.asarray(np.tile(np.eye(2, dtype=np.complex64),
+                                 (M, kmax, n_sta, 1, 1)))
+        cfg = sage.SageConfig(max_iter=3, max_lbfgs=0,
+                              solver_mode=args.solver,
+                              nbase=tile.nbase)
+        total_iter = M * cfg.max_iter
+        iter_bar = int(-(-0.8 * total_iter // M))
+        key = jax.random.fold_in(jax.random.PRNGKey(42), 0)
+        perm = jnp.arange(M, dtype=jnp.int32)
+        xres = x8 - sage.full_model8(J0, coh, s1, s2, cidx)
+        nuM = jnp.full((M,), 2.0, jnp.float32)
+
+        def sweep():
+            # fresh state per call: the sweep program donates its
+            # carries
+            return sage._jit_em_sweep(
+                J0.copy(), xres.copy(), nuM.copy(), x8, coh, s1, s2,
+                cidx, cmask, wt, jnp.zeros((M,), jnp.float32),
+                jnp.asarray(False), jnp.asarray(False), key, perm, None,
+                n_stations=n_sta, config=cfg._replace(max_emiter=0),
+                total_iter=total_iter, iter_bar=iter_bar, os_nsub=0)
+
+        out = sweep()
+        jax.block_until_ready(out[0])          # compile
+        times = []
+        for _ in range(args.reps):
+            t0 = time.time()
+            out = sweep()
+            jax.block_until_ready(out[0])
+            times.append(time.time() - t0)
+        med = float(np.median(times))
+        rows.append({"tilesz": tilesz, "B": int(B),
+                     "sweep_s": round(med, 3),
+                     "ms_per_cluster": round(1e3 * med / M, 2)})
+        print(f"tilesz={tilesz} B={B}: sweep {med:.3f} s -> "
+              f"{1e3 * med / M:.2f} ms/cluster "
+              f"(runs {[f'{t:.2f}' for t in times]})", flush=True)
+    full, quarter = rows[0], rows[-1]
+    ratio = full["ms_per_cluster"] / max(quarter["ms_per_cluster"], 1e-9)
+    bratio = full["B"] / quarter["B"]
+    # linear-in-B would give ratio ~= bratio; flat gives ~1
+    verdict = ("bandwidth" if ratio > 0.5 * bratio + 0.5 else "overhead")
+    rec = {"metric": "north-star sweep B-scaling",
+           "shape": f"N={n_sta} M={M} -j{args.solver} -g 3 hybrid-chunks",
+           "platform": jax.devices()[0].platform,
+           "rows": rows,
+           "ms_per_cluster_ratio_full_vs_quarter": round(ratio, 2),
+           "B_ratio_full_vs_quarter": round(bratio, 2),
+           "verdict": verdict}
+    with open(os.path.join(HERE, "BSCALING.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec))
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")
@@ -113,7 +236,14 @@ def main():
                     help="clusters in flight per SAGE sweep step")
     ap.add_argument("--keep", default=None,
                     help="reuse/keep the dataset directory")
+    ap.add_argument("--b-scaling", action="store_true",
+                    help="run the B/B2/B4 sweep-cost ladder instead of "
+                         "the full ADMM run (writes BSCALING.json)")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="warm sweep timings per shape (--b-scaling)")
     args = ap.parse_args()
+    if args.b_scaling:
+        return b_scaling(args)
 
     workdir = args.keep or tempfile.mkdtemp(prefix="northstar_")
     os.makedirs(workdir, exist_ok=True)

@@ -348,13 +348,20 @@ def _bytes_baseline(platform: str):
     """Per-config ``bytes_accessed`` from the newest round-stamped bench
     record of this ``platform`` committed next to this file (the bank
     the tentpole's traffic claims measure against); {} when no banked
-    record carries the roofline fields yet."""
+    record carries the roofline fields yet.
+
+    ``bench_results.json`` is consulted ONLY when no round-stamped
+    record exists (first-round bootstrap): every live run overwrites
+    it, so treating it as the newest bank would let a discarded
+    mis-measured run shadow the committed record and poison the next
+    run's Δbytes column (observed round 7: a rejected trial run left
+    its inflated figures there)."""
     import glob
     import re as _re
     best, best_r = {}, -1
     pat = os.path.join(HERE, f"BENCH_{platform.upper()}_r*.json")
-    for p in sorted(glob.glob(pat)) + [os.path.join(HERE,
-                                                    "bench_results.json")]:
+    stamped = sorted(glob.glob(pat))
+    for p in stamped or [os.path.join(HERE, "bench_results.json")]:
         try:
             with open(p) as f:
                 d = json.load(f)
@@ -381,7 +388,8 @@ def refine_trip_flops(M, kmax, n_stations, B, robust, dtype):
     return None if c is None else c["flops"]
 
 
-def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0):
+def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
+                     inner="chol"):
     """FLOPs + bytes accessed of ONE inner solver iteration at the
     per-cluster solve shape.
 
@@ -390,17 +398,25 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0):
     normal-equation + acceptance-cost pass at the trial point — the
     restructured lm.py body's single row traversal (rounds <= PR 1
     additionally priced a separate full-data cost evaluation, which the
-    body no longer performs).
+    body no longer performs). Under ``inner="cg"`` the damping trip's
+    fixed part is priced instead: the matrix-free gn_factors pass +
+    station-block preconditioner factorization + initial apply — the
+    PCG loop body itself is priced per EXECUTED trip by
+    :func:`cg_trip_cost` x info["cg_iters"] (roofline.trip_correct).
     RTR families (modes 4-5): one outer TR trip = Gauss-Newton assembly
     + cost + projected gradient, plus tcg_iters Hessian-vector products
-    ([K,8N,8N]@[K,8N] matvec + tangent projection each, rtr.py _tcg).
+    ([K,8N,8N]@[K,8N] matvec + tangent projection each, rtr.py _tcg;
+    under inner="cg" the product is the matrix-free gn_matvec and the
+    assembly is gn_factors — the trip count stays static, so the whole
+    correction still rides this one price).
     NSD (mode 6): one Nesterov step = projected gradient + the static
     ls_tries backtracking cost evaluations (rtr.py nsd_solve_robust) —
     no Cholesky/assembly, which the LM price would wrongly charge.
     ``nbase``: the rows' baseline period, forwarded to the assembly so
     the priced program IS the solvers' (normal_eq row_period path).
     """
-    key = (int(solver_mode), kmax, n_stations, B, str(dtype), int(nbase))
+    key = (int(solver_mode), kmax, n_stations, B, str(dtype), int(nbase),
+           str(inner))
     if key in _TRIP_CACHE:
         return _TRIP_CACHE[key]
     import jax
@@ -426,25 +442,53 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0):
             rnu = (2.0 if int(solver_mode)
                    == int(SolverMode.RTR_OSRLM_RLBFGS) else None)
 
-            def outer(p, x8, coh, s1, s2, cid, wt):
-                J = ne.jones_r2c(p.reshape(K, N, 8))
-                cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt, K, N,
-                                        robust_nu=rnu)
-                g = jax.grad(lambda q: jnp.sum(cfn(q)))(p)
-                g = rtr_mod.project_tangent(p, g, K, N)
-                JTJ, _, _ = ne.normal_equations(x8, J, coh, s1, s2, cid,
-                                                wt, N, K,
-                                                row_period=int(nbase))
-                return g, JTJ, cfn(p)
+            if inner == "cg":
+                def outer(p, x8, coh, s1, s2, cid, wt):
+                    J = ne.jones_r2c(p.reshape(K, N, 8))
+                    cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt,
+                                            K, N, robust_nu=rnu)
+                    g = jax.grad(lambda q: jnp.sum(cfn(q)))(p)
+                    g = rtr_mod.project_tangent(p, g, K, N)
+                    fac, _, _ = ne.gn_factors(x8, J, coh, s1, s2, cid,
+                                              wt, N, K,
+                                              row_period=int(nbase))
+                    return g, fac, cfn(p)
 
-            def hv(p, JTJ, v):
-                Hv = 2.0 * jnp.einsum("kij,kj->ki", JTJ, v)
-                return rtr_mod.project_tangent(p, Hv, K, N)
+                def hv(p, MA, MB, w2, D, v, s1, s2, cid):
+                    fac = ne.GNFactors(MA=MA, MB=MB, w2=w2, D=D)
+                    Hv = 2.0 * ne.gn_matvec(fac, v, s1, s2, cid, K,
+                                            N, row_period=int(nbase))
+                    return rtr_mod.project_tangent(p, Hv, K, N)
 
-            trip = _rl().combine(
-                _lower_cost(outer, p, x8, coh, s1, s2, cid, wt),
-                _rl().scale(_lower_cost(hv, p, S((K, P, P), f), p),
-                            rtr_mod.RTRConfig().tcg_iters))
+                trip = _rl().combine(
+                    _lower_cost(outer, p, x8, coh, s1, s2, cid, wt),
+                    _rl().scale(
+                        _lower_cost(hv, p, S((B, 2, 2, 4), f),
+                                    S((B, 2, 2, 4), f),
+                                    S((B, 2, 2, 2), f),
+                                    S((K, N, 2, 4, 4), f), p,
+                                    s1, s2, cid),
+                        rtr_mod.RTRConfig().tcg_iters))
+            else:
+                def outer(p, x8, coh, s1, s2, cid, wt):
+                    J = ne.jones_r2c(p.reshape(K, N, 8))
+                    cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt,
+                                            K, N, robust_nu=rnu)
+                    g = jax.grad(lambda q: jnp.sum(cfn(q)))(p)
+                    g = rtr_mod.project_tangent(p, g, K, N)
+                    JTJ, _, _ = ne.normal_equations(x8, J, coh, s1, s2,
+                                                    cid, wt, N, K,
+                                                    row_period=int(nbase))
+                    return g, JTJ, cfn(p)
+
+                def hv(p, JTJ, v):
+                    Hv = 2.0 * jnp.einsum("kij,kj->ki", JTJ, v)
+                    return rtr_mod.project_tangent(p, Hv, K, N)
+
+                trip = _rl().combine(
+                    _lower_cost(outer, p, x8, coh, s1, s2, cid, wt),
+                    _rl().scale(_lower_cost(hv, p, S((K, P, P), f), p),
+                                rtr_mod.RTRConfig().tcg_iters))
         elif int(solver_mode) == int(SolverMode.NSD_RLBFGS):
             def nsd_outer(p, x8, coh, s1, s2, cid, wt):
                 cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt, K, N,
@@ -461,9 +505,31 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0):
                 _rl().scale(_lower_cost(nsd_cost, p, x8, coh, s1, s2,
                                         cid, wt),
                             rtr_mod.NSDConfig().ls_tries))
+        elif inner == "cg":
+            # matrix-free damping trip, FIXED part only: gn_factors
+            # assembly at the trial point + station-block preconditioner
+            # factorization + the initial apply. The PCG loop body
+            # (matvec + apply) is priced per EXECUTED trip by
+            # cg_trip_cost — lm.py counts them in info["cg_iters"].
+            def lm_trip(JTe0, mu, p, x8, coh, s1, s2, cid, wt):
+                Jn = ne.jones_r2c(p.reshape(K, N, 8))
+                fac, JTe, cost = ne.gn_factors(x8, Jn, coh, s1, s2, cid,
+                                               wt, N, K,
+                                               row_period=int(nbase))
+                Lfac = ne.gn_precond_factor(fac.D, mu + 1e-9)
+                z0 = ne.gn_precond_apply(Lfac, JTe, K, N)
+                return fac, JTe, cost, z0
+
+            trip = _lower_cost(lm_trip, p, S((K,), f), p, x8, coh, s1,
+                               s2, cid, wt)
         else:
             def lm_trip(JTJ, JTe, mu, p, x8, coh, s1, s2, cid, wt):
-                dp, _ = lm_mod._solve_damped(JTJ, JTe, mu, 1e-9)
+                # price the executed all-ok solve body, NOT
+                # _solve_damped: cost analysis sums both lax.cond
+                # branches, so the wrapper would charge every trip for
+                # the never-taken jitter-retry factorization (+31%
+                # bytes on config 1 when this priced the wrapper)
+                dp, _ = lm_mod._chol_solve_shift(JTJ, JTe, mu + 1e-9)
                 Jn = ne.jones_r2c((p + dp).reshape(K, N, 8))
                 # normal equations AND acceptance cost from the body's
                 # single row pass (lm.py); no separate cost evaluation
@@ -476,6 +542,49 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0):
         return trip
     except Exception as e:          # pragma: no cover - version-dependent
         log(f"# trip pricing unavailable: {type(e).__name__}: {e}")
+        _TRIP_CACHE[key] = None
+        return None
+
+
+def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0):
+    """FLOPs + bytes of ONE executed PCG inner trip (lm.py
+    _solve_damped_cg body under inner="cg"): one matrix-free gn_matvec
+    over the Wirtinger factors + one station-block preconditioner apply
+    + the axpy/dot chain. Multiplied by info["cg_iters"] via
+    roofline.trip_correct — without this the matrix-free path's actual
+    Krylov traffic would vanish from the roofline (the while_loop body
+    prices once). The tiny [K,N,2] 4x4 factorization is charged per
+    damping trip (solver_trip_cost), not here."""
+    key = ("cgtrip", kmax, n_stations, B, str(dtype), int(nbase))
+    if key in _TRIP_CACHE:
+        return _TRIP_CACHE[key]
+    import jax
+    import jax.numpy as jnp
+    from sagecal_tpu.solvers import normal_eq as ne
+    K, N = kmax, n_stations
+    f = dtype
+    i = jnp.int32
+    S = jax.ShapeDtypeStruct
+    try:
+        def body(MA, MB, w2, Larr, v, r, shift, s1, s2, cid):
+            fac = ne.GNFactors(MA=MA, MB=MB, w2=w2, D=Larr)
+            Ap = ne.gn_matvec(fac, v, s1, s2, cid, K, N, shift=shift,
+                              row_period=int(nbase))
+            alpha = jnp.sum(r * r, axis=-1) \
+                / jnp.maximum(jnp.sum(v * Ap, axis=-1), 1e-30)
+            rn = r - alpha[:, None] * Ap
+            z = ne.gn_precond_apply((Larr, True), rn, K, N)
+            return rn, z, jnp.sum(rn * z, axis=-1)
+
+        trip = _lower_cost(
+            body, S((B, 2, 2, 4), f), S((B, 2, 2, 4), f),
+            S((B, 2, 2, 2), f), S((K, N, 2, 4, 4), f), S((K, 8 * N), f),
+            S((K, 8 * N), f), S((K,), f), S((B,), i), S((B,), i),
+            S((B,), i))
+        _TRIP_CACHE[key] = trip
+        return trip
+    except Exception as e:          # pragma: no cover - version-dependent
+        log(f"# cg trip pricing unavailable: {type(e).__name__}: {e}")
         _TRIP_CACHE[key] = None
         return None
 
@@ -564,7 +673,7 @@ def pallas_ok(device, dtype, sky) -> bool:
 
 def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
               max_emiter=3, max_iter=10, max_lbfgs=10, use_pallas=False,
-              inflight=1):
+              inflight=1, inner="chol"):
     """Compile + time one batched SAGE solve over ``tiles`` independent
     solve intervals; returns (vis/s, r0, r1, dt, compile_s, cost_step)
     where cost_step is {"flops", "bytes_accessed"} per timed step (or
@@ -602,7 +711,7 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
     os_ids, ns = lm_mod.os_subset_ids(tile.tilesz, tile.nbase)
     cfg = sage.SageConfig(max_emiter=max_emiter, max_iter=max_iter,
                           max_lbfgs=max_lbfgs, solver_mode=int(solver_mode),
-                          inflight=inflight, nbase=tile.nbase)
+                          inflight=inflight, nbase=tile.nbase, inner=inner)
     if T > 1:
         # tile-batch trials route through the per-sweep host-tiles
         # driver (VERDICT r5 weak #3): force-fuse each EM sweep into
@@ -647,12 +756,13 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
             x8, coh, s1, s2, cidx_d, cmask_d, r2c(J0), n, wt, config=cfg,
             os_id=os_d, keys=keys)
         return (J, info["res_0"], info["res_1"],
-                info["solver_iters"], info["lbfgs_iters"])
+                info["solver_iters"], info["lbfgs_iters"],
+                info["cg_iters"])
 
     args = (inp["x8"], inp["u"], inp["v"], inp["w"], inp["s1"], inp["s2"],
             inp["wt"], inp["J0"])
     tc0 = time.perf_counter()
-    J, r0, r1, si, lk = step(*args)
+    J, r0, r1, si, lk, ci = step(*args)
     jax.block_until_ready(J)
     compile_s = time.perf_counter() - tc0
     # untimed settling calls: sagefit_host_tiles may PROMOTE this shape
@@ -666,7 +776,7 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
     n_settle = 0
     for _ in range(2):
         tp0 = time.perf_counter()
-        J, r0, r1, si, lk = step(*args)
+        J, r0, r1, si, lk, ci = step(*args)
         jax.block_until_ready(J)
         t_call = time.perf_counter() - tp0
         settle_s += t_call
@@ -677,7 +787,7 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
     sage.program_stats_reset()
     t0 = time.perf_counter()
     for _ in range(reps):
-        J, r0, r1, si, lk = step(*args)
+        J, r0, r1, si, lk, ci = step(*args)
     jax.block_until_ready(J)
     dt = (time.perf_counter() - t0) / reps
     compile_s += max(settle_s - n_settle * dt, 0.0)
@@ -693,22 +803,30 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
         kmax = int(cmask_d.shape[1])
         trips = float(np.asarray(si).sum())
         refine_trips = float(np.asarray(lk).sum())
+        cg_trips = float(np.asarray(ci).sum())
         tf = solver_trip_cost(solver_mode, kmax, n, tile.nrows, dtype,
-                              nbase=tile.nbase)
+                              nbase=tile.nbase, inner=inner)
         rf = refine_trip_cost(sky.n_clusters, kmax, n, tile.nrows,
                               sage._is_robust(int(solver_mode)), dtype)
         # each term applies independently: dropping BOTH because one
         # price failed would silently revert to the orders-of-magnitude
         # undercount this correction exists to fix
         base_gf = cost_step["flops"] / 1e9
-        if tf is not None:
-            cost_step = rl.combine(cost_step, rl.scale(tf, trips))
-        if rf is not None:
-            cost_step = rl.combine(cost_step, rl.scale(rf, refine_trips))
+        cost_step = rl.trip_correct(cost_step, tf, trips)
+        cost_step = rl.trip_correct(cost_step, rf, refine_trips)
+        cf = None
+        if inner == "cg" and cg_trips:
+            # the matrix-free path's Krylov traffic: executed PCG trips
+            # (info["cg_iters"]) x one matvec + preconditioner apply
+            cf = cg_trip_cost(kmax, n, tile.nrows, dtype,
+                              nbase=tile.nbase)
+            cost_step = rl.trip_correct(cost_step, cf, cg_trips)
         log(f"# flops: {trips:.0f} solver trips x "
             f"{(tf['flops'] if tf else 0) / 1e9:.4f} GF + "
             f"{refine_trips:.0f} refine trips x "
-            f"{(rf['flops'] if rf else 0) / 1e9:.4f} GF "
+            f"{(rf['flops'] if rf else 0) / 1e9:.4f} GF + "
+            f"{cg_trips:.0f} cg trips x "
+            f"{(cf['flops'] if cf else 0) / 1e9:.4f} GF "
             f"+ base {base_gf:.2f} GF; "
             f"bytes {cost_step['bytes_accessed'] / 1e9:.3f} GB")
     nvis = T * tile.nrows * len(tile.freqs)
@@ -767,6 +885,19 @@ def _inflight_for(device, M: int, default: int = 1) -> tuple[int, int]:
     return G, sage._eff_inflight(sage.SageConfig(inflight=G), M)
 
 
+def _inner_for() -> str:
+    """Inner linear solver for the SAGE configs (SAGECAL_BENCH_INNER
+    override: "chol" | "cg"). Default chol — the measured verdict
+    everywhere on CPU: the north-star ladder has cg 13.6-16.6x slower
+    at every B rung (BSCALING_r07.json — each PCG trip re-pays a full
+    [B]-row matvec pass), and the config-1 cg trial loses the same way
+    at the small bench shape; see SageConfig.inner's rationale. The
+    banked BENCH_CPU_r07 rows therefore price the chol path; flip the
+    env var for a cg round on a TPU window."""
+    v = os.environ.get("SAGECAL_BENCH_INNER", "chol")
+    return v if v in ("chol", "cg") else "chol"
+
+
 def _roofline_fields(out, device, cost_step, dt):
     """Merge the roofline record (flops, bytes_accessed, achieved_gbps,
     bound, ... — diag.roofline) into a bench record, plus the legacy MFU
@@ -794,21 +925,25 @@ def config1_fullbatch_lm(device, dtype):
     from sagecal_tpu.config import SolverMode
     T = _tiles_for(device)
     G, Ge = _inflight_for(device, 8)
+    inr = _inner_for()
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=8,
                                        tilesz=10, n_tiles=T)
     pal = pallas_ok(device, dtype, sky)
     vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
                                           SolverMode.OSLM_OSRLM_RLBFGS,
-                                          use_pallas=pal, inflight=G)
+                                          use_pallas=pal, inflight=G,
+                                          inner=inr)
+    itag = "" if inr == "chol" else f" inner={inr}"
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, pallas=pal, tiles=T,
-               inflight=G, inflight_eff=Ge,
-               shape=f"N=62 M=8 tilesz=10 point -j3 T{T} G{Ge}")
+               inflight=G, inflight_eff=Ge, inner=inr,
+               shape=f"N=62 M=8 tilesz=10 point -j3 T{T} G{Ge}{itag}")
     _roofline_fields(out, device, fl, dt)
     if pal:
         vps0, _, _, _, _, _ = time_sage(device, dtype, sky, dsky, tiles,
                                         SolverMode.OSLM_OSRLM_RLBFGS,
-                                        use_pallas=False, inflight=G)
+                                        use_pallas=False, inflight=G,
+                                        inner=inr)
         out["value_xla"] = vps0
         out["pallas_speedup"] = vps / vps0
     return out
@@ -986,18 +1121,21 @@ def config3_rtr16(device, dtype):
     emi = 2 if on_tpu else 1
     T = _tiles_for(device)
     G, Ge = _inflight_for(device, 16)
+    inr = _inner_for()
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=16,
                                        tilesz=10, seed=SEED + 10,
                                        n_tiles=T)
     vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
                                           SolverMode.RTR_OSRLM_RLBFGS,
                                           reps=1, max_emiter=emi,
-                                          inflight=G)
+                                          inflight=G, inner=inr)
     small = "" if on_tpu else " (cpu-small E1)"
+    itag = "" if inr == "chol" else f" inner={inr}"
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, tiles=T, inflight=G,
-               inflight_eff=Ge,
-               shape=f"N=62 M=16 tilesz=10 point -j5 T{T} G{Ge}{small}")
+               inflight_eff=Ge, inner=inr,
+               shape=f"N=62 M=16 tilesz=10 point -j5 T{T} G{Ge}"
+                     f"{small}{itag}")
     return _roofline_fields(out, device, fl, dt)
 
 
@@ -1017,21 +1155,26 @@ def config4_extended(device, dtype):
                                        spectra3=True, seed=SEED + 20,
                                        n_tiles=T)
     pal = pallas_ok(device, dtype, sky)
+    inr = _inner_for()
     vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
                                           SolverMode.RTR_OSRLM_RLBFGS,
                                           reps=1, max_emiter=emi,
-                                          use_pallas=pal, inflight=G)
+                                          use_pallas=pal, inflight=G,
+                                          inner=inr)
     small = "" if on_tpu else " (cpu-small E1)"
+    itag = "" if inr == "chol" else f" inner={inr}"
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, pallas=pal, tiles=T,
-               inflight=G, inflight_eff=Ge,
-               shape=f"N=64 M=8 shapelet+gauss -F1 -j5 T{T} G{Ge}{small}")
+               inflight=G, inflight_eff=Ge, inner=inr,
+               shape=f"N=64 M=8 shapelet+gauss -F1 -j5 T{T} G{Ge}"
+                     f"{small}{itag}")
     _roofline_fields(out, device, fl, dt)
     if pal:
         vps0, _, _, _, _, _ = time_sage(device, dtype, sky, dsky, tiles,
                                         SolverMode.RTR_OSRLM_RLBFGS,
                                         reps=1, max_emiter=emi,
-                                        use_pallas=False, inflight=G)
+                                        use_pallas=False, inflight=G,
+                                        inner=inr)
         out["value_xla"] = vps0
         out["pallas_speedup"] = vps / vps0
     return out
@@ -1074,11 +1217,12 @@ def config5_admm32(device, dtype):
     Bpoly = cpoly.setup_polynomials(freqs, float(freqs.mean()), 2, 2)
     mesh = Mesh(np.array([device]), axis_names=("freq",))
 
+    inr = _inner_for()
     cfg = cadmm.ADMMConfig(
         n_admm=n_admm, npoly=2, rho=2.0, manifold_iters=5,
         sage=sage.SageConfig(max_emiter=1, max_iter=3, max_lbfgs=3,
                              solver_mode=int(SolverMode.LM_LBFGS),
-                             nbase=tile.nbase))
+                             nbase=tile.nbase, inner=inr))
     # host_loop: one bounded execution per ADMM iteration — required on
     # the tunneled chip (~60 s per-execution kill with F=32 folded onto
     # one device) and much cheaper to compile
@@ -1117,17 +1261,30 @@ def config5_admm32(device, dtype):
     per_iter = (time.perf_counter() - t0) / reps / n_admm
     res0, res1 = np.asarray(out[3]), np.asarray(out[4])
     small = "" if on_tpu else " (cpu-small)"
+    itag = "" if inr == "chol" else f" inner={inr}"
     rec = dict(value=per_iter, unit="s/ADMM-iter", compile_s=comp,
                res_0=float(res0.mean()), res_1=float(res1.mean()),
+               inner=inr,
                shape=f"F={F} N={n_stations} M={n_clusters} "
-                     f"folded-1-chip x{n_admm}it{small}")
+                     f"folded-1-chip x{n_admm}it{small}{itag}")
     # roofline: the ADMM J-update trip count is static here — the LM stop
     # thresholds (eps 1e-15) never fire at these residual levels, so
     # every cluster solve runs exactly sage.max_iter damping trips.
     # Per-iteration cost = F subbands x M clusters x max_iter x the
     # priced LM trip (consensus Z-update flops are small and uncounted).
+    # Under inner="cg" the dominant cost is the DYNAMIC PCG trip chain
+    # inside each damping trip, and the traced ADMM program does not
+    # surface info["cg_iters"] to the host — pricing only the fixed
+    # part would bank the exact orders-of-magnitude undercount the trip
+    # correction exists to prevent, so this config refuses to price the
+    # cg path until the runner exports the executed-trip counter.
+    if inr == "cg":
+        log("# config5 roofline skipped under inner=cg: the ADMM "
+            "program does not surface cg_iters; a fixed-part-only "
+            "price would undercount the Krylov traffic")
+        return rec
     tf = solver_trip_cost(int(SolverMode.LM_LBFGS), kmax, n_stations,
-                          B, dtype, nbase=tile.nbase)
+                          B, dtype, nbase=tile.nbase, inner=inr)
     if tf:
         fl = _rl().scale(tf, F * n_clusters * cfg.sage.max_iter)
         _roofline_fields(rec, device, fl, per_iter)

@@ -78,6 +78,12 @@ def build_parser() -> argparse.ArgumentParser:
     a("--inflight", type=int, default=1,
       help="clusters solved concurrently per SAGE sweep step (block-"
            "Jacobi groups); 1 = reference Gauss-Seidel sequencing")
+    a("--inner", choices=("chol", "cg"), default="chol",
+      help="inner linear solver for the damped Gauss-Newton step: "
+           "chol = dense [K,8N,8N] assembly + batched Cholesky "
+           "(bit-reference); cg = matrix-free preconditioned CG "
+           "(never forms the normal matrix; MIGRATION.md 'Inner "
+           "linear solver')")
     a("--shard-baselines", action="store_true",
       help="shard the baseline row axis of the (single) subband over "
            "all devices (P1 intra-subband parallelism)")
@@ -168,6 +174,7 @@ def config_from_args(args) -> RunConfig:
         tile_batch=args.tile_batch, solve_fuse=args.solve_fuse,
         solve_promote=args.solve_promote,
         cluster_inflight=args.inflight,
+        solver_inner=args.inner,
         shard_baselines=bool(args.shard_baselines))
 
 

@@ -118,6 +118,11 @@ def build_parser() -> argparse.ArgumentParser:
       help="clusters solved concurrently per SAGE sweep step (block-"
            "Jacobi groups; the reference GPU pipeline's 2-in-flight "
            "analogue, lmfit_cuda.c:450). 1 = strict sequencing")
+    a("--inner", choices=("chol", "cg"), default="chol",
+      help="inner linear solver for the per-cluster J-updates: chol = "
+           "dense [K,8N,8N] assembly (bit-reference); cg = matrix-free "
+           "preconditioned Krylov — melts the B-independent "
+           "factorization floor at north-star N/M (PERF.md round 7)")
     a("--host-loop", action="store_true",
       help="one device execution per ADMM iteration instead of a fully "
            "traced n_admm-iteration program")
@@ -323,7 +328,7 @@ def _main_consensus(args, dtrace) -> int:
             solver_mode=int(SolverMode(args.solver_mode)),
             nulow=args.nulow, nuhigh=args.nuhigh,
             randomize=bool(args.randomize),
-            inflight=args.inflight))
+            inflight=args.inflight, inner=args.inner))
 
     t0 = mss[0].read_tile(0)
     blk_timer = [] if args.block_f else None

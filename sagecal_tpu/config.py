@@ -148,6 +148,11 @@ class RunConfig:
     # (block-Jacobi groups, sage.SageConfig.inflight); 1 = reference
     # Gauss-Seidel sequencing
     cluster_inflight: int = 1
+    # --inner : inner linear solver for the damped Gauss-Newton step /
+    # RTR Hessian operator (sage.SageConfig.inner): "chol" dense
+    # [K, 8N, 8N] assembly (bit-reference), "cg" matrix-free
+    # preconditioned Krylov — see MIGRATION.md "Inner linear solver"
+    solver_inner: str = "chol"
 
     # --- observability
     profile_dir: str | None = None     # --profile : jax.profiler trace of

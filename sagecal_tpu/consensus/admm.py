@@ -141,7 +141,8 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                      mesh: Mesh, nf_total: int, with_shapelets: bool = False,
                      spatial_coords=None, host_loop: bool = False,
                      dobeam: int = 0, nbase: int | None = None,
-                     donate: bool = True, _return_parts: bool = False):
+                     donate: bool = True, timer: list | None = None,
+                     _return_parts: bool = False):
     """Build the jitted per-timeslot consensus-ADMM program.
 
     Returns ``run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F_r8)`` operating
@@ -166,6 +167,13 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
     execution (in-place reuse; bit-identical results, gated by
     tests/test_donation.py). False keeps every input buffer alive, for
     embedders that hold references across iterations.
+    timer: host-loop only — optional list receiving
+    ("iter0"|"body[k]", seconds) per device execution, the same
+    telemetry contract as make_admm_runner_blocked. The returned
+    runner also exposes ``run.consensus_program`` — the per-iteration
+    consensus half (Z psum + duals + BB rho) as its OWN mesh program,
+    so the multichip harness (tools_dev/northstar.py --multichip) can
+    time the collective overhead separately from the J-update solves.
     """
     from sagecal_tpu.consensus import spatial as sp
     from sagecal_tpu.rime import predict as rp
@@ -502,13 +510,39 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         check_vma=False),
         donate_argnums=tuple(range(6, 15)) if donate else ())
 
+    # consensus-only program: everything one ADMM body iteration does
+    # AFTER the J-update solves (z-sum psum, Bii solve, duals, BB rho),
+    # as its own mesh execution — the measured collective-overhead
+    # probe. Never donated: the caller times it repeatedly on one carry.
+    def cons_flat(Jr, r0, r1, JF, YF, Z, rhoF, Yhat, Jprev, Zbar, Xd,
+                  rho_upper, it):
+        carry = (JF, YF, Z, rhoF, Yhat, Jprev, Zbar, Xd, rho_upper)
+        carry, (r0o, r1o, dual) = body_post(Jr, r0, r1, carry, it)
+        return carry + (r0o, r1o, dual)
+
+    prog_cons = jax.jit(shard_map(
+        cons_flat, mesh=mesh,
+        in_specs=(spec_f, spec_f, spec_f) + carry_specs + (spec_r,),
+        out_specs=carry_specs + (spec_f, spec_f, spec_r),
+        check_vma=False))
+
     n_runs = [0]    # runner invocation ordinal = interval, for traces
+
+    import time as _time
+
+    def _t(label, t0, out):
+        if timer is not None:
+            jax.block_until_ready(out)
+            timer.append((label, _time.perf_counter() - t0))
+        return out
 
     def run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F, *beam_rest):
         interval = n_runs[0]
         n_runs[0] += 1
+        t0 = _time.perf_counter()
         out = prog0(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F,
                     *beam_rest)
+        _t("iter0", t0, out[0])
         carry, (res0, res1, Y0F) = out[:9], out[9:]
         if dtrace.active():
             # per-iteration convergence records; the float() syncs are
@@ -518,8 +552,10 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                         dual=0.0, rho_mean=float(jnp.mean(carry[3])))
         r1s, duals = [], []
         for it in range(1, max(cfg.n_admm, 1)):
+            t0 = _time.perf_counter()
             out = progb(x8F, uF, vF, wF, freqF, wtF, *carry,
                         jnp.asarray(it, jnp.int32), *beam_rest)
+            _t(f"body[{it}]", t0, out[0])
             carry, (_, r1, dual) = out[:9], out[9:]
             r1s.append(r1)
             duals.append(dual)
@@ -536,6 +572,7 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                    else jnp.zeros((0,), x8F.dtype))
         return JF, Z, rhoF, res0, res1, r1s_a, duals_a, Y0F
 
+    run.consensus_program = prog_cons
     return run
 
 

@@ -111,6 +111,26 @@ def scale(cost, k) -> dict:
             "bytes_accessed": cost["bytes_accessed"] * k}
 
 
+def trip_correct(cost, per_trip, trips) -> dict:
+    """Dynamic-trip correction: ``cost`` + ``trips`` x ``per_trip``.
+
+    XLA cost analysis prices loop bodies ONCE regardless of trip count,
+    so per-program figures undercount iterative solvers by orders of
+    magnitude. Callers price one body trip (:func:`lower_cost` at the
+    solve shapes) and multiply by the solver's EXECUTED iteration
+    counter. Two counter families exist: outer damping/TR/LBFGS trips
+    (``info["solver_iters"]``/``info["lbfgs_iters"]``) and — under the
+    matrix-free ``inner="cg"`` path — the PCG inner trips
+    (``info["cg_iters"]``), each priced as one gn_matvec +
+    preconditioner application; pricing the damping trip alone would
+    hide the Krylov traffic the inexact-Newton path actually moves.
+    ``per_trip=None`` (pricing unavailable) returns ``cost`` unchanged
+    rather than silently zeroing the base figure."""
+    if cost is None or per_trip is None:
+        return cost
+    return combine(cost, scale(per_trip, trips))
+
+
 def nbytes_of(tree) -> int:
     """Total host bytes of every array leaf in a pytree — the staging
     accountant (how much crosses host->device per tile)."""

@@ -172,6 +172,7 @@ class FullBatchPipeline:
             fuse=getattr(cfg, "solve_fuse", "auto"),
             promote=getattr(cfg, "solve_promote", "auto"),
             inflight=max(1, int(getattr(cfg, "cluster_inflight", 1))),
+            inner=getattr(cfg, "solver_inner", "chol"),
             # rows are [tilesz, nbase] (io.dataset layout): lets the
             # solvers' normal-equation assembly take the baseline-major
             # aggregation for single-chunk clusters

@@ -6,10 +6,21 @@ Capability parity with reference ``clevmar_der_single_nocuda``
 independent 8N-parameter problem, so ALL chunks solve simultaneously as one
 batched damped Gauss-Newton iteration under ``lax.while_loop`` — the
 reference's sequential per-chunk loop (lmfit.c:897-967) becomes a batch
-axis. Normal equations are built analytically (see normal_eq.py) and the
-8N x 8N systems solved with batched Cholesky, mirroring linsolv=0; the
-QR/SVD fallbacks of the reference collapse to a jitter retry, which is what
-they exist for.
+axis. The damped normal system is solved by one of two flag-selectable
+inner solvers (``LMConfig.inner``):
+
+- ``"chol"`` (default): normal equations assembled densely (normal_eq.py)
+  and the 8N x 8N systems solved with batched Cholesky, mirroring
+  linsolv=0; a failed factorization gets ONE jittered retry (the QR/SVD
+  fallbacks of the reference collapse to this — see _solve_damped), and
+  chunks that still fail return dp = 0 and recover through mu-growth.
+- ``"cg"``: matrix-free preconditioned CG — the [K, 8N, 8N] matrix is
+  never formed; each matvec is one [B]-pass over the Wirtinger factors
+  (normal_eq.gn_matvec) under the station-block preconditioner
+  (gn_precond_factor), stopped at the inexact-Newton forcing tolerance
+  ||r|| <= cg_tol * ||JTe|| with per-chunk early-stop masking. Executed
+  CG trips are counted (info["cg_iters"]) for the bench's roofline
+  trip accounting.
 
 Damping schedule = classic levmar (as cloned by clmfit.c):
   mu0 = tau * max(diag(JTJ)); accept if gain rho > 0 with
@@ -33,11 +44,20 @@ class LMConfig(NamedTuple):
     eps2: float = 1e-15        # ||dp||/||p|| stop
     eps3: float = 1e-15        # ||e||^2 stop
     jitter: float = 1e-9       # Cholesky regularization floor
+    # inner linear solver for (JTJ + mu I) dp = JTe: "chol" = dense
+    # assembly + batched Cholesky (bit-reference path); "cg" =
+    # matrix-free preconditioned CG (inexact Newton — same accepted
+    # trajectory within the forcing tolerance, NOT bit-identical; see
+    # MIGRATION.md "Inner linear solver")
+    inner: str = "chol"
+    cg_tol: float = 0.1        # forcing eta: stop at ||r|| <= eta ||JTe||
+    cg_maxiter: int = 25       # static PCG trip cap per damping iteration
 
 
 class LMState(NamedTuple):
     p: jax.Array        # [K, 8N] real parameters
-    JTJ: jax.Array      # [K, 8N, 8N] normal matrix at p
+    JTJ: jax.Array      # inner="chol": [K, 8N, 8N] normal matrix at p;
+                        # inner="cg": normal_eq.GNFactors (matrix-free op)
     JTe: jax.Array      # [K, 8N] gradient at p
     mu: jax.Array       # [K]
     nu: jax.Array       # [K]
@@ -46,6 +66,7 @@ class LMState(NamedTuple):
     live: jax.Array     # [K] bool: carried JTJ/JTe built from >=1 usable
                         # row of this chunk (always True outside OS)
     k: jax.Array        # iteration counter
+    cg: jax.Array       # executed PCG trips (0 under inner="chol")
 
 
 class OSConfig(NamedTuple):
@@ -85,14 +106,118 @@ def os_subset_ids(tilesz: int, nbase: int, n_subsets: int = 10):
     return os_id, int(os_id.max()) + 1
 
 
-def _solve_damped(JTJ, JTe, mu, jitter):
-    """Solve (JTJ + mu I) dp = JTe batched over chunks; returns dp, ok."""
+def _chol_solve_shift(JTJ, JTe, shift):
+    """ONE batched shifted-Cholesky attempt: solve (JTJ + shift I) dp =
+    JTe over chunks; returns dp, ok (dp all-finite per chunk — the f32
+    analogue of LAPACK potrf info). This is the executed all-ok body of
+    :func:`_solve_damped`; bench.py's trip pricing lowers THIS function
+    rather than ``_solve_damped`` because XLA cost analysis sums BOTH
+    branches of a lax.cond — pricing the wrapper would charge every
+    damping trip for a jitter-retry factorization the common case never
+    executes."""
     k8n = JTJ.shape[-1]
-    A = JTJ + (mu[:, None, None] + jitter) * jnp.eye(k8n, dtype=JTJ.dtype)[None]
+    eye = jnp.eye(k8n, dtype=JTJ.dtype)[None]
+    A = JTJ + shift[:, None, None] * eye
     L, lower = jax.scipy.linalg.cho_factor(A, lower=True)
     dp = jax.scipy.linalg.cho_solve((L, lower), JTe[..., None])[..., 0]
-    ok = jnp.all(jnp.isfinite(dp), axis=-1)
-    return jnp.where(ok[:, None], dp, 0.0), ok
+    return dp, jnp.all(jnp.isfinite(dp), axis=-1)
+
+
+def _solve_damped(JTJ, JTe, mu, jitter):
+    """Solve (JTJ + mu I) dp = JTe batched over chunks; returns dp, ok.
+
+    A failed factorization (non-finite dp: the f32 analogue of LAPACK
+    potrf info > 0) gets ONE jittered retry with the regularization
+    floor boosted to 1e-3 * max|diag(JTJ)| per chunk — the QR/SVD
+    fallbacks of the reference (linsolv 1/2, clmfit.c) exist exactly
+    for these near-singular systems, and a scaled-jitter Cholesky is
+    their batched-TPU equivalent. Chunks that still fail return dp = 0
+    and recover through mu-growth on rejection. The retry hides behind
+    a lax.cond, so the all-ok common case pays nothing; under vmap
+    (tile-batch / in-flight groups) the cond lowers to a select and
+    both factorizations execute — an accepted cost on those opt-in
+    paths (tests/test_krylov.py gates the recovery)."""
+    def solve(shift):
+        return _chol_solve_shift(JTJ, JTe, shift)
+
+    dp, ok = solve(mu + jitter)
+
+    def done():
+        return jnp.where(ok[:, None], dp, 0.0), ok
+
+    def retry():
+        diag_max = jnp.max(jnp.abs(jnp.diagonal(JTJ, axis1=-2, axis2=-1)),
+                           axis=-1)
+        dp2, ok2 = solve(mu + jitter + 1e-3 * jnp.maximum(diag_max, 1e-30))
+        dpw = jnp.where(ok[:, None], dp,
+                        jnp.where(ok2[:, None], dp2, 0.0))
+        return dpw, ok | ok2
+
+    return jax.lax.cond(jnp.all(ok), done, retry)
+
+
+def _solve_damped_cg(fac, JTe, mu, jitter, rho, sta1, sta2, chunk_id,
+                     kmax: int, n_stations: int, row_period: int,
+                     eta: float, maxiter: int, active=None):
+    """Matrix-free preconditioned CG for (JTJ + (mu+jitter) I [+ rho I])
+    dp = JTe, batched over chunks; returns (dp, ok, trips).
+
+    The operator applies straight from the Wirtinger factors
+    (normal_eq.gn_matvec — one [B]-pass per trip), preconditioned by
+    the factored station-diagonal blocks (gn_precond_factor: D + shift,
+    batched 4x4 Cholesky). Inexact-Newton forcing: each chunk stops at
+    ||r||^2 <= (eta ||JTe||)^2; converged chunks freeze (masked
+    updates) while the batch runs to the slowest live chunk, and
+    ``trips`` counts the executed loop iterations — the number the
+    roofline trip accounting multiplies by the per-matvec price. A
+    chunk with JTe == 0 (dead OS subset) starts converged and returns
+    dp = 0 exactly, preserving the carried-equation semantics the OS
+    body builds on. ``active`` [K] masks chunks out entirely (their rhs
+    zeroes, so they start converged) — the LM body passes its live mask
+    so already-stopped chunks never drive extra trips under vmap."""
+    shift = mu + jitter + rho                          # [K], always > 0
+    Lfac = ne.gn_precond_factor(fac.D, shift)
+    b = JTe if active is None else jnp.where(active[:, None], JTe, 0.0)
+    bnorm2 = jnp.sum(b * b, axis=-1)
+    tol2 = (eta * eta) * bnorm2
+    tiny = jnp.asarray(1e-30, b.dtype)
+
+    def matvec(v):
+        return ne.gn_matvec(fac, v, sta1, sta2, chunk_id, kmax,
+                            n_stations, shift=shift,
+                            row_period=row_period)
+
+    x0 = jnp.zeros_like(b)
+    z0 = ne.gn_precond_apply(Lfac, b, kmax, n_stations)
+    rz0 = jnp.sum(b * z0, axis=-1)
+
+    def active_of(r):
+        return jnp.sum(r * r, axis=-1) > tol2
+
+    def cond(s):
+        x, r, p, rz, k = s
+        return (k < maxiter) & jnp.any(active_of(r))
+
+    def body(s):
+        x, r, p, rz, k = s
+        act = active_of(r)
+        Ap = matvec(p)
+        pAp = jnp.sum(p * Ap, axis=-1)
+        alpha = jnp.where(act & (pAp > 0), rz / jnp.maximum(pAp, tiny),
+                          0.0)
+        x = x + alpha[:, None] * p
+        r = r - alpha[:, None] * Ap
+        z = ne.gn_precond_apply(Lfac, r, kmax, n_stations)
+        rz_new = jnp.sum(r * z, axis=-1)
+        beta = jnp.where(act, rz_new / jnp.maximum(rz, tiny), 0.0)
+        p = jnp.where(act[:, None], z + beta[:, None] * p, p)
+        rz = jnp.where(act, rz_new, rz)
+        return x, r, p, rz, k + 1
+
+    x, r, p, rz, k = jax.lax.while_loop(
+        cond, body, (x0, b, z0, rz0, jnp.zeros((), jnp.int32)))
+    ok = jnp.all(jnp.isfinite(x), axis=-1)
+    return jnp.where(ok[:, None], x, 0.0), ok, k
 
 
 def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
@@ -140,11 +265,16 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     p0 = ne.jones_c2r(J0).reshape(kmax, -1).astype(dtype)
     if chunk_mask is None:
         chunk_mask = jnp.ones((kmax,), bool)
+    inner_cg = config.inner == "cg"
 
+    rho_aug = 0.0
     if admm is not None:
         admm_y, admm_bz, admm_rho = admm
         admm_y = admm_y.reshape(kmax, -1).astype(dtype)
         admm_bz = admm_bz.reshape(kmax, -1).astype(dtype)
+        # the matrix-free path never forms JTJ, so the ADMM rho-term
+        # rides the operator shift instead of a dense += rho I
+        rho_aug = admm_rho
 
     def aug_cost(p, cost_data):
         """Add 2*(y^T d + rho/2 ||d||^2), consistent with the un-halved
@@ -158,19 +288,28 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     def nrm_eq(p, w=None, cw=None):
         """Normal equations + acceptance cost from ONE row pass: ``w``
         weights JTJ/JTe (subset weights under OS), ``cw`` the cost
-        (full-data weights under OS; defaults to ``w``)."""
+        (full-data weights under OS; defaults to ``w``). Under
+        inner="cg" the first return is the matrix-free GNFactors
+        operator instead of the dense [K, 8N, 8N] matrix."""
         J = ne.jones_r2c(p.reshape(kmax, n_stations, 8))
-        JTJ, JTe, cost = ne.normal_equations(x8, J, coh, sta1, sta2,
-                                             chunk_id,
-                                             wt if w is None else w,
-                                             n_stations, kmax, cost_wt=cw,
-                                             row_period=row_period)
+        if inner_cg:
+            op, JTe, cost = ne.gn_factors(x8, J, coh, sta1, sta2,
+                                          chunk_id,
+                                          wt if w is None else w,
+                                          n_stations, kmax, cost_wt=cw,
+                                          row_period=row_period)
+        else:
+            op, JTe, cost = ne.normal_equations(
+                x8, J, coh, sta1, sta2, chunk_id,
+                wt if w is None else w, n_stations, kmax, cost_wt=cw,
+                row_period=row_period)
         if admm is not None:
             d = p - admm_bz
             JTe = JTe - admm_y - admm_rho * d
-            JTJ = JTJ + admm_rho * jnp.eye(JTJ.shape[-1], dtype=JTJ.dtype)
+            if not inner_cg:
+                op = op + admm_rho * jnp.eye(op.shape[-1], dtype=op.dtype)
             cost = aug_cost(p, cost)
-        return JTJ, JTe, cost
+        return op, JTe, cost
 
     if os is not None:
         n_sub = int(os.n_subsets)
@@ -201,8 +340,17 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     else:
         JTJ0, JTe0, cost0 = nrm_eq(p0)
         live0 = jnp.ones((kmax,), bool)
-    diag_max = jnp.max(jnp.abs(jnp.diagonal(JTJ0, axis1=-2, axis2=-1)),
-                       axis=-1)
+    if inner_cg:
+        # max diag of the (never-formed) dense matrix: the matrix
+        # diagonal lives entirely in the station-diagonal blocks D, and
+        # the chol path's ADMM += rho I rides the diag as a uniform
+        # shift — add rho_aug so mu0 matches the dense seed
+        dd = jnp.diagonal(JTJ0.D, axis1=-2, axis2=-1)     # [K, N, 2, 4]
+        diag_max = jnp.max(jnp.abs(dd.reshape(kmax, -1)), axis=-1) \
+            + rho_aug
+    else:
+        diag_max = jnp.max(jnp.abs(jnp.diagonal(JTJ0, axis1=-2, axis2=-1)),
+                           axis=-1)
     mu0 = config.tau * jnp.maximum(diag_max, 1e-30)
 
     itmax = (jnp.minimum(jnp.asarray(itmax_dynamic, jnp.int32), config.itmax)
@@ -212,7 +360,14 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         return (s.k < itmax) & jnp.any(~s.stop & chunk_mask)
 
     def body(s: LMState):
-        dp, ok = _solve_damped(s.JTJ, s.JTe, s.mu, config.jitter)
+        if inner_cg:
+            dp, ok, trips = _solve_damped_cg(
+                s.JTJ, s.JTe, s.mu, config.jitter, rho_aug, sta1, sta2,
+                chunk_id, kmax, n_stations, row_period, config.cg_tol,
+                config.cg_maxiter, active=~s.stop & chunk_mask)
+        else:
+            dp, ok = _solve_damped(s.JTJ, s.JTe, s.mu, config.jitter)
+            trips = jnp.zeros((), jnp.int32)
         pnew = s.p + dp
         # ONE row pass per iteration: normal equations AND acceptance
         # cost at the trial point (OS: subset equations + full-data
@@ -250,7 +405,21 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
             adopt = accept | (~s.live & chunk_mask)
         else:
             adopt = accept
-        JTJ = jnp.where(adopt[:, None, None], JTJn, s.JTJ)
+        if inner_cg:
+            # the matrix-free operator carries per-ROW factors (MA/MB/w2
+            # over [B]) next to the per-chunk D blocks: the per-chunk
+            # adopt select maps onto rows through chunk_id — rows of a
+            # rejected chunk keep the entering point's factors, exactly
+            # the dense path's kept JTJ
+            ra = adopt[chunk_id][:, None, None, None]
+            JTJ = ne.GNFactors(
+                MA=jnp.where(ra, JTJn.MA, s.JTJ.MA),
+                MB=jnp.where(ra, JTJn.MB, s.JTJ.MB),
+                w2=jnp.where(ra, JTJn.w2, s.JTJ.w2),
+                D=jnp.where(adopt[:, None, None, None, None],
+                            JTJn.D, s.JTJ.D))
+        else:
+            JTJ = jnp.where(adopt[:, None, None], JTJn, s.JTJ)
         JTe = jnp.where(adopt[:, None], JTen, s.JTe)
         live = jnp.where(adopt, sub_live, s.live) if os is not None \
             else s.live
@@ -272,17 +441,18 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         stop = s.stop | small_grad | (accept & small_dp) | small_cost \
             | (s.k + 1 >= itmax)
         return LMState(p=p, JTJ=JTJ, JTe=JTe, mu=mu, nu=nu, cost=cost,
-                       stop=stop, live=live, k=s.k + 1)
+                       stop=stop, live=live, k=s.k + 1, cg=s.cg + trips)
 
     init = LMState(p=p0, JTJ=JTJ0, JTe=JTe0, mu=mu0,
                    nu=jnp.full((kmax,), 2.0, dtype),
                    cost=cost0, stop=jnp.zeros((kmax,), bool),
-                   live=live0, k=jnp.zeros((), jnp.int32))
+                   live=live0, k=jnp.zeros((), jnp.int32),
+                   cg=jnp.zeros((), jnp.int32))
     final = jax.lax.while_loop(cond, body, init)
     J = ne.jones_r2c(final.p.reshape(kmax, n_stations, 8))
     J = jnp.where(chunk_mask[:, None, None, None], J, J0)
     return J, {"init_cost": cost0, "final_cost": final.cost,
-               "iters": final.k}
+               "iters": final.k, "cg_iters": final.cg}
 
 
 def make_weights(flags, dtype=jnp.float32, extra=None):

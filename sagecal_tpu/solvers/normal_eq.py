@@ -19,6 +19,8 @@ layout (Dirac.h:1541-1546).
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 
@@ -268,3 +270,179 @@ def weighted_cost(x8, J, coh, sta1, sta2, chunk_id, wt, kmax: int):
     """Weighted residual cost per chunk [K] (no Jacobians)."""
     r = residual8(x8, J, coh, sta1, sta2, chunk_id) * wt
     return jnp.zeros((kmax,), r.dtype).at[chunk_id].add(jnp.sum(r * r, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# matrix-free Gauss-Newton operator (inexact-Newton inner solver)
+#
+# The damped normal system (JTJ + mu I [+ rho I]) dp = JTe never needs the
+# [K, 8N, 8N] matrix: JTJ is the Gram of the block-sparse weighted real
+# Jacobian whose only free parts are the two [B, 2, 2, 4] Wirtinger
+# factors MA/MB (see the module docstring). A Krylov solver therefore
+# needs exactly (a) those factors + the squared weights, (b) the
+# gradient/cost (one assembly-like [B]-pass, minus the station-pair
+# cross-block scatter the dense expansion pays), and (c) the
+# [K, N, 2, 4, 4] station-diagonal blocks D as a block-Jacobi
+# preconditioner. Each matvec is then one [B]-pass of batched dot
+# products — no O((8N)^2) residency, no O((8N)^3) triangular work.
+# ---------------------------------------------------------------------------
+
+
+class GNFactors(NamedTuple):
+    """Per-iteration invariants of the matrix-free GN operator.
+
+    MA/MB: [B, 2, 2, 4] unweighted Wirtinger factors of the current
+    point (MA[b, o, ri, j], MB[b, a, ri, j] — see _ma_factor/_mb_factor);
+    w2: [B, 2, 2, 2] squared sqrt-weights laid out (a, o, ri);
+    D: [K, N, 2, 4, 4] weight-folded station-diagonal Gram blocks — the
+    dense JTJ's [8, 8] station-diagonal block is block_diag(D[k,n,0],
+    D[k,n,1]) (the preconditioner AND the mu0 = tau*max(diag) seed).
+    """
+
+    MA: jax.Array
+    MB: jax.Array
+    w2: jax.Array
+    D: jax.Array
+
+
+def gn_factors(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
+               kmax: int, cost_wt=None, row_period=0):
+    """Matrix-free analogue of :func:`normal_equations`.
+
+    Same weighted Gauss-Newton linearization, but instead of the dense
+    (JTJ, JTe, cost) it returns (:class:`GNFactors`, JTe [K, 8N],
+    cost [K]) from ONE [B]-pass — everything :func:`gn_matvec` and the
+    station-block preconditioner need, skipping the [K, N, N, 2, 2, 4, 4]
+    cross-block scatter and the [K, 8N, 8N] dense expansion entirely.
+    ``cost_wt``/``row_period`` follow normal_equations (the OS body's
+    shared acceptance cost; the baseline-major aggregation for
+    single-chunk clusters).
+    """
+    N = n_stations
+    B = x8.shape[0]
+    Jp = J[chunk_id, sta1]
+    Jq = J[chunk_id, sta2]
+    A = coh @ jnp.conj(jnp.swapaxes(Jq, -1, -2))
+    Bm = Jp @ coh
+    V = Jp @ A
+    vf = V.reshape(-1, 4)
+    r = x8 - jnp.stack([vf.real, vf.imag], -1).reshape(-1, 8)
+    rw = r * wt
+    MA = _ma_factor(A)                             # [B, o, ri, 4]
+    MB = _mb_factor(Bm)                            # [B, a, ri, 4]
+    rc = rw if cost_wt is None else r * cost_wt
+    w2 = (wt * wt).reshape(B, 2, 2, 2)             # [B, a, o, ri]
+
+    if kmax == 1 and row_period > 0 and B % row_period == 0:
+        # baseline-major aggregation (normal_equations fast path, minus
+        # the cross blocks): every Gram/gradient product contracts over
+        # the time axis straight onto [nbase, ...] station blocks
+        T = B // row_period
+        nb = row_period
+        wv = wt.reshape(T, nb, 2, 2, 2)
+        WMAh = wv[..., None] * MA.reshape(T, nb, 1, 2, 2, 4)
+        WMBh = wv[..., None] * MB.reshape(T, nb, 2, 1, 2, 4)
+        rwv = rw.reshape(T, nb, 2, 2, 2)
+        pp = jnp.einsum("tnaori,tnaorj->naij", WMAh, WMAh)
+        qq = jnp.einsum("tnaori,tnaorj->noij", WMBh, WMBh)
+        jtep = jnp.einsum("tnaori,tnaor->nai", WMAh, rwv)
+        jteq = jnp.einsum("tnaori,tnaor->noi", WMBh, rwv)
+        s1b, s2b = sta1[:nb], sta2[:nb]
+        D = jnp.zeros((1, N, 2, 4, 4), rw.dtype)
+        D = D.at[0, s1b].add(pp).at[0, s2b].add(qq)
+        JTe = jnp.zeros((1, N, 2, 4), rw.dtype)
+        JTe = JTe.at[0, s1b].add(jtep).at[0, s2b].add(jteq)
+        cost = jnp.sum(rc * rc).reshape(1)
+    else:
+        rw2 = (rw * wt).reshape(B, 2, 2, 2)        # w^2 r
+        WMA = w2[..., None] * MA[:, None]          # [B, a, o, ri, 4]
+        WMB = w2[..., None] * MB[:, :, None]
+        pp = jnp.einsum("baori,borj->baij", WMA, MA)
+        qq = jnp.einsum("baorj,bari->boij", WMB, MB)
+        jtep = jnp.einsum("baor,bori->bai", rw2, MA)
+        jteq = jnp.einsum("baor,bari->boi", rw2, MB)
+        D = jnp.zeros((kmax, N, 2, 4, 4), rw.dtype)
+        D = D.at[chunk_id, sta1].add(pp)
+        D = D.at[chunk_id, sta2].add(qq)
+        JTe = jnp.zeros((kmax, N, 2, 4), rw.dtype)
+        JTe = JTe.at[chunk_id, sta1].add(jtep)
+        JTe = JTe.at[chunk_id, sta2].add(jteq)
+        cost = jnp.zeros((kmax,), rw.dtype).at[chunk_id].add(
+            jnp.sum(rc * rc, axis=1))
+
+    return GNFactors(MA=MA, MB=MB, w2=w2, D=D), \
+        JTe.reshape(kmax, 8 * N), cost
+
+
+def gn_matvec(fac: GNFactors, v, sta1, sta2, chunk_id, kmax: int,
+              n_stations: int, shift=None, row_period: int = 0):
+    """(JTJ + shift I) @ v without materializing JTJ: one [B]-pass.
+
+    ``v``: [K, 8N] (the parameter layout of :func:`normal_equations`'s
+    JTe — station-major, 8 reals per station). ``shift``: [K] (or
+    scalar) diagonal shift — callers fold mu + jitter and the ADMM rho
+    here; None adds nothing. The product is computed directly from the
+    Wirtinger factors: u = J v via MA/MB (Gp/Gq are block-diagonal over
+    one complex index each, so both halves are [B, 2, 4]x[B, 2, 2, 4]
+    batched dots), then y = J^T (w^2 u) scatters back through the same
+    factors. ``row_period`` enables the baseline-major time-axis
+    contraction for single-chunk clusters (same invariant as
+    normal_equations).
+    """
+    N = n_stations
+    B = fac.MA.shape[0]
+    vr = v.reshape(kmax, N, 2, 4)
+    if kmax == 1 and row_period > 0 and B % row_period == 0:
+        T = B // row_period
+        nb = row_period
+        s1b, s2b = sta1[:nb], sta2[:nb]
+        MA_r = fac.MA.reshape(T, nb, 2, 2, 4)      # [t, n, o, ri, j]
+        MB_r = fac.MB.reshape(T, nb, 2, 2, 4)      # [t, n, a, ri, j]
+        vpn = vr[0, s1b]                           # [n, a, j]
+        vqn = vr[0, s2b]                           # [n, o, j]
+        u = (jnp.einsum("tnorj,naj->tnaor", MA_r, vpn)
+             + jnp.einsum("tnarj,noj->tnaor", MB_r, vqn))
+        uw = u * fac.w2.reshape(T, nb, 2, 2, 2)
+        ypn = jnp.einsum("tnaor,tnorj->naj", uw, MA_r)
+        yqn = jnp.einsum("tnaor,tnarj->noj", uw, MB_r)
+        y = jnp.zeros((1, N, 2, 4), v.dtype)
+        y = y.at[0, s1b].add(ypn).at[0, s2b].add(yqn)
+    else:
+        vp = vr[chunk_id, sta1]                    # [B, a, j]
+        vq = vr[chunk_id, sta2]                    # [B, o, j]
+        # u[b, a, o, ri] = (J v)_b: station-p block contracts MA over
+        # its 4 free columns (block-diag over a), station-q over MB
+        u = (jnp.einsum("borj,baj->baor", fac.MA, vp)
+             + jnp.einsum("barj,boj->baor", fac.MB, vq))
+        uw = u * fac.w2
+        yp = jnp.einsum("baor,borj->baj", uw, fac.MA)
+        yq = jnp.einsum("baor,barj->boj", uw, fac.MB)
+        y = jnp.zeros((kmax, N, 2, 4), v.dtype)
+        y = y.at[chunk_id, sta1].add(yp).at[chunk_id, sta2].add(yq)
+    y = y.reshape(kmax, 8 * N)
+    if shift is not None:
+        y = y + jnp.asarray(shift)[..., None] * v
+    return y
+
+
+def gn_precond_factor(D, shift):
+    """Batched tiny Cholesky of the station-block preconditioner.
+
+    M = block_diag over (k, n, a) of (D[k, n, a] + shift_k I4) — the
+    EXACT station-diagonal blocks of (JTJ + shift I) (see
+    :class:`GNFactors`), factored as [K, N, 2] independent 4x4
+    Cholesky decompositions. Returns the (L, lower) pair for
+    :func:`gn_precond_apply`. ``shift``: [K] (mu + jitter [+ rho]) —
+    always > 0 on the solve path, so M is PD even for stations with no
+    usable rows in a chunk.
+    """
+    eye4 = jnp.eye(4, dtype=D.dtype)
+    A = D + jnp.asarray(shift)[..., None, None, None, None] * eye4
+    return jax.scipy.linalg.cho_factor(A, lower=True)
+
+
+def gn_precond_apply(Lfac, r, kmax: int, n_stations: int):
+    """z = M^-1 r with the factored station-block preconditioner."""
+    rr = r.reshape(kmax, n_stations, 2, 4)
+    z = jax.scipy.linalg.cho_solve(Lfac, rr[..., None])[..., 0]
+    return z.reshape(kmax, 8 * n_stations)

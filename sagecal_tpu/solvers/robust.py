@@ -105,15 +105,18 @@ def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         nu_new = update_nu_ml(w2, mask, nu, nulow, nuhigh)
         return (Jn, nu_new, jnp.zeros((), bool)), (info["init_cost"],
                                                    info["final_cost"],
-                                                   info["iters"])
+                                                   info["iters"],
+                                                   info["cg_iters"])
 
     (J, nu, _), costs = jax.lax.scan(
         round_body, (J0, jnp.asarray(nu0, x8.dtype), jnp.ones((), bool)),
         jnp.arange(wt_rounds))
     # "iters": executed inner-LM damping iterations summed over IRLS
-    # rounds — feeds the bench's MFU trip accounting (bench.py)
+    # rounds; "cg_iters": executed PCG trips under config.inner="cg"
+    # (0 otherwise) — both feed the bench's roofline trip accounting
     info = {"init_cost": costs[0][0], "final_cost": costs[1][-1],
-            "iters": jnp.sum(costs[2]).astype(jnp.int32)}
+            "iters": jnp.sum(costs[2]).astype(jnp.int32),
+            "cg_iters": jnp.sum(costs[3]).astype(jnp.int32)}
     return J, nu, info
 
 

@@ -57,6 +57,14 @@ class RTRConfig(NamedTuple):
     delta0_frac: float = 0.25  # Delta0 = frac * ||X0||_F per chunk
     delta_bar_frac: float = 2.0
     eps_grad: float = 1e-12    # relative gradient stop
+    # tCG Hessian operator representation: "chol" materializes the
+    # [K, 8N, 8N] Gauss-Newton normal matrix once per outer TR point
+    # and each product is a dense batched matvec; "cg" keeps the
+    # operator matrix-free (normal_eq.gn_factors + gn_matvec: one
+    # [B]-pass of Wirtinger-factor contractions per product) — the
+    # SAME linear operator to fp reordering, so unlike lm.py's
+    # inexact-Newton path this changes traffic, not trajectory class.
+    inner: str = "chol"
 
 
 class NSDConfig(NamedTuple):
@@ -277,6 +285,23 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         else:
             e = ne.residual8(x8, Jm, coh, sta1, sta2, chunk_id) * wt
             wt_eff = wt * jnp.sqrt(robust_nu) / (robust_nu + e * e)
+        if config.inner == "cg":
+            # matrix-free operator: JTJ @ v straight from the Wirtinger
+            # factors (one [B]-pass per product), never forming the
+            # [K, 8N, 8N] matrix; the unused JTe/cost outputs are
+            # dead-code-eliminated by XLA
+            fac, _, _ = ne.gn_factors(x8, Jm, coh, sta1, sta2, chunk_id,
+                                      wt_eff, n_stations, kmax,
+                                      row_period=row_period)
+
+            def hv(v):
+                Hv = 2.0 * ne.gn_matvec(fac, v, sta1, sta2, chunk_id,
+                                        kmax, n_stations,
+                                        row_period=row_period)
+                if admm_rho2 is not None:
+                    Hv = Hv + admm_rho2 * v
+                return project_tangent(p, Hv, kmax, n_stations)
+            return hv
         JTJ, _, _ = ne.normal_equations(x8, Jm, coh, sta1, sta2, chunk_id,
                                         wt_eff, n_stations, kmax,
                                         row_period=row_period)

@@ -175,6 +175,30 @@ class SageConfig(NamedTuple):
     # G>1 group sweeps ignore the flag (their block-Jacobi update
     # needs the plain residual).
     fuse_residual: bool = True
+    # inner linear solver for the per-cluster damped Gauss-Newton step
+    # (lm.LMConfig.inner) AND the RTR tCG Hessian operator
+    # (rtr.RTRConfig.inner): "chol" assembles the dense [K, 8N, 8N]
+    # normal matrix (batched Cholesky / materialized matvec — the
+    # bit-reference path), "cg" is matrix-free (Wirtinger-factor
+    # matvecs under the station-block preconditioner; inexact Newton on
+    # the LM path, exact-operator tCG on the RTR path). Default stays
+    # "chol", decided from measurement 2026-08-03 (BSCALING_r07.json,
+    # CPU): at the north-star -j5 shape (N=64, M=100) cg LOSES at
+    # every B rung — 506 -> 8420 ms/cluster at full B (+1564%), still
+    # +1383% at quarter B — because every PCG trip re-pays a full
+    # [B]-row matvec pass, and on CPU's ridge the trip chain's row
+    # traffic dwarfs the O((8N)^3) triangular work it deletes. The
+    # structural goal IS met: under cg the sweep scales ~linearly in B
+    # (full/quarter ratio 3.74 vs 4.0 in B; chol 3.33), i.e. the
+    # B-independent factorization floor is melted — it is just
+    # replaced by B-proportional matvec traffic that only pays off
+    # where batched einsum passes are cheap relative to serial
+    # triangular solves (the MXU/HBM regime this flag targets; the TPU
+    # verdict lands with the next healthy chip window). Flip per run
+    # with --inner cg.
+    inner: str = "chol"
+    cg_tol: float = 0.1           # inexact-Newton forcing eta (lm.py)
+    cg_maxiter: int = 25          # static PCG trip cap per damping iter
 
 
 _OS_MODES = (int(SolverMode.OSLM_LBFGS),
@@ -216,11 +240,16 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
     ``last`` (traced bool) is the is-last-EM-iteration switch; ``os_cfg``
     is an lm.OSConfig or None (static). Returns
     (Jn [K,N,2,2], nu_new scalar, init_cost [K], final_cost [K],
-    iters i32 scalar — executed inner-solver iterations, for the bench's
-    MFU trip accounting).
+    iters i32 scalar — executed inner-solver iterations — and
+    cg_iters i32 scalar — executed PCG trips under inner="cg" (0 on the
+    chol path and on RTR/NSD, whose tCG trip count is static), both for
+    the bench's roofline trip accounting).
     """
-    lm_cfg = lm_mod.LMConfig(itmax=itcap)
+    lm_cfg = lm_mod.LMConfig(itmax=itcap, inner=config.inner,
+                             cg_tol=config.cg_tol,
+                             cg_maxiter=config.cg_maxiter)
     nbase = int(config.nbase)
+    zero_i = jnp.zeros((), jnp.int32)
 
     def plain_lm(os=None):
         Jn, info = lm_mod.lm_solve(
@@ -228,7 +257,7 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
             chunk_mask=cmask_m, config=lm_cfg, itmax_dynamic=itermax,
             admm=admm_m, os=os, row_period=nbase)
         return (Jn, nu_cj, info["init_cost"], info["final_cost"],
-                info["iters"])
+                info["iters"], info["cg_iters"])
 
     def robust_lm(os=None):
         Jn, nu_new, info = rb.robust_lm_solve(
@@ -238,19 +267,19 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
             itmax_dynamic=itermax, admm=admm_m, os=os,       # robustlm.c:103
             row_period=nbase)
         return (Jn, nu_new, info["init_cost"], info["final_cost"],
-                info["iters"])
+                info["iters"], info["cg_iters"])
 
     if mode == int(SolverMode.RTR_OSLM_LBFGS):
-        rtr_cfg = rtr_mod.RTRConfig(itmax=itcap)
+        rtr_cfg = rtr_mod.RTRConfig(itmax=itcap, inner=config.inner)
         Jn, info = rtr_mod.rtr_solve(
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             chunk_mask=cmask_m, config=rtr_cfg, itmax_dynamic=itermax,
             admm=admm_m, row_period=nbase)
         return (Jn, nu_cj, info["init_cost"], info["final_cost"],
-                info["iters"])
+                info["iters"], zero_i)
 
     if mode == int(SolverMode.RTR_OSRLM_RLBFGS):
-        rtr_cfg = rtr_mod.RTRConfig(itmax=itcap)
+        rtr_cfg = rtr_mod.RTRConfig(itmax=itcap, inner=config.inner)
         Jn, nu_new, info = rtr_mod.rtr_solve_robust(
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             nu0=nu_cj, nulow=config.nulow, nuhigh=config.nuhigh,
@@ -260,7 +289,7 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
             chunk_mask=cmask_m, config=rtr_cfg, wt_rounds=2,
             itmax_dynamic=itermax, admm=admm_m, row_period=nbase)
         return (Jn, nu_new, info["init_cost"], info["final_cost"],
-                info["iters"])
+                info["iters"], zero_i)
 
     if mode == int(SolverMode.NSD_RLBFGS):
         nsd_cfg = rtr_mod.NSDConfig(itmax=2 * itcap)
@@ -270,7 +299,7 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
             chunk_mask=cmask_m, config=nsd_cfg, itmax_dynamic=2 * itermax,
             admm=admm_m)
         return (Jn, nu_new, info["init_cost"], info["final_cost"],
-                info["iters"])
+                info["iters"], zero_i)
 
     if mode == int(SolverMode.LM_LBFGS) or os_cfg is None:
         # without OS machinery, the OS modes (0/3) degrade to
@@ -299,7 +328,7 @@ def _visit_solve(cj, xdummy, coh_m, cidx_m, cmask_m, J_m, nu_cj,
     """The solve half of one cluster visit (shared by the plain and the
     residual-fused sweeps): per-cluster gathers already done, ``xdummy``
     = residual + this cluster's model. Returns (Jn, nu_new, dcost,
-    its)."""
+    its, cgs)."""
     mode = int(config.solver_mode)
     itermax = jnp.where(
         weighted,
@@ -320,7 +349,7 @@ def _visit_solve(cj, xdummy, coh_m, cidx_m, cmask_m, J_m, nu_cj,
             key=jax.random.fold_in(key, cj), randomize=config.randomize)
 
     itcap = int(config.max_iter) + iter_bar  # static while-loop cap
-    Jn, nu_new, init_cost, final_cost, its = _cluster_solve(
+    Jn, nu_new, init_cost, final_cost, its, cgs = _cluster_solve(
         mode, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m, wt_base, J_m,
         n_stations, nu_cj, config, itermax, itcap, admm_m,
         os_cfg, last)
@@ -329,7 +358,7 @@ def _visit_solve(cj, xdummy, coh_m, cidx_m, cmask_m, J_m, nu_cj,
     dcost = jnp.where(init_res > 0,
                       jnp.maximum((init_res - final_res) / init_res, 0.0),
                       0.0)
-    return Jn, nu_new, dcost, its
+    return Jn, nu_new, dcost, its, cgs
 
 
 def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
@@ -338,9 +367,10 @@ def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                     total_iter: int, iter_bar: int):
     """Visit one cluster: add model back to residual, solve, re-subtract
     (lmfit.c:890-981). ``state`` = (J, xres, nerr_acc, nuM, tk) with
-    ``tk`` an i32[2] counter pair: [0] executed inner-solver iterations
-    (MFU accounting), [1] rejected group steps (always 0 here — only
-    :func:`_group_update` can reject)."""
+    ``tk`` an i32[3] counter triple: [0] executed inner-solver
+    iterations (roofline trip accounting), [1] rejected group steps
+    (always 0 here — only :func:`_group_update` can reject), [2]
+    executed PCG inner trips (SageConfig.inner="cg" only)."""
     J, xres, nerr_acc, nuM, tk = state
     coh_m = jnp.take(coh, cj, axis=0)
     cidx_m = jnp.take(chunk_idx, cj, axis=0)
@@ -348,7 +378,7 @@ def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     J_m = jnp.take(J, cj, axis=0)
 
     xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
-    Jn, nu_new, dcost, its = _visit_solve(
+    Jn, nu_new, dcost, its, cgs = _visit_solve(
         cj, xdummy, coh_m, cidx_m, cmask_m, J_m, jnp.take(nuM, cj),
         sta1, sta2, wt_base, n_stations, config, nerr_prev, weighted,
         last, key, admm, os_id, total_iter, iter_bar)
@@ -356,7 +386,7 @@ def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     nerr_acc = nerr_acc.at[cj].set(dcost)
     xres = xdummy - _model8(Jn, coh_m, sta1, sta2, cidx_m)
     J = J.at[cj].set(Jn)
-    return J, xres, nerr_acc, nuM, tk.at[0].add(its)
+    return J, xres, nerr_acc, nuM, tk.at[0].add(its).at[2].add(cgs)
 
 
 def _sweep_g1(perm, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
@@ -403,7 +433,7 @@ def _sweep_g1(perm, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
         cj = cl_of(j)
         coh_m, cidx_m, cmask_m = gather(cj)
         J_m = jnp.take(J, cj, axis=0)
-        Jn, nu_new, dcost, its = _visit_solve(
+        Jn, nu_new, dcost, its, cgs = _visit_solve(
             cj, xd, coh_m, cidx_m, cmask_m, J_m, jnp.take(nuM, cj),
             sta1, sta2, wt_base, n_stations, config, nerr_prev,
             weighted, last, key, admm, os_id, total_iter, iter_bar)
@@ -419,7 +449,7 @@ def _sweep_g1(perm, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                              cidx_n)
         model_new = _model8(Jn, coh_m, sta1, sta2, cidx_m)
         xd = (xd - model_new) + jnp.where(j + 1 < M, model_next, 0.0)
-        return J, xd, nerr_acc, nuM, tk.at[0].add(its)
+        return J, xd, nerr_acc, nuM, tk.at[0].add(its).at[2].add(cgs)
 
     J, xd, nerr_acc, nuM, tk = jax.lax.fori_loop(
         0, M, body, (J0_, xd, nerr_acc0, nuM0, tk0))
@@ -493,13 +523,13 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                 randomize=config.randomize)
         xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
         itcap = int(config.max_iter) + iter_bar
-        Jn, nu_new, init_cost, final_cost, its = _cluster_solve(
+        Jn, nu_new, init_cost, final_cost, its, cgs = _cluster_solve(
             mode, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m, wt_base,
             J_m, n_stations, jnp.take(nuM, cj, mode="clip"), config,
             itermax, itcap, admm_m, os_cfg, last)
-        return Jn, nu_new, init_cost, final_cost, its, xdummy
+        return Jn, nu_new, init_cost, final_cost, its, cgs, xdummy
 
-    Jn_g, nu_g, ic_g, fc_g, its_g, xd_g = jax.vmap(solve_one)(cjs)
+    Jn_g, nu_g, ic_g, fc_g, its_g, cgs_g, xd_g = jax.vmap(solve_one)(cjs)
     Jo_g = jnp.take(J, cjs, axis=0)              # entering Jones (clipped)
     coh_g = jnp.take(coh, cjs, axis=0)
     cidx_g = jnp.take(chunk_idx, cjs, axis=0)
@@ -556,8 +586,10 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     # slowest lane finishes; rejected groups still executed them).
     # tk[1]: fully-rejected group steps — the observability hook for
     # "groups are all vetoing" (info['rejected_groups']).
+    # tk[2]: executed PCG inner trips (inner="cg"), same live-lane sum.
     tk = tk.at[0].add(jnp.sum(jnp.where(valid, its_g, 0)).astype(jnp.int32))
     tk = tk.at[1].add((~accept).astype(jnp.int32))
+    tk = tk.at[2].add(jnp.sum(jnp.where(valid, cgs_g, 0)).astype(jnp.int32))
     return J, xres, nerr_acc, nuM, tk
 
 
@@ -710,7 +742,7 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
 
     nuM0 = jnp.full((M,), jnp.asarray(nu0, dtype))
     carry0 = (J0, xres0, jnp.zeros((M,), dtype), nuM0,
-              jnp.zeros((2,), jnp.int32))
+              jnp.zeros((3,), jnp.int32))
     if G0 == G or config.max_emiter < 1:
         J, xres, nerr, nuM, tk = jax.lax.fori_loop(
             0, config.max_emiter, lambda ci, c: em_iter_width(ci, c, G),
@@ -745,7 +777,8 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
     res_1 = jnp.linalg.norm(xres_f * wt_base) / n
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
                "nerr": nerr, "solver_iters": tk[0],
-               "rejected_groups": tk[1], "lbfgs_iters": lbfgs_k}
+               "rejected_groups": tk[1], "cg_iters": tk[2],
+               "lbfgs_iters": lbfgs_k}
 
 
 # ---------------------------------------------------------------------------
@@ -762,7 +795,7 @@ def _jit_cluster_update(cj, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
                         total_iter, iter_bar, os_nsub):
     os_id = None if os_ids is None else (os_ids, os_nsub)
     return _cluster_update(cj, (J, xres, nerr_acc, nuM,
-                                jnp.zeros((2,), jnp.int32)),
+                                jnp.zeros((3,), jnp.int32)),
                            x8, coh, sta1,
                            sta2, chunk_idx, chunk_mask, wt_base, n_stations,
                            config, nerr_prev, weighted, last, key, admm,
@@ -783,7 +816,7 @@ def _jit_group_update(cjs, J, xres, nerr_acc, nuM, x8, coh, sta1, sta2,
     group-step safeguard."""
     os_id = None if os_ids is None else (os_ids, os_nsub)
     return _group_update(cjs, (J, xres, nerr_acc, nuM,
-                               jnp.zeros((2,), jnp.int32)),
+                               jnp.zeros((3,), jnp.int32)),
                          x8, coh, sta1,
                          sta2, chunk_idx, chunk_mask, wt_base, n_stations,
                          config, nerr_prev, weighted, last, key, None,
@@ -808,7 +841,7 @@ def _jit_em_sweep(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     if G == 1:
         return _sweep_g1(
             perm, (J, xres, jnp.zeros((M,), x8.dtype), nuM,
-                   jnp.zeros((2,), jnp.int32)),
+                   jnp.zeros((3,), jnp.int32)),
             x8, coh, sta1, sta2, chunk_idx, chunk_mask, wt_base,
             n_stations, config, nerr_prev, weighted, last, kci, None,
             os_id, total_iter, iter_bar)
@@ -826,7 +859,7 @@ def _jit_em_sweep(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     return jax.lax.fori_loop(
         0, n_groups, group_step,
         (J, xres, jnp.zeros((M,), x8.dtype), nuM,
-         jnp.zeros((2,), jnp.int32)))
+         jnp.zeros((3,), jnp.int32)))
 
 
 @jax.jit
@@ -951,7 +984,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     fused = (fuse_mode == "on" or
              (fuse_mode == "auto" and _FUSION_CACHE.get(fuse_key, False)))
     sweep_times: list = []
-    tk_total = jnp.zeros((2,), jnp.int32)
+    tk_total = jnp.zeros((3,), jnp.int32)
     for ci in range(config.max_emiter):
         weighted = config.randomize and (ci % 2 == 1)
         last = ci == config.max_emiter - 1
@@ -1052,7 +1085,8 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                       wt_base)
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
                "nerr": nerr, "solver_iters": tk_total[0],
-               "rejected_groups": tk_total[1], "lbfgs_iters": lbfgs_k}
+               "rejected_groups": tk_total[1], "cg_iters": tk_total[2],
+               "lbfgs_iters": lbfgs_k}
 
 
 # ---------------------------------------------------------------------------
@@ -1106,7 +1140,7 @@ def _jit_em_sweep_tiles(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx,
         if G == 1:
             return _sweep_g1(
                 perm_t, (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t,
-                         jnp.zeros((2,), jnp.int32)),
+                         jnp.zeros((3,), jnp.int32)),
                 x8_t, coh_t, sta1, sta2, chunk_idx, chunk_mask, wt_t,
                 n_stations, config, nerr_t, weighted, last, key_t, None,
                 os_id, total_iter, iter_bar)
@@ -1124,7 +1158,7 @@ def _jit_em_sweep_tiles(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx,
         return jax.lax.fori_loop(
             0, n_groups, group_step,
             (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t,
-             jnp.zeros((2,), jnp.int32)))
+             jnp.zeros((3,), jnp.int32)))
     return jax.vmap(one)(J, xres, nuM, x8, coh, wt_base, nerr_prev, keys,
                          perm)
 
@@ -1241,7 +1275,7 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     fused = (fuse_mode == "on" or
              (fuse_mode == "auto" and _FUSION_CACHE.get(fuse_key, False)))
     sweep_times: list = []
-    tk_total = jnp.zeros((T, 2), jnp.int32)
+    tk_total = jnp.zeros((T, 3), jnp.int32)
     for ci in range(config.max_emiter):
         weighted = config.randomize and (ci % 2 == 1)
         last = ci == config.max_emiter - 1
@@ -1335,6 +1369,7 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
                "nerr": nerr, "solver_iters": tk_total[:, 0],
                "rejected_groups": tk_total[:, 1],
+               "cg_iters": tk_total[:, 2],
                "lbfgs_iters": lbfgs_k}
 
 
@@ -1353,7 +1388,7 @@ def _jit_cluster_update_tiles(cj, J, xres, nerr_acc, nuM, x8, coh, sta1,
             nerr_t, key_t):
         os_id = None if os_ids is None else (os_ids, os_nsub)
         return _cluster_update(cj_t, (J_t, xres_t, nerr_acc_t, nuM_t,
-                                      jnp.zeros((2,), jnp.int32)),
+                                      jnp.zeros((3,), jnp.int32)),
                                x8_t, coh_t, sta1, sta2, chunk_idx,
                                chunk_mask, wt_t, n_stations, config,
                                nerr_t, weighted, last, key_t, None, os_id,
@@ -1378,7 +1413,7 @@ def _jit_group_update_tiles(cjs, J, xres, nerr_acc, nuM, x8, coh, sta1,
             key_t, anch_t):
         os_id = None if os_ids is None else (os_ids, os_nsub)
         return _group_update(cjs_t, (J_t, xres_t, na_t, nuM_t,
-                                     jnp.zeros((2,), jnp.int32)), x8_t,
+                                     jnp.zeros((3,), jnp.int32)), x8_t,
                              coh_t, sta1, sta2, chunk_idx, chunk_mask,
                              wt_t, n_stations, config, nerr_t, weighted,
                              last, key_t, None, os_id, total_iter,

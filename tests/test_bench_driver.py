@@ -179,12 +179,26 @@ def test_round_stamp_increments_and_pins(sandbox):
     assert bench._stamp_path("cpu") == p       # pinned per process
 
 
-def test_bytes_baseline_prefers_newest_with_bytes(sandbox):
-    json.dump({"platform": "cpu",
-               "results": {"1-fullbatch-lm": {"bytes_accessed": None}}},
-              open(sandbox / "BENCH_CPU_r05.json", "w"))
+def test_bytes_baseline_stamped_records_win(sandbox):
+    """Round-stamped records are the ONLY bank once one exists: the live
+    ``bench_results.json`` is overwritten by every run — including
+    discarded trials — so it must never shadow a committed stamped
+    record (the round-7 Δbytes-poisoning fix). It remains the
+    first-round bootstrap when no stamped record exists."""
     json.dump({"platform": "cpu",
                "results": {"1-fullbatch-lm": {"bytes_accessed": 4.4e10}}},
               open(sandbox / "bench_results.json", "w"))
+    # bootstrap: no stamped record yet -> the live record is the bank
     assert bench._bytes_baseline("cpu") == {"1-fullbatch-lm": 4.4e10}
+    # a stamped record exists (even without usable bytes): the live
+    # record is no longer consulted
+    json.dump({"platform": "cpu",
+               "results": {"1-fullbatch-lm": {"bytes_accessed": None}}},
+              open(sandbox / "BENCH_CPU_r05.json", "w"))
+    assert bench._bytes_baseline("cpu") == {}
+    # the newest stamped record carrying bytes wins
+    json.dump({"platform": "cpu",
+               "results": {"1-fullbatch-lm": {"bytes_accessed": 3.3e10}}},
+              open(sandbox / "BENCH_CPU_r06.json", "w"))
+    assert bench._bytes_baseline("cpu") == {"1-fullbatch-lm": 3.3e10}
     assert bench._bytes_baseline("tpu") == {}

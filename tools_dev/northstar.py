@@ -96,28 +96,12 @@ def generate(workdir, n_sta, n_dir, n_sub, tilesz, n_tiles, seed=5):
     return skyp, clup, lst
 
 
-def b_scaling(args):
-    """The round-5 VERDICT's missing experiment: the north-star
-    per-cluster sweep cost at B, B/2, B/4 data rows (tilesz 4/2/1 at
-    N=64, M=100, robust-RTR -g 3 — the exact shape whose 31 ms/cluster
-    plateaus the single-chip target). If ms/cluster scales ~linearly
-    with B the sweep is data-traffic-bound (fusion/dtype wins ride on
-    it); if it barely moves, the floor is per-cluster dispatch/latency
-    overhead and more traffic shrinking cannot cut it. Runs in-process
-    (one subband, one EM sweep per shape, warm-timed); writes
-    BSCALING.json and prints the table."""
-    import jax
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
+def _northstar_sky(n_sta, n_dir, seed=5):
+    """The in-process north-star sky (100 directions x 2 sources,
+    hybrid chunks 1/2 alternating) shared by --b-scaling and
+    --multichip."""
     from sagecal_tpu import skymodel
-    from sagecal_tpu.io import dataset as ds
-    from sagecal_tpu.rime import predict as rp
-    from sagecal_tpu.solvers import normal_eq as nesolv
-    from sagecal_tpu.solvers import sage
-
-    rng = np.random.default_rng(5)
-    n_sta, n_dir = args.stations, args.dirs
+    rng = np.random.default_rng(seed)
     srcs, clusters = {}, []
     for m in range(n_dir):
         names = []
@@ -132,14 +116,42 @@ def b_scaling(args):
                 spec_idx=-0.7, spec_idx1=0.0, spec_idx2=0.0, f0=150e6)
             names.append(nm)
         clusters.append((m, 1 + m % 2, names))    # hybrid chunks 1/2
-    sky = skymodel.build_cluster_sky(srcs, clusters)
+    return skymodel.build_cluster_sky(srcs, clusters)
+
+
+def b_scaling(args):
+    """The round-5 VERDICT's missing experiment: the north-star
+    per-cluster sweep cost at B, B/2, B/4 data rows (tilesz 4/2/1 at
+    N=64, M=100, robust-RTR -g 3 — the exact shape whose 31 ms/cluster
+    plateaus the single-chip target). If ms/cluster scales ~linearly
+    with B the sweep is data-traffic-bound (fusion/dtype wins ride on
+    it); if it barely moves, the floor is per-cluster dispatch/latency
+    overhead and more traffic shrinking cannot cut it. Runs in-process
+    (one subband, one EM sweep per shape, warm-timed).
+
+    ``--inner chol|cg`` selects the inner linear solver; ``--inner
+    both`` runs the ladder under each and writes the round-7 comparison
+    record BSCALING_r07.json (chol vs cg per B rung + the delta on the
+    B-independent floor) instead of BSCALING.json — the PR-3 tentpole's
+    banked verdict."""
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.rime import predict as rp
+    from sagecal_tpu.solvers import sage
+
+    n_sta, n_dir = args.stations, args.dirs
+    sky = _northstar_sky(n_sta, n_dir)
     dsky = rp.sky_to_device(sky, jnp.float32)
     kmax = int(sky.nchunk.max())
     cmask = jnp.asarray(
         np.arange(kmax)[None, :] < sky.nchunk[:, None])
     Jtrue = ds.random_jones(n_dir, sky.nchunk, n_sta, seed=6, scale=0.15)
     M = n_dir
-    rows = []
+    inners = (("chol", "cg") if args.inner == "both" else (args.inner,))
+    ladders = {inner: [] for inner in inners}
     for tilesz in (args.tilesz, args.tilesz // 2, args.tilesz // 4):
         if tilesz < 1:
             continue
@@ -166,56 +178,265 @@ def b_scaling(args):
         s2 = jnp.asarray(tile.sta2, jnp.int32)
         J0 = jnp.asarray(np.tile(np.eye(2, dtype=np.complex64),
                                  (M, kmax, n_sta, 1, 1)))
-        cfg = sage.SageConfig(max_iter=3, max_lbfgs=0,
-                              solver_mode=args.solver,
-                              nbase=tile.nbase)
-        total_iter = M * cfg.max_iter
+        total_iter = M * 3
         iter_bar = int(-(-0.8 * total_iter // M))
         key = jax.random.fold_in(jax.random.PRNGKey(42), 0)
         perm = jnp.arange(M, dtype=jnp.int32)
         xres = x8 - sage.full_model8(J0, coh, s1, s2, cidx)
         nuM = jnp.full((M,), 2.0, jnp.float32)
 
-        def sweep():
-            # fresh state per call: the sweep program donates its
-            # carries
-            return sage._jit_em_sweep(
-                J0.copy(), xres.copy(), nuM.copy(), x8, coh, s1, s2,
-                cidx, cmask, wt, jnp.zeros((M,), jnp.float32),
-                jnp.asarray(False), jnp.asarray(False), key, perm, None,
-                n_stations=n_sta, config=cfg._replace(max_emiter=0),
-                total_iter=total_iter, iter_bar=iter_bar, os_nsub=0)
+        for inner in inners:
+            cfg = sage.SageConfig(max_iter=3, max_lbfgs=0,
+                                  solver_mode=args.solver,
+                                  nbase=tile.nbase, inner=inner)
 
-        out = sweep()
-        jax.block_until_ready(out[0])          # compile
-        times = []
-        for _ in range(args.reps):
-            t0 = time.time()
+            def sweep():
+                # fresh state per call: the sweep program donates its
+                # carries
+                return sage._jit_em_sweep(
+                    J0.copy(), xres.copy(), nuM.copy(), x8, coh, s1, s2,
+                    cidx, cmask, wt, jnp.zeros((M,), jnp.float32),
+                    jnp.asarray(False), jnp.asarray(False), key, perm,
+                    None, n_stations=n_sta,
+                    config=cfg._replace(max_emiter=0),
+                    total_iter=total_iter, iter_bar=iter_bar, os_nsub=0)
+
             out = sweep()
-            jax.block_until_ready(out[0])
-            times.append(time.time() - t0)
-        med = float(np.median(times))
-        rows.append({"tilesz": tilesz, "B": int(B),
-                     "sweep_s": round(med, 3),
-                     "ms_per_cluster": round(1e3 * med / M, 2)})
-        print(f"tilesz={tilesz} B={B}: sweep {med:.3f} s -> "
-              f"{1e3 * med / M:.2f} ms/cluster "
-              f"(runs {[f'{t:.2f}' for t in times]})", flush=True)
-    full, quarter = rows[0], rows[-1]
-    ratio = full["ms_per_cluster"] / max(quarter["ms_per_cluster"], 1e-9)
-    bratio = full["B"] / quarter["B"]
-    # linear-in-B would give ratio ~= bratio; flat gives ~1
-    verdict = ("bandwidth" if ratio > 0.5 * bratio + 0.5 else "overhead")
-    rec = {"metric": "north-star sweep B-scaling",
-           "shape": f"N={n_sta} M={M} -j{args.solver} -g 3 hybrid-chunks",
-           "platform": jax.devices()[0].platform,
-           "rows": rows,
-           "ms_per_cluster_ratio_full_vs_quarter": round(ratio, 2),
-           "B_ratio_full_vs_quarter": round(bratio, 2),
-           "verdict": verdict}
-    with open(os.path.join(HERE, "BSCALING.json"), "w") as f:
+            jax.block_until_ready(out[0])          # compile
+            times = []
+            for _ in range(args.reps):
+                t0 = time.time()
+                out = sweep()
+                jax.block_until_ready(out[0])
+                times.append(time.time() - t0)
+            med = float(np.median(times))
+            ladders[inner].append(
+                {"tilesz": tilesz, "B": int(B), "sweep_s": round(med, 3),
+                 "ms_per_cluster": round(1e3 * med / M, 2)})
+            print(f"inner={inner} tilesz={tilesz} B={B}: sweep "
+                  f"{med:.3f} s -> {1e3 * med / M:.2f} ms/cluster "
+                  f"(runs {[f'{t:.2f}' for t in times]})", flush=True)
+
+    def ladder_fields(rows):
+        full, quarter = rows[0], rows[-1]
+        ratio = full["ms_per_cluster"] / max(quarter["ms_per_cluster"],
+                                             1e-9)
+        bratio = full["B"] / quarter["B"]
+        # linear-in-B would give ratio ~= bratio; flat gives ~1
+        verdict = ("bandwidth" if ratio > 0.5 * bratio + 0.5
+                   else "overhead")
+        return {"rows": rows,
+                "ms_per_cluster_ratio_full_vs_quarter": round(ratio, 2),
+                "B_ratio_full_vs_quarter": round(bratio, 2),
+                "verdict": verdict}
+
+    import jax as _jax
+    shape = f"N={n_sta} M={M} -j{args.solver} -g 3 hybrid-chunks"
+    if len(inners) == 1:
+        rec = {"metric": "north-star sweep B-scaling", "shape": shape,
+               "platform": _jax.devices()[0].platform,
+               "inner": inners[0], **ladder_fields(ladders[inners[0]])}
+        out_path = os.path.join(HERE, "BSCALING.json")
+    else:
+        per = {k: ladder_fields(v) for k, v in ladders.items()}
+        # the tentpole's headline: how much of the B-independent floor
+        # does the matrix-free inner melt, per B rung and at the floor
+        # (the quarter-B rung, where the PR-2 record showed wall-clock
+        # stops following B)
+        deltas = [
+            {"tilesz": c["tilesz"], "B": c["B"],
+             "chol_ms_per_cluster": c["ms_per_cluster"],
+             "cg_ms_per_cluster": g["ms_per_cluster"],
+             "cg_vs_chol_pct": round(
+                 100.0 * (g["ms_per_cluster"] - c["ms_per_cluster"])
+                 / c["ms_per_cluster"], 1)}
+            for c, g in zip(per["chol"]["rows"], per["cg"]["rows"])]
+        rec = {"metric": "north-star sweep B-scaling, chol vs cg inner",
+               "shape": shape,
+               "platform": _jax.devices()[0].platform,
+               "chol": per["chol"], "cg": per["cg"],
+               "cg_vs_chol": deltas,
+               "floor_cg_vs_chol_pct": deltas[-1]["cg_vs_chol_pct"]}
+        out_path = os.path.join(HERE, "BSCALING_r07.json")
+    with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps(rec))
+    return 0
+
+
+def multichip(args):
+    """Measured (not projected) multi-device evidence at the north-star
+    ADMM shape: the full consensus-ADMM program on a VIRTUAL 8-device
+    CPU mesh (``--xla_force_host_platform_device_count``), one subband
+    per device, host-looped so every ADMM iteration is a bounded timed
+    execution. Banks MULTICHIP_rNN.json with (a) per-iteration
+    wall-clock, (b) the consensus half (z-sum psum + Bii solve + dual
+    updates + manifold collectives) timed as its OWN mesh program —
+    the per-iteration collective overhead, measured on the real
+    communication pattern rather than projected from op counts — and
+    (c) per-subband residuals, which must still FALL under the
+    matrix-free inner solver (--inner cg) for the record to count
+    (VERDICT weak-multichip follow-up)."""
+    import os as _os
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = _os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        _os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{args.devices}").strip()
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.devices)
+    except Exception:
+        pass
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from sagecal_tpu import utils
+    from sagecal_tpu.consensus import admm as cadmm
+    from sagecal_tpu.consensus import poly as cpoly
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.rime import predict as rp
+    from sagecal_tpu.solvers import lm as lm_mod, sage
+
+    ndev = args.devices
+    assert len(jax.devices()) >= ndev, jax.devices()
+    n_sta, n_dir, F = args.stations, args.dirs, args.subbands
+    sky = _northstar_sky(n_sta, n_dir)
+    dsky = rp.sky_to_device(sky, jnp.float32)
+    kmax = int(sky.nchunk.max())
+    Jbase = ds.random_jones(n_dir, sky.nchunk, n_sta, seed=6, scale=0.15)
+    slope = (ds.random_jones(n_dir, sky.nchunk, n_sta, seed=7,
+                             scale=0.04) - np.eye(2))
+    freqs = 120e6 * (1 + 0.004 * np.arange(F))
+    tiles = []
+    for f_i in range(F):
+        Jf = Jbase + slope * (freqs[f_i] - 120e6) / 120e6
+        tiles.append(ds.simulate_dataset(
+            dsky, n_stations=n_sta, tilesz=args.tilesz, freqs=[freqs[f_i]],
+            ra0=1.2, dec0=0.7, jones=Jf, nchunk=sky.nchunk,
+            noise_sigma=0.02, seed=20 + f_i))
+    tile = tiles[0]
+    B = tile.nrows
+    cidx = rp.chunk_indices(args.tilesz, tile.nbase, sky.nchunk)
+    cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
+    Bpoly = cpoly.setup_polynomials(freqs, float(freqs.mean()), 2, 2)
+    mesh = Mesh(np.array(jax.devices()[:ndev]), axis_names=("freq",))
+
+    timer: list = []
+    cfg = cadmm.ADMMConfig(
+        n_admm=args.admm, npoly=2, rho=5.0, manifold_iters=5,
+        sage=sage.SageConfig(max_emiter=1, max_iter=3, max_lbfgs=0,
+                             solver_mode=args.solver, nbase=tile.nbase,
+                             inner=args.inner))
+    runner = cadmm.make_admm_runner(
+        dsky, tile.sta1, tile.sta2, cidx, cmask, n_sta, tile.fdelta,
+        Bpoly, cfg, mesh, F, host_loop=True, nbase=tile.nbase,
+        timer=timer)
+
+    def x8_of(t):
+        xa = np.asarray(t.averaged())
+        return np.stack([xa.reshape(-1, 4).real, xa.reshape(-1, 4).imag],
+                        -1).reshape(-1, 8)
+
+    x8F = np.stack([x8_of(t) for t in tiles])
+    uF = np.stack([t.u for t in tiles])
+    vF = np.stack([t.v for t in tiles])
+    wF = np.stack([t.w for t in tiles])
+    wtF = np.stack([np.asarray(lm_mod.make_weights(
+        jnp.asarray(t.flags, jnp.int32), jnp.float32)) for t in tiles])
+    J0 = np.tile(np.eye(2, dtype=np.complex64),
+                 (F, n_dir, kmax, n_sta, 1, 1))
+    sh = NamedSharding(mesh, P("freq"))
+    argsd = [jax.device_put(jnp.asarray(a, jnp.float32), sh) for a in
+             (x8F, uF, vF, wF, freqs, wtF, np.ones(F),
+              utils.jones_c2r_np(J0))]
+
+    print(f"multichip: {ndev} virtual CPU devices, N={n_sta} M={n_dir} "
+          f"F={F} B={B} tilesz={args.tilesz} -j{args.solver} "
+          f"inner={args.inner} x{args.admm} ADMM iters", flush=True)
+    t0 = time.time()
+    out = runner(*argsd)           # compile + first (cold) run
+    compile_s = time.time() - t0
+    cold = list(timer)
+    timer.clear()
+    t0 = time.time()
+    out = runner(*argsd)           # warm run: the banked numbers
+    warm_total = time.time() - t0
+    JF, Z, rhoF, res0, res1, r1s, duals = out[:7]
+    res0 = np.asarray(res0)
+    res1 = np.asarray(res1)
+    r1s = np.asarray(r1s)          # [n_admm-1, F]
+    body_walls = [s for lbl, s in timer if lbl.startswith("body")]
+
+    # consensus-only: the collective half of one body iteration as its
+    # own mesh execution, warm-timed on correctly-shaped carries — the
+    # measured per-iteration collective overhead
+    Ppoly = Bpoly.shape[1]
+    f32 = jnp.float32
+    mk = (F, n_dir, kmax, n_sta, 8)
+    shr = NamedSharding(mesh, P())
+    carry_shapes = [
+        (mk, sh), (mk, sh), ((n_dir, Ppoly, kmax, n_sta, 8), shr),
+        ((F, n_dir), sh), (mk, sh), (mk, sh),
+        ((n_dir, Ppoly, kmax, n_sta, 8), shr),
+        ((n_dir, Ppoly, kmax, n_sta, 8), shr), ((F, n_dir), sh)]
+    carry0 = [jax.device_put(jnp.full(shp, 0.01, f32), s)
+              for shp, s in carry_shapes]
+    carry0[3] = jax.device_put(jnp.full((F, n_dir), 5.0, f32), sh)  # rhoF
+    carry0[8] = carry0[3]                                    # rho_upper
+    Jr = jax.device_put(jnp.full(mk, 0.01, f32), sh)
+    r0d = jax.device_put(jnp.zeros((F,), f32), sh)
+    cons = runner.consensus_program
+    it1 = jnp.asarray(1, jnp.int32)
+    o = cons(Jr, r0d, r0d, *carry0, it1)
+    jax.block_until_ready(o[0])    # compile
+    cons_times = []
+    for _ in range(max(args.reps, 2)):
+        t0 = time.time()
+        o = cons(Jr, r0d, r0d, *carry0, it1)
+        jax.block_until_ready(o[0])
+        cons_times.append(time.time() - t0)
+    cons_s = float(np.median(cons_times))
+
+    body_med = float(np.median(body_walls)) if body_walls else float("nan")
+    # residual trajectory per subband: iteration-0 final, then each
+    # ADMM body iteration's final — all must fall vs the initial
+    falling = bool(np.all(res1 < res0)) and (
+        r1s.shape[0] == 0 or bool(np.all(r1s[-1] < res0)))
+    import glob as _glob
+    import re as _re
+    rounds = [int(m.group(1)) for p in
+              _glob.glob(os.path.join(HERE, "MULTICHIP_r*.json"))
+              if (m := _re.search(r"_r(\d+)\.json$", p))]
+    out_path = os.path.join(
+        HERE, f"MULTICHIP_r{max(rounds, default=0) + 1:02d}.json")
+    rec = {
+        "metric": "north-star ADMM on virtual multi-device CPU mesh",
+        "n_devices": ndev, "measured": True,
+        "shape": f"N={n_sta} M={n_dir} F={F} B={B} tilesz={args.tilesz} "
+                 f"-j{args.solver} -g 3 inner={args.inner} "
+                 f"x{args.admm}it host-loop",
+        "platform": "cpu-virtual-mesh",
+        "compile_s": round(compile_s, 1),
+        "cold_iter_s": [round(s, 3) for _, s in cold],
+        "warm_iter0_s": round(dict(timer).get("iter0", float("nan")), 3),
+        "warm_body_iter_s": [round(s, 3) for s in body_walls],
+        "warm_body_iter_median_s": round(body_med, 3),
+        "consensus_only_s": round(cons_s, 4),
+        "consensus_share_pct": round(100.0 * cons_s / body_med, 2)
+        if body_med == body_med else None,
+        "warm_total_s": round(warm_total, 1),
+        "res0": res0.round(5).tolist(), "res1": res1.round(5).tolist(),
+        "r1_per_admm": r1s.round(5).tolist(),
+        "residuals_falling_all_subbands": falling,
+    }
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec))
+    if not falling:
+        print("WARNING: residuals not falling on all subbands")
+        return 1
     return 0
 
 
@@ -238,12 +459,32 @@ def main():
                     help="reuse/keep the dataset directory")
     ap.add_argument("--b-scaling", action="store_true",
                     help="run the B/B2/B4 sweep-cost ladder instead of "
-                         "the full ADMM run (writes BSCALING.json)")
+                         "the full ADMM run (writes BSCALING.json, or "
+                         "BSCALING_r07.json with --inner both)")
+    ap.add_argument("--inner", choices=("chol", "cg", "both"),
+                    default="chol",
+                    help="inner linear solver (sage.SageConfig.inner); "
+                         "'both' runs the --b-scaling ladder under each "
+                         "and banks the comparison")
+    ap.add_argument("--multichip", action="store_true",
+                    help="run the ADMM shape on a virtual multi-device "
+                         "CPU mesh and bank a measured per-iteration + "
+                         "collective-overhead record (MULTICHIP_rNN)")
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual device count for --multichip")
     ap.add_argument("--reps", type=int, default=3,
                     help="warm sweep timings per shape (--b-scaling)")
     args = ap.parse_args()
+    if args.inner == "both" and not args.b_scaling:
+        # "both" is the --b-scaling comparison mode only; silently
+        # coercing it to chol would bank a record indistinguishable
+        # from an intentional chol run
+        ap.error("--inner both requires --b-scaling "
+                 "(--multichip and the full ADMM run take chol|cg)")
     if args.b_scaling:
         return b_scaling(args)
+    if args.multichip:
+        return multichip(args)
 
     workdir = args.keep or tempfile.mkdtemp(prefix="northstar_")
     os.makedirs(workdir, exist_ok=True)
@@ -263,7 +504,8 @@ def main():
            "-j", str(args.solver), "-e", "1", "-g", "3", "-l", "0",
            "-t", str(args.tilesz), "-V",
            "--block-f", str(args.block_f),
-           "--inflight", str(args.inflight)]
+           "--inflight", str(args.inflight),
+           "--inner", args.inner]
     env = dict(os.environ)
     # persistent XLA compilation cache: re-runs (and the second tile's
     # programs) skip the big solve compiles. Keyed per platform (+ CPU
@@ -310,9 +552,10 @@ def main():
     # body iterations are distinct programs; report the body median
     body = warm[1:] if len(warm) > 1 else warm
     per_iter = float(np.median(body)) if body else float("nan")
+    itag = "" if args.inner in ("chol", "both") else f" inner={args.inner}"
     shape = (f"N={args.stations} M={args.dirs} F={args.subbands} "
              f"hybrid-chunks tilesz={args.tilesz} -j{args.solver} "
-             f"block_f={args.block_f} G={args.inflight}")
+             f"block_f={args.block_f} G={args.inflight}{itag}")
     rec = {"metric": "ADMM wall-clock/iter (north-star shape)",
            "value": round(per_iter, 3), "unit": "s/ADMM-iter",
            "shape": shape, "per_tile_iters": per_tile_iters,

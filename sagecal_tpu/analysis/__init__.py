@@ -1,0 +1,50 @@
+"""jaxlint — AST-level static analysis of this repo's JAX contracts.
+
+The solver hot paths carry invariants that no runtime test sees until
+they rot: buffer donation (PR 2/3 threaded ``donate_argnums`` through
+every jitted carry), retrace discipline (one compiled program per
+shape), host-sync hygiene (async dispatch dies the moment a scalar
+crosses to Python), the f32/c64 dtype pipeline, and the cond-branch
+pricing contract (XLA cost analysis sums BOTH branches of a
+``lax.cond``, so heavy work must live in module-level priceable
+functions — the phantom-bytes class fixed by hand in PR 3). jaxlint
+checks all five statically, before a TPU ever compiles the program:
+
+- ``use-after-donate``  — donated buffers read after the donating call,
+  caller-owned buffers donated without a copy-guard, donated argument
+  tuples escaping into outliving containers;
+- ``retrace``           — ``jax.jit`` constructed per call/iteration,
+  non-hashable static arguments, Python ``if``/``bool``/``float``/
+  ``int`` on tracer values inside traced bodies;
+- ``host-sync``         — ``.item()``/``np.asarray``/``device_get``/
+  ``print`` inside traced code, un-gated per-iteration device syncs in
+  the hot-path host loops (the ``dtrace.active()`` gate is the blessed
+  pattern);
+- ``dtype-promotion``   — dtype-less array creation and wide-dtype
+  literals inside traced solver kernels (x64 test mode would silently
+  upcast the f32/c64 pipeline);
+- ``cond-cost``         — ``lax.cond`` branches that inline heavy ops
+  instead of calling a module-level priceable function.
+
+Usage::
+
+    python -m sagecal_tpu.analysis                # report everything
+    python -m sagecal_tpu.analysis --ci           # fail on NEW findings
+    python -m sagecal_tpu.analysis --write-baseline
+
+Inline suppression (reason required)::
+
+    total = float(jnp.sum(x))  # jaxlint: disable=host-sync -- EM loop needs the scalar
+
+``jaxlint_baseline.json`` (repo root) pins the accepted findings; the
+``--ci`` gate fails only on violations not in the baseline. MIGRATION.md
+"Static contracts" documents the rules embedders must keep.
+"""
+
+from sagecal_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    RULES,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)

@@ -1,0 +1,133 @@
+"""jaxlint CLI: ``python -m sagecal_tpu.analysis [paths] [--ci]``.
+
+Modes:
+
+- default: report ALL findings (baseline-pinned ones marked), exit 1
+  if any exist — the audit view;
+- ``--ci``: report only findings NOT in the baseline and exit non-zero
+  on any — the gate (stale baseline entries print as warnings; the
+  test suite keeps them at zero);
+- ``--write-baseline``: pin the current findings (preserving reasons
+  of entries that survive) — run after fixing what can be fixed and
+  suppressing (with reasons) what cannot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from sagecal_tpu.analysis.core import (BASELINE_NAME, diff_baseline,
+                                       load_baseline, run_paths,
+                                       write_baseline)
+
+
+def _default_root():
+    # repo root = parent of the installed-in-place package
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m sagecal_tpu.analysis",
+        description="jaxlint: tracer-safety / donation / retrace / "
+                    "host-sync / dtype / cond-cost static analysis")
+    ap.add_argument("paths", nargs="*",
+                    help="files or directories (default: the "
+                         "sagecal_tpu package)")
+    ap.add_argument("--ci", action="store_true",
+                    help="fail only on findings not in the baseline")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline path (default: <root>/"
+                         f"{BASELINE_NAME})")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="pin current findings as the baseline")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        # a typo'd path must NOT scan zero files and report green —
+        # that is exactly the silent-rot failure the gate exists for
+        ap.error(f"path(s) do not exist: {', '.join(missing)}")
+    if args.write_baseline and args.paths:
+        # a partial scan would re-pin ONLY its own findings, silently
+        # deleting every other file's accepted entries (and reasons)
+        ap.error("--write-baseline only operates on the full default "
+                 "scan; drop the path arguments")
+
+    root = _default_root()
+    if args.paths:
+        paths = args.paths
+        abspaths = [os.path.abspath(p) for p in paths]
+        if not all(os.path.commonpath([p, root]) == root
+                   for p in abspaths):
+            # scanning outside the repo (fixture trees): relpaths — and
+            # with them the hot-path scoping and baseline fingerprints —
+            # anchor to the scanned tree instead
+            root = (abspaths[0] if len(abspaths) == 1
+                    else os.path.commonpath(abspaths))
+            if os.path.isfile(root):
+                root = os.path.dirname(root)
+        # in-repo paths keep the REPO root: fingerprints must match the
+        # committed baseline and 'solvers/...' must stay a path segment
+        # (hot-path scoping) even when linting a single file
+    else:
+        paths = [os.path.join(root, "sagecal_tpu")]
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+
+    from sagecal_tpu.analysis.core import collect_files
+    if not collect_files(paths):
+        print(f"jaxlint: no .py files under {', '.join(paths)}",
+              file=sys.stderr)
+        return 2
+
+    findings, suppressed, errors = run_paths(paths, root=root)
+    baseline = load_baseline(baseline_path)
+    new, stale = diff_baseline(findings, baseline)
+
+    if args.write_baseline:
+        keep = {fp: e.get("reason", "")
+                for fp, e in baseline.items() if e.get("reason")}
+        write_baseline(baseline_path, findings, reasons=keep)
+        print(f"baseline: {len(findings)} finding(s) pinned -> "
+              f"{baseline_path}")
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "new": [f.fingerprint for f in new],
+            "stale": [e["fingerprint"] for e in stale],
+            "suppressed": len(suppressed),
+            "errors": errors,
+        }, indent=1))
+        return 1 if (new if args.ci else findings) else 0
+
+    shown = new if args.ci else findings
+    pinned = {f.fingerprint for f in findings} - {f.fingerprint
+                                                  for f in new}
+    for f in sorted(shown, key=lambda f: (f.path, f.line, f.col)):
+        tag = "" if args.ci or f.fingerprint not in pinned \
+            else " [baseline]"
+        print(f.render() + tag)
+    for rel, msg in errors:
+        print(f"{rel}: ERROR: {msg}", file=sys.stderr)
+    for e in stale:
+        print(f"warning: stale baseline entry {e['fingerprint']} "
+              f"({e['rule']} {e['path']}): no longer found",
+              file=sys.stderr)
+    n_base = len(findings) - len(new)
+    print(f"jaxlint: {len(findings)} finding(s) "
+          f"({len(new)} new, {n_base} baseline-pinned, "
+          f"{len(suppressed)} suppressed inline, {len(stale)} stale "
+          f"baseline entr{'y' if len(stale) == 1 else 'ies'})")
+    return 1 if shown else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

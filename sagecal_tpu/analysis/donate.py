@@ -1,0 +1,306 @@
+"""use-after-donate: the buffer-donation contract, checked statically.
+
+PR 2/3 threaded ``donate_argnums`` through every jitted solver carry
+(MIGRATION.md "Buffer donation"): after a donating call the argument
+buffer is DEAD — XLA reused its memory for an output. Reading it again
+serves deleted-buffer errors at best, silent corruption on runtimes
+that skip the liveness check. Three statically checkable hazards:
+
+1. a donated name read after the donating call before being rebound
+   (loop bodies: a donated name never rebound in the loop is dead on
+   every iteration after the first);
+2. a donated name that may alias a caller-owned buffer — a function
+   parameter donated directly, or bound from a CONDITIONAL copy-guard
+   (the sagefit_host ``J0.copy() if ... else J0`` class);
+3. the forwarded argument tuple escaping into a container that
+   outlives the call (the ``_call`` program-log class: storing live
+   args in a module global pins buffers XLA already reclaimed).
+
+Codebase tuning: ``_call(label, jfn, *args)`` (solvers/sage.py)
+forwards to the jitted ``jfn`` — donated positions shift by two; the
+``make_admm_runner(donate=)`` escape hatch registers its host-loop
+programs (``progb``/``cons0``/``consb``) through the ordinary
+``name = jax.jit(..., donate_argnums=...)`` form, and the runner
+body's ``*carry`` forwarding is tracked as donation of the whole
+tuple name.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sagecal_tpu.analysis.core import dotted
+
+RULE = "use-after-donate"
+
+
+def _is_fresh(expr) -> bool:
+    """Argument expressions the caller cannot re-read: any non-Name
+    (calls like ``x.copy()``/``jnp.asarray(...)``, subscripts,
+    literals) is a fresh temporary from the caller's point of view."""
+    return not isinstance(expr, (ast.Name, ast.Starred))
+
+
+def _fn_params(fn) -> set:
+    a = fn.args
+    names = {p.arg for p in a.args + a.posonlyargs + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _bound_names(stmt) -> set:
+    """Names (re)bound by this single statement (no recursion into
+    nested statements)."""
+    out: set = set()
+
+    def targets(t):
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                targets(el)
+        elif isinstance(t, ast.Starred):
+            targets(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            targets(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets(stmt.target)
+    elif isinstance(stmt, ast.For):
+        targets(stmt.target)
+    elif isinstance(stmt, ast.With):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                targets(item.optional_vars)
+    return out
+
+
+def _own_exprs(stmt):
+    """Expression subtrees directly attached to ``stmt`` — child
+    statements and nested defs are other entries of the linear scan."""
+    for f in ast.iter_fields(stmt):
+        vals = f[1] if isinstance(f[1], list) else [f[1]]
+        for v in vals:
+            if isinstance(v, ast.expr):
+                yield v
+
+
+def _reads_in(stmt, name, skip_call=None):
+    """Load sites of ``name`` in ``stmt``'s own expressions, excluding
+    the subtree of ``skip_call`` (the donating call reads its args).
+    Reads inside nested lambdas count too, deliberately: a deferred
+    read of a dead buffer is still a read — when the closure provably
+    runs after a rebind, suppress with a reason."""
+    skip = set(map(id, ast.walk(skip_call))) if skip_call else set()
+    for e in _own_exprs(stmt):
+        for sub in ast.walk(e):
+            if (isinstance(sub, ast.Name) and sub.id == name
+                    and isinstance(sub.ctx, ast.Load)
+                    and id(sub) not in skip):
+                yield sub
+
+
+def _donating_call(ctx, call):
+    """(positions, kw-names) donated at THIS call, or None. Positions
+    index the call's positional args; names match keyword args (the
+    donate_argnames spelling when the wrapped signature could not be
+    resolved to positions)."""
+    fn = call.func
+    d = dotted(fn)
+    # _call(label, jfn, *args): donated argnums of jfn shift by two
+    if d == "_call" and len(call.args) >= 2:
+        e = ctx.jits.get(dotted(call.args[1]))
+        if e is not None and (e.donate or e.donate_names):
+            return tuple(i + 2 for i in e.donate), e.donate_names
+        return None
+    e = ctx.jits.get(d) if d is not None else None
+    if e is not None and not e.is_attr and (e.donate or e.donate_names):
+        return e.donate, e.donate_names
+    if isinstance(fn, ast.Attribute):
+        e = ctx.jits.get(fn.attr)
+        if e is not None and e.is_attr and (e.donate or e.donate_names):
+            return e.donate, e.donate_names
+    return None
+
+
+def _donated_args(call, positions, names=()):
+    """Arg expressions at donated positions (plus keyword args matching
+    unresolved donate_argnames); a ``*name`` star covering a donated
+    position donates (a slice of) the whole tuple. Only the FIRST star
+    is tracked — positions past it are ambiguous (the star's length is
+    unknown), and the carry-forwarding idiom puts the donated tuple
+    first."""
+    out = []
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            if any(p >= i for p in positions):
+                out.append(a.value)
+            break
+        if i in positions:
+            out.append(a)
+    out.extend(kw.value for kw in call.keywords if kw.arg in names)
+    return out
+
+
+def _scope_stmts(ctx, fn):
+    """This function's own statements, linearized in source order
+    (nested function bodies excluded — they are their own scope)."""
+    out = []
+    for s in ast.walk(fn):
+        if not isinstance(s, ast.stmt) or s is fn:
+            continue
+        cur = ctx.parents.get(s)
+        own = True
+        while cur is not None and cur is not fn:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                own = False
+                break
+            cur = ctx.parents.get(cur)
+        if own and not isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+            out.append(s)
+    return sorted(out, key=lambda s: (s.lineno, s.col_offset))
+
+
+def _stmt_of(ctx, node):
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = ctx.parents.get(cur)
+    return cur
+
+
+def _alias_source(order, idx, name, params):
+    """The earlier Assign that binds ``name`` with a bare-parameter
+    branch (conditional copy-guard), if any."""
+    for earlier in reversed(order[:idx]):
+        if not isinstance(earlier, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == name
+                   for t in earlier.targets):
+            continue
+        v = earlier.value
+        branches = ([v.body, v.orelse] if isinstance(v, ast.IfExp)
+                    else [v])
+        hits = sorted({b.id for b in branches
+                       if isinstance(b, ast.Name) and b.id in params})
+        # an unconditional fresh bind (e.g. plain ``x = y.copy()``)
+        # shadows any earlier aliasing — stop at the nearest binder
+        return (earlier, hits) if hits else (None, ())
+    return None, ()
+
+
+def check(ctx):
+    findings = []
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = _fn_params(fn)
+        order = _scope_stmts(ctx, fn)
+        aliased_reported: set = set()
+        for idx, stmt in enumerate(order):
+            for call in [c for e in _own_exprs(stmt)
+                         for c in ast.walk(e)
+                         if isinstance(c, ast.Call)]:
+                donated = _donating_call(ctx, call)
+                if donated is None:
+                    continue
+                for expr in _donated_args(call, *donated):
+                    if _is_fresh(expr):
+                        continue
+                    name = expr.id
+                    findings.extend(_track(
+                        ctx, fn, order, idx, stmt, call, name, params,
+                        aliased_reported))
+        findings.extend(_escapes(ctx, fn))
+    return findings
+
+
+def _track(ctx, fn, order, idx, stmt, call, name, params, reported):
+    out = []
+    callee = dotted(call.func) or (
+        call.func.attr if isinstance(call.func, ast.Attribute)
+        else "<call>")
+    rebound_here = name in _bound_names(stmt)
+    if not rebound_here:
+        for later in order[idx:]:
+            skip = call if later is stmt else None
+            for h in _reads_in(later, name, skip_call=skip):
+                out.append(ctx.finding(
+                    RULE, h,
+                    f"'{name}' read after being donated to '{callee}' "
+                    f"(line {call.lineno}); rebind it from the call's "
+                    f"outputs or pass a copy"))
+            if later is not stmt and name in _bound_names(later):
+                break
+        loop = ctx.enclosing_loop(stmt, stop_at=fn)
+        if loop is not None and not any(
+                name in _bound_names(s) for s in ast.walk(loop)
+                if isinstance(s, ast.stmt)):
+            out.append(ctx.finding(
+                RULE, call,
+                f"'{name}' donated to '{callee}' inside a loop but "
+                f"never rebound in the loop body — dead buffer on "
+                f"every iteration after the first"))
+    # caller-owned buffers: donating a parameter consumes the caller's
+    # buffer; a conditional copy-guard may still alias it
+    if name in params and (fn, name, "param") not in reported:
+        reported.add((fn, name, "param"))
+        out.append(ctx.finding(
+            RULE, call,
+            f"caller-owned parameter '{name}' donated to '{callee}' "
+            f"without a copy-guard — the caller's buffer is consumed"))
+    elif name not in params:
+        src, hits = _alias_source(order, idx, name, params)
+        if src is not None and (fn, name, "alias") not in reported:
+            reported.add((fn, name, "alias"))
+            out.append(ctx.finding(
+                RULE, call,
+                f"'{name}' donated to '{callee}' may alias caller-owned "
+                f"{', '.join(hits)} (copy-guard at line {src.lineno} is "
+                f"conditional)"))
+    return out
+
+
+def _escapes(ctx, fn):
+    """Wrapper-escape rule: a function forwarding its ``*args`` to a
+    callable parameter (``jfn(*args)``) must not store the raw tuple
+    in an outliving container — donated buffers get pinned (and later
+    re-read) after XLA reclaimed them. Storing shape/dtype metadata
+    (any wrapping call) passes."""
+    a = fn.args
+    if not a.vararg:
+        return []
+    vararg = a.vararg.arg
+    param_names = {p.arg for p in a.args}
+    forwards = any(
+        isinstance(c, ast.Call) and isinstance(c.func, ast.Name)
+        and c.func.id in param_names
+        and any(isinstance(x, ast.Starred)
+                and isinstance(x.value, ast.Name)
+                and x.value.id == vararg for x in c.args)
+        for c in ast.walk(fn) if isinstance(c, ast.Call))
+    if not forwards:
+        return []
+    out = []
+    for stmt in ast.walk(fn):
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not any(isinstance(t, (ast.Subscript, ast.Attribute))
+                   for t in stmt.targets):
+            continue
+        v = stmt.value
+        bare = ([v] if isinstance(v, ast.Name)
+                else list(v.elts) if isinstance(v, (ast.Tuple, ast.List))
+                else [])
+        if any(isinstance(b, ast.Name) and b.id == vararg for b in bare):
+            out.append(ctx.finding(
+                RULE, stmt,
+                f"forwarded '*{vararg}' (may contain donated buffers) "
+                f"stored into an outliving container — keep only "
+                f"shape/dtype metadata, not live arrays"))
+    return out

@@ -1,0 +1,116 @@
+"""dtype-promotion lint: the f32/c64 pipeline must not silently widen.
+
+The solvers run f32 reals / c64 Jones end to end (RunConfig dtype;
+MIGRATION.md). Tests enable x64, where a dtype-less ``jnp.zeros`` is
+f64 — one such temporary inside a kernel upcasts every downstream op
+(2x the bytes on a pipeline PR 2 proved bandwidth-bound). Two rules,
+scoped to TRACED bodies in the hot-path modules:
+
+- array creation without a dtype: ``jnp.zeros/ones/empty/eye/arange/
+  linspace/identity`` with no dtype argument, ``jnp.full`` with a
+  literal fill and no dtype, ``jnp.array`` of a literal with no dtype
+  (``*_like`` and ``jnp.asarray(x)`` preserve their input's dtype and
+  are fine);
+- wide-dtype literals: ``jnp.float64``/``jnp.complex128``/
+  ``np.float64``/``np.complex128`` referenced inside a kernel.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sagecal_tpu.analysis.core import dotted
+
+RULE = "dtype-promotion"
+
+# creation fn -> positional index where dtype may legally appear
+_CREATORS = {"zeros": 1, "ones": 1, "empty": 1, "eye": 3, "identity": 1,
+             "arange": 3, "linspace": 5}
+_WIDE = ("jnp.float64", "jnp.complex128", "jax.numpy.float64",
+         "jax.numpy.complex128", "np.float64", "np.complex128",
+         "numpy.float64", "numpy.complex128")
+
+
+def _literal(node) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float, complex))
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_literal(e) for e in node.elts)
+    if isinstance(node, ast.UnaryOp):
+        return _literal(node.operand)
+    return False
+
+
+def _creation_findings(ctx, node, findings):
+    d = dotted(node.func)
+    if d is None or not (d.startswith("jnp.")
+                         or d.startswith("jax.numpy.")):
+        return
+    base = d.rsplit(".", 1)[1]
+    has_dtype_kw = any(kw.arg == "dtype" for kw in node.keywords)
+    if base in _CREATORS:
+        if has_dtype_kw or len(node.args) > _CREATORS[base]:
+            return
+        findings.append(ctx.finding(
+            RULE, node,
+            f"{d}() without a dtype inside a traced kernel — defaults "
+            f"to f64 under x64 and upcasts the f32/c64 pipeline; pass "
+            f"dtype= from an input array"))
+    elif base == "full":
+        if has_dtype_kw or len(node.args) > 2:
+            return
+        if len(node.args) == 2 and _literal(node.args[1]):
+            findings.append(ctx.finding(
+                RULE, node,
+                f"{d}() with a literal fill and no dtype inside a "
+                f"traced kernel — inherits the default (f64 under "
+                f"x64); pass dtype="))
+    elif base == "array":
+        if has_dtype_kw or not node.args or not _literal(node.args[0]):
+            return
+        findings.append(ctx.finding(
+            RULE, node,
+            f"{d}() of a literal without a dtype inside a traced "
+            f"kernel — pass dtype= or use jnp.asarray(x, other.dtype)"))
+
+
+def _dtype_derivation(ctx, node) -> bool:
+    """The blessed widening idiom: a wide literal chosen by an IfExp
+    that TESTS a dtype (``jnp.complex64 if dtype == jnp.float32 else
+    jnp.complex128``) derives precision from the pipeline instead of
+    forcing it — exempt."""
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        parent = ctx.parents.get(cur)
+        if isinstance(parent, ast.IfExp) and cur in (parent.body,
+                                                     parent.orelse):
+            for sub in ast.walk(parent.test):
+                d = dotted(sub)
+                if d is not None and ("dtype" in d
+                                      or d.endswith("float32")
+                                      or d.endswith("float64")):
+                    return True
+        cur = parent
+    return False
+
+
+def check(ctx):
+    if not ctx.hot:
+        return []
+    findings: list = []
+    for fn in ctx.traced:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in [n for b in body for n in ast.walk(b)]:
+            scope = ctx.enclosing_functions(node)
+            if scope and scope[0] is not fn:
+                continue
+            if isinstance(node, ast.Call):
+                _creation_findings(ctx, node, findings)
+            d = dotted(node)
+            if d in _WIDE and not _dtype_derivation(ctx, node):
+                findings.append(ctx.finding(
+                    RULE, node,
+                    f"wide dtype literal {d} inside a traced kernel — "
+                    f"upcasts the f32/c64 pipeline; derive the dtype "
+                    f"from an input array"))
+    return findings

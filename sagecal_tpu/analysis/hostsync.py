@@ -1,0 +1,112 @@
+"""host-sync leaks: async dispatch dies where a scalar crosses to host.
+
+The hot paths (solvers/, consensus/, rime/, pipeline.py) stay fast by
+keeping the device queue full; one stray ``.item()`` or
+``float(jnp...)`` per iteration serializes every dispatch behind it
+(PR 1 measured the per-sweep sync cost when it wired the
+``dtrace.active()`` gate around the telemetry emits — that gate is the
+blessed pattern and such blocks are exempt here). Two scopes:
+
+- inside TRACED bodies, any host-crossing call is a bug outright:
+  ``np.asarray``/``np.array`` (constant-folds the tracer or dies),
+  ``jax.device_get``, ``.item()``, ``print`` (runs at trace time, not
+  run time), ``jax.block_until_ready``;
+- in hot-path HOST loops, per-iteration syncs not behind the trace
+  gate: ``.item()``, ``jax.device_get``, and ``float(...)``/
+  ``int(...)`` of an expression that mentions ``jnp.`` (a device
+  value by construction).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sagecal_tpu.analysis.core import dotted
+
+RULE = "host-sync"
+
+_NP_SYNC = ("np.asarray", "np.array", "numpy.asarray", "numpy.array",
+            "onp.asarray", "onp.array")
+_DEVICE_GET = ("jax.device_get", "device_get")
+_BLOCK = ("jax.block_until_ready", "block_until_ready")
+
+
+def _mentions_jnp(expr) -> bool:
+    for sub in ast.walk(expr):
+        d = dotted(sub)
+        if d is not None and (d.startswith("jnp.") or d.startswith(
+                "jax.numpy.")):
+            return True
+    return False
+
+
+def _traced_body_leaks(ctx, findings):
+    for fn in ctx.traced:
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in [n for b in body for n in ast.walk(b)]:
+            scope = ctx.enclosing_functions(node)
+            if scope and scope[0] is not fn:
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d in _NP_SYNC + _DEVICE_GET + _BLOCK:
+                findings.append(ctx.finding(
+                    RULE, node,
+                    f"{d}() inside a traced body — host transfer at "
+                    f"trace time (constant-folds or dies on tracers)"))
+            elif d == "print":
+                findings.append(ctx.finding(
+                    RULE, node,
+                    "print() inside a traced body runs at TRACE time "
+                    "only — use jax.debug.print or hoist behind the "
+                    "dtrace.active() gate"))
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "item" and not node.args):
+                findings.append(ctx.finding(
+                    RULE, node,
+                    ".item() inside a traced body — concretization "
+                    "error / host sync"))
+
+
+def _host_loop_syncs(ctx, findings):
+    if not ctx.hot:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if ctx.in_traced_body(node):
+            continue                       # handled above
+        encl = ctx.enclosing_functions(node)
+        fn = encl[0] if encl else None
+        if fn is None or ctx.enclosing_loop(node, stop_at=fn) is None:
+            continue
+        if ctx.under_trace_gate(node):
+            continue
+        d = dotted(node.func)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item" and not node.args):
+            findings.append(ctx.finding(
+                RULE, node,
+                ".item() in a hot-path host loop — per-iteration "
+                "device sync; gate it behind dtrace.active() or keep "
+                "the value on device"))
+        elif d in _DEVICE_GET:
+            findings.append(ctx.finding(
+                RULE, node,
+                f"{d}() in a hot-path host loop — per-iteration "
+                f"device sync; gate or batch the transfer"))
+        elif d in ("float", "int") and node.args and _mentions_jnp(
+                node.args[0]):
+            findings.append(ctx.finding(
+                RULE, node,
+                f"{d}(jnp...) in a hot-path host loop — per-iteration "
+                f"device sync; keep the reduction on device "
+                f"(jnp.where) or gate it behind dtrace.active()"))
+
+
+def check(ctx):
+    findings: list = []
+    _traced_body_leaks(ctx, findings)
+    _host_loop_syncs(ctx, findings)
+    return findings

@@ -1,0 +1,242 @@
+"""retrace hazards: one compiled program per shape, or pay at dispatch.
+
+A retrace regression is invisible to unit tests (the math stays right)
+and catastrophic in production — every solve recompiles. Three
+statically checkable classes:
+
+1. ``jax.jit`` constructed per call: a jit wrapper built inside a loop
+   body gets a fresh trace cache every iteration; one built inside a
+   plain function/method body gets a fresh cache every CALL. Factories
+   are the blessed pattern — functions named ``make_*``/``build_*``/
+   ``_build_*``, ``__init__`` (construct-once), and jits that are part
+   of a ``return`` expression (the caller owns the single instance)
+   are exempt.
+2. non-hashable static arguments: a list/dict/set literal passed at a
+   ``static_argnums``/``static_argnames`` position raises at runtime
+   and — worse — a mutable-but-hashable stand-in retraces per call.
+3. Python control flow on tracer values inside traced bodies:
+   ``if tracer:``/``bool(tracer)``/``float(tracer)``/``int(tracer)``
+   force a concretization error (or a silent constant-fold on weak
+   types). Structure tests (``is None``, ``.shape``/``.ndim``/
+   ``.dtype``/``len()``, ``isinstance``) are static and exempt, as are
+   parameters named in the jit's ``static_argnames``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sagecal_tpu.analysis.core import _JIT_NAMES, dotted
+
+RULE = "retrace"
+
+_FACTORY_PREFIXES = ("make_", "build_", "_build_", "get_")
+_FACTORY_NAMES = ("__init__",)
+
+
+def _jit_ctor_calls(ctx):
+    """All ``jax.jit(...)`` construction sites (incl. via
+    functools.partial)."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            d = dotted(node.func)
+            if d in _JIT_NAMES:
+                yield node
+            elif (d in ("functools.partial", "partial") and node.args
+                  and dotted(node.args[0]) in _JIT_NAMES):
+                yield node
+
+
+def _in_return(ctx, node):
+    cur = node
+    while cur is not None:
+        if isinstance(cur, ast.Return):
+            return True
+        if isinstance(cur, ast.stmt):
+            return False
+        cur = ctx.parents.get(cur)
+    return False
+
+
+def _in_decorator(ctx, node):
+    cur = node
+    parent = ctx.parents.get(cur)
+    while parent is not None:
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and cur in parent.decorator_list:
+            return True
+        cur, parent = parent, ctx.parents.get(parent)
+    return False
+
+
+def _cached_once(ctx, call) -> bool:
+    """The lazy-cache idiom: ``if self._x is None: self._x = jax.jit(...)``
+    constructs once per instance — exempt. Matched structurally: the
+    construction is assigned to the same target the enclosing If tests
+    against None."""
+    stmt = ctx.parents.get(call)
+    while stmt is not None and not isinstance(stmt, ast.stmt):
+        stmt = ctx.parents.get(stmt)
+    if not isinstance(stmt, ast.Assign):
+        return False
+    targets = {dotted(t) for t in stmt.targets}
+    cur = stmt
+    while cur is not None:
+        parent = ctx.parents.get(cur)
+        if isinstance(parent, ast.If) and cur in parent.body:
+            t = parent.test
+            if (isinstance(t, ast.Compare) and len(t.ops) == 1
+                    and isinstance(t.ops[0], ast.Is)
+                    and isinstance(t.comparators[0], ast.Constant)
+                    and t.comparators[0].value is None
+                    and dotted(t.left) in targets):
+                return True
+        if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            return False
+        cur = parent
+    return False
+
+
+def _check_construction(ctx, findings):
+    for call in _jit_ctor_calls(ctx):
+        if _in_decorator(ctx, call) or _in_return(ctx, call):
+            continue
+        if _cached_once(ctx, call):
+            continue
+        encl = ctx.enclosing_functions(call)
+        if not encl:
+            continue                      # module scope: traced once
+        loop = ctx.enclosing_loop(call, stop_at=encl[0])
+        if loop is not None:
+            findings.append(ctx.finding(
+                RULE, call,
+                "jax.jit constructed inside a loop — a fresh wrapper "
+                "(and trace cache) per iteration; hoist it out"))
+            continue
+        outer = encl[-1]
+        fname = getattr(outer, "name", "<lambda>")
+        if (fname.startswith(_FACTORY_PREFIXES)
+                or fname in _FACTORY_NAMES):
+            continue
+        findings.append(ctx.finding(
+            RULE, call,
+            f"jax.jit constructed per call of '{fname}' — every call "
+            f"pays a fresh trace cache; build it once (factory/"
+            f"__init__) or cache it"))
+
+
+def _check_static_args(ctx, findings):
+    for call in ast.walk(ctx.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        d = dotted(call.func)
+        entry = ctx.jits.get(d)
+        if entry is None or not (entry.static_nums or entry.static_names):
+            continue
+        flagged = []
+        for i, a in enumerate(call.args):
+            if i in entry.static_nums and isinstance(
+                    a, (ast.List, ast.Dict, ast.Set)):
+                flagged.append(a)
+        for kw in call.keywords:
+            if kw.arg in entry.static_names and isinstance(
+                    kw.value, (ast.List, ast.Dict, ast.Set)):
+                flagged.append(kw.value)
+        for a in flagged:
+            findings.append(ctx.finding(
+                RULE, a,
+                f"non-hashable literal passed at a static position of "
+                f"'{d}' — static args must hash (use a tuple)"))
+
+
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size")
+_STATIC_TESTS = ("len", "isinstance", "hasattr", "getattr", "callable")
+
+
+def _static_expr(node) -> bool:
+    """Expression whose truth is trace-static: structure access,
+    ``is None`` comparisons, type predicates, pure constants."""
+    if isinstance(node, ast.Compare):
+        if any(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            return True
+        return all(_static_expr(c)
+                   for c in [node.left] + node.comparators)
+    if isinstance(node, ast.BoolOp):
+        return all(_static_expr(v) for v in node.values)
+    if isinstance(node, ast.UnaryOp):
+        return _static_expr(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _static_expr(node.left) and _static_expr(node.right)
+    if isinstance(node, ast.Attribute):
+        return node.attr in _STATIC_ATTRS or _static_expr(node.value)
+    if isinstance(node, ast.Subscript):
+        return _static_expr(node.value)
+    if isinstance(node, ast.Call):
+        return dotted(node.func) in _STATIC_TESTS
+    if isinstance(node, ast.Constant):
+        return True
+    return False
+
+
+def _tracer_params(ctx, fn) -> set:
+    """Parameter names of a traced body that carry tracers: everything
+    except the jit's declared statics (unknown statics => only flag
+    names we are SURE about, i.e. none for transitively traced defs
+    unless they are lambdas/defs handed directly to lax control flow,
+    whose params are all traced operands)."""
+    entry = next((e for e in ctx.jits.values() if e.fn_def is fn), None)
+    a = fn.args
+    names = [p.arg for p in a.args]
+    if entry is not None:
+        static = set(entry.static_names)
+        static.update(names[i] for i in entry.static_nums
+                      if i < len(names))
+        return {n for n in names if n not in static and n != "self"}
+    if isinstance(fn, ast.Lambda):
+        return set(names)
+    return set()
+
+
+def _check_tracer_flow(ctx, findings):
+    for fn in ctx.traced:
+        tracers = _tracer_params(ctx, fn)
+        if not tracers:
+            continue
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in [n for b in body for n in ast.walk(b)]:
+            # stay in this body's scope; nested traced defs get their
+            # own visit (with their own params)
+            scope = ctx.enclosing_functions(node)
+            if scope and scope[0] is not fn:
+                continue
+            if isinstance(node, ast.If) and not _static_expr(node.test):
+                used = {s.id for s in ast.walk(node.test)
+                        if isinstance(s, ast.Name)} & tracers
+                if used:
+                    findings.append(ctx.finding(
+                        RULE, node,
+                        f"Python `if` on tracer value(s) "
+                        f"{', '.join(sorted(used))} inside a traced "
+                        f"body — concretization error or silent "
+                        f"retrace; use lax.cond/jnp.where"))
+            if (isinstance(node, ast.Call)
+                    and dotted(node.func) in ("bool", "float", "int")
+                    and node.args and not _static_expr(node.args[0])):
+                used = {s.id for s in ast.walk(node.args[0])
+                        if isinstance(s, ast.Name)} & tracers
+                if used:
+                    findings.append(ctx.finding(
+                        RULE, node,
+                        f"{dotted(node.func)}() on tracer value(s) "
+                        f"{', '.join(sorted(used))} inside a traced "
+                        f"body forces a host sync / concretization "
+                        f"error"))
+
+
+def check(ctx):
+    findings: list = []
+    _check_construction(ctx, findings)
+    _check_static_args(ctx, findings)
+    _check_tracer_flow(ctx, findings)
+    return findings

@@ -256,14 +256,14 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         # Bfull via the global subband index of each local row. ax=None:
         # everything is local (single-device blocked path).
         dev_idx = 0 if ax is None else jax.lax.axis_index(ax)
-        local_ids = dev_idx * Fl + jnp.arange(Fl)
+        local_ids = dev_idx * Fl + jnp.arange(Fl, dtype=jnp.int32)
         return Bfull[local_ids]                  # [Fl, P]
 
     def _fmask(Fl, dtype, ax=axis):
         """[Fl, 1] 1.0 for real subbands, 0.0 for padded slots (global
         index >= nf_total when the caller padded F up to the mesh)."""
         dev_idx = 0 if ax is None else jax.lax.axis_index(ax)
-        local_ids = dev_idx * Fl + jnp.arange(Fl)
+        local_ids = dev_idx * Fl + jnp.arange(Fl, dtype=jnp.int32)
         return (local_ids < nf_total).astype(dtype)[:, None]
 
     # rho for ALL subbands (for Bii): [M, F]
@@ -454,7 +454,8 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                               beamF)
 
         carry, (r0s, r1s, duals) = jax.lax.scan(
-            body, carry, jnp.arange(1, max(cfg.n_admm, 1)))
+            body, carry, jnp.arange(1, max(cfg.n_admm, 1),
+                                    dtype=jnp.int32))
         JF, YF, Z, rhoF = carry[0], carry[1], carry[2], carry[3]
         return JF, Z, rhoF, res0, res1, r1s, duals, Y0F
 

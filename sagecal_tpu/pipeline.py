@@ -202,6 +202,7 @@ class FullBatchPipeline:
         # second tile-sized buffer per interval — callers stage x_r
         # fresh from tile.x and only ever read the output back
         self._residual_fn = jax.jit(self._residuals, donate_argnums=(1,))
+        self._sim_jit = None       # built lazily by run_simulation
         self._chan_solver = None
         self._chan_residual_fn = None
         if cfg.per_channel_bfgs:
@@ -907,7 +908,13 @@ class FullBatchPipeline:
                 tslot=jnp.asarray(self.tslot))
             return utils.c2r(out)
 
-        sim_jit = jax.jit(sim_fn)
+        # built once per pipeline and cached: a fresh jit wrapper per
+        # run_simulation call would re-trace every tile shape on every
+        # call (jaxlint retrace); cfg/sky are fixed for this instance
+        # so the cached program stays valid
+        if self._sim_jit is None:
+            self._sim_jit = jax.jit(sim_fn)
+        sim_jit = self._sim_jit
         for ti, tile in ms.tiles():
             J_r8 = None
             if blocks_iter:

@@ -201,8 +201,14 @@ def linesearch_fletcher(cost_func, grad_func, xk, pk, gk=None,
         take_mu = mu <= (2.0 * alphai - alphai1)
         lo = 2.0 * alphai - alphai1
         hi = jnp.minimum(mu, alphai + t1 * (alphai - alphai1))
-        alpha_adv = jax.lax.cond(take_mu | (code_n != 0),
-                                 lambda: mu, lambda: cubic(lo, hi))
+        alpha_adv = jax.lax.cond(
+            take_mu | (code_n != 0), lambda: mu,
+            # jaxlint: disable=cond-cost -- cubic's phi/dphi are
+            # closure-bound (cost_func), so a module-level split could
+            # not be priced standalone either; the both-branches
+            # overstatement is bounded by ~5 small cost evals per trip
+            # and noted in bench refine_trip_cost
+            lambda: cubic(lo, hi))
         alphai1_n = jnp.where(code_n == 0, alphai, alphai1)
         alphai_n = jnp.where(code_n == 0, alpha_adv, alphai)
         phi_i1_n = jnp.where(code_n == 0, phi_i, phi_i1)

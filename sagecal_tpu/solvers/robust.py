@@ -24,6 +24,9 @@ def update_weights(e, nu):
 
 
 def nu_grid(nulow, nuhigh, nd: int = 30):
+    # jaxlint: disable=dtype-promotion -- 30-element grid; the wide
+    # intermediates are deliberate for the digamma root-find and the
+    # selected nu is cast to the caller's dtype (update_nu_* .astype)
     return nulow + jnp.arange(nd) * (nuhigh - nulow) / nd
 
 

@@ -52,3 +52,46 @@ def _clear_xla_caches_per_module():
         jax.clear_caches()
     except Exception:
         pass
+
+
+# ---------------------------------------------------------------------------
+# Retrace gate (ISSUE 4): diag/guard.py's compile counter promoted to a
+# reusable fixture — the runtime complement of the static jaxlint
+# retrace checker. A workload is warmed once, then an identically
+# shaped re-run must add ZERO compile requests: any delta means a
+# weak-type flip, an unhashable static, or a per-call jit wrapper
+# leaked into the hot path.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def retrace_guard():
+    def assert_zero_retrace(thunk, warmups: int = 1):
+        """Run ``thunk`` ``warmups`` times (compiles allowed), then once
+        more under the compile counter asserting no new programs. The
+        thunk must stage fresh inputs per call (donated buffers!) with
+        identical shapes/dtypes/statics."""
+        from sagecal_tpu.diag import guard
+        for _ in range(max(warmups, 1)):
+            jax.block_until_ready(thunk())
+        with guard.CompileGuard() as g:
+            out = thunk()
+            jax.block_until_ready(out)
+        assert g.compiles == 0, (
+            f"{g.compiles} compile request(s) on an identically shaped "
+            f"re-run — a retrace leaked into the hot path")
+        return out
+    return assert_zero_retrace
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--sanitize", action="store_true", default=False,
+        help="run under jax_enable_checks + debug-NaNs (the CI slow "
+             "lane around the fast solver subset)")
+
+
+def pytest_configure(config):
+    if config.getoption("--sanitize"):
+        jax.config.update("jax_enable_checks", True)
+        jax.config.update("jax_debug_nans", True)

@@ -1,0 +1,463 @@
+"""jaxlint gates: every checker's fixture violations must be caught
+(positive), their suppressed/clean twins must pass (negative), the
+--ci exit-code contract must hold under violation injection, and the
+committed baseline must stay in sync with the tree.
+
+These tests never import jax-traced code — the analyzer parses source,
+so each fixture is a string snippet written to a tmp tree whose layout
+(``solvers/…``) marks it hot-path where a rule needs that scope.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from sagecal_tpu.analysis import core
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_PRELUDE = """\
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(x, y):
+    return x + y
+"""
+
+
+def _lint(tmp_path, source, relpath="solvers/kernel.py"):
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(_PRELUDE + textwrap.dedent(source))
+    findings, suppressed, errors = core.run_paths(
+        [str(tmp_path)], root=str(tmp_path))
+    assert not errors, errors
+    return findings, suppressed
+
+
+def _rules(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# use-after-donate
+# ---------------------------------------------------------------------------
+
+def test_donate_read_after_call_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def driver(y):
+        x = y * 2
+        out = step(x, y)
+        return out + x
+    """)
+    assert _rules(f) == ["use-after-donate"]
+    assert "read after being donated" in f[0].message
+
+
+def test_donate_rebind_and_copy_twins_clean(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def ok_rebind(y):
+        x = y * 2
+        x = step(x, y)
+        return x
+
+    def ok_copy(y):
+        x = y * 2
+        out = step(x.copy(), y)
+        return out + x
+    """)
+    assert f == []
+
+
+def test_donate_loop_without_rebind_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def driver(y):
+        x = y * 2
+        out = None
+        for _ in range(3):
+            out = step(x, y)
+        return out
+    """)
+    assert "use-after-donate" in _rules(f)
+    assert any("inside a loop" in x.message for x in f)
+
+
+def test_donate_param_and_conditional_guard_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def bad_param(x, y):
+        return step(x, y)
+
+    def cond_guard(x, y):
+        j = x.copy() if isinstance(x, jax.Array) else x
+        return step(j, y)
+    """)
+    msgs = " | ".join(x.message for x in f)
+    assert "caller-owned parameter 'x'" in msgs
+    assert "may alias caller-owned x" in msgs
+
+
+def test_donate_arg_tuple_escape_flagged_and_fixed_twin(tmp_path):
+    f, _ = _lint(tmp_path, """
+    LOG = {}
+
+    def _call(name, jfn, *args, **kwargs):
+        rec = LOG.setdefault(name, [jfn, None, 0])
+        rec[1] = (args, kwargs)
+        return jfn(*args, **kwargs)
+
+    def _call_fixed(name, jfn, *args, **kwargs):
+        rec = LOG.setdefault(name, [jfn, None, 0])
+        rec[1] = (tuple(map(_spec, args)), kwargs)
+        return jfn(*args, **kwargs)
+    """)
+    assert _rules(f) == ["use-after-donate"]
+    assert "outliving container" in f[0].message
+
+
+def test_donate_argnames_spelling_flagged(tmp_path):
+    """The modern donate_argnames spelling is tracked too — resolved to
+    positions through the wrapped def's signature, and matched against
+    keyword call args."""
+    f, _ = _lint(tmp_path, """
+    def _step2(carry, y):
+        return carry + y
+
+    step2 = jax.jit(_step2, donate_argnames=("carry",))
+
+    def driver(y):
+        c = y * 2
+        out = step2(c, y)
+        return out + c
+
+    def driver_kw(y):
+        c = y * 3
+        out = step2(y=y, carry=c)
+        return out + c
+    """)
+    assert _rules(f) == ["use-after-donate", "use-after-donate"]
+
+
+def test_hostsync_phase_context_is_not_a_gate(tmp_path):
+    """`with dtrace.phase(...)` bodies execute unconditionally (null
+    context when tracing is off) — a sync inside one is still a leak;
+    only `if dtrace.active():` gates."""
+    f, _ = _lint(tmp_path, """
+    def sweep(xs, dtrace):
+        tot = 0.0
+        for x in xs:
+            with dtrace.phase("sum"):
+                tot += float(jnp.sum(x))
+        return tot
+    """)
+    assert _rules(f) == ["host-sync"]
+
+
+# ---------------------------------------------------------------------------
+# retrace
+# ---------------------------------------------------------------------------
+
+def test_retrace_jit_in_loop_and_per_call_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def run_all(xs):
+        out = []
+        for x in xs:
+            f = jax.jit(lambda a: a + 1)
+            out.append(f(x))
+        return out
+
+    def runner(x):
+        f = jax.jit(lambda a: a * 2)
+        return f(x)
+    """)
+    assert _rules(f) == ["retrace", "retrace"]
+    msgs = " | ".join(x.message for x in f)
+    assert "inside a loop" in msgs and "per call" in msgs
+
+
+def test_retrace_factory_return_and_cache_twins_clean(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def make_solver():
+        return jax.jit(lambda a: a + 1)
+
+    def _build_resid(fn):
+        g = jax.jit(fn)
+        return g
+
+    class P:
+        def __init__(self):
+            self._f = jax.jit(lambda a: a)
+            self._sim = None
+
+        def run(self, x):
+            if self._sim is None:
+                self._sim = jax.jit(lambda a: a - 1)
+            return self._sim(x)
+    """)
+    assert f == []
+
+
+def test_retrace_nonhashable_static_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    @functools.partial(jax.jit, static_argnames=("opts",))
+    def solve(x, opts):
+        return x
+
+    def use(x):
+        return solve(x, opts=[1, 2])
+    """)
+    assert _rules(f) == ["retrace"]
+    assert "static" in f[0].message
+
+
+def test_retrace_tracer_control_flow_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    @jax.jit
+    def body(x):
+        if x > 0:
+            return x
+        return -x
+
+    @jax.jit
+    def body2(x):
+        return float(x) + 1.0
+    """)
+    assert _rules(f) == ["retrace", "retrace"]
+
+
+def test_retrace_static_tests_clean(tmp_path):
+    f, _ = _lint(tmp_path, """
+    @functools.partial(jax.jit, static_argnames=("cfg",))
+    def body(x, cfg, opt=None):
+        if opt is None:
+            x = x + 1
+        if x.shape[0] > 2:
+            x = x * 2
+        if cfg.flag:
+            x = x - 1
+        return x
+    """)
+    assert f == []
+
+
+# ---------------------------------------------------------------------------
+# host-sync (hot-path scope)
+# ---------------------------------------------------------------------------
+
+def test_hostsync_traced_and_loop_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    @jax.jit
+    def kern(x):
+        return np.asarray(x).sum()
+
+    def sweep(xs):
+        tot = 0.0
+        for x in xs:
+            tot += float(jnp.sum(x))
+        return tot
+    """)
+    assert _rules(f) == ["host-sync", "host-sync"]
+
+
+def test_hostsync_gated_and_cold_path_clean(tmp_path):
+    # the dtrace.active() gate is the blessed telemetry pattern, and a
+    # non-hot module (tools/) is out of scope for the host-loop rule
+    f, _ = _lint(tmp_path, """
+    def sweep(xs, emit):
+        for x in xs:
+            if dtrace.active():
+                emit(float(jnp.sum(x)))
+    """)
+    assert f == []
+    f, _ = _lint(tmp_path, """
+    def sweep(xs):
+        tot = 0.0
+        for x in xs:
+            tot += float(jnp.sum(x))
+        return tot
+    """, relpath="tools/offline.py")
+    assert f == []
+
+
+# ---------------------------------------------------------------------------
+# dtype-promotion (traced bodies in hot modules)
+# ---------------------------------------------------------------------------
+
+def test_dtype_promotion_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    @jax.jit
+    def kern(x):
+        scale = jnp.zeros((4,))
+        return x * scale
+
+    @jax.jit
+    def widen(x):
+        return x.astype(jnp.complex128)
+    """)
+    assert _rules(f) == ["dtype-promotion", "dtype-promotion"]
+
+
+def test_dtype_derivation_and_explicit_clean(tmp_path):
+    f, _ = _lint(tmp_path, """
+    @jax.jit
+    def kern(x):
+        scale = jnp.zeros((4,), x.dtype)
+        cdt = jnp.complex64 if x.dtype == jnp.float32 else jnp.complex128
+        return (x * scale).astype(cdt)
+
+    def host_staging(xs):
+        return jnp.zeros((4,))
+    """)
+    assert f == []
+
+
+# ---------------------------------------------------------------------------
+# cond-cost
+# ---------------------------------------------------------------------------
+
+def test_condcost_inlined_heavy_branch_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def outer(x, w):
+        def heavy():
+            return jnp.einsum("ij,jk->ik", x, w)
+        return jax.lax.cond(x.ndim > 1, lambda: x, heavy)
+    """)
+    assert _rules(f) == ["cond-cost"]
+    assert "einsum" in f[0].message
+
+
+def test_condcost_module_level_branch_clean(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def _mm(x, w):
+        return jnp.einsum("ij,jk->ik", x, w)
+
+    def outer(x, w):
+        def fwd():
+            # forwarding through a module-level priceable boundary
+            return _mm(x, w)
+        return jax.lax.cond(x.ndim > 1, lambda: x, fwd)
+
+    def cheap(x):
+        return jax.lax.cond(x.ndim > 1, lambda: jnp.where(x > 0, x, 0.0),
+                            lambda: x)
+    """)
+    assert f == []
+
+
+# ---------------------------------------------------------------------------
+# suppression syntax
+# ---------------------------------------------------------------------------
+
+def test_suppression_with_reason_silences(tmp_path):
+    f, supp = _lint(tmp_path, """
+    def sweep(xs):
+        tot = 0.0
+        for x in xs:
+            # jaxlint: disable=host-sync -- convergence check needs it
+            tot += float(jnp.sum(x))
+        return tot
+    """)
+    assert f == []
+    assert len(supp) == 1
+    assert supp[0][1] == "convergence check needs it"
+
+
+def test_suppression_without_reason_is_a_finding(tmp_path):
+    f, supp = _lint(tmp_path, """
+    def sweep(xs):
+        tot = 0.0
+        for x in xs:
+            # jaxlint: disable=host-sync
+            tot += float(jnp.sum(x))
+        return tot
+    """)
+    assert "suppression" in _rules(f)
+    # and the reasonless directive does NOT silence the finding
+    assert "host-sync" in _rules(f)
+
+
+def test_suppression_unknown_rule_is_a_finding(tmp_path):
+    f, _ = _lint(tmp_path, """
+    X = 1  # jaxlint: disable=not-a-rule -- whatever
+    """)
+    assert "suppression" in _rules(f)
+
+
+# ---------------------------------------------------------------------------
+# baseline + the --ci gate
+# ---------------------------------------------------------------------------
+
+def test_baseline_in_sync_with_tree():
+    """The committed baseline pins exactly the tree's accepted
+    findings: no NEW finding (the gate must be green at HEAD) and no
+    STALE entry (fixed violations leave the baseline), and every entry
+    carries a written reason."""
+    findings, _, errors = core.run_paths(
+        [os.path.join(REPO, "sagecal_tpu")], root=REPO)
+    assert not errors, errors
+    baseline = core.load_baseline(os.path.join(REPO, core.BASELINE_NAME))
+    new, stale = core.diff_baseline(findings, baseline)
+    assert not new, "unbaselined finding(s):\n" + "\n".join(
+        f.render() for f in new)
+    assert not stale, f"stale baseline entr(ies): {stale}"
+    missing = [e for e in baseline.values() if not e.get("reason")]
+    assert not missing, f"baseline entries without a reason: {missing}"
+
+
+_VIOLATIONS = {
+    "use-after-donate": """
+    def driver(y):
+        x = y * 2
+        out = step(x, y)
+        return out + x
+    """,
+    "retrace": """
+    def runner(x):
+        f = jax.jit(lambda a: a * 2)
+        return f(x)
+    """,
+    "host-sync": """
+    def sweep(xs):
+        tot = 0.0
+        for x in xs:
+            tot += float(jnp.sum(x))
+        return tot
+    """,
+    "dtype-promotion": """
+    @jax.jit
+    def kern(x):
+        return x * jnp.zeros((4,))
+    """,
+    "cond-cost": """
+    def outer(x, w):
+        def heavy():
+            return jnp.einsum("ij,jk->ik", x, w)
+        return jax.lax.cond(x.ndim > 1, lambda: x, heavy)
+    """,
+}
+
+
+def test_ci_gate_green_on_tree():
+    r = subprocess.run(
+        [sys.executable, "-m", "sagecal_tpu.analysis", "--ci"],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_ci_gate_fails_on_injected_violations(tmp_path):
+    """Acceptance: --ci exits non-zero when any checker's fixture
+    violation is injected into the scanned set."""
+    for rule, src in _VIOLATIONS.items():
+        d = tmp_path / rule.replace("-", "_") / "solvers"
+        d.mkdir(parents=True)
+        (d / "bad.py").write_text(_PRELUDE + textwrap.dedent(src))
+        r = subprocess.run(
+            [sys.executable, "-m", "sagecal_tpu.analysis", "--ci",
+             str(d.parent)],
+            cwd=REPO, capture_output=True, text=True)
+        assert r.returncode != 0, (rule, r.stdout, r.stderr)
+        assert rule in r.stdout, (rule, r.stdout)

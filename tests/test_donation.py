@@ -196,3 +196,30 @@ def test_admm_host_loop_donation_bit_identical(problem):
     for name, a, b in zip(("J", "Z", "rho", "res0", "res1", "r1s",
                            "duals", "Y0"), outs[0], outs[1]):
         assert np.array_equal(a, b), name
+
+
+def test_program_log_keeps_no_live_buffers(problem):
+    """jaxlint use-after-donate regression (ANALYSIS.md, PR 4): the
+    sage program log stored the raw args of every logged program;
+    several of those programs DONATE their carries, so the log pinned —
+    and bench's cost accounting later re-read — buffers XLA had
+    already reclaimed. The log must keep shape/dtype skeletons only,
+    and those skeletons must still satisfy the bench contract
+    (program lowers + prices from the stored record)."""
+    args, kw = _sweep_args(problem, int(SolverMode.OSLM_LBFGS))
+    sage.program_stats_reset()
+    try:
+        out = sage._call("em_sweep_probe", sage._jit_em_sweep,
+                         *(a.copy() if isinstance(a, jax.Array) else a
+                           for a in args), **kw)
+        jax.block_until_ready(out[0])
+        jfn, (largs, lkw), n = sage.program_stats()["em_sweep_probe"]
+        assert n == 1
+        for leaf in tuple(largs) + tuple(lkw.values()):
+            assert not isinstance(leaf, (jax.Array, np.ndarray)), (
+                f"live buffer retained in the program log: {leaf!r}")
+        ca = jfn.lower(*largs, **lkw).compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        assert float(ca.get("flops", 0.0)) > 0
+    finally:
+        sage.program_stats_reset()

@@ -259,3 +259,21 @@ def test_normal_equations_generic_for_multichunk():
                             row_period=nbase)
     for x, y in zip(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_lm_solve_zero_retrace(retrace_guard):
+    """Tier-1 retrace gate (runtime complement of jaxlint's static
+    checker): an identically shaped second LM solve must hit the trace
+    cache — zero new compile requests."""
+    x8, coh, sta1, sta2, chunk_id, _ = _toy_problem(N=6, T=4, K=2, seed=5)
+    J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex128), (2, 6, 1, 1))
+    wt = lm_mod.make_weights(jnp.zeros(x8.shape[0], jnp.int32), x8.dtype)
+    solve = jax.jit(lm_mod.lm_solve,
+                    static_argnames=("n_stations", "config",
+                                     "row_period"))
+
+    def thunk():
+        return solve(x8, coh, sta1, sta2, chunk_id, wt, J0, 6,
+                     config=lm_mod.LMConfig(itmax=6))
+
+    retrace_guard(thunk)

@@ -187,3 +187,20 @@ def test_sage_dispatches_rtr_modes():
         J, info = sage.sagefit(Vsum, coh, sta1, sta2, cidx, cmask, J0, 6,
                                wt, config=cfg)
         assert float(info["res_1"]) < float(info["res_0"]), mode
+
+
+def test_rtr_solve_zero_retrace(retrace_guard):
+    """Tier-1 retrace gate: identically shaped RTR solves share one
+    compiled program (zero compile requests on the re-run)."""
+    x8, coh, sta1, sta2, chunk_id, _ = _toy_problem_scalar(N=6, T=4,
+                                                           K=2, seed=7)
+    J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex128), (2, 6, 1, 1))
+    wt = lm_mod.make_weights(jnp.zeros(x8.shape[0], jnp.int32), x8.dtype)
+    solve = jax.jit(rtr_mod.rtr_solve,
+                    static_argnames=("n_stations", "config"))
+
+    def thunk():
+        return solve(x8, coh, sta1, sta2, chunk_id, wt, J0, 6,
+                     config=rtr_mod.RTRConfig(itmax=6))
+
+    retrace_guard(thunk)

@@ -205,3 +205,36 @@ def test_fused_residual_sweep_parity():
     # the two assembly paths differ only by summation order
     np.testing.assert_allclose(outs[(True, 0)][1],
                                outs[(True, tile.nbase)][1], rtol=1e-5)
+
+
+def test_sagefit_host_zero_retrace(retrace_guard):
+    """Tier-1 retrace gate over the host-driven EM path: a second solve
+    of the same shape reuses every per-sweep program (prelude, fused
+    em_sweep, residual) — zero new compile requests. fuse/promote are
+    forced so the execution plan cannot flip between runs."""
+    sky, dsky, Jtrue, tile = _calib_problem(n_stations=6, tilesz=4)
+    coh = rp.coherencies(dsky, jnp.asarray(tile.u), jnp.asarray(tile.v),
+                         jnp.asarray(tile.w), jnp.asarray([tile.freq0]),
+                         tile.fdelta)[:, :, 0]
+    xa = tile.averaged()
+    x8 = jnp.asarray(np.stack([xa.reshape(-1, 4).real,
+                               xa.reshape(-1, 4).imag], -1).reshape(-1, 8))
+    cidx = jnp.asarray(rp.chunk_indices(tile.tilesz, tile.nbase,
+                                        sky.nchunk))
+    kmax = int(sky.nchunk.max())
+    cmask = jnp.asarray(np.arange(kmax)[None, :] < sky.nchunk[:, None])
+    J0 = jnp.asarray(np.tile(np.eye(2, dtype=complex),
+                             (sky.n_clusters, kmax, tile.n_stations,
+                              1, 1)))
+    wt = lm_mod.make_weights(jnp.asarray(tile.flags, jnp.int32),
+                             jnp.float64)
+    cfg = sage.SageConfig(max_emiter=2, max_iter=4, max_lbfgs=0,
+                          solver_mode=int(SolverMode.OSLM_LBFGS),
+                          fuse="on", promote="off")
+
+    def thunk():
+        return sage.sagefit_host(x8, coh, jnp.asarray(tile.sta1),
+                                 jnp.asarray(tile.sta2), cidx, cmask,
+                                 J0, tile.n_stations, wt, config=cfg)
+
+    retrace_guard(thunk)

@@ -1291,12 +1291,127 @@ def config5_admm32(device, dtype):
     return rec
 
 
+def config6_overlap(device, dtype):
+    """Round-8 config: END-TO-END overlapped execution (ISSUE 5) —
+    tiles/sec and device-busy fraction over a >=4-tile config-1-shaped
+    pipeline run, deliberately distinct from configs 1-5's per-step
+    pricing: this one times the WHOLE host loop (io + stage + solve +
+    residual + write) twice at equal trip counts, ``--prefetch 0``
+    (synchronous reference) vs ``--prefetch 1`` (double-buffered tile
+    prefetch + async residual writeback), and refuses to bank unless
+    solutions AND written residuals are bit-identical between the two.
+
+    The Δwall column is ``dwall_pct`` (async vs sync, negative =
+    overlap won); bubble accounting comes from the diag trace
+    (trace.overlap_stats). NO ``bytes_accessed`` here on purpose:
+    ``_bytes_baseline`` must keep reading configs 1-5's traffic from
+    the newest record that prices it.
+    """
+    import tempfile
+    import jax
+    from sagecal_tpu import pipeline as pl
+    from sagecal_tpu.config import RunConfig, SolverMode
+    from sagecal_tpu.diag import trace as dtrace
+    from sagecal_tpu.io import dataset as ds_mod
+
+    # shape choice (measured 2026-08-03 on this host): the overlap can
+    # only win what the host loop stalls on, so the e2e metric runs a
+    # STREAMING-shaped problem — many short solve intervals over a
+    # wide band (12 tiles x tilesz 4 x 16 channels), where the
+    # io+stage+residual-fetch+write share is ~10% of wall. At config
+    # 1's exact shape (4 big tiles, deep solves) the bubble is ~0.6%
+    # and the comparison is pure noise.
+    n_tiles, n_stations, n_clusters, tilesz, nchan = 12, 20, 3, 4, 16
+    sky, dsky, tiles = build_fullbatch(dtype, n_stations, n_clusters,
+                                       tilesz, nchan=nchan,
+                                       n_tiles=n_tiles, seed=SEED + 60)
+    tmpd = tempfile.mkdtemp(prefix="sagecal_overlap_")
+    msdir = os.path.join(tmpd, "sim.ms")
+    ds_mod.SimMS.create(msdir, tiles)
+    cfg = RunConfig(ms=msdir, tile_size=tilesz, max_em_iter=1,
+                    max_iter=4, max_lbfgs=2,
+                    solver_mode=SolverMode.OSLM_LBFGS)
+    ms = ds_mod.SimMS(msdir)
+    noop = (lambda *a: None)
+    pipe = pl.FullBatchPipeline(cfg, ms, sky, log=noop)
+
+    def run(depth, tag, traced=False):
+        tr = os.path.join(tmpd, f"{tag}.jsonl")
+        if traced:
+            dtrace.enable(tr, entry="bench-overlap", prefetch=depth)
+        try:
+            t0 = time.perf_counter()
+            hist = pipe.run(solution_path=os.path.join(
+                tmpd, f"{tag}.solutions"), prefetch=depth, log=noop)
+            wall = time.perf_counter() - t0
+        finally:
+            if traced:
+                dtrace.disable()
+        out = ds_mod.SimMS(msdir, data_column="CORRECTED_DATA")
+        res = [out.read_tile(i).x.copy() for i in range(n_tiles)]
+        return wall, hist, res, tr
+
+    # TWO settling runs: run 1 learns the fuse/promote execution plan,
+    # run 2 compiles the promoted program (the same settle contract as
+    # time_sage) — a single warm run leaves a multi-second compile
+    # inside the first "timed" rep and fabricates a 2.5x overlap win
+    t_w0 = time.perf_counter()
+    run(0, "warm0")
+    run(1, "warm1")
+    comp_wall = time.perf_counter() - t_w0
+    # alternating timed reps, min per mode: wall noise on a shared
+    # 2-core host is ~10%, an order larger than the io+stage+write
+    # bubble the overlap can hide — min-of-3 at EQUAL trip counts is
+    # the comparison the Δwall column banks
+    walls = {0: [], 1: []}
+    outs = {}
+    for rep in range(3):
+        for depth in (0, 1):
+            tag = f"{'sync' if depth == 0 else 'async'}{rep}"
+            wall, hist, res, tr = run(depth, tag, traced=True)
+            walls[depth].append(wall)
+            outs[depth] = (hist, res, tr, tag)
+    (h0, res_sync, tr_sync, tag0) = outs[0]
+    (h1, res_async, tr_async, tag1) = outs[1]
+
+    same = all(np.array_equal(a, b)
+               for a, b in zip(res_sync, res_async))
+    with open(os.path.join(tmpd, f"{tag0}.solutions")) as f0, \
+            open(os.path.join(tmpd, f"{tag1}.solutions")) as f1:
+        same = same and (f0.read() == f1.read())
+    if not same:
+        return {"error": "prefetch=1 outputs NOT bit-identical to the "
+                         "sync reference — overlap contract broken"}
+    st_sync = dtrace.overlap_stats(dtrace.read(tr_sync))
+    st_async = dtrace.overlap_stats(dtrace.read(tr_async))
+    wall_sync = min(walls[0])
+    wall_async = min(walls[1])
+    rec = dict(
+        value=n_tiles / wall_async, unit="tiles/s",
+        res_0=h1[0]["res_0"], res_1=h1[0]["res_1"],
+        step_s=wall_async / n_tiles,
+        compile_s=max(comp_wall - wall_sync - wall_async, 0.0),
+        wall_sync_s=wall_sync, wall_async_s=wall_async,
+        walls_sync=[round(w, 3) for w in walls[0]],
+        walls_async=[round(w, 3) for w in walls[1]],
+        dwall_pct=100.0 * (wall_async - wall_sync) / wall_sync,
+        busy_frac_sync=st_sync["busy_frac"],
+        busy_frac_async=st_async["busy_frac"],
+        bubble_s_sync=st_sync["bubble_s"],
+        bubble_s_async=st_async["bubble_s"],
+        bit_identical=True,
+        shape=f"N={n_stations} M={n_clusters} tilesz={tilesz} "
+              f"F={nchan} x{n_tiles}tiles -j0 e1g4l2 pf1-vs-pf0")
+    return rec
+
+
 CONFIGS = [
     ("1-fullbatch-lm", config1_fullbatch_lm),
     ("2-stochastic-lbfgs", config2_stochastic),
     ("3-rtr-16cluster", config3_rtr16),
     ("4-extended-64sta", config4_extended),
     ("5-admm-32subband", config5_admm32),
+    ("6-overlap-e2e", config6_overlap),
 ]
 
 

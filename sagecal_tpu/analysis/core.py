@@ -48,9 +48,11 @@ RULES = {
 }
 
 # modules whose host loops are hot-path territory for host-sync, and
-# whose traced kernels the dtype lint covers (ISSUE 4 scope)
+# whose traced kernels the dtype lint covers (ISSUE 4 scope; sched.py
+# joined in ISSUE 5 — the overlap layer's thread loops must never grow
+# a per-iteration sync)
 _HOT_SEGMENTS = ("solvers", "consensus", "rime")
-_HOT_BASENAMES = ("pipeline.py",)
+_HOT_BASENAMES = ("pipeline.py", "sched.py")
 
 
 def is_hot_path(relpath: str) -> bool:

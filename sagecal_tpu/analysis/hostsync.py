@@ -1,7 +1,7 @@
 """host-sync leaks: async dispatch dies where a scalar crosses to host.
 
-The hot paths (solvers/, consensus/, rime/, pipeline.py) stay fast by
-keeping the device queue full; one stray ``.item()`` or
+The hot paths (solvers/, consensus/, rime/, pipeline.py, sched.py)
+stay fast by keeping the device queue full; one stray ``.item()`` or
 ``float(jnp...)`` per iteration serializes every dispatch behind it
 (PR 1 measured the per-sweep sync cost when it wired the
 ``dtrace.active()`` gate around the telemetry emits — that gate is the
@@ -12,9 +12,18 @@ blessed pattern and such blocks are exempt here). Two scopes:
   ``jax.device_get``, ``.item()``, ``print`` (runs at trace time, not
   run time), ``jax.block_until_ready``;
 - in hot-path HOST loops, per-iteration syncs not behind the trace
-  gate: ``.item()``, ``jax.device_get``, and ``float(...)``/
-  ``int(...)`` of an expression that mentions ``jnp.`` (a device
-  value by construction).
+  gate: ``.item()``, ``jax.device_get``, ``float(...)``/``int(...)``
+  of an expression that mentions ``jnp.`` (a device value by
+  construction), and ``jax.block_until_ready``/``.block_until_ready()``
+  — a full-queue drain per iteration (deliberate per-sweep timing
+  barriers carry inline suppressions with their why).
+
+BLESSED async-readback API (never a finding anywhere):
+``.copy_to_host_async()`` starts the device->host DMA without
+stalling dispatch — the overlapped-execution pattern
+(sagecal_tpu.sched.start_host_copy): dispatch, start the copy, hand
+the blocking ``np.asarray`` fetch to the ordered writer thread. A
+future broadening of this checker must keep it exempt.
 """
 
 from __future__ import annotations
@@ -29,6 +38,10 @@ _NP_SYNC = ("np.asarray", "np.array", "numpy.asarray", "numpy.array",
             "onp.asarray", "onp.array")
 _DEVICE_GET = ("jax.device_get", "device_get")
 _BLOCK = ("jax.block_until_ready", "block_until_ready")
+# the non-blocking readback: starts the d->h copy and returns — the
+# opposite of a sync; explicitly exempt so attribute-pattern rules
+# (".item"-style) can never grow to catch it
+_ASYNC_OK = ("copy_to_host_async",)
 
 
 def _mentions_jnp(expr) -> bool:
@@ -85,6 +98,18 @@ def _host_loop_syncs(ctx, findings):
             continue
         d = dotted(node.func)
         if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ASYNC_OK):
+            continue                       # blessed: non-blocking copy
+        if (d in _BLOCK or (isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "block_until_ready"
+                            and not node.args)):
+            findings.append(ctx.finding(
+                RULE, node,
+                "block_until_ready in a hot-path host loop — drains "
+                "the whole device queue per iteration; overlap via "
+                "copy_to_host_async + the sched writer thread, or "
+                "suppress with the timing-barrier reason"))
+        elif (isinstance(node.func, ast.Attribute)
                 and node.func.attr == "item" and not node.args):
             findings.append(ctx.finding(
                 RULE, node,

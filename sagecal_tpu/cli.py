@@ -78,6 +78,13 @@ def build_parser() -> argparse.ArgumentParser:
     a("--inflight", type=int, default=1,
       help="clusters solved concurrently per SAGE sweep step (block-"
            "Jacobi groups); 1 = reference Gauss-Seidel sequencing")
+    a("--prefetch", type=int, default=1, metavar="N",
+      help="overlapped execution depth (sagecal_tpu.sched): read + "
+           "host-prepare tile t+N on a background thread while tile t "
+           "solves, residual/solution writes on an ordered writer "
+           "thread (bit-identical outputs; default 1 = double-"
+           "buffered). 0 = fully synchronous reference loop — the "
+           "debugging escape hatch")
     a("--inner", choices=("chol", "cg"), default="chol",
       help="inner linear solver for the damped Gauss-Newton step: "
            "chol = dense [K,8N,8N] assembly + batched Cholesky "
@@ -175,6 +182,7 @@ def config_from_args(args) -> RunConfig:
         solve_promote=args.solve_promote,
         cluster_inflight=args.inflight,
         solver_inner=args.inner,
+        prefetch=args.prefetch,
         shard_baselines=bool(args.shard_baselines))
 
 

@@ -126,6 +126,12 @@ def build_parser() -> argparse.ArgumentParser:
     a("--host-loop", action="store_true",
       help="one device execution per ADMM iteration instead of a fully "
            "traced n_admm-iteration program")
+    a("--prefetch", type=int, default=1, metavar="N",
+      help="overlapped execution depth (sagecal_tpu.sched): all "
+           "subbands of interval t+N are read on a background thread "
+           "while interval t solves; residual/solution writes run on "
+           "an ordered writer thread (bit-identical outputs). 0 = "
+           "fully synchronous loop — the debugging escape hatch")
     a("--diag", default=None, metavar="PATH",
       help="write a JSONL diagnostic trace (phase timers, per-ADMM-"
            "iteration convergence records, staging bytes-accounting; "
@@ -520,182 +526,217 @@ def _main_consensus(args, dtrace) -> int:
                 interval_min, n, sky.n_clusters, sky.n_eff_clusters)
             for m in mss]
 
-    for ti in range(start, stop):
-        tiles = [m.read_tile(ti) for m in mss]
-        # shared staging decision (VisTile.solve_input): per-channel
-        # packing when cflags exist, plain mean else; uv-cut rows (flag 2)
-        # stay excluded from the solve; the downweight ratio is the GOOD
-        # fraction (sagecal_slave.cpp:513)
-        x8_l, wt_l, fr_l = [], [], []
-        uvcut_on = args.uvmin > 0.0 or args.uvmax < 1e9
-        orig_flags = [t.flags for t in tiles]
-        for t in tiles:
+    # overlapped execution (sagecal_tpu.sched): read all subbands of
+    # interval t+N on a background thread while interval t solves, and
+    # drain residual/solution writes on an ordered writer thread;
+    # --prefetch 0 is the synchronous escape hatch. Bit-identical: the
+    # warm-start chain (J0 carry) stays sequential, only data movement
+    # overlaps.
+    from sagecal_tpu import sched
+
+    pf_depth = max(0, int(getattr(args, "prefetch", 1)))
+    aw = sched.AsyncWriter(enabled=pf_depth > 0)
+    source = sched.Prefetcher(
+        lambda i: [m.read_tile(start + i) for m in mss],
+        stop - start, depth=pf_depth)
+
+    try:
+        for _i, tiles, io_wait in source:
+            ti = start + _i
+            aw.check()      # async write failure -> fail at this boundary
+            dtrace.emit("phase", name="io", tile=ti, dur_s=io_wait)
+            # shared staging decision (VisTile.solve_input): per-channel
+            # packing when cflags exist, plain mean else; uv-cut rows (flag 2)
+            # stay excluded from the solve; the downweight ratio is the GOOD
+            # fraction (sagecal_slave.cpp:513)
+            x8_l, wt_l, fr_l = [], [], []
+            uvcut_on = args.uvmin > 0.0 or args.uvmax < 1e9
+            orig_flags = [t.flags for t in tiles]
+            for t in tiles:
+                if uvcut_on:
+                    # uv-window rows -> flag 2: subtracted, excluded from
+                    # the solve (predict.c:876 rule, as in the single-node
+                    # pipeline). Solve-scoped only: the original flags are
+                    # restored before write-back so the cut is never baked
+                    # into the stored dataset.
+                    t.flags = rp.apply_uvcut(t.flags, t,
+                                             args.uvmin, args.uvmax)
+                x8_t, flags_t, good = t.solve_input()
+                fr_l.append(good)
+                if args.whiten:
+                    from sagecal_tpu.solvers import robust as rb
+                    x8_t = np.asarray(rb.whiten_data(
+                        jnp.asarray(x8_t, rdt), jnp.asarray(t.u, rdt),
+                        jnp.asarray(t.v, rdt), t.freq0))
+                x8_l.append(x8_t)
+                wt_l.append(np.asarray(lm_mod.make_weights(
+                    jnp.asarray(flags_t, jnp.int32), rdt)))
             if uvcut_on:
-                # uv-window rows -> flag 2: subtracted, excluded from
-                # the solve (predict.c:876 rule, as in the single-node
-                # pipeline). Solve-scoped only: the original flags are
-                # restored before write-back so the cut is never baked
-                # into the stored dataset.
-                t.flags = rp.apply_uvcut(t.flags, t,
-                                         args.uvmin, args.uvmax)
-            x8_t, flags_t, good = t.solve_input()
-            fr_l.append(good)
-            if args.whiten:
-                from sagecal_tpu.solvers import robust as rb
-                x8_t = np.asarray(rb.whiten_data(
-                    jnp.asarray(x8_t, rdt), jnp.asarray(t.u, rdt),
-                    jnp.asarray(t.v, rdt), t.freq0))
-            x8_l.append(x8_t)
-            wt_l.append(np.asarray(lm_mod.make_weights(
-                jnp.asarray(flags_t, jnp.int32), rdt)))
-        if uvcut_on:
-            for t, fl in zip(tiles, orig_flags):
-                t.flags = fl
-        x8F = np.stack(x8_l)
-        uF = np.stack([t.u for t in tiles])
-        vF = np.stack([t.v for t in tiles])
-        wF = np.stack([t.w for t in tiles])
-        wtF = np.stack(wt_l)
-        # rho scaled by unflagged fraction (master :646-650)
-        fratioF = np.array(fr_l)
+                for t, fl in zip(tiles, orig_flags):
+                    t.flags = fl
+            x8F = np.stack(x8_l)
+            uF = np.stack([t.u for t in tiles])
+            vF = np.stack([t.v for t in tiles])
+            wF = np.stack([t.w for t in tiles])
+            wtF = np.stack(wt_l)
+            # rho scaled by unflagged fraction (master :646-650)
+            fratioF = np.array(fr_l)
 
-        padded, _, _ = cadmm.pad_subbands(
-            (x8F, uF, vF, wF, freqs, wtF, fratioF, J0), Bpoly, nf, ndev)
-        args_dev = [stage(np.asarray(a, np.dtype(rdt))) for a in padded]
-        if dtrace.active():
-            dtrace.emit("stage_bytes", what="tile_inputs", tile=ti,
-                        bytes=int(sum(np.asarray(a).size for a in padded)
-                                  * np.dtype(rdt).itemsize))
-        gmstF = None
-        if dobeam:
-            # only the per-tile gmst time track crosses host->device
-            # here; the static tables were staged once before the loop
-            gmstF = np.stack(
-                [np.asarray(_coords.jd2gmst_np(t.time_jd))
-                 for t in tiles]).astype(np.dtype(rdt))
-            if fpad > nf:   # padded mesh slots reuse subband 0's track
-                gmstF = np.concatenate(
-                    [gmstF, np.repeat(gmstF[:1], fpad - nf, axis=0)])
-            args_dev.append(beam_static_dev._replace(gmst=stage(gmstF)))
-            dtrace.emit("stage_bytes", what="beam_gmst", tile=ti,
-                        bytes=int(gmstF.nbytes))
-        if blk_timer is not None:
-            blk_timer.clear()
-        JF_r8, Z, rhoF, res0, res1, r1s, duals, Y0F = runner(*args_dev)
-        if blk_timer is not None and is_writer:
-            # per-ADMM-iteration wall-clock from the blocked runner's
-            # per-execution telemetry (solve blocks + consensus); the
-            # first tile's numbers include compilation
-            nblk = -(-fpad // args.block_f)
-            times = [t for _, t in blk_timer]
-            per_iter = [sum(times[i * (nblk + 1):(i + 1) * (nblk + 1)])
-                        for i in range(cfg.n_admm)]
-            print("ADMM wall-clock/iter: "
-                  + " ".join(f"{t:.2f}s" for t in per_iter)
-                  + f" (blocks of {args.block_f} subbands, "
-                  f"{nblk} solve executions + 1 consensus each)")
-        # slice padded subband rows off every per-subband output
-        JF_r8 = fetch(JF_r8)[:nf]
-        JF_r8_5 = np.asarray(JF_r8).reshape(nf, sky.n_clusters, kmax, n, 8)
-        if worker_writers:
-            J_all = utils.jones_r2c_np(JF_r8_5)
-            for f, ww in enumerate(worker_writers):
-                ww.write_interval(J_all[f], sky.nchunk)
-        Z = fetch(Z)
-        res0, res1 = fetch(res0)[:nf], fetch(res1)[:nf]
-        r1s = fetch(r1s)[:, :nf]
-        duals = fetch(duals)
-        Y0F = fetch(Y0F)[:nf]
-
-        if args.mdl and ti == start and is_writer:
-            # model-order report from iteration-0 rho*J (master :815-822)
-            from sagecal_tpu.consensus import mdl as mdlmod
-            res = mdlmod.minimum_description_length(
-                np.asarray(Y0F), np.broadcast_to(
-                    np.asarray(rho0, float), (sky.n_clusters,)),
-                freqs, float(freqs.mean()), weight=fratioF,
-                polytype=args.polytype, kstart=1, kfinish=args.npoly)
-            mdlmod.report(res)
-
-        res0 = np.asarray(res0)
-        res1 = np.asarray(r1s)[-1] if cfg.n_admm > 1 else np.asarray(res1)
-        duals = np.asarray(duals)
-
-        if dtrace.active():
-            # per-ADMM-iteration convergence records from the fetched
-            # telemetry. The host-loop and blocked runners already emit
-            # live per-iteration records (admm.py), so only the fully
-            # traced mesh program needs the post-hoc emission.
-            if not args.host_loop and not args.block_f:
-                for k in range(np.asarray(r1s).shape[0]):
-                    dtrace.emit(
-                        "admm_iter", interval=ti, iter=k + 1,
-                        r1_mean=float(np.asarray(r1s)[k].mean()),
-                        dual=float(duals[k]) if len(duals) else 0.0)
-            # interval summary with the consensus primal residual
-            # ||J - BZ|| (the reference master's convergence axis)
-            BZf = np.einsum("fp,mpknr->fmknr", Bpoly, np.asarray(Z))
-            primal = float(
-                np.linalg.norm(JF_r8_5 - BZf) / np.sqrt(BZf.size))
-            dtrace.emit("tile", tile=ti, res_0=float(res0.mean()),
-                        res_1=float(res1.mean()), primal=primal,
-                        rho_mean=float(np.asarray(fetch(rhoF))[:nf]
-                                       .mean()))
-
-        # warm-start the next interval; per-subband divergence reset
-        # (slave :680-683 res_ratio check; fullbatch warm-start analogue)
-        J_new = np.asarray(JF_r8)
-        bad = (~np.isfinite(res1)) | (res1 == 0.0) | (res1 > 5.0 * res0)
-        for f in range(nf):
-            J0[f] = Jinit[f] if bad[f] else J_new[f]
-            if bad[f] and is_writer:
-                print(f"  subband {f}: diverged; Resetting Solution")
-        if is_writer:
-            print(f"Timeslot:{ti} ADMM:{cfg.n_admm} residual "
-                  f"initial={res0.mean():.6g} final={res1.mean():.6g} "
-                  f"dual={duals[-1] if len(duals) else 0:.3g}")
-            if args.verbose:
-                for f in range(nf):
-                    print(f"  subband {f}: {res0[f]:.6g} -> {res1[f]:.6g}")
-
-        # residuals + write back (slave :832-869); multi-host: process 0
-        # owns all outputs (shared-filesystem assumption, like the
-        # reference's slaves-glob-the-same-paths setup)
-        if is_writer:
-            if args.use_global_solution:
-                # evaluate BZ at each subband: smooth consensus solutions
-                BZ = np.einsum("fp,mpknr->fmknr", Bpoly, np.asarray(Z))
-                J_res = BZ.reshape(nf, sky.n_clusters, kmax, n, 8)
-            else:
-                J_res = JF_r8_5
-            xF_r = np.stack([utils.c2r(t.x) for t in tiles])
-            bargs = ()
+            padded, _, _ = cadmm.pad_subbands(
+                (x8F, uF, vF, wF, freqs, wtF, fratioF, J0), Bpoly, nf, ndev)
+            args_dev = [stage(np.asarray(a, np.dtype(rdt))) for a in padded]
+            if dtrace.active():
+                dtrace.emit("stage_bytes", what="tile_inputs", tile=ti,
+                            bytes=int(sum(np.asarray(a).size for a in padded)
+                                      * np.dtype(rdt).itemsize))
+            gmstF = None
             if dobeam:
-                # residual beam: the UNPADDED nf subbands with this
-                # tile's gmst track
-                bargs = (jax.tree.map(
-                    lambda a: jnp.asarray(a),
-                    beamF_static._replace(gmst=gmstF[:nf])),)
-            res_r = res_jit(jnp.asarray(J_res, rdt), jnp.asarray(xF_r, rdt),
-                            jnp.asarray(uF, rdt), jnp.asarray(vF, rdt),
-                            jnp.asarray(wF, rdt), jnp.asarray(freqs, rdt),
-                            *bargs)
-            res_np = utils.r2c(np.asarray(res_r))
-            for f, (msx, t) in enumerate(zip(mss, tiles)):
-                t.x = res_np[f].astype(np.complex128)
-                msx.write_tile(ti, t)
+                # only the per-tile gmst time track crosses host->device
+                # here; the static tables were staged once before the loop
+                gmstF = np.stack(
+                    [np.asarray(_coords.jd2gmst_np(t.time_jd))
+                     for t in tiles]).astype(np.dtype(rdt))
+                if fpad > nf:   # padded mesh slots reuse subband 0's track
+                    gmstF = np.concatenate(
+                        [gmstF, np.repeat(gmstF[:1], fpad - nf, axis=0)])
+                args_dev.append(beam_static_dev._replace(gmst=stage(gmstF)))
+                dtrace.emit("stage_bytes", what="beam_gmst", tile=ti,
+                            bytes=int(gmstF.nbytes))
+            if blk_timer is not None:
+                blk_timer.clear()
+            JF_r8, Z, rhoF, res0, res1, r1s, duals, Y0F = runner(*args_dev)
+            if blk_timer is not None and is_writer:
+                # per-ADMM-iteration wall-clock from the blocked runner's
+                # per-execution telemetry (solve blocks + consensus); the
+                # first tile's numbers include compilation
+                nblk = -(-fpad // args.block_f)
+                times = [t for _, t in blk_timer]
+                per_iter = [sum(times[i * (nblk + 1):(i + 1) * (nblk + 1)])
+                            for i in range(cfg.n_admm)]
+                print("ADMM wall-clock/iter: "
+                      + " ".join(f"{t:.2f}s" for t in per_iter)
+                      + f" (blocks of {args.block_f} subbands, "
+                      f"{nblk} solve executions + 1 consensus each)")
+            # slice padded subband rows off every per-subband output
+            JF_r8 = fetch(JF_r8)[:nf]
+            JF_r8_5 = np.asarray(JF_r8).reshape(nf, sky.n_clusters, kmax, n, 8)
+            if worker_writers:
+                J_all = utils.jones_r2c_np(JF_r8_5)
 
-        if spatial_file is not None:
-            write_spatial_model(np.asarray(Z))
-        if writer:
-            # Z coefficient columns: [M, P, K, N, 8] -> Jones-like blocks
-            Zr = np.asarray(Z)
-            Zj = utils.jones_r2c_np(
-                Zr.transpose(0, 2, 1, 3, 4).reshape(
-                    sky.n_clusters, kmax * args.npoly, n, 8))
-            nchunk_poly = sky.nchunk * args.npoly
-            writer.write_interval(Zj, nchunk_poly)
+                def _write_workers(J_all=J_all):
+                    for f, ww in enumerate(worker_writers):
+                        ww.write_interval(J_all[f], sky.nchunk)
+                aw.submit(_write_workers)
+            Z = fetch(Z)
+            res0, res1 = fetch(res0)[:nf], fetch(res1)[:nf]
+            r1s = fetch(r1s)[:, :nf]
+            duals = fetch(duals)
+            Y0F = fetch(Y0F)[:nf]
 
+            if args.mdl and ti == start and is_writer:
+                # model-order report from iteration-0 rho*J (master :815-822)
+                from sagecal_tpu.consensus import mdl as mdlmod
+                res = mdlmod.minimum_description_length(
+                    np.asarray(Y0F), np.broadcast_to(
+                        np.asarray(rho0, float), (sky.n_clusters,)),
+                    freqs, float(freqs.mean()), weight=fratioF,
+                    polytype=args.polytype, kstart=1, kfinish=args.npoly)
+                mdlmod.report(res)
+
+            res0 = np.asarray(res0)
+            res1 = np.asarray(r1s)[-1] if cfg.n_admm > 1 else np.asarray(res1)
+            duals = np.asarray(duals)
+
+            if dtrace.active():
+                # per-ADMM-iteration convergence records from the fetched
+                # telemetry. The host-loop and blocked runners already emit
+                # live per-iteration records (admm.py), so only the fully
+                # traced mesh program needs the post-hoc emission.
+                if not args.host_loop and not args.block_f:
+                    for k in range(np.asarray(r1s).shape[0]):
+                        dtrace.emit(
+                            "admm_iter", interval=ti, iter=k + 1,
+                            r1_mean=float(np.asarray(r1s)[k].mean()),
+                            dual=float(duals[k]) if len(duals) else 0.0)
+                # interval summary with the consensus primal residual
+                # ||J - BZ|| (the reference master's convergence axis)
+                BZf = np.einsum("fp,mpknr->fmknr", Bpoly, np.asarray(Z))
+                primal = float(
+                    np.linalg.norm(JF_r8_5 - BZf) / np.sqrt(BZf.size))
+                dtrace.emit("tile", tile=ti, res_0=float(res0.mean()),
+                            res_1=float(res1.mean()), primal=primal,
+                            rho_mean=float(np.asarray(fetch(rhoF))[:nf]
+                                           .mean()))
+
+            # warm-start the next interval; per-subband divergence reset
+            # (slave :680-683 res_ratio check; fullbatch warm-start analogue)
+            J_new = np.asarray(JF_r8)
+            bad = (~np.isfinite(res1)) | (res1 == 0.0) | (res1 > 5.0 * res0)
+            for f in range(nf):
+                J0[f] = Jinit[f] if bad[f] else J_new[f]
+                if bad[f] and is_writer:
+                    print(f"  subband {f}: diverged; Resetting Solution")
+            if is_writer:
+                print(f"Timeslot:{ti} ADMM:{cfg.n_admm} residual "
+                      f"initial={res0.mean():.6g} final={res1.mean():.6g} "
+                      f"dual={duals[-1] if len(duals) else 0:.3g}")
+                if args.verbose:
+                    for f in range(nf):
+                        print(f"  subband {f}: {res0[f]:.6g} -> {res1[f]:.6g}")
+
+            # residuals + write back (slave :832-869); multi-host: process 0
+            # owns all outputs (shared-filesystem assumption, like the
+            # reference's slaves-glob-the-same-paths setup)
+            if is_writer:
+                if args.use_global_solution:
+                    # evaluate BZ at each subband: smooth consensus solutions
+                    BZ = np.einsum("fp,mpknr->fmknr", Bpoly, np.asarray(Z))
+                    J_res = BZ.reshape(nf, sky.n_clusters, kmax, n, 8)
+                else:
+                    J_res = JF_r8_5
+                xF_r = np.stack([utils.c2r(t.x) for t in tiles])
+                bargs = ()
+                if dobeam:
+                    # residual beam: the UNPADDED nf subbands with this
+                    # tile's gmst track
+                    bargs = (jax.tree.map(
+                        lambda a: jnp.asarray(a),
+                        beamF_static._replace(gmst=gmstF[:nf])),)
+                res_r = res_jit(jnp.asarray(J_res, rdt), jnp.asarray(xF_r, rdt),
+                                jnp.asarray(uF, rdt), jnp.asarray(vF, rdt),
+                                jnp.asarray(wF, rdt), jnp.asarray(freqs, rdt),
+                                *bargs)
+
+                def _write_res(ti=ti, tiles=tiles, res_r=res_r):
+                    with dtrace.phase("write", tile=ti, bg=pf_depth > 0):
+                        res_np = utils.r2c(np.asarray(res_r))
+                        for f, (msx, t) in enumerate(zip(mss, tiles)):
+                            t.x = res_np[f].astype(np.complex128)
+                            msx.write_tile(ti, t)
+                # non-blocking d->h copy now; fetch + per-subband write on
+                # the ordered writer thread
+                sched.start_host_copy(res_r)
+                aw.submit(_write_res)
+
+            if spatial_file is not None:
+                write_spatial_model(np.asarray(Z))
+            if writer:
+                # Z coefficient columns: [M, P, K, N, 8] -> Jones-like blocks
+                Zr = np.asarray(Z)
+                Zj = utils.jones_r2c_np(
+                    Zr.transpose(0, 2, 1, 3, 4).reshape(
+                        sky.n_clusters, kmax * args.npoly, n, 8))
+                nchunk_poly = sky.nchunk * args.npoly
+                aw.submit(writer.write_interval, Zj, nchunk_poly)
+
+    finally:
+        # a mid-loop failure (solver error, reader-thread or async
+        # writer exception) must still cancel the prefetch thread and
+        # drain/raise the ordered write queue — otherwise completed
+        # intervals' queued writes are silently dropped, diverging
+        # from the --prefetch 0 inline-write behavior
+        source.close()
+        aw.close()
     if writer:
         writer.close()
     if spatial_file is not None:

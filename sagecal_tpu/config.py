@@ -153,6 +153,13 @@ class RunConfig:
     # [K, 8N, 8N] assembly (bit-reference), "cg" matrix-free
     # preconditioned Krylov — see MIGRATION.md "Inner linear solver"
     solver_inner: str = "chol"
+    # --prefetch : overlapped execution depth (sagecal_tpu.sched).
+    # N>0: tile t+N is read + host-prepared on a background thread
+    # while tile t solves, and residual/solution writes run on an
+    # ordered writer thread (bit-identical outputs; memory cost = N
+    # extra staged tiles). 0: the fully synchronous reference loop —
+    # the debugging escape hatch (MIGRATION.md "Overlapped execution")
+    prefetch: int = 1
 
     # --- observability
     profile_dir: str | None = None     # --profile : jax.profiler trace of

@@ -136,6 +136,24 @@ def manifold_average_mesh(Y_r8, axis_name, nf_total: int, m: int,
     return _unblocks(Xout, m, k, n)
 
 
+def _emit_deferred(pend, interval):
+    """Emit the host loop's collected per-iteration admm_iter records
+    in ONE batched device->host fetch AFTER the loop (overlap-
+    preserving: tracing never serializes the ADMM dispatch chain
+    behind per-iteration float() syncs). ``pend``: (iter, r1_mean,
+    dual|None, rho_mean) device scalars, copies started async."""
+    if not pend:
+        return
+    from sagecal_tpu import sched as _sched
+    _sched.start_host_copy(*[x for rec in pend for x in rec[1:]
+                             if x is not None])
+    for it, r1m, dual, rhom in pend:
+        dtrace.emit("admm_iter", interval=interval, iter=it,
+                    r1_mean=float(np.asarray(r1m)),
+                    dual=0.0 if dual is None else float(np.asarray(dual)),
+                    rho_mean=float(np.asarray(rhom)), deferred=True)
+
+
 def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                      fdelta: float, B_poly: np.ndarray, cfg: ADMMConfig,
                      mesh: Mesh, nf_total: int, with_shapelets: bool = False,
@@ -545,12 +563,13 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
                     *beam_rest)
         _t("iter0", t0, out[0])
         carry, (res0, res1, Y0F) = out[:9], out[9:]
+        # per-iteration convergence records are DEFERRED: the means are
+        # dispatched on device here (gated, cheap) and fetched in ONE
+        # batched transfer after the loop, so tracing never inserts a
+        # per-iteration host sync into the ADMM chain
+        pend = []
         if dtrace.active():
-            # per-iteration convergence records; the float() syncs are
-            # behind the gate so untraced runs keep async dispatch
-            dtrace.emit("admm_iter", interval=interval, iter=0,
-                        r1_mean=float(jnp.mean(res1)),
-                        dual=0.0, rho_mean=float(jnp.mean(carry[3])))
+            pend.append((0, jnp.mean(res1), None, jnp.mean(carry[3])))
         r1s, duals = [], []
         for it in range(1, max(cfg.n_admm, 1)):
             t0 = _time.perf_counter()
@@ -561,10 +580,9 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
             r1s.append(r1)
             duals.append(dual)
             if dtrace.active():
-                dtrace.emit("admm_iter", interval=interval, iter=it,
-                            r1_mean=float(jnp.mean(r1)),
-                            dual=float(dual),
-                            rho_mean=float(jnp.mean(carry[3])))
+                pend.append((it, jnp.mean(r1), dual,
+                             jnp.mean(carry[3])))
+        _emit_deferred(pend, interval)
         JF, Z, rhoF = carry[0], carry[2], carry[3]
         F = x8F.shape[0]
         r1s_a = (jnp.stack(r1s) if r1s
@@ -700,6 +718,7 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
         carry, res0, res1, Y0F = cons0(JF, res0, res1, fratioF)
         _t("cons0", t0, carry[2])
         r1h, dualh = [], []
+        pend = []       # deferred admm_iter records (no per-iter sync)
         for it in range(1, max(cfg.n_admm, 1)):
             BZ = bz_prog(carry[2], Brow_full)
             Jr, r0, r1 = blockwise(solveb_re, carry[0], carry[1], BZ,
@@ -711,10 +730,9 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
             r1h.append(r1)
             dualh.append(dual)
             if dtrace.active():
-                dtrace.emit("admm_iter", interval=interval, iter=it,
-                            r1_mean=float(jnp.mean(r1)),
-                            dual=float(dual),
-                            rho_mean=float(jnp.mean(carry[3])))
+                pend.append((it, jnp.mean(r1), dual,
+                             jnp.mean(carry[3])))
+        _emit_deferred(pend, interval)
         JF, Z, rhoF = carry[0], carry[2], carry[3]
         r1s_a = (jnp.stack(r1h) if r1h
                  else jnp.zeros((0, F), x8F.dtype))

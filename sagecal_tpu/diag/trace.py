@@ -20,16 +20,28 @@ event            meaning / required extra fields
 ===============  ============================================================
 ``run_start``    first record; run metadata (argv, entry point)
 ``phase``        a timed host phase: ``name`` (io/stage/solve/residual/
-                 write/consensus), ``dur_s``; optional ``tile``
+                 write/read/consensus), ``dur_s``; optional ``tile``,
+                 ``bg`` (True when the phase ran on a background
+                 prefetch/writeback thread — under overlapped
+                 execution the "io" phase records the host's WAIT for
+                 the next tile, the bubble, while the thread's own
+                 read/stage time carries ``bg``)
 ``em_sweep``     one SAGE EM sweep (solvers/sage.py host driver):
                  ``sweep``, ``wall_s``, ``fused``, ``err_reduction``,
                  ``solver_iters`` (cumulative executed inner trips)
 ``tile``         one solve interval's convergence summary (pipeline.py /
                  cli_mpi.py): ``tile``, ``res_0``, ``res_1``; optional
                  ``mean_nu``, ``solver_iters``, ``lbfgs_iters``,
-                 ``minutes``, ``primal``, ``rho_mean``
+                 ``minutes``, ``primal``, ``rho_mean``, and the
+                 overlap accounting pair ``bubble_s`` (host seconds
+                 blocked on data movement for this tile: io wait +
+                 write wait/backpressure) / ``overlap`` (the prefetch
+                 depth; 0 = synchronous reference loop)
 ``admm_iter``    one consensus-ADMM iteration: ``iter``, ``r1_mean``,
-                 ``dual``; optional ``interval``, ``rho_mean``, ``primal``
+                 ``dual``; optional ``interval``, ``rho_mean``,
+                 ``primal``, ``deferred`` (True when the record was
+                 emitted in one batched fetch AFTER the host loop —
+                 the overlap-preserving path: no per-iteration sync)
 ``minibatch``    one stochastic minibatch solve: ``epoch``, ``minibatch``,
                  ``res_0``, ``res_1``; optional ``admm``, ``iters``
 ``stage_bytes``  host->device staging accounting: ``bytes``, ``what``;
@@ -45,6 +57,7 @@ so the disabled path never forces a device sync).
 from __future__ import annotations
 
 import json
+import threading
 import time
 
 # record fields guaranteed on every line (the schema tests key on this)
@@ -59,6 +72,11 @@ class Tracer:
     def __init__(self, path, **run_meta):
         self.path = path
         self._f = open(path, "a", buffering=1)   # line-buffered
+        # overlapped execution (sagecal_tpu.sched) emits from the
+        # prefetch and writer threads concurrently with the main loop;
+        # TextIOWrapper.write is not thread-safe, so one lock keeps
+        # every JSONL line atomic
+        self._lock = threading.Lock()
         self._t0 = time.time()
         self.emit("run_start", **run_meta)
 
@@ -66,14 +84,16 @@ class Tracer:
         rec = {"t": time.time(), "ev": ev}
         rec.update(fields)
         try:
-            self._f.write(json.dumps(rec) + "\n")
+            line = json.dumps(rec) + "\n"
         except (TypeError, ValueError):
             # a non-serializable field must not kill a calibration run;
             # keep the record with offenders stringified
             rec = {k: (v if isinstance(v, (int, float, str, bool,
                                            type(None))) else repr(v))
                    for k, v in rec.items()}
-            self._f.write(json.dumps(rec) + "\n")
+            line = json.dumps(rec) + "\n"
+        with self._lock:
+            self._f.write(line)
 
     def phase(self, name: str, **fields):
         return _Phase(self, name, fields)
@@ -158,6 +178,48 @@ def phase(name: str, **fields):
     if _TRACER is None:
         return _NULL_PHASE
     return _TRACER.phase(name, **fields)
+
+
+def overlap_stats(recs: list) -> dict:
+    """Pipeline-bubble accounting over one run's records.
+
+    Classifies host wall-clock into device-driving time (solve +
+    residual dispatch phases) vs bubble (host blocked on data
+    movement): per-tile ``bubble_s`` when the tile records carry the
+    overlap fields, else the synchronous attribution io + write +
+    residual phase sums. Background (``bg``) phase records are the
+    prefetch/writeback threads' own time and never count as bubble.
+
+    Returns ``{"tiles", "wall_s", "busy_s", "bubble_s", "busy_frac",
+    "bubble_frac", "overlap"}`` — fractions are of ``wall_s`` (run_end
+    when present, else the record time span).
+    """
+    tiles = [r for r in recs if r.get("ev") == "tile"]
+    phases = [r for r in recs if r.get("ev") == "phase"
+              and not r.get("bg")]
+    wall = None
+    for r in recs:
+        if r.get("ev") == "run_end" and "wall_s" in r:
+            wall = float(r["wall_s"])
+    if wall is None and recs:
+        wall = float(recs[-1]["t"]) - float(recs[0]["t"])
+    busy = sum(r.get("dur_s", 0.0) for r in phases
+               if r.get("name") in ("solve", "residual"))
+    overlap = max([int(r.get("overlap", 0)) for r in tiles], default=0)
+    if any("bubble_s" in r for r in tiles):
+        bubble = sum(float(r.get("bubble_s", 0.0)) for r in tiles)
+    else:
+        # sync attribution: io (inline read) + write (blocking fetch +
+        # disk) are the host's data-movement stalls
+        bubble = sum(r.get("dur_s", 0.0) for r in phases
+                     if r.get("name") in ("io", "write"))
+    wall = wall or 0.0
+    return {
+        "tiles": len(tiles), "wall_s": wall, "busy_s": busy,
+        "bubble_s": bubble, "overlap": overlap,
+        "busy_frac": (busy / wall) if wall else 0.0,
+        "bubble_frac": (bubble / wall) if wall else 0.0,
+    }
 
 
 def read(path) -> list:

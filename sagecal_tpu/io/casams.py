@@ -93,8 +93,15 @@ class CasaMS:
                  data_column: str = "DATA",
                  out_column: str = "CORRECTED_DATA",
                  tables_mod=None):
+        import threading
         self._ct = tables_mod or _tables()
         self.path = path
+        # overlapped execution (sagecal_tpu.sched) reads tile t+N on
+        # a prefetch thread while the writer thread writes tile t;
+        # python-casacore table objects are NOT thread-safe, so all
+        # column access on this MS serializes through one lock
+        # (SimMS needs none: per-tile npz files, distinct paths)
+        self._io_lock = threading.Lock()
         self._t = self._ct.table(path, readonly=False, ack=False)
         self._ts = self._t.sort("TIME,ANTENNA1,ANTENNA2")
         self.data_column = data_column
@@ -215,6 +222,10 @@ class CasaMS:
         return np.asarray(self._ts.getcol("DATA_DESC_ID", r0, nr))
 
     def read_tile(self, i: int) -> VisTile:
+        with self._io_lock:
+            return self._read_tile_locked(i)
+
+    def _read_tile_locked(self, i: int) -> VisTile:
         m = self.meta
         r0, nr, slot0, nslots = self._tile_rows(i)
         nbase, F = m["nbase"], len(m["freqs"])
@@ -274,7 +285,12 @@ class CasaMS:
 
     def write_tile(self, i: int, tile: VisTile) -> None:
         """Write tile.x (residuals, [B, F, 2, 2]) to the output column at
-        the rows present in the MS (writeData :1280-1299)."""
+        the rows present in the MS (writeData :1280-1299). Serialized
+        against concurrent prefetch reads (see __init__'s lock)."""
+        with self._io_lock:
+            self._write_tile_locked(i, tile)
+
+    def _write_tile_locked(self, i: int, tile: VisTile) -> None:
         r0, nr, slot0, _ = self._tile_rows(i)
         a1 = np.asarray(self._ts.getcol("ANTENNA1", r0, nr))
         a2 = np.asarray(self._ts.getcol("ANTENNA2", r0, nr))

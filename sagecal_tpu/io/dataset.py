@@ -565,50 +565,12 @@ def _tiles_prefetch_impl(dataset, depth: int = 2):
     """Tile iterator with background read-ahead: the host overlaps
     disk I/O with the device solve of the previous tile (the
     streaming analogue of the reference's synchronous per-tile MSIter
-    loop; SURVEY.md section 5 'host streaming')."""
-    import queue
-    import threading
+    loop; SURVEY.md section 5 'host streaming'). ``depth <= 0`` reads
+    inline (the synchronous reference path). Built on
+    :class:`sagecal_tpu.sched.Prefetcher`, which also propagates
+    reader-thread exceptions with their original traceback."""
+    from sagecal_tpu import sched
 
-    q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
-    stop = object()
-    cancel = threading.Event()
-
-    def _put(item) -> bool:
-        while not cancel.is_set():
-            try:
-                q.put(item, timeout=0.2)
-                return True
-            except queue.Full:
-                continue
-        return False
-
-    def reader():
-        try:
-            for i in range(dataset.n_tiles):
-                if cancel.is_set():
-                    return
-                if not _put((i, dataset.read_tile(i))):
-                    return
-        except Exception as e:          # surface in the consumer
-            _put((stop, e))
-            return
-        _put((stop, None))
-
-    th = threading.Thread(target=reader, daemon=True)
-    th.start()
-    try:
-        while True:
-            item = q.get()
-            if item[0] is stop:
-                if item[1] is not None:
-                    raise item[1]
-                break
-            yield item
-    finally:
-        cancel.set()
-        while not q.empty():            # unblock a full queue
-            try:
-                q.get_nowait()
-            except queue.Empty:
-                break
-        th.join(timeout=5.0)
+    for i, tile, _wait in sched.Prefetcher(dataset.read_tile,
+                                           dataset.n_tiles, depth=depth):
+        yield i, tile

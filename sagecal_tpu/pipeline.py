@@ -27,7 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from sagecal_tpu import coords, skymodel, utils
+from sagecal_tpu import coords, sched, skymodel, utils
 from sagecal_tpu.config import RunConfig, SimulationMode, SolverMode
 from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.solvers import normal_eq as ne
@@ -48,26 +48,20 @@ LMCUT = 40      # sagecalmain.h:24
 RES_RATIO = 5.0  # fullbatch_mode.cpp:239
 
 
-def _traced_tiles(gen):
-    """Yield from a tile iterator, timing the host wait for each tile as
-    the diag "io" phase (a no-op without an active tracer)."""
-    gen = iter(gen)
-    while True:
-        with dtrace.phase("io"):
-            try:
-                item = next(gen)
-            except StopIteration:
-                return
-        yield item
-
-
-def _emit_tile_record(ti, res_0, res_1, mean_nu, info, minutes):
+def _emit_tile_record(ti, res_0, res_1, mean_nu, info, minutes,
+                      bubble_s=None, overlap=None):
     """Per-solve-interval convergence record (gated on an active tracer
-    so the extra device->host syncs never run otherwise)."""
+    so the extra device->host syncs never run otherwise). ``bubble_s``
+    / ``overlap`` are the overlapped-execution accounting pair: host
+    seconds blocked on data movement for this tile, and the prefetch
+    depth it ran under (0 = synchronous reference loop)."""
     if not dtrace.active():
         return
     rec = dict(tile=ti, res_0=res_0, res_1=res_1, mean_nu=mean_nu,
                minutes=minutes)
+    if bubble_s is not None:
+        rec["bubble_s"] = float(bubble_s)
+        rec["overlap"] = int(overlap or 0)
     # host-driver extras (the sharded solver reports only residuals)
     for k in ("solver_iters", "lbfgs_iters"):
         if isinstance(info, dict) and k in info:
@@ -518,7 +512,46 @@ class FullBatchPipeline:
                 J0 = Jq
         return J0
 
-    def _run_batched(self, write_residuals, solution_path, max_tiles, log):
+    # -- overlapped execution (sagecal_tpu.sched) --------------------------
+
+    def _prefetch_depth(self, prefetch) -> int:
+        """Effective overlap depth: the per-call override, else the run
+        config's --prefetch (default 1 = double-buffered)."""
+        if prefetch is None:
+            prefetch = getattr(self.cfg, "prefetch", 1)
+        return max(0, int(prefetch))
+
+    def _tile_source(self, stage_fn, max_tiles, depth):
+        """Yield ``(ti, tile, staged, io_wait_s)`` with read + host
+        staging running ``depth`` tiles ahead on a background thread
+        (depth 0: inline — the synchronous reference path). The io
+        wait is the consumer's bubble; the thread's own read+stage
+        time is emitted as a ``bg``-tagged "read" phase."""
+        n = self.ms.n_tiles
+        if max_tiles is not None:
+            n = min(n, max_tiles)
+
+        def produce(i):
+            tile = self.ms.read_tile(i)
+            return tile, stage_fn(i, tile)
+
+        for ti, (tile, stg), wait in sched.Prefetcher(produce, n,
+                                                      depth=depth):
+            dtrace.emit("phase", name="io", tile=ti, dur_s=wait)
+            yield ti, tile, stg, wait
+
+    def _write_residual_tile(self, ti, tile, res_r, bg=True):
+        """Fetch the residual buffer (already copy-to-host-async'd on
+        the overlapped path) and write the MS tile. Runs as the
+        writer-thread job under overlap (``bg=True``) or inline on the
+        synchronous path; the "write" phase covers fetch + disk so the
+        sync attribution shows the full data-movement stall."""
+        with dtrace.phase("write", tile=ti, bg=bg):
+            tile.x = utils.r2c(np.asarray(res_r)).astype(np.complex128)
+            self.ms.write_tile(ti, tile)
+
+    def _run_batched(self, write_residuals, solution_path, max_tiles, log,
+                     prefetch=None):
         """--tile-batch>1 fullbatch driver: tile 0 (and every re-armed
         boost tile after a divergence reset) solves solo, then groups of
         T tiles solve as ONE vmapped program (sagefit_host_tiles); the
@@ -531,6 +564,7 @@ class FullBatchPipeline:
         meta = ms.meta
         from sagecal_tpu.solvers import robust as rb
         T = self.tile_batch
+        depth = self._prefetch_depth(prefetch)
         pinit = self.initial_jones()
         writer = None
         if solution_path:
@@ -541,6 +575,10 @@ class FullBatchPipeline:
         history = []
         state = {"J": pinit.copy(), "first": True, "res_prev": None}
         pending = []
+        # donated-staging ring: up to T pending + depth prefetched +
+        # in-flight slots hold a staged residual input concurrently
+        ring = sched.DonatedRing(T + depth + 2)
+        aw = sched.AsyncWriter(enabled=depth > 0)
 
         def stage(ti, tile):
             t_stage = time.perf_counter()
@@ -559,9 +597,14 @@ class FullBatchPipeline:
                        sta1=jnp.asarray(tile.sta1),
                        sta2=jnp.asarray(tile.sta2),
                        # staged once: solve + residual write reuse it
-                       beam=self._tile_beam(tile))
+                       beam=self._tile_beam(tile), bubble=0.0)
+            if write_residuals:
+                # the residual program DONATES its staged visibility
+                # input; the ring keeps overlapped staging from ever
+                # aliasing an in-flight donated buffer
+                ring.stage(ti, jnp.asarray(utils.c2r(tile.x), self.rdt))
             dtrace.emit("phase", name="stage", tile=ti,
-                        dur_s=time.perf_counter() - t_stage)
+                        dur_s=time.perf_counter() - t_stage, bg=depth > 0)
             return out
 
         def post(stg, res_0, res_1, mean_nu, Jnew, minutes):
@@ -580,27 +623,34 @@ class FullBatchPipeline:
                 state["res_prev"] = (res_1 if state["res_prev"] is None
                                      else min(state["res_prev"], res_1))
             if writer:
-                writer.write_interval(state["J"] if state["first"]
-                                      else Jnew, sky.nchunk)
+                stg["bubble"] += aw.submit(
+                    writer.write_interval,
+                    state["J"] if state["first"] else Jnew, sky.nchunk)
             if write_residuals:
                 t_res = time.perf_counter()
                 res_r = self._residual_fn(
                     jnp.asarray(utils.jones_c2r_np(
                         state["J"] if state["first"] else Jnew), self.rdt),
-                    jnp.asarray(utils.c2r(tile.x), self.rdt),
+                    ring.take(ti),
                     stg["u"], stg["v"], stg["w"], stg["sta1"], stg["sta2"],
                     stg["beam"])
-                tile.x = utils.r2c(np.asarray(res_r)).astype(np.complex128)
                 dtrace.emit("phase", name="residual", tile=ti,
                             dur_s=time.perf_counter() - t_res)
-                with dtrace.phase("write", tile=ti):
-                    ms.write_tile(ti, tile)
+                if depth > 0:
+                    # start the non-blocking device->host copy, hand
+                    # fetch + MS write to the ordered writer thread
+                    sched.start_host_copy(res_r)
+                    stg["bubble"] += aw.submit(
+                        self._write_residual_tile, ti, tile, res_r)
+                else:
+                    self._write_residual_tile(ti, tile, res_r, bg=False)
             log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
                 f"final={res_1:.6g}, Time spent={minutes:.3g} minutes, "
                 f"nu={mean_nu:.2f}")
             history.append({"tile": ti, "res_0": res_0, "res_1": res_1,
                             "mean_nu": mean_nu, "minutes": minutes})
-            _emit_tile_record(ti, res_0, res_1, mean_nu, None, minutes)
+            _emit_tile_record(ti, res_0, res_1, mean_nu, None, minutes,
+                              bubble_s=stg["bubble"], overlap=depth)
 
         def solve_solo(stg, boosted):
             t0 = time.time()
@@ -652,10 +702,10 @@ class FullBatchPipeline:
                      utils.jones_r2c_np(Jd[t]), minutes)
 
         try:
-            for ti, tile in _traced_tiles(ms.tiles_prefetch()):
-                if max_tiles is not None and ti >= max_tiles:
-                    break
-                stg = stage(ti, tile)
+            for ti, tile, stg, io_wait in self._tile_source(
+                    stage, max_tiles, depth):
+                aw.check()      # writer failure -> fail at the boundary
+                stg["bubble"] += io_wait
                 if state["first"]:
                     solve_solo(stg, boosted=True)
                     continue
@@ -664,19 +714,26 @@ class FullBatchPipeline:
                     flush(pending)
                     pending = []
         finally:
-            flush(pending)
-            if writer:
-                writer.close()
+            try:
+                flush(pending)
+            finally:
+                aw.close()
+                if writer:
+                    writer.close()
         return history
 
     def run(self, write_residuals: bool = True, solution_path=None,
-            max_tiles=None, log=print):
+            max_tiles=None, log=print, prefetch=None):
+        """``prefetch``: overlap depth override (None = cfg.prefetch;
+        0 = the synchronous reference loop). Outputs are bit-identical
+        across depths — only data movement overlaps; the warm-start
+        solve chain stays sequential (tests/test_overlap.py)."""
         if getattr(self, "batch_ok", False):
             return self._run_batched(write_residuals, solution_path,
-                                     max_tiles, log)
+                                     max_tiles, log, prefetch)
         cfg, ms, sky = self.cfg, self.ms, self.sky
         meta = ms.meta
-        cdt = jnp.complex64 if self.rdt == jnp.float32 else jnp.complex128
+        depth = self._prefetch_depth(prefetch)
 
         pinit = self.initial_jones()
         J = pinit.copy()
@@ -701,39 +758,60 @@ class FullBatchPipeline:
         res_prev = None
         first = True
         history = []
+        # donated-staging ring + ordered writer thread (sched): under
+        # overlap the next tile reads + stages on a background thread
+        # while this one solves, and residual/solution writes drain on
+        # the writer thread — strictly in tile order, failures
+        # re-raised at the next tile boundary
+        ring = sched.DonatedRing(depth + 2)
+        aw = sched.AsyncWriter(enabled=depth > 0)
+        stage_xr = write_residuals and not cfg.per_channel_bfgs
+
+        def stage(ti, tile):
+            t_stage = time.perf_counter()
+            u = jnp.asarray(tile.u, self.rdt)
+            v = jnp.asarray(tile.v, self.rdt)
+            w = jnp.asarray(tile.w, self.rdt)
+            # shared staging decision (VisTile.solve_input): native
+            # per-channel-flag packing when applicable, plain mean else;
+            # stored uv-cut rows survive either way
+            x8_np, rowflags, _good = tile.solve_input(
+                uvtaper_m=cfg.uvtaper)
+            x8 = jnp.asarray(x8_np, self.rdt)
+            flags = rp.uvcut_flags(jnp.asarray(rowflags, jnp.int32), u, v,
+                                   jnp.asarray(tile.freqs, self.rdt),
+                                   cfg.uvmin, cfg.uvmax)
+            if cfg.whiten:
+                # -W: uv-density whitening of the solve input only
+                # (fullbatch_mode.cpp applies whiten_data to the averaged x)
+                from sagecal_tpu.solvers import robust as rb
+                x8 = rb.whiten_data(x8, u, v, meta["freq0"])
+            stg = dict(u=u, v=v, w=w, x8=x8, flags=flags,
+                       wt=lm_mod.make_weights(flags, self.rdt),
+                       sta1=jnp.asarray(tile.sta1),
+                       sta2=jnp.asarray(tile.sta2),
+                       beam=self._tile_beam(tile))
+            if stage_xr:
+                # residual input staged ahead; DONATED to the residual
+                # program (ring: no read-after-donate, no aliasing)
+                ring.stage(ti, jnp.asarray(utils.c2r(tile.x), self.rdt))
+            dtrace.emit("phase", name="stage", tile=ti,
+                        dur_s=time.perf_counter() - t_stage, bg=depth > 0)
+            return stg
+
         try:
-            for ti, tile in _traced_tiles(ms.tiles_prefetch()):
-                if max_tiles is not None and ti >= max_tiles:
-                    break
+            for ti, tile, stg, io_wait in self._tile_source(
+                    stage, max_tiles, depth):
+                aw.check()  # async write failure -> fail at the boundary
+                bubble = io_wait
                 t0 = time.time()
-                t_stage = time.perf_counter()
-                u = jnp.asarray(tile.u, self.rdt)
-                v = jnp.asarray(tile.v, self.rdt)
-                w = jnp.asarray(tile.w, self.rdt)
-                # shared staging decision (VisTile.solve_input): native
-                # per-channel-flag packing when applicable, plain mean else;
-                # stored uv-cut rows survive either way
-                x8_np, rowflags, _good = tile.solve_input(
-                    uvtaper_m=cfg.uvtaper)
-                base_flags = jnp.asarray(rowflags, jnp.int32)
-                x8 = jnp.asarray(x8_np, self.rdt)
-                flags = rp.uvcut_flags(base_flags, u, v,
-                                       jnp.asarray(tile.freqs, self.rdt),
-                                       cfg.uvmin, cfg.uvmax)
-                if cfg.whiten:
-                    # -W: uv-density whitening of the solve input only
-                    # (fullbatch_mode.cpp applies whiten_data to the averaged x)
-                    from sagecal_tpu.solvers import robust as rb
-                    x8 = rb.whiten_data(x8, u, v, meta["freq0"])
-                wt = lm_mod.make_weights(flags, self.rdt)
-                sta1 = jnp.asarray(tile.sta1)
-                sta2 = jnp.asarray(tile.sta2)
+                u, v, w = stg["u"], stg["v"], stg["w"]
+                sta1, sta2 = stg["sta1"], stg["sta2"]
+                x8, flags, wt = stg["x8"], stg["flags"], stg["wt"]
+                tile_beam = stg["beam"]
 
                 solver = self._solve_first if first else self._solve_rest
                 J_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
-                tile_beam = self._tile_beam(tile)
-                dtrace.emit("phase", name="stage", tile=ti,
-                            dur_s=time.perf_counter() - t_stage)
                 t_solve = time.perf_counter()
                 Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8,
                                      tile_beam, tile_idx=ti)
@@ -839,26 +917,34 @@ class FullBatchPipeline:
                         tile.x = np.moveaxis(
                             utils.r2c(resC)[:, :, 0], 0, 1
                         ).astype(np.complex128)
-                        ms.write_tile(ti, tile)
+                        bubble += aw.submit(ms.write_tile, ti, tile)
                     J = utils.jones_r2c_np(np.asarray(JC_r8[-1]))
                     if writer:
-                        writer.write_interval(J, sky.nchunk)
+                        bubble += aw.submit(writer.write_interval, J,
+                                            sky.nchunk)
                 else:
                     if writer:
-                        writer.write_interval(J, sky.nchunk)
+                        bubble += aw.submit(writer.write_interval, J,
+                                            sky.nchunk)
 
                     if write_residuals:
                         t_res = time.perf_counter()
                         res_r = self._residual_fn(
                             jnp.asarray(utils.jones_c2r_np(J), self.rdt),
-                            jnp.asarray(utils.c2r(tile.x), self.rdt),
+                            ring.take(ti),
                             u, v, w, sta1, sta2, tile_beam)
-                        tile.x = utils.r2c(np.asarray(res_r)).astype(
-                            np.complex128)
                         dtrace.emit("phase", name="residual", tile=ti,
                                     dur_s=time.perf_counter() - t_res)
-                        with dtrace.phase("write", tile=ti):
-                            ms.write_tile(ti, tile)
+                        if depth > 0:
+                            # non-blocking d->h copy now; fetch + MS
+                            # write on the ordered writer thread
+                            sched.start_host_copy(res_r)
+                            bubble += aw.submit(
+                                self._write_residual_tile, ti, tile,
+                                res_r)
+                        else:
+                            self._write_residual_tile(ti, tile, res_r,
+                                                      bg=False)
 
                 dt = (time.time() - t0) / 60.0
                 log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
@@ -866,7 +952,8 @@ class FullBatchPipeline:
                     f"nu={mean_nu:.2f}")
                 history.append({"tile": ti, "res_0": res_0, "res_1": res_1,
                                 "mean_nu": mean_nu, "minutes": dt})
-                _emit_tile_record(ti, res_0, res_1, mean_nu, info, dt)
+                _emit_tile_record(ti, res_0, res_1, mean_nu, info, dt,
+                                  bubble_s=bubble, overlap=depth)
                 if prof_live:
                     import jax.profiler
                     jax.profiler.stop_trace()
@@ -874,9 +961,12 @@ class FullBatchPipeline:
                     log(f"profile trace written to {prof_dir}")
 
         finally:
-            if prof_live:   # abnormal exit or 0-tile run:
-                import jax.profiler
-                jax.profiler.stop_trace()  # close the trace
+            try:
+                aw.close()
+            finally:
+                if prof_live:   # abnormal exit or 0-tile run:
+                    import jax.profiler
+                    jax.profiler.stop_trace()  # close the trace
         if writer:
             writer.close()
         return history

@@ -1,0 +1,272 @@
+"""Overlapped host execution: prefetch, ordered async writeback, rings.
+
+The calibration host loops (pipeline.py, stochastic.py, cli_mpi.py)
+execute io -> stage -> solve -> residual-fetch -> write per solve
+interval. PR 1's roofline measured the solve as bandwidth-bound, so
+the device idles through every host-side phase of that chain. This
+module holds the three primitives that hide those phases behind the
+solve without changing a single computed bit:
+
+- :class:`Prefetcher` — a bounded-depth background producer: tile t+1
+  is read (and host-prepared, when the caller's ``produce`` stages too)
+  on a reader thread while tile t solves. The consumer observes only
+  its *wait* for each item — the pipeline bubble — which is what the
+  diag "io" phase must record under overlap (the thread's own
+  production time is emitted separately, tagged ``bg``).
+- :class:`AsyncWriter` — one writer thread executing submitted jobs
+  strictly in submission order (MS residual tiles, solution rows). An
+  exception in any job fails the run at the next tile boundary with
+  the original traceback — never swallowed; ``--prefetch 0`` is the
+  debugging escape hatch that runs every job inline.
+- :class:`DonatedRing` — an N-slot ring for staged device buffers
+  whose consumer DONATES them (the per-tile residual input, PR 2's
+  contract). Under overlap the next tile's buffer is staged while the
+  previous one is still in flight; the ring guarantees a donated slot
+  is never read again and a live slot is never overwritten.
+
+Ordering guarantees (the embedder contract, MIGRATION.md "Overlapped
+execution"): items are produced and consumed strictly in index order;
+write jobs execute strictly in submission order; the warm-start solve
+chain stays sequential — only data movement overlaps. Memory cost is
+bounded: ``depth`` extra staged tiles plus the writer queue.
+
+Layering: stdlib + diag.trace only. Device arrays pass through
+opaquely; the non-blocking device->host copy (``copy_to_host_async``)
+is started by callers before submitting a fetch job here.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from sagecal_tpu.diag import trace as dtrace
+
+
+def start_host_copy(*arrays) -> None:
+    """Start the non-blocking device->host copy of jax arrays (the
+    blessed async-readback API — see analysis/hostsync.py): the DMA
+    overlaps with subsequent dispatches, so the writer thread's later
+    ``np.asarray`` finds the bytes already on host. A backend without
+    the method just pays the copy at fetch time."""
+    for a in arrays:
+        fn = getattr(a, "copy_to_host_async", None)
+        if fn is not None:
+            fn()
+
+
+class Prefetcher:
+    """Produce ``fn(i)`` for ``i in range(n)`` ``depth`` items ahead.
+
+    Iterating yields ``(i, item, wait_s)`` in index order; ``wait_s``
+    is the host time spent BLOCKED on the item. ``depth <= 0`` runs
+    ``fn`` inline (the synchronous reference path) and ``wait_s`` is
+    then the full production time. Producer exceptions re-raise in the
+    consumer with the original traceback; abandoning the iterator
+    (``close()``/GC) cancels the thread.
+    """
+
+    def __init__(self, fn, n: int, depth: int = 1, name: str = "read"):
+        self.fn = fn
+        self.n = int(n)
+        self.depth = int(depth)
+        self.name = name
+        self._cancel = threading.Event()
+        self._q: queue.Queue = queue.Queue(maxsize=max(self.depth, 1))
+        self._thread = None
+        if self.depth > 0:
+            self._thread = threading.Thread(
+                target=self._producer, name=f"prefetch-{name}",
+                daemon=True)
+            self._thread.start()
+
+    # -- producer thread ---------------------------------------------------
+
+    def _put(self, item) -> bool:
+        while not self._cancel.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer(self):
+        try:
+            for i in range(self.n):
+                if self._cancel.is_set():
+                    return
+                t0 = time.perf_counter()
+                item = self.fn(i)
+                # the background production time — NOT the consumer's
+                # io wait; tagged bg so attribution stays honest
+                dtrace.emit("phase", name=self.name, tile=i,
+                            dur_s=time.perf_counter() - t0, bg=True)
+                if not self._put((i, item)):
+                    return
+        except BaseException as e:      # surface in the consumer
+            self._put((None, e))
+            return
+        self._put((None, None))
+
+    # -- consumer ----------------------------------------------------------
+
+    def __iter__(self):
+        if self.depth <= 0:
+            for i in range(self.n):
+                t0 = time.perf_counter()
+                item = self.fn(i)
+                yield i, item, time.perf_counter() - t0
+            return
+        try:
+            while True:
+                t0 = time.perf_counter()
+                i, item = self._q.get()
+                wait = time.perf_counter() - t0
+                if i is None:
+                    if item is not None:
+                        raise item
+                    return
+                yield i, item, wait
+        finally:
+            self.close()
+
+    def close(self):
+        self._cancel.set()
+        while True:                     # unblock a full queue
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+class AsyncWriter:
+    """Strictly ordered background execution of write jobs.
+
+    ``submit(fn, *args)`` enqueues; one writer thread runs jobs in
+    submission order. After a job raises, no later job executes: the
+    exception re-raises (original traceback) at the caller's next
+    :meth:`check` — pipelines call it at every tile boundary — or at
+    :meth:`close`. ``enabled=False`` degrades to inline execution
+    (identical semantics, zero threads): the ``--prefetch 0`` path.
+
+    ``submit`` returns the seconds it spent blocked on a full queue
+    (writer backpressure — bubble time for the caller's accounting).
+    """
+
+    _STOP = object()
+
+    def __init__(self, enabled: bool = True, maxsize: int = 4):
+        self.enabled = bool(enabled)
+        self._exc = None
+        self._raised = False
+        self._q: queue.Queue = queue.Queue(maxsize=max(maxsize, 1))
+        self._thread = None
+        if self.enabled:
+            self._thread = threading.Thread(
+                target=self._worker, name="async-writer", daemon=True)
+            self._thread.start()
+
+    def _worker(self):
+        while True:
+            job = self._q.get()
+            try:
+                if job is self._STOP:
+                    return
+                if self._exc is None:   # fail-stop: drain, don't run
+                    fn, args, kwargs = job
+                    fn(*args, **kwargs)
+            except BaseException as e:
+                self._exc = e
+            finally:
+                self._q.task_done()
+
+    def check(self) -> None:
+        """Re-raise a pending writer failure (original traceback).
+        Raises once: after it fired, the run is already unwinding and
+        the cleanup-path re-check must not mask the original."""
+        if self._exc is not None and not self._raised:
+            self._raised = True
+            raise self._exc
+
+    def submit(self, fn, *args, **kwargs) -> float:
+        self.check()
+        if not self.enabled:
+            fn(*args, **kwargs)
+            return 0.0
+        t0 = time.perf_counter()
+        self._q.put((fn, args, kwargs))
+        return time.perf_counter() - t0
+
+    def drain(self) -> float:
+        """Block until every submitted job ran; returns the wait."""
+        t0 = time.perf_counter()
+        if self.enabled:
+            self._q.join()
+        self.check()
+        return time.perf_counter() - t0
+
+    def close(self, raise_pending: bool = True) -> None:
+        if self._thread is not None:
+            self._q.join()
+            self._q.put(self._STOP)
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if raise_pending:
+            self.check()
+
+
+class DonatedRing:
+    """N-slot ring of staged device buffers consumed by DONATION.
+
+    The per-tile residual program donates its staged visibility input
+    (PR 2's buffer-donation contract). Under overlap the producer
+    stages tile t+1's buffer while tile t's is still in flight, so the
+    donated buffer must alternate slots instead of aliasing in-flight
+    memory. The ring enforces the two safety rules statically checked
+    nowhere else:
+
+    - :meth:`take` hands the buffer out exactly once (the donating
+      call); a second read of the slot RAISES instead of touching
+      memory XLA may already have reclaimed;
+    - :meth:`stage` refuses to overwrite a slot whose buffer was never
+      consumed (an in-flight donation would alias).
+
+    Slot choice is ``tag % depth``; sizing is the caller's prefetch
+    depth + 1 (two slots for the default double-buffered loop).
+    """
+
+    def __init__(self, depth: int = 2):
+        self.depth = max(int(depth), 1)
+        self._bufs = [None] * self.depth
+        self._live = [False] * self.depth
+        self._tags = [None] * self.depth
+        self._lock = threading.Lock()
+
+    def stage(self, tag: int, buf) -> None:
+        with self._lock:
+            i = tag % self.depth
+            if self._live[i]:
+                raise RuntimeError(
+                    f"DonatedRing: staging tag {tag} would overwrite "
+                    f"slot {i} (tag {self._tags[i]}) whose buffer was "
+                    f"never taken — in-flight donation would alias")
+            self._bufs[i] = buf
+            self._live[i] = True
+            self._tags[i] = tag
+
+    def take(self, tag: int):
+        """The buffer for ``tag``, exactly once (caller donates it)."""
+        with self._lock:
+            i = tag % self.depth
+            if not self._live[i] or self._tags[i] != tag:
+                raise RuntimeError(
+                    f"DonatedRing: tag {tag} not staged in slot {i} "
+                    f"(slot holds tag {self._tags[i]}, "
+                    f"live={self._live[i]}) — read after donation?")
+            buf, self._bufs[i] = self._bufs[i], None
+            self._live[i] = False
+            return buf

@@ -1042,6 +1042,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                 kci, jnp.asarray(order, jnp.int32), os_ids,
                 n_stations, cfg_i, total_iter, iter_bar, os_nsub)
             tk_total = tk_total + tk
+            # jaxlint: disable=host-sync -- deliberate ONE-per-sweep timing barrier: the auto fuse/promote plan learns from real sweep wall-clock (bounded-execution contract)
             jax.block_until_ready(J)
             sweep_times.append(time.perf_counter() - t_sweep)
         else:
@@ -1071,6 +1072,7 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                         jnp.asarray(last), kci, os_ids, n_stations,
                         cfg_i, total_iter, iter_bar, os_nsub, anchor)
                     tk_total = tk_total + tk
+            # jaxlint: disable=host-sync -- deliberate ONE-per-sweep timing barrier: the fuse=auto verdict needs the unfused sweep's real wall-clock
             jax.block_until_ready(J)
             # the fused program does the same work minus dispatch overhead,
             # so a 25 s per-cluster sweep bounds it well under the ~60 s
@@ -1338,6 +1340,7 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                 kci, order, os_ids, n_stations, cfg_i, total_iter,
                 iter_bar, os_nsub)
             tk_total = tk_total + tk
+            # jaxlint: disable=host-sync -- deliberate ONE-per-sweep timing barrier: the auto fuse/promote plan learns from real sweep wall-clock (bounded-execution contract)
             jax.block_until_ready(J)
             sweep_times.append(time.perf_counter() - t_sweep)
         else:
@@ -1367,6 +1370,7 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                         jnp.asarray(last), kci, os_ids, n_stations,
                         cfg_i, total_iter, iter_bar, os_nsub, anchor)
                     tk_total = tk_total + tk
+            # jaxlint: disable=host-sync -- deliberate ONE-per-sweep timing barrier: the fuse=auto verdict needs the unfused sweep's real wall-clock
             jax.block_until_ready(J)
             if fuse_mode == "auto":
                 fused = time.perf_counter() - t_sweep < 25.0

@@ -46,7 +46,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from sagecal_tpu import skymodel, utils
+from sagecal_tpu import sched, skymodel, utils
 from sagecal_tpu.config import RunConfig
 from sagecal_tpu.consensus import poly as cpoly
 from sagecal_tpu.diag import trace as dtrace
@@ -463,10 +463,18 @@ class _StochasticRunner:
 
         return jax.jit(resid)
 
-    def write_residuals(self, tile, ti, pfreq):
+    def write_residuals(self, tile, ti, pfreq, aw=None):
         """Per-minibatch, per-band residual subtract + write back
-        (minibatch_mode.cpp:450-492)."""
-        xout = np.array(tile.x)
+        (minibatch_mode.cpp:450-492).
+
+        With an enabled :class:`sched.AsyncWriter` every residual
+        program is dispatched up front, the device->host copies start
+        non-blocking, and the fetch + assembly + MS write run as ONE
+        ordered writer-thread job — the next tile's prepare/solve
+        overlaps the whole writeback instead of serializing behind
+        per-band ``np.asarray`` fetches. Returns the seconds blocked
+        on writer backpressure (bubble accounting)."""
+        jobs = []
         for nmb in range(self.minibatches):
             r0 = self.row0[nmb]
             nrow = self.nts[nmb] * self.nbase
@@ -474,14 +482,28 @@ class _StochasticRunner:
                 c0, nc = self.chanstart[b], self.nchan[b]
                 x8F, u, v, w, s1, s2, _, freqsF, tsj = \
                     self.band_inputs(nmb, b)
-                out = np.asarray(self._resid_jit(
+                out = self._resid_jit(
                     x8F, u, v, w, s1, s2, freqsF, tsj,
-                    jnp.asarray(pfreq[b], self.rdt), self.tile_beam))
-                res = utils.r2c(out.reshape(self.bmb, self.fpad, 4, 2))
+                    jnp.asarray(pfreq[b], self.rdt), self.tile_beam)
+                jobs.append((r0, nrow, c0, nc, out))
+        if aw is not None and aw.enabled:
+            sched.start_host_copy(*[j[-1] for j in jobs])
+            return aw.submit(self._assemble_write, tile, ti, jobs)
+        self._assemble_write(tile, ti, jobs, bg=False)
+        return 0.0
+
+    def _assemble_write(self, tile, ti, jobs, bg=True):
+        """Fetch dispatched residual outputs, assemble the channel
+        window of every (minibatch, band) slice, write the tile."""
+        with dtrace.phase("write", tile=ti, bg=bg):
+            xout = np.array(tile.x)
+            for r0, nrow, c0, nc, out in jobs:
+                res = utils.r2c(
+                    np.asarray(out).reshape(self.bmb, self.fpad, 4, 2))
                 xout[r0:r0 + nrow, c0:c0 + nc] = res.reshape(
                     self.bmb, self.fpad, 2, 2)[:nrow, :nc]
-        tile.x = xout
-        self.ms.write_tile(ti, tile)
+            tile.x = xout
+            self.ms.write_tile(ti, tile)
 
     def solution_writer(self):
         if not self.cfg.solutions_file:
@@ -494,16 +516,26 @@ class _StochasticRunner:
             nsolbw=self.nsolbw if self.nsolbw > 1 else None)
 
     def end_of_tile(self, tile, ti, state, resband, res_0, res_1, t0,
-                    writer, history):
+                    writer, history, aw=None, bubble_s=None, overlap=0):
         """Shared per-tile tail: residual write-back, solution rows,
         per-band + global divergence resets, telemetry
-        (minibatch_mode.cpp:448-546)."""
+        (minibatch_mode.cpp:448-546). ``aw``: ordered writer thread
+        (sched.AsyncWriter) — residual + solution writes overlap the
+        next tile when enabled; solution blocks are materialized HERE
+        (before the reset logic rebinds pfreq entries) so the deferred
+        write sees this tile's values. ``bubble_s`` arrives as the io
+        wait and accumulates writer backpressure below; ``overlap`` is
+        the EFFECTIVE prefetch depth (already clamped to >= 0)."""
         pfreq, mems, pinit = state["pfreq"], state["mems"], state["pinit"]
-        self.write_residuals(tile, ti, pfreq)
+        wb = self.write_residuals(tile, ti, pfreq, aw=aw)
         if writer:
-            writer.write_interval_multiband(
-                [utils.jones_r2c_np(p.astype(np.float64)) for p in pfreq],
-                self.sky.nchunk)
+            blocks = [utils.jones_r2c_np(p.astype(np.float64))
+                      for p in pfreq]
+            if aw is not None and aw.enabled:
+                wb += aw.submit(writer.write_interval_multiband, blocks,
+                                self.sky.nchunk)
+            else:
+                writer.write_interval_multiband(blocks, self.sky.nchunk)
 
         # per-band reset (minibatch_mode.cpp:516-523)
         for b in range(self.nsolbw):
@@ -530,8 +562,12 @@ class _StochasticRunner:
                  f"final={res_1:.6g}, Time spent={dt:.3g} minutes")
         history.append({"tile": ti, "res_0": res_0, "res_1": res_1,
                         "minutes": dt})
+        extra = {}
+        if bubble_s is not None:
+            extra = dict(bubble_s=float(bubble_s) + wb,
+                         overlap=int(overlap))
         dtrace.emit("tile", tile=ti, res_0=res_0, res_1=res_1,
-                    minutes=dt)
+                    minutes=dt, **extra)
 
 
 def _open(cfg: RunConfig, log):
@@ -543,6 +579,25 @@ def _open(cfg: RunConfig, log):
                                     meta["ra0"], meta["dec0"], meta["freq0"],
                                     cfg.format_3)
     return ms, sky
+
+
+def _tile_source(ms, cfg):
+    """(source, depth): tile iterator yielding ``(ti, tile, io_wait)``
+    with --prefetch read-ahead on a background thread (depth 0 reads
+    inline — the synchronous reference path); the io phase records the
+    host WAIT, the thread's read time is emitted ``bg``-tagged."""
+    depth = max(0, int(getattr(cfg, "prefetch", 1)))
+    n = ms.n_tiles
+    if cfg.max_timeslots:
+        n = min(n, cfg.max_timeslots)
+
+    def src():
+        for ti, tile, wait in sched.Prefetcher(ms.read_tile, n,
+                                               depth=depth):
+            dtrace.emit("phase", name="io", tile=ti, dur_s=wait)
+            yield ti, tile, wait
+
+    return src(), depth
 
 
 def run_minibatch(cfg: RunConfig, log=print):
@@ -562,38 +617,44 @@ def run_minibatch(cfg: RunConfig, log=print):
     state = {"pfreq": pfreq, "mems": mems, "pinit": pinit, "res_prev": None}
 
     history = []
-    for ti, tile in ms.tiles():
-        if cfg.max_timeslots and ti >= cfg.max_timeslots:
-            break
-        t0 = time.time()
-        rn.prepare_tile(tile)
-        resband = np.zeros(rn.nsolbw)
-        res_0 = res_1 = 0.0
-        # all bands ride one device program (P7); host state restacks
-        # only at tile boundaries where the reset logic lives
-        pstack, memstack = rn.stack_state(pfreq, mems)
-        for nepch in range(cfg.n_epochs):
-            for nmb in range(rn.minibatches):
-                args = rn.band_inputs_all(nmb)
-                out = solver(*args, pstack, memstack, None, None, None,
-                             rn.tile_beam)
-                pstack, memstack = out.p, out.mem
-                r0s = np.asarray(out.res_0)
-                r1s = np.asarray(out.res_1)
-                resband[:] = r1s
-                if cfg.verbose:
-                    for b in range(rn.nsolbw):
-                        log(f"epoch={nepch} minibatch={nmb} band={b} "
-                            f"{r0s[b]:.6f} {r1s[b]:.6f}")
-                res_0, res_1 = float(np.mean(r0s)), float(np.mean(r1s))
-                if dtrace.active():
-                    dtrace.emit("minibatch", tile=ti, epoch=nepch,
-                                minibatch=nmb, res_0=res_0, res_1=res_1,
-                                iters=int(np.asarray(out.iters).sum()))
-        rn.unstack_state(pstack, memstack, pfreq, mems)
+    source, depth = _tile_source(ms, cfg)
+    aw = sched.AsyncWriter(enabled=depth > 0)
+    try:
+        for ti, tile, io_wait in source:
+            aw.check()  # async write failure -> fail at this boundary
+            t0 = time.time()
+            rn.prepare_tile(tile)
+            resband = np.zeros(rn.nsolbw)
+            res_0 = res_1 = 0.0
+            # all bands ride one device program (P7); host state restacks
+            # only at tile boundaries where the reset logic lives
+            pstack, memstack = rn.stack_state(pfreq, mems)
+            for nepch in range(cfg.n_epochs):
+                for nmb in range(rn.minibatches):
+                    args = rn.band_inputs_all(nmb)
+                    out = solver(*args, pstack, memstack, None, None, None,
+                                 rn.tile_beam)
+                    pstack, memstack = out.p, out.mem
+                    r0s = np.asarray(out.res_0)
+                    r1s = np.asarray(out.res_1)
+                    resband[:] = r1s
+                    if cfg.verbose:
+                        for b in range(rn.nsolbw):
+                            log(f"epoch={nepch} minibatch={nmb} band={b} "
+                                f"{r0s[b]:.6f} {r1s[b]:.6f}")
+                    res_0, res_1 = float(np.mean(r0s)), float(np.mean(r1s))
+                    if dtrace.active():
+                        dtrace.emit("minibatch", tile=ti, epoch=nepch,
+                                    minibatch=nmb, res_0=res_0,
+                                    res_1=res_1,
+                                    iters=int(np.asarray(out.iters).sum()))
+            rn.unstack_state(pstack, memstack, pfreq, mems)
 
-        rn.end_of_tile(tile, ti, state, resband, res_0, res_1, t0,
-                       writer, history)
+            rn.end_of_tile(tile, ti, state, resband, res_0, res_1, t0,
+                           writer, history, aw=aw, bubble_s=io_wait,
+                           overlap=depth)
+    finally:
+        aw.close()
     if writer:
         writer.close()
     return history
@@ -639,85 +700,90 @@ def run_minibatch_consensus(cfg: RunConfig, log=print):
     pshape = (rn.M, rn.kmax, rn.n, 8)
     cmask4 = rn.cmask[..., None, None]                         # [M, K, 1, 1]
     history = []
-    for ti, tile in ms.tiles():
-        if cfg.max_timeslots and ti >= cfg.max_timeslots:
-            break
-        t0 = time.time()
-        rn.prepare_tile(tile)
-        Y = np.zeros((rn.nsolbw,) + pshape)                    # dual, per band
-        Z = np.zeros((rn.M, cfg.n_poly, rn.kmax, rn.n, 8))
-        resband = np.zeros(rn.nsolbw)
-        res_0 = res_1 = 0.0
-        pstack, memstack = rn.stack_state(pfreq, mems)
-        rho_d = jnp.asarray(rhok, rn.rdt)
-        for nadmm in range(cfg.n_admm):
-            for nepch in range(cfg.n_epochs):
-                for nmb in range(rn.minibatches):
-                    # ONE device program solves all bands (P7); the
-                    # host keeps only the cheap Z/Y consensus updates
-                    BZ_all = np.einsum("bp,mpkns->bmkns", B, Z)
-                    args = rn.band_inputs_all(nmb)
-                    out = solver(*args, pstack, memstack,
-                                 jnp.asarray(Y, rn.rdt),
-                                 jnp.asarray(BZ_all, rn.rdt),
-                                 rho_d, rn.tile_beam)
-                    pstack, memstack = out.p, out.mem
-                    p_np = np.asarray(pstack, np.float64)
-                    r0s = np.asarray(out.res_0)
-                    r1s = np.asarray(out.res_1)
-                    # -ve residual marks a bad solve
-                    resband[:] = np.where((r0s > 0) & (r1s > 0), r1s,
-                                          np.inf)
-                    if cfg.verbose:
-                        for b in range(rn.nsolbw):
+    source, depth = _tile_source(ms, cfg)
+    aw = sched.AsyncWriter(enabled=depth > 0)
+    try:
+        for ti, tile, io_wait in source:
+            aw.check()
+            t0 = time.time()
+            rn.prepare_tile(tile)
+            Y = np.zeros((rn.nsolbw,) + pshape)                # dual, per band
+            Z = np.zeros((rn.M, cfg.n_poly, rn.kmax, rn.n, 8))
+            resband = np.zeros(rn.nsolbw)
+            res_0 = res_1 = 0.0
+            pstack, memstack = rn.stack_state(pfreq, mems)
+            rho_d = jnp.asarray(rhok, rn.rdt)
+            for nadmm in range(cfg.n_admm):
+                for nepch in range(cfg.n_epochs):
+                    for nmb in range(rn.minibatches):
+                        # ONE device program solves all bands (P7); the
+                        # host keeps only the cheap Z/Y consensus updates
+                        BZ_all = np.einsum("bp,mpkns->bmkns", B, Z)
+                        args = rn.band_inputs_all(nmb)
+                        out = solver(*args, pstack, memstack,
+                                     jnp.asarray(Y, rn.rdt),
+                                     jnp.asarray(BZ_all, rn.rdt),
+                                     rho_d, rn.tile_beam)
+                        pstack, memstack = out.p, out.mem
+                        p_np = np.asarray(pstack, np.float64)
+                        r0s = np.asarray(out.res_0)
+                        r1s = np.asarray(out.res_1)
+                        # -ve residual marks a bad solve
+                        resband[:] = np.where((r0s > 0) & (r1s > 0), r1s,
+                                              np.inf)
+                        if cfg.verbose:
+                            for b in range(rn.nsolbw):
+                                primal = float(np.linalg.norm(
+                                    (p_np[b] - BZ_all[b]) * cmask4)
+                                    / np.sqrt(p_np[b].size))
+                                log(f"admm={nadmm} epoch={nepch} "
+                                    f"minibatch={nmb} band={b} primal "
+                                    f"{primal:.6f} {r0s[b]:.6f} {r1s[b]:.6f}")
+                        res_0, res_1 = float(np.mean(r0s)), float(np.mean(r1s))
+                        if dtrace.active():
                             primal = float(np.linalg.norm(
-                                (p_np[b] - BZ_all[b]) * cmask4)
-                                / np.sqrt(p_np[b].size))
-                            log(f"admm={nadmm} epoch={nepch} "
-                                f"minibatch={nmb} band={b} primal "
-                                f"{primal:.6f} {r0s[b]:.6f} {r1s[b]:.6f}")
-                    res_0, res_1 = float(np.mean(r0s)), float(np.mean(r1s))
-                    if dtrace.active():
-                        primal = float(np.linalg.norm(
-                            (p_np - BZ_all) * cmask4[None])
-                            / np.sqrt(p_np.size))
-                        dtrace.emit("minibatch", tile=ti, admm=nadmm,
-                                    epoch=nepch, minibatch=nmb,
-                                    res_0=res_0, res_1=res_1,
-                                    primal=primal,
-                                    iters=int(np.asarray(out.iters).sum()))
-                    # flag diverged bands out of the Z update (:528-546)
-                    fband = resband > RES_RATIO * res_1
+                                (p_np - BZ_all) * cmask4[None])
+                                / np.sqrt(p_np.size))
+                            dtrace.emit("minibatch", tile=ti, admm=nadmm,
+                                        epoch=nepch, minibatch=nmb,
+                                        res_0=res_0, res_1=res_1,
+                                        primal=primal,
+                                        iters=int(np.asarray(out.iters).sum()))
+                        # flag diverged bands out of the Z update (:528-546)
+                        fband = resband > RES_RATIO * res_1
 
-                    # ADMM updates (minibatch_consensus_mode.cpp:551-590)
-                    good = ~fband
-                    for b in np.where(good)[0]:
-                        Y[b] += rhok[b][:, None, None, None] * p_np[b]
-                    zsum = np.einsum("b,bp,bmkns->mpkns",
-                                     good.astype(float), B, Y)
-                    Zold = Z.copy()
-                    Z = np.asarray(cpoly.z_from_contributions(
-                        jnp.asarray(zsum), jnp.asarray(Bii)))
-                    dual = np.linalg.norm(Z - Zold) / np.sqrt(Z.size)
-                    if cfg.verbose:
-                        log(f"ADMM : {nadmm} dual residual={dual:.6f}")
-                    if dtrace.active():
-                        dtrace.emit("admm_iter", interval=ti, iter=nadmm,
-                                    r1_mean=res_1, dual=float(dual),
-                                    rho_mean=float(np.mean(rhok)))
-                    for b in np.where(good)[0]:
-                        BZb = np.einsum("p,mpkns->mkns", B[b], Z)
-                        Y[b] -= rhok[b][:, None, None, None] * BZb
-        rn.unstack_state(pstack, memstack, pfreq, mems)
+                        # ADMM updates (minibatch_consensus_mode.cpp:551-590)
+                        good = ~fband
+                        for b in np.where(good)[0]:
+                            Y[b] += rhok[b][:, None, None, None] * p_np[b]
+                        zsum = np.einsum("b,bp,bmkns->mpkns",
+                                         good.astype(float), B, Y)
+                        Zold = Z.copy()
+                        Z = np.asarray(cpoly.z_from_contributions(
+                            jnp.asarray(zsum), jnp.asarray(Bii)))
+                        dual = np.linalg.norm(Z - Zold) / np.sqrt(Z.size)
+                        if cfg.verbose:
+                            log(f"ADMM : {nadmm} dual residual={dual:.6f}")
+                        if dtrace.active():
+                            dtrace.emit("admm_iter", interval=ti, iter=nadmm,
+                                        r1_mean=res_1, dual=float(dual),
+                                        rho_mean=float(np.mean(rhok)))
+                        for b in np.where(good)[0]:
+                            BZb = np.einsum("p,mpkns->mkns", B[b], Z)
+                            Y[b] -= rhok[b][:, None, None, None] * BZb
+            rn.unstack_state(pstack, memstack, pfreq, mems)
 
-        if cfg.use_global_solution:
-            log("Using Global")
-            for b in range(rn.nsolbw):
-                pfreq[b] = np.einsum("p,mpkns->mkns", B[b], Z).astype(
-                    np.float32)
+            if cfg.use_global_solution:
+                log("Using Global")
+                for b in range(rn.nsolbw):
+                    pfreq[b] = np.einsum("p,mpkns->mkns", B[b], Z).astype(
+                        np.float32)
 
-        rn.end_of_tile(tile, ti, state, resband, res_0, res_1, t0,
-                       writer, history)
+            rn.end_of_tile(tile, ti, state, resband, res_0, res_1, t0,
+                           writer, history, aw=aw, bubble_s=io_wait,
+                           overlap=depth)
+    finally:
+        aw.close()
     if writer:
         writer.close()
     return history

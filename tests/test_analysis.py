@@ -283,6 +283,58 @@ def test_hostsync_gated_and_cold_path_clean(tmp_path):
     assert f == []
 
 
+def test_hostsync_block_in_loop_flagged_async_readback_blessed(tmp_path):
+    """ISSUE 5 overlap contract: a per-iteration block_until_ready in
+    a hot host loop is a finding, while the BLESSED async-readback API
+    (.copy_to_host_async, started before handing the fetch to the
+    sched writer thread) must never be — not now, not via a future
+    broadening of the attribute-pattern rules."""
+    f, _ = _lint(tmp_path, """
+    def drain(xs):
+        outs = []
+        for x in xs:
+            r = step(x, x)
+            jax.block_until_ready(r)
+            outs.append(r)
+        return outs
+    """)
+    assert _rules(f) == ["host-sync"]
+    assert "block_until_ready" in f[0].message
+
+    f, _ = _lint(tmp_path, """
+    def overlapped(xs, submit):
+        for x in xs:
+            r = step(x, x)
+            r.copy_to_host_async()
+            submit(r)
+    """)
+    assert f == []
+    # sched.py itself is hot-path scope now (core._HOT_BASENAMES): the
+    # writer/prefetch thread loops must never grow a per-iteration sync
+    f, _ = _lint(tmp_path, """
+    def worker(q):
+        while True:
+            r = q.get()
+            r.item()
+    """, relpath="sched.py")
+    assert _rules(f) == ["host-sync"]
+
+
+def test_hostsync_block_in_loop_suppressed_with_reason_ok(tmp_path):
+    """The deliberate per-sweep timing barrier (sage.py's fuse=auto
+    plan learning) stays expressible: an inline suppression WITH a
+    reason silences the block_until_ready finding."""
+    f, s = _lint(tmp_path, """
+    def sweeps(xs):
+        for x in xs:
+            r = step(x, x)
+            # jaxlint: disable=host-sync -- per-sweep timing barrier
+            jax.block_until_ready(r)
+    """)
+    assert f == []
+    assert len(s) == 1 and "timing barrier" in s[0][1]
+
+
 # ---------------------------------------------------------------------------
 # dtype-promotion (traced bodies in hot modules)
 # ---------------------------------------------------------------------------

@@ -82,9 +82,10 @@ def test_tpu_death_falls_back_to_cpu(monkeypatch, sandbox, capsys):
     assert calls[0] == ("1-fullbatch-lm", False)
     tpu_calls = [c for c in calls if not c[1]]
     assert tpu_calls == [("1-fullbatch-lm", False)]
-    # downgrade pass recovered config 1 on cpu -> 5/5, no FAILED rows
+    # downgrade pass recovered config 1 on cpu -> full record,
+    # no FAILED rows
     assert all("error" not in r for r in results.values())
-    assert len(results) == 5
+    assert len(results) == len(bench.CONFIGS)
 
 
 def test_tpu_alive_but_config_fails_stays_on_tpu(monkeypatch, sandbox,
@@ -97,8 +98,9 @@ def test_tpu_alive_but_config_fails_stays_on_tpu(monkeypatch, sandbox,
         tpu_result={"error": "rc=1: kernel fault"})
     capsys.readouterr()
     tpu_calls = [c for c in calls if not c[1]]
-    # all five configs were still attempted on the chip
-    assert [n for n, _ in tpu_calls][:5] == [n for n, _ in bench.CONFIGS]
+    # every config was still attempted on the chip
+    assert ([n for n, _ in tpu_calls][:len(bench.CONFIGS)]
+            == [n for n, _ in bench.CONFIGS])
     # and the downgrade pass then filled them in on cpu
     assert all(r.get("platform") == "cpu" for r in results.values())
     # deliberate CPU repair runs beside a LIVE chip must not write a
@@ -140,7 +142,7 @@ def test_cpu_run_unaffected(monkeypatch, sandbox, capsys):
         tpu_result={"error": "unused"})
     capsys.readouterr()
     assert all(cpu for _, cpu in calls)
-    assert len(results) == 5
+    assert len(results) == len(bench.CONFIGS)
     assert all("error" not in r for r in results.values())
 
 

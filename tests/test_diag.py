@@ -276,6 +276,96 @@ def test_cli_diag_trace_end_to_end(tmp_path):
     assert not trace.active()
 
 
+def test_diag_overlap_attribution(tmp_path):
+    """Sync-vs-async io attribution (ISSUE 5): under --prefetch N>0
+    the "io" phase records the host WAIT for the next tile (the
+    bubble) while the background thread's read time is emitted as a
+    ``bg``-tagged record, and tile records carry the bubble_s/overlap
+    accounting pair; under --prefetch 0 there are no bg records and
+    overlap is 0. ONE pipeline serves both runs (compile once); the
+    CLI plumbing of --prefetch/--diag is covered by
+    test_cli_diag_trace_end_to_end."""
+    from sagecal_tpu import cli, pipeline, skymodel
+    from sagecal_tpu.io import dataset as ds
+
+    msdir, sky_file = _make_sim_dataset(tmp_path)
+    args = cli.build_parser().parse_args([
+        "-d", str(msdir), "-s", str(sky_file),
+        "-c", str(sky_file) + ".cluster",
+        "-e", "1", "-g", "3", "-l", "2", "-j", "1", "-B", "0"])
+    cfg = cli.config_from_args(args)
+    ms = ds.SimMS(str(msdir))
+    sky = skymodel.read_sky_cluster(
+        str(sky_file), str(sky_file) + ".cluster", ms.meta["ra0"],
+        ms.meta["dec0"], ms.meta["freq0"])
+    pipe = pipeline.FullBatchPipeline(cfg, ms, sky, log=lambda *a: None)
+
+    def run(depth, path):
+        trace.enable(str(path))
+        try:
+            pipe.run(prefetch=depth, log=lambda *a: None)
+        finally:
+            trace.disable()
+
+    tr_async = tmp_path / "async.jsonl"
+    run(1, tr_async)
+    recs = trace.read(str(tr_async))
+    tiles = [r for r in recs if r["ev"] == "tile"]
+    assert tiles and all(r["overlap"] == 1 for r in tiles)
+    assert all(r["bubble_s"] >= 0.0 for r in tiles)
+    # the background thread's read + stage time is bg-tagged...
+    bg = [r for r in recs if r["ev"] == "phase" and r.get("bg")]
+    assert {"read", "stage"} <= {r["name"] for r in bg}
+    # ...and the consumer-side io phase (the wait) is NOT bg
+    ios = [r for r in recs if r["ev"] == "phase" and r["name"] == "io"]
+    assert ios and not any(r.get("bg") for r in ios)
+
+    tr_sync = tmp_path / "sync.jsonl"
+    run(0, tr_sync)
+    recs = trace.read(str(tr_sync))
+    tiles = [r for r in recs if r["ev"] == "tile"]
+    assert tiles and all(r["overlap"] == 0 for r in tiles)
+    assert not any(r.get("bg") for r in recs)
+    # sync io phase = the inline read+stage (production) time; the
+    # stage phase exists un-tagged
+    phases = {r["name"] for r in recs if r["ev"] == "phase"}
+    assert {"io", "stage", "solve", "residual", "write"} <= phases
+
+    # overlap_stats classifies both traces
+    st = trace.overlap_stats(trace.read(str(tr_async)))
+    assert st["tiles"] == 2 and st["overlap"] == 1
+    assert st["wall_s"] > 0 and 0.0 <= st["busy_frac"] <= 1.5
+    st0 = trace.overlap_stats(trace.read(str(tr_sync)))
+    assert st0["overlap"] == 0 and st0["bubble_s"] >= 0.0
+
+
+def test_overlap_stats_math():
+    recs = [
+        {"t": 0.0, "ev": "run_start"},
+        {"t": 0.1, "ev": "phase", "name": "read", "dur_s": 5.0,
+         "bg": True},
+        {"t": 0.2, "ev": "phase", "name": "io", "dur_s": 0.25},
+        {"t": 0.3, "ev": "phase", "name": "solve", "dur_s": 6.0},
+        {"t": 0.4, "ev": "phase", "name": "residual", "dur_s": 1.0},
+        {"t": 0.5, "ev": "tile", "tile": 0, "res_0": 1.0, "res_1": 0.5,
+         "bubble_s": 0.5, "overlap": 2},
+        {"t": 0.6, "ev": "run_end", "wall_s": 10.0},
+    ]
+    st = trace.overlap_stats(recs)
+    assert st["tiles"] == 1 and st["overlap"] == 2
+    assert st["wall_s"] == 10.0
+    assert st["busy_s"] == 7.0          # solve + residual, bg excluded
+    assert st["bubble_s"] == 0.5        # tile bubble_s wins over io sum
+    assert st["busy_frac"] == 0.7 and st["bubble_frac"] == 0.05
+    # sync attribution: no bubble_s on tiles -> io + write phases
+    recs2 = [r.copy() for r in recs]
+    del recs2[5]["bubble_s"]
+    recs2.insert(5, {"t": 0.45, "ev": "phase", "name": "write",
+                     "dur_s": 0.75})
+    st2 = trace.overlap_stats(recs2)
+    assert st2["bubble_s"] == 1.0       # io 0.25 + write 0.75
+
+
 def test_cli_legacy_flag_warning(capsys):
     from sagecal_tpu import cli
 

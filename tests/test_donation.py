@@ -198,6 +198,37 @@ def test_admm_host_loop_donation_bit_identical(problem):
         assert np.array_equal(a, b), name
 
 
+def test_donated_ring_never_reads_a_donated_slot():
+    """ISSUE 5 two-slot buffer ring (sched.DonatedRing): under
+    overlapped execution the next tile's residual input is staged
+    while the previous one is in flight; the ring must (a) refuse to
+    overwrite a live (un-donated) slot, (b) hand each buffer out
+    exactly once, and (c) refuse any read after the donating take —
+    so pipeline code can never touch memory XLA reclaimed."""
+    from sagecal_tpu import sched
+
+    donating = jax.jit(lambda x: x + 1.0, donate_argnums=(0,))
+    ring = sched.DonatedRing(2)
+    a0 = jnp.full((256,), 3.0, jnp.float32)
+    ring.stage(0, a0)
+    ring.stage(1, jnp.full((256,), 4.0, jnp.float32))
+    # overwrite of a live slot (tag 2 -> slot 0, never taken) refused
+    with pytest.raises(RuntimeError, match="never taken"):
+        ring.stage(2, jnp.zeros((256,), jnp.float32))
+    buf = ring.take(0)
+    out = donating(buf)
+    jax.block_until_ready(out)
+    # the slot cannot serve the donated buffer again
+    with pytest.raises(RuntimeError, match="donation"):
+        ring.take(0)
+    # consumed slot re-arms for the tile after next
+    ring.stage(2, jnp.zeros((256,), jnp.float32))
+    assert np.asarray(ring.take(2)).sum() == 0.0
+    if buf.is_deleted():    # backend implements donation: the buffer
+        with pytest.raises(RuntimeError):   # is really gone
+            np.asarray(buf)
+
+
 def test_program_log_keeps_no_live_buffers(problem):
     """jaxlint use-after-donate regression (ANALYSIS.md, PR 4): the
     sage program log stored the raw args of every logged program;

@@ -1,0 +1,296 @@
+"""Overlapped-execution gates (sagecal_tpu.sched + --prefetch).
+
+The contract under test (MIGRATION.md "Overlapped execution"):
+
+- ``--prefetch N`` is BIT-INVISIBLE: solutions written to the
+  solutions file AND residuals written back to the dataset are
+  bit-identical between the synchronous reference loop (0) and the
+  overlapped loop (N>0), across the solo, tile-batch T>1, beam, and
+  minibatch paths — only data movement overlaps, the warm-start solve
+  chain stays sequential;
+- a failing asynchronous MS/solutions write FAILS the run at the next
+  tile boundary with the original traceback, never swallowed;
+- the sched primitives themselves: ordered production/writes,
+  exception propagation, bounded depth.
+"""
+
+import math
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from sagecal_tpu import cli, pipeline, sched, skymodel, stochastic  # noqa: E402
+from sagecal_tpu.io import dataset as ds  # noqa: E402
+from sagecal_tpu.rime import predict as rp  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# sched primitives
+# ---------------------------------------------------------------------------
+
+def test_sched_prefetcher_orders_and_waits():
+    seen_threads = set()
+
+    def produce(i):
+        seen_threads.add(threading.current_thread().name)
+        return i * 10
+
+    out = list(sched.Prefetcher(produce, 5, depth=2))
+    assert [(i, v) for i, v, _ in out] == [(i, i * 10) for i in range(5)]
+    assert all(w >= 0.0 for _, _, w in out)
+    assert all("prefetch" in t for t in seen_threads)
+    # depth 0: inline, same items, produced on THIS thread
+    seen_threads.clear()
+    out = list(sched.Prefetcher(produce, 3, depth=0))
+    assert [(i, v) for i, v, _ in out] == [(i, i * 10) for i in range(3)]
+    assert seen_threads == {threading.current_thread().name}
+
+
+def test_sched_prefetcher_propagates_producer_error():
+    def produce(i):
+        if i == 2:
+            raise ValueError("injected read failure")
+        return i
+
+    it = iter(sched.Prefetcher(produce, 5, depth=1))
+    assert next(it)[0] == 0
+    assert next(it)[0] == 1
+    with pytest.raises(ValueError, match="injected read failure"):
+        for _ in it:
+            pass
+
+
+def test_sched_asyncwriter_ordered_and_failfast():
+    done = []
+    aw = sched.AsyncWriter(enabled=True, maxsize=2)
+    for k in range(6):
+        aw.submit(done.append, k)
+    aw.drain()
+    assert done == list(range(6))       # strict submission order
+
+    def boom():
+        raise RuntimeError("injected write failure")
+
+    aw.submit(boom)
+    aw.submit(done.append, 99)          # must never run after a failure
+    with pytest.raises(RuntimeError, match="injected write failure") as ei:
+        aw.drain()
+    # the original traceback (the failing job's frame) is preserved
+    import traceback
+    assert "boom" in "".join(traceback.format_tb(ei.value.__traceback__))
+    assert 99 not in done
+    aw.close(raise_pending=False)
+
+    # disabled: inline execution, exceptions surface at the call site
+    aw = sched.AsyncWriter(enabled=False)
+    with pytest.raises(RuntimeError, match="injected write failure"):
+        aw.submit(boom)
+    aw.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end bit-identity, sync vs async
+# ---------------------------------------------------------------------------
+
+SKY = """\
+P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6
+P1A 1 20 0 38 0 0 2.5 0 0 0 0 0 0 0 0 150e6
+"""
+
+CLUSTER = """\
+0 1 P0A
+1 2 P1A
+"""
+
+
+def _make_dataset(tmp_path, n_tiles=3, n_stations=8, tilesz=4, nchan=2):
+    sky_path = tmp_path / "sky.txt"
+    sky_path.write_text(SKY)
+    clus_path = tmp_path / "sky.txt.cluster"
+    clus_path.write_text(CLUSTER)
+    ra0 = (41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(sky_path), ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(str(clus_path)))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jt = ds.random_jones(sky.n_clusters, sky.nchunk, n_stations, seed=5,
+                         scale=0.15)
+    freqs = np.linspace(149e6, 151e6, nchan)
+    tiles = [ds.simulate_dataset(dsky, n_stations=n_stations,
+                                 tilesz=tilesz, freqs=freqs, ra0=ra0,
+                                 dec0=dec0, jones=Jt, nchunk=sky.nchunk,
+                                 noise_sigma=0.02, seed=11 + t)
+             for t in range(n_tiles)]
+    msdir = tmp_path / "sim.ms"
+    ds.SimMS.create(str(msdir), tiles)
+    return str(msdir), str(sky_path), str(clus_path)
+
+
+def _cfg(msdir, sky_path, clus_path, extra=()):
+    args = cli.build_parser().parse_args([
+        "-d", msdir, "-s", sky_path, "-c", clus_path,
+        "-j", "0", "-e", "1", "-g", "4", "-l", "2", "-t", "4",
+        *extra])
+    return cli.config_from_args(args)
+
+
+def _corrected(msdir, n_tiles):
+    ms = ds.SimMS(msdir, data_column="CORRECTED_DATA")
+    return [ms.read_tile(i).x.copy() for i in range(n_tiles)]
+
+
+def _assert_bitident(msdir, n_tiles, tmp_path, run, tag=""):
+    """Run ``run(prefetch, sol_path)`` at depth 0 then 2; assert the
+    written residual tiles AND solutions files are bit-identical."""
+    sol0 = str(tmp_path / f"sol0{tag}.txt")
+    sol1 = str(tmp_path / f"sol1{tag}.txt")
+    h0 = run(0, sol0)
+    res0 = _corrected(msdir, n_tiles)
+    h1 = run(2, sol1)
+    res1 = _corrected(msdir, n_tiles)
+    for a, b in zip(res0, res1):
+        assert np.array_equal(a, b)     # bit-identical residuals
+    with open(sol0) as f0, open(sol1) as f1:
+        assert f0.read() == f1.read()   # bit-identical solutions
+    for a, b in zip(h0, h1):
+        assert a["res_0"] == b["res_0"] and a["res_1"] == b["res_1"]
+    return h0
+
+
+def test_bitident_solo(tmp_path):
+    msdir, skyf, clusf = _make_dataset(tmp_path)
+    cfg = _cfg(msdir, skyf, clusf)
+    ms = ds.SimMS(msdir)
+    sky = skymodel.read_sky_cluster(skyf, clusf, ms.meta["ra0"],
+                                    ms.meta["dec0"], ms.meta["freq0"])
+    pipe = pipeline.FullBatchPipeline(cfg, ms, sky, log=lambda *a: None)
+
+    def run(depth, sol):
+        return pipe.run(solution_path=sol, prefetch=depth,
+                        log=lambda *a: None)
+
+    h = _assert_bitident(msdir, 3, tmp_path, run)
+    assert len(h) == 3
+    assert all(np.isfinite(x["res_1"]) for x in h)
+
+
+@pytest.mark.slow
+def test_bitident_tile_batch(tmp_path):
+    """--tile-batch 2 (the batched driver, solo boost tile + one
+    2-tile group) under overlap == sync, bit for bit. Slow-marked
+    (PR 1 precedent: the tier-1 wall holds its budget; the full CI
+    suite runs it every push)."""
+    msdir, skyf, clusf = _make_dataset(tmp_path)
+    cfg = _cfg(msdir, skyf, clusf, extra=("--tile-batch", "2"))
+    ms = ds.SimMS(msdir)
+    sky = skymodel.read_sky_cluster(skyf, clusf, ms.meta["ra0"],
+                                    ms.meta["dec0"], ms.meta["freq0"])
+    pipe = pipeline.FullBatchPipeline(cfg, ms, sky, log=lambda *a: None)
+    assert pipe.batch_ok
+
+    def run(depth, sol):
+        return pipe.run(solution_path=sol, prefetch=depth,
+                        log=lambda *a: None)
+
+    _assert_bitident(msdir, 3, tmp_path, run, tag="T2")
+
+
+def test_bitident_beam(tmp_path):
+    """-B 1 (synthetic beam tables staged per tile, incl. on the
+    prefetch thread) under overlap == sync, bit for bit."""
+    msdir, skyf, clusf = _make_dataset(tmp_path, n_tiles=2)
+    cfg = _cfg(msdir, skyf, clusf, extra=("-B", "1"))
+    ms = ds.SimMS(msdir)
+    sky = skymodel.read_sky_cluster(skyf, clusf, ms.meta["ra0"],
+                                    ms.meta["dec0"], ms.meta["freq0"])
+    pipe = pipeline.FullBatchPipeline(cfg, ms, sky, log=lambda *a: None)
+    assert pipe.dobeam
+
+    def run(depth, sol):
+        return pipe.run(solution_path=sol, prefetch=depth,
+                        log=lambda *a: None)
+
+    _assert_bitident(msdir, 2, tmp_path, run, tag="B")
+
+
+@pytest.mark.slow
+def test_bitident_minibatch(tmp_path):
+    """Stochastic minibatch runner (-N 1 -M 2 -w 2): prefetched reads
+    + async residual/solution writeback == the sync loop, bit for
+    bit. Slow-marked to hold the tier-1 budget; full CI runs it."""
+    msdir, skyf, clusf = _make_dataset(tmp_path, n_tiles=2, nchan=4)
+
+    def run(depth, sol):
+        args = cli.build_parser().parse_args([
+            "-d", msdir, "-s", skyf, "-c", clusf, "-t", "4",
+            "-N", "1", "-M", "2", "-w", "2", "-l", "3", "-p", sol,
+            "--prefetch", str(depth)])
+        cfg = cli.config_from_args(args)
+        return stochastic.run_minibatch(cfg, log=lambda *a: None)
+
+    _assert_bitident(msdir, 2, tmp_path, run, tag="mb")
+
+
+# ---------------------------------------------------------------------------
+# writer-thread failure semantics
+# ---------------------------------------------------------------------------
+
+def test_writer_failure_fails_run_with_original_traceback(
+        tmp_path, monkeypatch):
+    """An exception in the async MS write must fail the run at the
+    next tile boundary with the ORIGINAL traceback — never swallowed.
+    (--prefetch 0 is the documented debugging escape hatch: the same
+    failure then raises inline at the write site itself.)"""
+    msdir, skyf, clusf = _make_dataset(tmp_path)
+    cfg = _cfg(msdir, skyf, clusf)
+    ms = ds.SimMS(msdir)
+    sky = skymodel.read_sky_cluster(skyf, clusf, ms.meta["ra0"],
+                                    ms.meta["dec0"], ms.meta["freq0"])
+    pipe = pipeline.FullBatchPipeline(cfg, ms, sky, log=lambda *a: None)
+
+    real_write = ds.SimMS.write_tile
+    calls = []
+
+    def failing_write(self, i, tile, column=None):
+        calls.append(i)
+        if i == 1:
+            raise OSError("injected MS write failure")
+        return real_write(self, i, tile, column=column)
+
+    monkeypatch.setattr(ds.SimMS, "write_tile", failing_write)
+    with pytest.raises(OSError, match="injected MS write failure") as ei:
+        pipe.run(prefetch=1, log=lambda *a: None)
+    import traceback
+    tb = "".join(traceback.format_tb(ei.value.__traceback__))
+    assert "failing_write" in tb        # original frames preserved
+    # the failure stopped the run: tile 2's write never happened
+    assert 2 not in calls
+
+    # sync escape hatch: same failure, raised inline
+    calls.clear()
+    with pytest.raises(OSError, match="injected MS write failure"):
+        pipe.run(prefetch=0, log=lambda *a: None)
+
+
+def test_sched_slow_writer_backpressure_bounded():
+    """A slow writer never grows the queue without bound: submit
+    blocks once maxsize jobs are pending (the bubble the diag records
+    as write backpressure)."""
+    aw = sched.AsyncWriter(enabled=True, maxsize=1)
+    release = threading.Event()
+    aw.submit(release.wait)             # occupies the writer
+    aw.submit(lambda: None)             # fills the 1-slot queue
+    t0 = time.perf_counter()
+    threading.Timer(0.15, release.set).start()
+    blocked = aw.submit(lambda: None)   # must block until release
+    assert time.perf_counter() - t0 >= 0.1
+    assert blocked >= 0.1
+    aw.close()

@@ -421,19 +421,27 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
         return _TRIP_CACHE[key]
     import jax
     import jax.numpy as jnp
+    from sagecal_tpu import dtypes as dtp
     from sagecal_tpu.config import SolverMode
     from sagecal_tpu.solvers import lm as lm_mod
     from sagecal_tpu.solvers import normal_eq as ne
     from sagecal_tpu.solvers import rtr as rtr_mod
     K, N = kmax, n_stations
     P = 8 * N
+    # ``dtype`` may be a reduced STORAGE dtype (SAGECAL_BENCH_DTYPE /
+    # config 7): data specs carry it, solver-state specs carry the
+    # accumulator dtype, and the priced bodies are the reduced ones
+    # (normal_equations dispatches on the spec dtype; the damped solve
+    # routes through the LU body the reduced lm path executes)
     f = dtype
-    c = jnp.complex64 if dtype == jnp.float32 else jnp.complex128
+    fa = dtp.acc_dtype(dtype)
+    reduced = dtp.is_reduced(dtype)
+    c = jnp.complex64 if fa == jnp.float32 else jnp.complex128
     i = jnp.int32
     S = jax.ShapeDtypeStruct
     x8, coh = S((B, 8), f), S((B, 2, 2), c)
     s1, s2, cid = S((B,), i), S((B,), i), S((B,), i)
-    wt, p = S((B, 8), f), S((K, P), f)
+    wt, p = S((B, 8), f), S((K, P), fa)
     try:
         if int(solver_mode) in (int(SolverMode.RTR_OSLM_LBFGS),
                                 int(SolverMode.RTR_OSRLM_RLBFGS)):
@@ -466,7 +474,7 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
                         _lower_cost(hv, p, S((B, 2, 2, 4), f),
                                     S((B, 2, 2, 4), f),
                                     S((B, 2, 2, 2), f),
-                                    S((K, N, 2, 4, 4), f), p,
+                                    S((K, N, 2, 4, 4), fa), p,
                                     s1, s2, cid),
                         rtr_mod.RTRConfig().tcg_iters))
             else:
@@ -487,7 +495,7 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
 
                 trip = _rl().combine(
                     _lower_cost(outer, p, x8, coh, s1, s2, cid, wt),
-                    _rl().scale(_lower_cost(hv, p, S((K, P, P), f), p),
+                    _rl().scale(_lower_cost(hv, p, S((K, P, P), fa), p),
                                 rtr_mod.RTRConfig().tcg_iters))
         elif int(solver_mode) == int(SolverMode.NSD_RLBFGS):
             def nsd_outer(p, x8, coh, s1, s2, cid, wt):
@@ -520,23 +528,58 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
                 z0 = ne.gn_precond_apply(Lfac, JTe, K, N)
                 return fac, JTe, cost, z0
 
-            trip = _lower_cost(lm_trip, p, S((K,), f), p, x8, coh, s1,
+            trip = _lower_cost(lm_trip, p, S((K,), fa), p, x8, coh, s1,
                                s2, cid, wt)
+        elif (reduced and K == 1 and int(nbase) > 0
+              and B % int(nbase) == 0
+              and int(solver_mode)
+              == int(SolverMode.OSLM_OSRLM_RLBFGS)):
+            # reduced-policy ORDERED-SUBSETS trip (mode 3: every EM
+            # iteration's LM body runs under OS): lm.py slices the
+            # subset's contiguous rows (ne.os_subset_equations — exact,
+            # and ~1/n_subsets of the assembly traffic) plus one
+            # full-[B] residual pass for the acceptance cost, solved by
+            # the LU body. Pricing the masked full assembly here would
+            # overstate the reduced path's bytes by ~3x. Modes 0/2 mix
+            # OS and non-OS EM iterations, so they keep the full-
+            # assembly price (an over-, never under-count).
+            tilesz = B // int(nbase)
+            # derive ntper from the SAME partition lm.py executes
+            # (os_subset_ids), not a re-statement of its law: the block
+            # size is subset 0's timeslot count
+            os_ids_np, _ns = lm_mod.os_subset_ids(tilesz, int(nbase))
+            import numpy as _np
+            ntper = int(_np.sum(_np.asarray(os_ids_np)[::int(nbase)] == 0))
+
+            def lm_trip(JTJ, JTe, mu, p, x8, coh, s1, s2, wt, osids, l):
+                dp, _ = lm_mod._lu_solve_shift(JTJ, JTe, mu + 1e-9)
+                Jn = ne.jones_r2c((p + dp).reshape(K, N, 8))
+                return ne.os_subset_equations(x8, Jn, coh, s1, s2, wt,
+                                              osids, l, ntper,
+                                              int(nbase), N, wt)
+
+            trip = _lower_cost(lm_trip, S((K, P, P), fa), p, S((K,), fa),
+                               p, x8, coh, s1, s2, wt, S((B,), i),
+                               S((), i))
         else:
             def lm_trip(JTJ, JTe, mu, p, x8, coh, s1, s2, cid, wt):
                 # price the executed all-ok solve body, NOT
                 # _solve_damped: cost analysis sums both lax.cond
                 # branches, so the wrapper would charge every trip for
                 # the never-taken jitter-retry factorization (+31%
-                # bytes on config 1 when this priced the wrapper)
-                dp, _ = lm_mod._chol_solve_shift(JTJ, JTe, mu + 1e-9)
+                # bytes on config 1 when this priced the wrapper).
+                # Reduced policies price the LU body lm.py executes.
+                if reduced:
+                    dp, _ = lm_mod._lu_solve_shift(JTJ, JTe, mu + 1e-9)
+                else:
+                    dp, _ = lm_mod._chol_solve_shift(JTJ, JTe, mu + 1e-9)
                 Jn = ne.jones_r2c((p + dp).reshape(K, N, 8))
                 # normal equations AND acceptance cost from the body's
                 # single row pass (lm.py); no separate cost evaluation
                 return ne.normal_equations(x8, Jn, coh, s1, s2, cid, wt,
                                            N, K, row_period=int(nbase))
 
-            trip = _lower_cost(lm_trip, S((K, P, P), f), p, S((K,), f),
+            trip = _lower_cost(lm_trip, S((K, P, P), fa), p, S((K,), fa),
                                p, x8, coh, s1, s2, cid, wt)
         _TRIP_CACHE[key] = trip
         return trip
@@ -560,9 +603,11 @@ def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0):
         return _TRIP_CACHE[key]
     import jax
     import jax.numpy as jnp
+    from sagecal_tpu import dtypes as dtp
     from sagecal_tpu.solvers import normal_eq as ne
     K, N = kmax, n_stations
     f = dtype
+    fa = dtp.acc_dtype(dtype)
     i = jnp.int32
     S = jax.ShapeDtypeStruct
     try:
@@ -578,9 +623,9 @@ def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0):
 
         trip = _lower_cost(
             body, S((B, 2, 2, 4), f), S((B, 2, 2, 4), f),
-            S((B, 2, 2, 2), f), S((K, N, 2, 4, 4), f), S((K, 8 * N), f),
-            S((K, 8 * N), f), S((K,), f), S((B,), i), S((B,), i),
-            S((B,), i))
+            S((B, 2, 2, 2), f), S((K, N, 2, 4, 4), fa),
+            S((K, 8 * N), fa), S((K, 8 * N), fa), S((K,), fa),
+            S((B,), i), S((B,), i), S((B,), i))
         _TRIP_CACHE[key] = trip
         return trip
     except Exception as e:          # pragma: no cover - version-dependent
@@ -598,9 +643,11 @@ def refine_trip_cost(M, kmax, n_stations, B, robust, dtype):
         return _TRIP_CACHE[key]
     import jax
     import jax.numpy as jnp
+    from sagecal_tpu import dtypes as dtp
     from sagecal_tpu.solvers import sage as sage_mod
     f = dtype
-    c = jnp.complex64 if dtype == jnp.float32 else jnp.complex128
+    fa = dtp.acc_dtype(dtype)
+    c = jnp.complex64 if fa == jnp.float32 else jnp.complex128
     i = jnp.int32
     S = jax.ShapeDtypeStruct
     shape = (M * kmax, n_stations, 8)
@@ -612,7 +659,7 @@ def refine_trip_cost(M, kmax, n_stations, B, robust, dtype):
             return jax.value_and_grad(cost_fn)(p)
 
         out = _lower_cost(
-            cg, S((M * kmax * n_stations * 8,), f), S((B, 8), f),
+            cg, S((M * kmax * n_stations * 8,), fa), S((B, 8), f),
             S((M, B, 2, 2), c), S((B,), i), S((B,), i), S((M, B), i),
             S((B, 8), f))
         _TRIP_CACHE[key] = out
@@ -673,7 +720,7 @@ def pallas_ok(device, dtype, sky) -> bool:
 
 def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
               max_emiter=3, max_iter=10, max_lbfgs=10, use_pallas=False,
-              inflight=1, inner="chol"):
+              inflight=1, inner="chol", dtype_policy="f32"):
     """Compile + time one batched SAGE solve over ``tiles`` independent
     solve intervals; returns (vis/s, r0, r1, dt, compile_s, cost_step)
     where cost_step is {"flops", "bytes_accessed"} per timed step (or
@@ -701,17 +748,24 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
     """
     import jax
     import jax.numpy as jnp
+    from sagecal_tpu import dtypes as dtp
     from sagecal_tpu.rime import predict as rp
     from sagecal_tpu.solvers import lm as lm_mod, normal_eq as ne, sage
 
     tile = tiles[0]
     T = len(tiles)
     inp = _sage_inputs(sky, tiles, dtype, device)
+    # dtype-policy storage staging: the bench ships/solves the same
+    # sdt bytes the pipeline would (identity at "f32")
+    sdt = dtp.storage_dtype(dtype_policy, dtype)
+    inp["x8"] = inp["x8"].astype(sdt)
+    inp["wt"] = inp["wt"].astype(sdt)
     dsky_d = jax.device_put(dsky, device)
     os_ids, ns = lm_mod.os_subset_ids(tile.tilesz, tile.nbase)
     cfg = sage.SageConfig(max_emiter=max_emiter, max_iter=max_iter,
                           max_lbfgs=max_lbfgs, solver_mode=int(solver_mode),
-                          inflight=inflight, nbase=tile.nbase, inner=inner)
+                          inflight=inflight, nbase=tile.nbase, inner=inner,
+                          dtype_policy=dtype_policy)
     if T > 1:
         # tile-batch trials route through the per-sweep host-tiles
         # driver (VERDICT r5 weak #3): force-fuse each EM sweep into
@@ -804,10 +858,21 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
         trips = float(np.asarray(si).sum())
         refine_trips = float(np.asarray(lk).sum())
         cg_trips = float(np.asarray(ci).sum())
-        tf = solver_trip_cost(solver_mode, kmax, n, tile.nrows, dtype,
+        tf = solver_trip_cost(solver_mode, kmax, n, tile.nrows, sdt,
                               nbase=tile.nbase, inner=inner)
         rf = refine_trip_cost(sky.n_clusters, kmax, n, tile.nrows,
-                              sage._is_robust(int(solver_mode)), dtype)
+                              sage._is_robust(int(solver_mode)), sdt)
+        # composition detail so config 7 can re-price at EQUAL trip
+        # counts across policies (merged into cost_step after the trip
+        # corrections below — trip_correct returns a fresh dict)
+        detail = {
+            "base_bytes": cost_step["bytes_accessed"],
+            "solver_trips": trips, "refine_trips": refine_trips,
+            "cg_trips": cg_trips,
+            "solver_trip_bytes": 0.0 if tf is None
+            else tf["bytes_accessed"],
+            "refine_trip_bytes": 0.0 if rf is None
+            else rf["bytes_accessed"]}
         # each term applies independently: dropping BOTH because one
         # price failed would silently revert to the orders-of-magnitude
         # undercount this correction exists to fix
@@ -818,9 +883,10 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
         if inner == "cg" and cg_trips:
             # the matrix-free path's Krylov traffic: executed PCG trips
             # (info["cg_iters"]) x one matvec + preconditioner apply
-            cf = cg_trip_cost(kmax, n, tile.nrows, dtype,
+            cf = cg_trip_cost(kmax, n, tile.nrows, sdt,
                               nbase=tile.nbase)
             cost_step = rl.trip_correct(cost_step, cf, cg_trips)
+        cost_step.update(detail)
         log(f"# flops: {trips:.0f} solver trips x "
             f"{(tf['flops'] if tf else 0) / 1e9:.4f} GF + "
             f"{refine_trips:.0f} refine trips x "
@@ -885,6 +951,19 @@ def _inflight_for(device, M: int, default: int = 1) -> tuple[int, int]:
     return G, sage._eff_inflight(sage.SageConfig(inflight=G), M)
 
 
+def _dtype_policy_for() -> str:
+    """Storage dtype policy for the SAGE configs (SAGECAL_BENCH_DTYPE
+    override: f32 | bf16 | f16, default f32). Non-f32 runs tag their
+    records with ``dtype_policy`` and are NEVER round-stamped as the
+    standard configs (the bank must stay the f32 reference the Δbytes
+    column measures against) — config ``7-dtype-melt`` is the banked
+    vehicle for the per-policy numbers."""
+    v = os.environ.get("SAGECAL_BENCH_DTYPE", "f32")
+    if v not in ("f32", "bf16", "f16"):
+        raise SystemExit(f"SAGECAL_BENCH_DTYPE={v}: pick f32|bf16|f16")
+    return v
+
+
 def _inner_for() -> str:
     """Inner linear solver for the SAGE configs (SAGECAL_BENCH_INNER
     override: "chol" | "cg"). Default chol — the measured verdict
@@ -926,24 +1005,28 @@ def config1_fullbatch_lm(device, dtype):
     T = _tiles_for(device)
     G, Ge = _inflight_for(device, 8)
     inr = _inner_for()
+    pol = _dtype_policy_for()
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=8,
                                        tilesz=10, n_tiles=T)
     pal = pallas_ok(device, dtype, sky)
     vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
                                           SolverMode.OSLM_OSRLM_RLBFGS,
                                           use_pallas=pal, inflight=G,
-                                          inner=inr)
+                                          inner=inr, dtype_policy=pol)
     itag = "" if inr == "chol" else f" inner={inr}"
+    ptag = "" if pol == "f32" else f" {pol}"
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, pallas=pal, tiles=T,
                inflight=G, inflight_eff=Ge, inner=inr,
-               shape=f"N=62 M=8 tilesz=10 point -j3 T{T} G{Ge}{itag}")
+               shape=f"N=62 M=8 tilesz=10 point -j3 T{T} G{Ge}{itag}{ptag}")
+    if pol != "f32":
+        out["dtype_policy"] = pol
     _roofline_fields(out, device, fl, dt)
     if pal:
         vps0, _, _, _, _, _ = time_sage(device, dtype, sky, dsky, tiles,
                                         SolverMode.OSLM_OSRLM_RLBFGS,
                                         use_pallas=False, inflight=G,
-                                        inner=inr)
+                                        inner=inr, dtype_policy=pol)
         out["value_xla"] = vps0
         out["pallas_speedup"] = vps / vps0
     return out
@@ -1122,20 +1205,25 @@ def config3_rtr16(device, dtype):
     T = _tiles_for(device)
     G, Ge = _inflight_for(device, 16)
     inr = _inner_for()
+    pol = _dtype_policy_for()
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=16,
                                        tilesz=10, seed=SEED + 10,
                                        n_tiles=T)
     vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
                                           SolverMode.RTR_OSRLM_RLBFGS,
                                           reps=1, max_emiter=emi,
-                                          inflight=G, inner=inr)
+                                          inflight=G, inner=inr,
+                                          dtype_policy=pol)
     small = "" if on_tpu else " (cpu-small E1)"
     itag = "" if inr == "chol" else f" inner={inr}"
+    ptag = "" if pol == "f32" else f" {pol}"
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, tiles=T, inflight=G,
                inflight_eff=Ge, inner=inr,
                shape=f"N=62 M=16 tilesz=10 point -j5 T{T} G{Ge}"
-                     f"{small}{itag}")
+                     f"{small}{itag}{ptag}")
+    if pol != "f32":
+        out["dtype_policy"] = pol
     return _roofline_fields(out, device, fl, dt)
 
 
@@ -1156,25 +1244,29 @@ def config4_extended(device, dtype):
                                        n_tiles=T)
     pal = pallas_ok(device, dtype, sky)
     inr = _inner_for()
+    pol = _dtype_policy_for()
     vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
                                           SolverMode.RTR_OSRLM_RLBFGS,
                                           reps=1, max_emiter=emi,
                                           use_pallas=pal, inflight=G,
-                                          inner=inr)
+                                          inner=inr, dtype_policy=pol)
     small = "" if on_tpu else " (cpu-small E1)"
     itag = "" if inr == "chol" else f" inner={inr}"
+    ptag = "" if pol == "f32" else f" {pol}"
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, pallas=pal, tiles=T,
                inflight=G, inflight_eff=Ge, inner=inr,
                shape=f"N=64 M=8 shapelet+gauss -F1 -j5 T{T} G{Ge}"
-                     f"{small}{itag}")
+                     f"{small}{itag}{ptag}")
+    if pol != "f32":
+        out["dtype_policy"] = pol
     _roofline_fields(out, device, fl, dt)
     if pal:
         vps0, _, _, _, _, _ = time_sage(device, dtype, sky, dsky, tiles,
                                         SolverMode.RTR_OSRLM_RLBFGS,
                                         reps=1, max_emiter=emi,
                                         use_pallas=False, inflight=G,
-                                        inner=inr)
+                                        inner=inr, dtype_policy=pol)
         out["value_xla"] = vps0
         out["pallas_speedup"] = vps / vps0
     return out
@@ -1405,6 +1497,109 @@ def config6_overlap(device, dtype):
     return rec
 
 
+# per-policy residual-drift envelopes for the dtype-melt config: a
+# record whose |res_1/res_1_f32 - 1| exceeds its policy's envelope is
+# REFUSED from the bank (the byte win would be riding a broken solve).
+# bf16 (8-bit mantissa) is allowed more drift than f16 (11-bit);
+# envelopes sized 4x above the measured config-1 drift so noise never
+# flaps the gate while a real breakage (O(1) drift) always trips it.
+DTYPE_DRIFT_ENVELOPE = {"bf16": 0.25, "f16": 0.10}
+
+
+def config7_dtype(device, dtype):
+    """Round-9 config: the mixed-precision traffic melt (ISSUE 6).
+
+    Runs the config-1 problem shape (N=62, M=8, tilesz=10, -j3) under
+    each dtype policy at a reduced iteration budget (the per-trip price
+    is shape-determined, and the comparison below normalizes trip
+    counts anyway), then reports per policy, ALL AT THE f32 RUN'S
+    EXECUTED TRIP COUNTS:
+
+      bytes_eq = base_bytes(policy) + solver_trips_f32 x trip(policy)
+                 + refine_trips_f32 x refine(policy)
+
+    so ``bytes_vs_f32_pct`` is a pure price delta — trajectory-length
+    differences between policies cannot masquerade as traffic savings.
+    ``res_drift`` is |res_1/res_1_f32 - 1|; policies beyond their
+    DTYPE_DRIFT_ENVELOPE are dropped from the banked record (refusal
+    logged). The top-level bytes_accessed/res fields are the f32
+    reference's, so the round-stamped bank stays f32-comparable for
+    future Δbytes columns.
+    """
+    from sagecal_tpu.config import SolverMode
+    sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=8,
+                                       tilesz=10, n_tiles=1)
+    runs = {}
+    for policy in ("f32", "bf16", "f16"):
+        vps, r0, r1, dt, comp, fl = time_sage(
+            device, dtype, sky, dsky, tiles,
+            SolverMode.OSLM_OSRLM_RLBFGS, reps=1, max_emiter=1,
+            max_iter=8, max_lbfgs=4, dtype_policy=policy)
+        runs[policy] = dict(value=vps, res_0=r0, res_1=r1, step_s=dt,
+                            compile_s=comp, cost=fl)
+    f32r = runs["f32"]
+    fc = f32r["cost"]
+    if (fc is None or not fc.get("solver_trips")
+            or not fc.get("solver_trip_bytes")):
+        # solver_trip_cost fails version-dependently (its own
+        # try/except leaves trip bytes at 0.0 while the trip COUNTER
+        # stays nonzero) — a zero price would divide by zero below or
+        # bank phantom savings
+        out = dict(error="cost analysis unavailable; dtype melt needs "
+                         "the priced composition",
+                   shape="N=62 M=8 tilesz=10 point -j3 dtype-melt")
+        return out
+
+    def bytes_eq(c):
+        # equal-trip pricing: THIS policy's prices, the f32 run's trips
+        return (c["base_bytes"]
+                + fc["solver_trips"] * c["solver_trip_bytes"]
+                + fc["refine_trips"] * c["refine_trip_bytes"])
+
+    ref_bytes = bytes_eq(fc)
+    out = dict(value=f32r["value"], unit="vis/s", res_0=f32r["res_0"],
+               res_1=f32r["res_1"], step_s=f32r["step_s"],
+               compile_s=f32r["compile_s"],
+               solver_trips=fc["solver_trips"],
+               refine_trips=fc["refine_trips"],
+               shape="N=62 M=8 tilesz=10 point -j3 dtype-melt")
+    _roofline_fields(out, device, {"flops": fc["flops"],
+                                   "bytes_accessed": ref_bytes},
+                     f32r["step_s"])
+    policies = {}
+    for policy in ("bf16", "f16"):
+        r = runs[policy]
+        c = r["cost"]
+        if c is None or not c.get("solver_trip_bytes"):
+            # a failed reduced-trip price would read as a phantom
+            # ~-100% byte saving — refuse instead of banking it
+            log(f"# dtype policy {policy}: trip pricing unavailable; "
+                "dropping from the record")
+            continue
+        drift = abs(r["res_1"] / f32r["res_1"] - 1.0) \
+            if f32r["res_1"] else float("inf")
+        rec = dict(bytes_eq=bytes_eq(c),
+                   bytes_vs_f32_pct=round(
+                       100.0 * (bytes_eq(c) / ref_bytes - 1.0), 2),
+                   trip_bytes=c["solver_trip_bytes"],
+                   trip_vs_f32_pct=round(
+                       100.0 * (c["solver_trip_bytes"]
+                                / fc["solver_trip_bytes"] - 1.0), 2),
+                   wall_s=r["step_s"],
+                   wall_vs_f32_pct=round(
+                       100.0 * (r["step_s"] / f32r["step_s"] - 1.0), 2),
+                   res_1=r["res_1"], res_drift=drift)
+        env = DTYPE_DRIFT_ENVELOPE[policy]
+        if drift > env:
+            log(f"# REFUSING to bank dtype policy {policy}: residual "
+                f"drift {drift:.3g} exceeds its tolerance envelope "
+                f"{env} — the byte win would ride a broken solve")
+            rec["refused"] = f"drift {drift:.3g} > envelope {env}"
+        policies[policy] = rec
+    out["dtype_policies"] = policies
+    return out
+
+
 CONFIGS = [
     ("1-fullbatch-lm", config1_fullbatch_lm),
     ("2-stochastic-lbfgs", config2_stochastic),
@@ -1412,6 +1607,7 @@ CONFIGS = [
     ("4-extended-64sta", config4_extended),
     ("5-admm-32subband", config5_admm32),
     ("6-overlap-e2e", config6_overlap),
+    ("7-dtype-melt", config7_dtype),
 ]
 
 
@@ -1545,8 +1741,24 @@ def write_table(results, platform, date=None, stamp=False):
             log(f"# NORTHSTAR.json unreadable: {e}")
     payload = {"platform": platform, "date": date, "results": results}
     if stamp:
+        # bank hygiene: a standard config measured under a non-f32
+        # SAGECAL_BENCH_DTYPE exploration run must never become the
+        # round-stamped reference — the Δbytes column measures reduced
+        # policies AGAINST the f32 bank (config 7 banks the per-policy
+        # numbers; a refused-drift policy is already dropped there)
+        off_policy = {k for k, v in results.items()
+                      if isinstance(v, dict)
+                      and v.get("dtype_policy", "f32") != "f32"}
+        if off_policy:
+            log(f"# refusing to round-stamp off-policy records "
+                f"{sorted(off_policy)}; rerun without "
+                f"SAGECAL_BENCH_DTYPE to bank")
+            payload = {"platform": platform, "date": date,
+                       "results": {k: v for k, v in results.items()
+                                   if k not in off_policy}}
         with open(_stamp_path(platform), "w") as f:
             json.dump(payload, f, indent=1, default=float)
+        payload = {"platform": platform, "date": date, "results": results}
     live = os.path.join(HERE, "bench_results.json")
     if stamp and not os.environ.get("SAGECAL_BENCH_OVERWRITE"):
         # snapshot the PRE-RUN record's backend once per process: the

@@ -39,6 +39,10 @@ RULES = {
     "dtype-promotion": (
         "dtype-less array creation or wide-dtype literal inside a "
         "traced solver kernel"),
+    "storage-accum": (
+        "reduction/contraction over a reduced-storage (bf16/f16) "
+        "array without a named f32 accumulator "
+        "(preferred_element_type= or an explicit upcast)"),
     "cond-cost": (
         "lax.cond branch inlines heavy ops instead of calling a "
         "module-level priceable function"),

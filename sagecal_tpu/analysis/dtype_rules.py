@@ -13,6 +13,32 @@ scoped to TRACED bodies in the hot-path modules:
   are fine);
 - wide-dtype literals: ``jnp.float64``/``jnp.complex128``/
   ``np.float64``/``np.complex128`` referenced inside a kernel.
+
+Storage/accumulate boundary (``storage-accum``, ISSUE 6): under the
+reduced dtype policy (sagecal_tpu.dtypes) the [B]-data arrays live in
+bf16/f16, and a reduction or contraction that silently ACCUMULATES in
+the storage dtype loses ~3 significant digits per 2^8 summands — the
+exact failure mode the policy's f32-accumulation contract exists to
+prevent. The rule runs intra-function dataflow over the codebase's own
+storage conventions:
+
+- an array is STORAGE-TAINTED when it is assigned from
+  ``dtypes.to_storage(...)``, from ``.astype(<storage dtype>)`` (a
+  dtype variable named ``st``/``sdt``/``stq`` or assigned from
+  ``storage_dtype(...)``/``<tainted>.dtype``), or from elementwise
+  arithmetic / reshapes / transposes / stacks of tainted arrays;
+- taint CLEARS through an explicit upcast: ``dtypes.acc(x)`` or
+  ``.astype(<non-storage dtype>)``;
+- a FINDING is a reduction/contraction call (``jnp.einsum/sum/dot/
+  matmul/tensordot/vdot/mean/linalg.norm``, ``segment_sum``, or an
+  ``.at[...].add/max`` scatter-accumulation) whose operand is tainted
+  and which names no f32 accumulator — neither a
+  ``preferred_element_type=`` keyword nor a ``**pet`` splat of a
+  ``dtypes.pet(...)`` result.
+
+Function parameters are never seeded (their dtypes are unknowable
+statically), so the rule polices the storage casts a function itself
+introduces — which is exactly where the boundary lives in this tree.
 """
 
 from __future__ import annotations
@@ -22,6 +48,7 @@ import ast
 from sagecal_tpu.analysis.core import dotted
 
 RULE = "dtype-promotion"
+STORAGE_RULE = "storage-accum"
 
 # creation fn -> positional index where dtype may legally appear
 _CREATORS = {"zeros": 1, "ones": 1, "empty": 1, "eye": 3, "identity": 1,
@@ -94,6 +121,153 @@ def _dtype_derivation(ctx, node) -> bool:
     return False
 
 
+# ---------------------------------------------------------------------------
+# storage/accumulate boundary rule
+# ---------------------------------------------------------------------------
+
+_SDT_NAMES = {"st", "sdt", "stq"}
+# jnp reducers whose silent storage-dtype accumulation is the finding
+_REDUCERS = {"sum", "einsum", "dot", "matmul", "tensordot", "vdot",
+             "mean", "norm", "segment_sum"}
+# elementwise/layout ops that PROPAGATE taint through their array args
+_PROPAGATE = {"where", "stack", "concatenate", "transpose", "reshape",
+              "moveaxis", "swapaxes", "broadcast_to", "abs", "sqrt",
+              "maximum", "minimum", "exp", "log"}
+# method calls on a tainted base that keep it tainted
+_PROP_METHODS = {"reshape", "transpose", "swapaxes", "ravel", "squeeze"}
+
+
+def _is_sdt_expr(node, sdt_names, tainted) -> bool:
+    """Expression denoting a STORAGE dtype: a name from the ``st`` family,
+    a ``storage_dtype(...)`` call, or ``<tainted array>.dtype``."""
+    if isinstance(node, ast.Name):
+        return node.id in sdt_names
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        return d is not None and d.split(".")[-1] == "storage_dtype"
+    if isinstance(node, ast.Attribute) and node.attr == "dtype":
+        return _tainted_expr(node.value, sdt_names, tainted)
+    return False
+
+
+def _tainted_expr(node, sdt_names, tainted) -> bool:
+    """Conservative: does this expression carry a storage-dtype array?"""
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    if isinstance(node, (ast.BinOp,)):
+        return (_tainted_expr(node.left, sdt_names, tainted)
+                or _tainted_expr(node.right, sdt_names, tainted))
+    if isinstance(node, ast.UnaryOp):
+        return _tainted_expr(node.operand, sdt_names, tainted)
+    if isinstance(node, ast.IfExp):
+        return (_tainted_expr(node.body, sdt_names, tainted)
+                or _tainted_expr(node.orelse, sdt_names, tainted))
+    if isinstance(node, ast.Subscript):
+        return _tainted_expr(node.value, sdt_names, tainted)
+    if isinstance(node, ast.Call):
+        d = dotted(node.func)
+        base = d.split(".")[-1] if d else None
+        if base == "to_storage":
+            return True
+        if base in ("acc", "acc_dtype"):
+            return False                      # the blessed upcast
+        if isinstance(node.func, ast.Attribute):
+            meth = node.func.attr
+            if meth == "astype":
+                # cast TO a storage dtype taints; any other cast clears
+                return bool(node.args) and _is_sdt_expr(
+                    node.args[0], sdt_names, tainted)
+            if meth in _PROP_METHODS:
+                return _tainted_expr(node.func.value, sdt_names, tainted)
+        if base in _PROPAGATE:
+            return any(_tainted_expr(a, sdt_names, tainted)
+                       for a in node.args)
+        return False
+    return False
+
+
+def _names_pet(fn):
+    """Local names assigned from a ``pet(...)`` /
+    ``dtypes.pet(...)`` call — the ``**pet`` accumulator splat."""
+    out = set()
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            d = dotted(node.value.func)
+            if d is not None and d.split(".")[-1] == "pet":
+                out.add(node.targets[0].id)
+    return out
+
+
+def _names_accumulator(call, pet_names) -> bool:
+    """True when the reduction call names its accumulator: an explicit
+    ``preferred_element_type=`` kwarg, a ``**pet`` splat, or a ``dtype=``
+    kwarg (jnp.sum/mean accept dtype= as the accumulator)."""
+    for kw in call.keywords:
+        if kw.arg in ("preferred_element_type", "dtype"):
+            return True
+        if kw.arg is None and isinstance(kw.value, ast.Name) \
+                and kw.value.id in pet_names:
+            return True
+    return False
+
+
+def _storage_findings(ctx, fn, findings):
+    sdt_names = set(_SDT_NAMES)
+    tainted: set = set()
+    assigns = [n for n in ast.walk(fn)
+               if isinstance(n, ast.Assign) and len(n.targets) == 1
+               and isinstance(n.targets[0], ast.Name)]
+    changed = True
+    while changed:                      # order-free fixpoint (no SSA)
+        changed = False
+        for a in assigns:
+            t = a.targets[0].id
+            if t not in sdt_names and _is_sdt_expr(a.value, sdt_names,
+                                                   tainted):
+                sdt_names.add(t)
+                changed = True
+            if t not in tainted and _tainted_expr(a.value, sdt_names,
+                                                  tainted):
+                tainted.add(t)
+                changed = True
+    if not tainted:
+        return
+    pet_names = _names_pet(fn)
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        d = dotted(node.func)
+        base = d.split(".")[-1] if d else (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else None)
+        is_scatter_add = (isinstance(node.func, ast.Attribute)
+                          and node.func.attr in ("add", "max")
+                          and isinstance(node.func.value, ast.Subscript))
+        if base in _REDUCERS and not is_scatter_add:
+            if _names_accumulator(node, pet_names):
+                continue
+            if any(_tainted_expr(a, sdt_names, tainted)
+                   for a in node.args):
+                findings.append(ctx.finding(
+                    STORAGE_RULE, node,
+                    f"{base}() reduces over a reduced-storage array "
+                    f"without naming an f32 accumulator — pass "
+                    f"preferred_element_type= (dtypes.pet) or upcast "
+                    f"the operand (dtypes.acc); silent bf16 "
+                    f"accumulation loses ~3 digits per 2^8 summands"))
+        elif is_scatter_add:
+            if any(_tainted_expr(a, sdt_names, tainted)
+                   for a in node.args):
+                findings.append(ctx.finding(
+                    STORAGE_RULE, node,
+                    "scatter-accumulation of reduced-storage updates — "
+                    "the .at[].add target must be an f32 accumulator "
+                    "and the updates upcast (dtypes.acc) or produced "
+                    "by an f32-accumulating contraction"))
+
+
 def check(ctx):
     if not ctx.hot:
         return []
@@ -113,4 +287,5 @@ def check(ctx):
                     f"wide dtype literal {d} inside a traced kernel — "
                     f"upcasts the f32/c64 pipeline; derive the dtype "
                     f"from an input array"))
+        _storage_findings(ctx, fn, findings)
     return findings

@@ -85,6 +85,12 @@ def build_parser() -> argparse.ArgumentParser:
            "thread (bit-identical outputs; default 1 = double-"
            "buffered). 0 = fully synchronous reference loop — the "
            "debugging escape hatch")
+    a("--dtype-policy", choices=("f32", "bf16", "f16"), default="f32",
+      help="storage dtype for the [B]-data (visibilities, weights, "
+           "staged residual tiles, Wirtinger factors) with f32 "
+           "accumulation everywhere; f32 = bit-frozen default "
+           "(MIGRATION.md 'Dtype policy' for the per-policy tolerance "
+           "envelopes)")
     a("--inner", choices=("chol", "cg"), default="chol",
       help="inner linear solver for the damped Gauss-Newton step: "
            "chol = dense [K,8N,8N] assembly + batched Cholesky "
@@ -182,6 +188,7 @@ def config_from_args(args) -> RunConfig:
         solve_promote=args.solve_promote,
         cluster_inflight=args.inflight,
         solver_inner=args.inner,
+        dtype_policy=args.dtype_policy,
         prefetch=args.prefetch,
         shard_baselines=bool(args.shard_baselines))
 

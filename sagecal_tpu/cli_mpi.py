@@ -118,6 +118,10 @@ def build_parser() -> argparse.ArgumentParser:
       help="clusters solved concurrently per SAGE sweep step (block-"
            "Jacobi groups; the reference GPU pipeline's 2-in-flight "
            "analogue, lmfit_cuda.c:450). 1 = strict sequencing")
+    a("--dtype-policy", choices=("f32", "bf16", "f16"), default="f32",
+      help="storage dtype for visibilities/weights/Wirtinger factors "
+           "with f32 accumulation (sagecal_tpu.dtypes; MIGRATION.md "
+           "'Dtype policy'). f32 = bit-frozen default")
     a("--inner", choices=("chol", "cg"), default="chol",
       help="inner linear solver for the per-cluster J-updates: chol = "
            "dense [K,8N,8N] assembly (bit-reference); cg = matrix-free "
@@ -259,6 +263,14 @@ def _main_consensus(args, dtrace) -> int:
     platform = jax.devices()[0].platform
     rdt = jnp.float64 if (platform == "cpu"
                           and jax.config.read("jax_enable_x64")) else jnp.float32
+    # --dtype-policy storage dtype for staged visibilities/weights and
+    # the residual readback (sagecal_tpu.dtypes; "f32" -> sdt == rdt)
+    from sagecal_tpu import dtypes as dtp
+    if getattr(args, "dtype_policy", "f32") != "f32" and rdt == jnp.float64:
+        # reduced policies pair with the f32/c64 pipeline (accumulator
+        # contract is f32; see pipeline.py)
+        rdt = jnp.float32
+    sdt = dtp.storage_dtype(getattr(args, "dtype_policy", "f32"), rdt)
 
     sky = skymodel.read_sky_cluster(
         args.sky_model, args.cluster_file, meta0["ra0"], meta0["dec0"],
@@ -334,7 +346,8 @@ def _main_consensus(args, dtrace) -> int:
             solver_mode=int(SolverMode(args.solver_mode)),
             nulow=args.nulow, nuhigh=args.nuhigh,
             randomize=bool(args.randomize),
-            inflight=args.inflight, inner=args.inner))
+            inflight=args.inflight, inner=args.inner,
+            dtype_policy=getattr(args, "dtype_policy", "f32")))
 
     t0 = mss[0].read_tile(0)
     blk_timer = [] if args.block_f else None
@@ -375,7 +388,9 @@ def _main_consensus(args, dtrace) -> int:
             rho=args.mmse_rho, phase_only=bool(args.phase_only),
             beam=beam_rest[0] if beam_rest else None, dobeam=dobeam,
             tslot=tslot_rows)
-        return utils.c2r(res)
+        # storage-dtype writeback emission (rr.residual_writeback):
+        # the d->h readback ships sdt bytes; identity at "f32"
+        return rr.residual_writeback(res, sdt)
 
     # jaxlint: disable=retrace -- one-shot per-process CLI driver; the
     # wrapper is constructed exactly once per run
@@ -584,11 +599,16 @@ def _main_consensus(args, dtrace) -> int:
 
             padded, _, _ = cadmm.pad_subbands(
                 (x8F, uF, vF, wF, freqs, wtF, fratioF, J0), Bpoly, nf, ndev)
-            args_dev = [stage(np.asarray(a, np.dtype(rdt))) for a in padded]
+            # dtype policy: visibilities + weights stage in the storage
+            # dtype; geometry/frequencies/J0 keep the pipeline dtype
+            pdts = (sdt, rdt, rdt, rdt, rdt, sdt, rdt, rdt)
+            args_dev = [stage(np.asarray(a, np.dtype(d)))
+                        for a, d in zip(padded, pdts)]
             if dtrace.active():
                 dtrace.emit("stage_bytes", what="tile_inputs", tile=ti,
-                            bytes=int(sum(np.asarray(a).size for a in padded)
-                                      * np.dtype(rdt).itemsize))
+                            bytes=int(sum(
+                                np.asarray(a).size * np.dtype(d).itemsize
+                                for a, d in zip(padded, pdts))))
             gmstF = None
             if dobeam:
                 # only the per-tile gmst time track crosses host->device
@@ -702,14 +722,17 @@ def _main_consensus(args, dtrace) -> int:
                     bargs = (jax.tree.map(
                         lambda a: jnp.asarray(a),
                         beamF_static._replace(gmst=gmstF[:nf])),)
-                res_r = res_jit(jnp.asarray(J_res, rdt), jnp.asarray(xF_r, rdt),
+                res_r = res_jit(jnp.asarray(J_res, rdt),
+                                jnp.asarray(xF_r, sdt),
                                 jnp.asarray(uF, rdt), jnp.asarray(vF, rdt),
                                 jnp.asarray(wF, rdt), jnp.asarray(freqs, rdt),
                                 *bargs)
 
                 def _write_res(ti=ti, tiles=tiles, res_r=res_r):
                     with dtrace.phase("write", tile=ti, bg=pf_depth > 0):
-                        res_np = utils.r2c(np.asarray(res_r))
+                        # fetch through float64 (numpy-side r2c has no
+                        # ml_dtypes bf16 path; the MS is complex128)
+                        res_np = utils.r2c(np.asarray(res_r, np.float64))
                         for f, (msx, t) in enumerate(zip(mss, tiles)):
                             t.x = res_np[f].astype(np.complex128)
                             msx.write_tile(ti, t)

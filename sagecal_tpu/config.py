@@ -153,6 +153,14 @@ class RunConfig:
     # [K, 8N, 8N] assembly (bit-reference), "cg" matrix-free
     # preconditioned Krylov — see MIGRATION.md "Inner linear solver"
     solver_inner: str = "chol"
+    # --dtype-policy : storage dtype for the [B]-proportional data
+    # (visibilities, weights, staged residual tiles, Wirtinger
+    # factors): "f32" (identity, bit-frozen default) | "bf16" | "f16".
+    # Accumulation stays f32 everywhere (sagecal_tpu.dtypes;
+    # MIGRATION.md "Dtype policy" for the per-policy tolerance
+    # envelopes and what never quantizes: solutions J, consensus
+    # state, uvw geometry, the robust-nu root-find)
+    dtype_policy: str = "f32"
     # --prefetch : overlapped execution depth (sagecal_tpu.sched).
     # N>0: tile t+N is read + host-prepared on a background thread
     # while tile t solves, and residual/solution writes run on an

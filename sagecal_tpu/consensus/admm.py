@@ -192,6 +192,15 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
     consensus half (Z psum + duals + BB rho) as its OWN mesh program,
     so the multichip harness (tools_dev/northstar.py --multichip) can
     time the collective overhead separately from the J-update solves.
+
+    Dtype policy (MIGRATION.md "Dtype policy"): ``x8F``/``wtF`` may
+    arrive in the reduced storage dtype (cli_mpi stages them per
+    ``--dtype-policy``; ``cfg.sage.dtype_policy`` rides into every
+    sagefit call, which owns the storage/accumulate split). The
+    consensus state itself — Y, Z, BZ, rho, and the polynomial basis —
+    NEVER quantizes: it derives from the f32 Jones state (``JF.dtype``
+    below), so the ADMM convergence analysis is untouched by the
+    policy and the Z psum collectives move f32.
     """
     from sagecal_tpu.consensus import spatial as sp
     from sagecal_tpu.rime import predict as rp

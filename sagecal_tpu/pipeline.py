@@ -27,7 +27,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from sagecal_tpu import coords, sched, skymodel, utils
+from sagecal_tpu import coords, dtypes as dtp, sched, skymodel, utils
 from sagecal_tpu.config import RunConfig, SimulationMode, SolverMode
 from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.solvers import normal_eq as ne
@@ -98,6 +98,25 @@ class FullBatchPipeline:
                 platform == "cpu" and jax.config.read("jax_enable_x64")
             ) else jnp.float32
         self.rdt = real_dtype
+        # --dtype-policy storage dtype for the staged [B]-data (x8, wt,
+        # residual ring slots); "f32" keeps sdt == rdt (bit-frozen).
+        # The sharded (GSPMD) path stages through parallel.pad_rows in
+        # rdt and is policy-exempt for now — reduced policies fall back
+        # to f32 there with a log line rather than silently diverging.
+        policy = getattr(cfg, "dtype_policy", "f32")
+        if policy != "f32" and getattr(cfg, "shard_baselines", False):
+            log("dtype-policy: sharded path is policy-exempt; "
+                "staging stays f32")
+            policy = "f32"
+        if policy != "f32" and real_dtype == jnp.float64:
+            # a reduced storage policy pairs with the f32/c64 pipeline
+            # (the accumulator contract is f32); keeping the f64/c128
+            # CPU-test pipeline underneath would mix f64 model streams
+            # into f32 solver state
+            real_dtype = jnp.float32
+            self.rdt = real_dtype
+        self.dtype_policy = policy
+        self.sdt = dtp.storage_dtype(policy, real_dtype)
         self.dsky = rp.sky_to_device(sky, real_dtype)
         meta = ms.meta
         self.kmax = int(sky.nchunk.max())
@@ -167,6 +186,7 @@ class FullBatchPipeline:
             promote=getattr(cfg, "solve_promote", "auto"),
             inflight=max(1, int(getattr(cfg, "cluster_inflight", 1))),
             inner=getattr(cfg, "solver_inner", "chol"),
+            dtype_policy=self.dtype_policy,
             # rows are [tilesz, nbase] (io.dataset layout): lets the
             # solvers' normal-equation assembly take the baseline-major
             # aggregation for single-chunk clusters
@@ -441,7 +461,7 @@ class FullBatchPipeline:
             self.sky, self.cfg.correct_cluster)
 
     def _residuals(self, J_r8, x_r, u, v, w, sta1, sta2, beam=None,
-                   freqs=None):
+                   freqs=None, out_dtype=None):
         """Residuals over ``freqs`` (default: all channels; a single
         [1] freq gives the per-channel -b 1 path, fullbatch_mode.cpp:483)."""
         meta = self.ms.meta
@@ -455,11 +475,17 @@ class FullBatchPipeline:
             rho=self.cfg.mmse_rho,
             beam=beam, dobeam=self.dobeam, tslot=jnp.asarray(self.tslot),
             phase_only=self.cfg.phase_only)
-        return utils.c2r(res)
+        # storage-dtype writeback emission: the donated x_r slot and
+        # this output share shape AND dtype, so the ring keeps working
+        # and the d->h readback ships storage bytes (rr doc)
+        return rr.residual_writeback(
+            res, self.sdt if out_dtype is None else out_dtype)
 
     def _chan_residual(self, J_r8, x_r, u, v, w, sta1, sta2, freq, beam):
+        # the -b 1 channel path assembles its residuals host-side with
+        # numpy (no ml_dtypes support), so it keeps the pipeline dtype
         return self._residuals(J_r8, x_r, u, v, w, sta1, sta2, beam,
-                               freqs=freq[None])
+                               freqs=freq[None], out_dtype=self.rdt)
 
     def _build_chan_residual(self):
         """All channels' residuals in one program (vmap over channels)."""
@@ -547,7 +573,10 @@ class FullBatchPipeline:
         synchronous path; the "write" phase covers fetch + disk so the
         sync attribution shows the full data-movement stall."""
         with dtrace.phase("write", tile=ti, bg=bg):
-            tile.x = utils.r2c(np.asarray(res_r)).astype(np.complex128)
+            # fetch through float64: numpy-side r2c on ml_dtypes bf16
+            # arrays is not supported, and the MS stores complex128
+            tile.x = utils.r2c(np.asarray(res_r, np.float64)).astype(
+                np.complex128)
             self.ms.write_tile(ti, tile)
 
     def _run_batched(self, write_residuals, solution_path, max_tiles, log,
@@ -586,14 +615,16 @@ class FullBatchPipeline:
             v = jnp.asarray(tile.v, self.rdt)
             w = jnp.asarray(tile.w, self.rdt)
             x8_np, rowflags, _good = tile.solve_input(uvtaper_m=cfg.uvtaper)
-            x8 = jnp.asarray(x8_np, self.rdt)
+            # staged in the dtype-policy storage dtype: the prefetcher
+            # and the solve both ship sdt bytes (sdt == rdt at "f32")
+            x8 = jnp.asarray(x8_np, self.sdt)
             flags = rp.uvcut_flags(jnp.asarray(rowflags, jnp.int32), u, v,
                                    jnp.asarray(tile.freqs, self.rdt),
                                    cfg.uvmin, cfg.uvmax)
             if cfg.whiten:
                 x8 = rb.whiten_data(x8, u, v, meta["freq0"])
             out = dict(ti=ti, tile=tile, u=u, v=v, w=w, x8=x8,
-                       wt=lm_mod.make_weights(flags, self.rdt),
+                       wt=lm_mod.make_weights(flags, self.sdt),
                        sta1=jnp.asarray(tile.sta1),
                        sta2=jnp.asarray(tile.sta2),
                        # staged once: solve + residual write reuse it
@@ -602,7 +633,7 @@ class FullBatchPipeline:
                 # the residual program DONATES its staged visibility
                 # input; the ring keeps overlapped staging from ever
                 # aliasing an in-flight donated buffer
-                ring.stage(ti, jnp.asarray(utils.c2r(tile.x), self.rdt))
+                ring.stage(ti, jnp.asarray(utils.c2r(tile.x), self.sdt))
             dtrace.emit("phase", name="stage", tile=ti,
                         dur_s=time.perf_counter() - t_stage, bg=depth > 0)
             return out
@@ -777,7 +808,8 @@ class FullBatchPipeline:
             # stored uv-cut rows survive either way
             x8_np, rowflags, _good = tile.solve_input(
                 uvtaper_m=cfg.uvtaper)
-            x8 = jnp.asarray(x8_np, self.rdt)
+            # dtype-policy storage staging (see the batched driver)
+            x8 = jnp.asarray(x8_np, self.sdt)
             flags = rp.uvcut_flags(jnp.asarray(rowflags, jnp.int32), u, v,
                                    jnp.asarray(tile.freqs, self.rdt),
                                    cfg.uvmin, cfg.uvmax)
@@ -787,14 +819,14 @@ class FullBatchPipeline:
                 from sagecal_tpu.solvers import robust as rb
                 x8 = rb.whiten_data(x8, u, v, meta["freq0"])
             stg = dict(u=u, v=v, w=w, x8=x8, flags=flags,
-                       wt=lm_mod.make_weights(flags, self.rdt),
+                       wt=lm_mod.make_weights(flags, self.sdt),
                        sta1=jnp.asarray(tile.sta1),
                        sta2=jnp.asarray(tile.sta2),
                        beam=self._tile_beam(tile))
             if stage_xr:
                 # residual input staged ahead; DONATED to the residual
                 # program (ring: no read-after-donate, no aliasing)
-                ring.stage(ti, jnp.asarray(utils.c2r(tile.x), self.rdt))
+                ring.stage(ti, jnp.asarray(utils.c2r(tile.x), self.sdt))
             dtrace.emit("phase", name="stage", tile=ti,
                         dur_s=time.perf_counter() - t_stage, bg=depth > 0)
             return stg

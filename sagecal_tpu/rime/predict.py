@@ -279,6 +279,28 @@ def chunk_indices(tilesz: int, nbase: int, nchunk: np.ndarray) -> np.ndarray:
     return out
 
 
+def model8(coh_m, J_m, sta1, sta2, chunk_idx_m, out_dtype=None):
+    """One cluster's corrupted model as [B, 8] reals (solve-path data
+    order: (Re, Im) of XX, XY, YX, YY — Dirac.h:1541-1546).
+
+    ``out_dtype`` is the dtype-policy storage emission contract
+    (sagecal_tpu.dtypes): the model EVALUATION is complex (c64 — J and
+    the coherencies never quantize) and the emitted real stream casts
+    to the storage dtype exactly where it joins the [B]-residual
+    traffic; a no-op for f32/f64. The solver-side twins
+    (solvers.sage._model8 / normal_eq.residual8) follow the same
+    contract — this is the rime-layer entry point for embedders that
+    build their own residual streams.
+    """
+    from sagecal_tpu import dtypes as dtp
+    Jp = J_m[chunk_idx_m, sta1]
+    Jq = J_m[chunk_idx_m, sta2]
+    V = Jp @ coh_m @ jnp.conj(jnp.swapaxes(Jq, -1, -2))
+    vf = V.reshape(-1, 4)
+    out = jnp.stack([vf.real, vf.imag], -1).reshape(-1, 8)
+    return out if out_dtype is None else dtp.to_storage(out, out_dtype)
+
+
 def apply_jones(coh_m, J_m, sta1, sta2, chunk_idx_m):
     """One cluster's corrupted model: J_p C J_q^H per baseline.
 

@@ -20,6 +20,21 @@ import jax.numpy as jnp
 from sagecal_tpu.rime import predict as rp
 
 
+def residual_writeback(res, out_dtype=None):
+    """[..., 2, 2] complex residual -> stacked real pairs [..., 2] in
+    the dtype-policy storage dtype.
+
+    The writeback emission point of the residual pipeline: under a
+    reduced policy the device->host readback (and the DonatedRing slot
+    that carried the staged input) ships half the bytes, while the
+    residual subtraction itself stays c64. ``out_dtype`` None or
+    f32/f64 is the identity path (the pre-policy utils.c2r layout).
+    """
+    from sagecal_tpu import dtypes as dtp
+    out = jnp.stack([res.real, res.imag], axis=-1)
+    return out if out_dtype is None else dtp.to_storage(out, out_dtype)
+
+
 def mmse_inverse(J, rho):
     """Regularized 2x2 inverse: inv(J + rho I), det nudged by rho when
     nearly singular (residual.c:163 ``mat_invert``)."""

@@ -34,6 +34,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from sagecal_tpu import dtypes as dtp
 from sagecal_tpu.solvers import normal_eq as ne
 
 
@@ -52,6 +53,12 @@ class LMConfig(NamedTuple):
     inner: str = "chol"
     cg_tol: float = 0.1        # forcing eta: stop at ||r|| <= eta ||JTe||
     cg_maxiter: int = 25       # static PCG trip cap per damping iteration
+    # storage dtype policy (sagecal_tpu.dtypes): "f32" is the identity
+    # (bit-frozen default); "bf16"/"f16" quantize the [B]-data and
+    # Wirtinger-factor storage while every accumulator stays f32 —
+    # trajectory gated by tolerance, not bit parity (MIGRATION.md
+    # "Dtype policy")
+    dtype_policy: str = "f32"
 
 
 class LMState(NamedTuple):
@@ -123,7 +130,26 @@ def _chol_solve_shift(JTJ, JTe, shift):
     return dp, jnp.all(jnp.isfinite(dp), axis=-1)
 
 
-def _solve_damped(JTJ, JTe, mu, jitter):
+def _lu_solve_shift(JTJ, JTe, shift):
+    """Reduced-policy analogue of :func:`_chol_solve_shift`: solve the
+    damped system with one batched LU instead of Cholesky. On the CPU
+    cost model a getrf+getrs pair prices ~8 MB/trip below
+    cho_factor+cho_solve at the config-1 shape (the triangular-solve
+    custom calls are charged ~8 operand passes each), and the damped
+    matrix is PD by construction (Gram + positive shift) so partial
+    pivoting is as stable as the Cholesky here. Only the reduced
+    (bf16/f16) storage policy takes this body — its trajectory contract
+    is tolerance-based; the f32 path keeps the bit-frozen Cholesky.
+    A singular system still yields non-finite dp -> ok=False, so the
+    jitter-retry/mu-growth recovery semantics are unchanged."""
+    k8n = JTJ.shape[-1]
+    eye = jnp.eye(k8n, dtype=JTJ.dtype)[None]
+    A = JTJ + shift[:, None, None] * eye
+    dp = jnp.linalg.solve(A, JTe[..., None])[..., 0]
+    return dp, jnp.all(jnp.isfinite(dp), axis=-1)
+
+
+def _solve_damped(JTJ, JTe, mu, jitter, reduced: bool = False):
     """Solve (JTJ + mu I) dp = JTe batched over chunks; returns dp, ok.
 
     A failed factorization (non-finite dp: the f32 analogue of LAPACK
@@ -136,8 +162,12 @@ def _solve_damped(JTJ, JTe, mu, jitter):
     a lax.cond, so the all-ok common case pays nothing; under vmap
     (tile-batch / in-flight groups) the cond lowers to a select and
     both factorizations execute — an accepted cost on those opt-in
-    paths (tests/test_krylov.py gates the recovery)."""
+    paths (tests/test_krylov.py gates the recovery). ``reduced``
+    (static) routes the dtype-policy reduced path through the cheaper
+    LU body (:func:`_lu_solve_shift`); the default stays Cholesky."""
     def solve(shift):
+        if reduced:
+            return _lu_solve_shift(JTJ, JTe, shift)
         return _chol_solve_shift(JTJ, JTe, shift)
 
     dp, ok = solve(mu + jitter)
@@ -261,7 +291,15 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     full-data cost pass plus a conditional rebuild per iteration.
     """
     kmax = J0.shape[0]
-    dtype = x8.dtype
+    # dtype policy: quantize the [B]-data to the storage dtype at entry
+    # (identity under "f32" / pre-quantized inputs); the SOLVE state
+    # (p, mu, costs, JTJ/JTe accumulators) always lives in the
+    # accumulator dtype — solutions J stay c64
+    st = dtp.storage_dtype(config.dtype_policy, x8.dtype)
+    x8 = dtp.to_storage(x8, st)
+    wt = dtp.to_storage(wt, st)
+    reduced = dtp.is_reduced(x8.dtype)
+    dtype = dtp.acc_dtype(x8.dtype)
     p0 = ne.jones_c2r(J0).reshape(kmax, -1).astype(dtype)
     if chunk_mask is None:
         chunk_mask = jnp.ones((kmax,), bool)
@@ -285,13 +323,36 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         return cost_data + 2.0 * jnp.sum(admm_y * d, axis=-1) \
             + admm_rho * jnp.sum(d * d, axis=-1)
 
-    def nrm_eq(p, w=None, cw=None):
+    # reduced-policy OS fast path: the subset's equations assemble from
+    # the subset's contiguous rows ONLY (ne.os_subset_equations — exact,
+    # zero-weight rows contribute nothing; the bit-frozen f32 path keeps
+    # the masked full-[B] pass). Static geometry: ntper timeslots per
+    # contiguous subset block.
+    os_ntper = 0
+    if (reduced and os is not None and kmax == 1 and row_period > 0
+            and x8.shape[0] % row_period == 0 and not inner_cg):
+        _tilesz = x8.shape[0] // row_period
+        os_ntper = -(-_tilesz // int(os.n_subsets))
+
+    def nrm_eq(p, w=None, cw=None, os_subset=None):
         """Normal equations + acceptance cost from ONE row pass: ``w``
         weights JTJ/JTe (subset weights under OS), ``cw`` the cost
         (full-data weights under OS; defaults to ``w``). Under
         inner="cg" the first return is the matrix-free GNFactors
-        operator instead of the dense [K, 8N, 8N] matrix."""
+        operator instead of the dense [K, 8N, 8N] matrix. With the
+        reduced OS fast path active, ``os_subset`` (traced index)
+        routes through the subset-sliced assembly."""
         J = ne.jones_r2c(p.reshape(kmax, n_stations, 8))
+        if os_subset is not None and os_ntper:
+            op, JTe, cost = ne.os_subset_equations(
+                x8, J, coh, sta1, sta2, wt, os.os_id, os_subset,
+                os_ntper, row_period, n_stations, cw)
+            if admm is not None:
+                d = p - admm_bz
+                JTe = JTe - admm_y - admm_rho * d
+                op = op + admm_rho * jnp.eye(op.shape[-1], dtype=op.dtype)
+                cost = aug_cost(p, cost)
+            return op, JTe, cost
         if inner_cg:
             op, JTe, cost = ne.gn_factors(x8, J, coh, sta1, sta2,
                                           chunk_id,
@@ -331,11 +392,13 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
             k. A subset is a contiguous time block, so it can miss a
             hybrid chunk entirely (or be fully flagged) — that chunk's
             equations are identically zero and must not drive the solve."""
-            row = jnp.any(w > 0, axis=1).astype(x8.dtype)
-            return jnp.zeros((kmax,), x8.dtype).at[chunk_id].max(row) > 0
+            row = jnp.any(w > 0, axis=1).astype(dtype)
+            return jnp.zeros((kmax,), dtype).at[chunk_id].max(row) > 0
 
-        wt0 = os_wt(subset_for(jnp.zeros((), jnp.int32)))
-        JTJ0, JTe0, cost0 = nrm_eq(p0, wt0, cw=wt)
+        l0 = subset_for(jnp.zeros((), jnp.int32))
+        wt0 = os_wt(l0)
+        JTJ0, JTe0, cost0 = nrm_eq(p0, wt0, cw=wt,
+                                   os_subset=l0 if os_ntper else None)
         live0 = os_live(wt0)
     else:
         JTJ0, JTe0, cost0 = nrm_eq(p0)
@@ -366,15 +429,19 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
                 chunk_id, kmax, n_stations, row_period, config.cg_tol,
                 config.cg_maxiter, active=~s.stop & chunk_mask)
         else:
-            dp, ok = _solve_damped(s.JTJ, s.JTe, s.mu, config.jitter)
+            dp, ok = _solve_damped(s.JTJ, s.JTe, s.mu, config.jitter,
+                                   reduced=reduced)
             trips = jnp.zeros((), jnp.int32)
         pnew = s.p + dp
         # ONE row pass per iteration: normal equations AND acceptance
         # cost at the trial point (OS: subset equations + full-data
         # cost, sharing the same model/residual evaluation)
         if os is not None:
-            wt_next = os_wt(subset_for(s.k + 1))
-            JTJn, JTen, cost_new = nrm_eq(pnew, wt_next, cw=wt)
+            ln = subset_for(s.k + 1)
+            wt_next = os_wt(ln)
+            JTJn, JTen, cost_new = nrm_eq(pnew, wt_next, cw=wt,
+                                          os_subset=ln if os_ntper
+                                          else None)
             # a subset with no usable rows of chunk k gives zero
             # equations there; that is not convergence (per-chunk)
             sub_live = os_live(wt_next)

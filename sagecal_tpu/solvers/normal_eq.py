@@ -24,6 +24,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from sagecal_tpu import dtypes as dtp
+
 _EYE2 = jnp.eye(2)
 
 
@@ -50,7 +52,10 @@ def residual8(x8, J, coh, sta1, sta2, chunk_id):
     V = Jp @ coh @ jnp.conj(jnp.swapaxes(Jq, -1, -2))
     vflat = V.reshape(-1, 4)
     v8 = jnp.stack([vflat.real, vflat.imag], axis=-1).reshape(-1, 8)
-    return x8 - v8
+    # dtype-policy storage/accumulate contract: the model EMITS the
+    # data's storage dtype (a no-op for f32/f64 data), so the residual
+    # stream stays storage-sized; reductions over it upcast (dtp.acc)
+    return x8 - dtp.to_storage(v8, x8.dtype)
 
 
 def _real_jac(D, conj_param: bool):
@@ -147,6 +152,217 @@ def _mb_factor(Bm):
     return MB.reshape(Bm.shape[0], 2, 2, 4)        # [B, a, ri, (d, ci)]
 
 
+def _reduced_gram_baseline_major(wt, MA, MB, rw, T: int, nb: int, N: int,
+                                 sta1, sta2, acc):
+    """The reduced path's baseline-major Gram/gradient assembly from
+    storage-dtype factors: f32 dot operands materialized directly in
+    merged-contraction layouts (each dot reads its operands once on the
+    CPU cost model), cross blocks scattered straight into the final
+    [1, N, 8, N, 8] station-major matrix. Returns (JTJ [1, 8N, 8N],
+    JTe [1, 8N]). Shared by :func:`_normal_equations_reduced` and the
+    OS-subset assembly :func:`os_subset_equations`."""
+    wvr = wt.reshape(T, nb, 2, 2, 2)           # [t, n, a, o, r]
+    MAr = MA.reshape(T, nb, 2, 2, 4)           # [t, n, o, r, i]
+    MBr = MB.reshape(T, nb, 2, 2, 4)           # [t, n, a, r, j]
+    rwr = rw.reshape(T, nb, 2, 2, 2)
+    wv_a = jnp.transpose(wvr, (1, 2, 3, 0, 4))          # [n,a,o,t,r]
+    MA_a = jnp.transpose(MAr, (1, 2, 0, 3, 4))[:, None]  # [n,1,o,t,r,i]
+    rw_a = jnp.transpose(rwr, (1, 2, 3, 0, 4))
+    wv_b = jnp.transpose(wvr, (1, 3, 2, 0, 4))          # [n,o,a,t,r]
+    MB_b = jnp.transpose(MBr, (1, 2, 0, 3, 4))[:, None]  # [n,1,a,t,r,j]
+    rw_b = jnp.transpose(rwr, (1, 3, 2, 0, 4))
+    MB_a = jnp.transpose(MBr, (1, 2, 0, 3, 4))[:, :, None]  # [n,a,1,..]
+    Xa = (wv_a[..., None].astype(acc)
+          * MA_a.astype(acc)).reshape(nb, 2, 2 * T * 2, 4)
+    Xb = (wv_b[..., None].astype(acc)
+          * MB_b.astype(acc)).reshape(nb, 2, 2 * T * 2, 4)
+    Xab = (wv_a[..., None].astype(acc)
+           * MB_a.astype(acc)).reshape(nb, 2, 2, T * 2, 4)
+    Ra = rw_a.astype(acc).reshape(nb, 2, 2 * T * 2)
+    Rb = rw_b.astype(acc).reshape(nb, 2, 2 * T * 2)
+    pp = jnp.einsum("naki,nakj->naij", Xa, Xa)
+    qq = jnp.einsum("noki,nokj->noij", Xb, Xb)
+    # cross block: native dot emission [n,a,o,i,j], then the two
+    # scatter layouts ([(a i), (o j)] block and its transpose) as
+    # output permutes — cheaper than forcing the dot to emit the
+    # interleaved order (the pq lhs is a bitcast view of Xa:
+    # [n,a,(o t r),i] -> [n,a,o,(t r),i])
+    pq4 = jnp.einsum("naoki,naokj->naoij",
+                     Xa.reshape(nb, 2, 2, T * 2, 4), Xab)
+    pq = jnp.transpose(pq4, (0, 1, 3, 2, 4)).reshape(nb, 8, 8)
+    pqT = jnp.transpose(pq4, (0, 2, 4, 1, 3)).reshape(nb, 8, 8)
+    jtep = jnp.einsum("naki,nak->nai", Xa, Ra)
+    jteq = jnp.einsum("noki,nok->noi", Xb, Rb)
+    s1b, s2b = sta1[:nb], sta2[:nb]
+    D = jnp.zeros((1, N, 2, 4, 4), acc)
+    D = D.at[0, s1b].add(pp).at[0, s2b].add(qq)
+    JTe = jnp.zeros((1, N, 2, 4), acc)
+    JTe = JTe.at[0, s1b].add(jtep).at[0, s2b].add(jteq)
+    eye2 = jnp.eye(2, dtype=acc)
+    Dfull = jnp.einsum("knaij,ab->knaibj", D, eye2).reshape(1, N, 8, 8)
+    idx = jnp.arange(N)
+    JTJ = jnp.zeros((1, N, 8, N, 8), acc)
+    JTJ = JTJ.at[0, s1b, :, s2b, :].add(pq)
+    JTJ = JTJ.at[0, s2b, :, s1b, :].add(pqT)
+    JTJ = JTJ.at[0, idx, :, idx, :].add(Dfull[0])
+    return JTJ.reshape(1, 8 * N, 8 * N), JTe.reshape(1, 8 * N)
+
+
+def os_subset_equations(x8, J, coh, sta1, sta2, wt, os_id, subset,
+                        ntper: int, row_period: int, n_stations: int,
+                        cost_wt):
+    """Ordered-subsets normal equations from the SUBSET's rows only
+    (reduced dtype policy, single-chunk baseline-major layout).
+
+    The OS body's equations come from one contiguous time block of
+    ``ntper`` timeslots; the f32 path realizes that as a FULL [B]-pass
+    with subset-masked weights (bit-reference), which pays the whole
+    row traffic for ~1/n_subsets of the information. Zero-weight rows
+    contribute exactly nothing to JTJ/JTe, so slicing the assembly to
+    the block is numerically exact up to summation order — freedom the
+    reduced path's trajectory-tolerance contract grants and the
+    bit-frozen default does not have. The FULL-data acceptance cost
+    (``cost_wt``, clmfit.c:1404 semantics) still takes one whole-[B]
+    model/residual pass — that pass also feeds the sliced residual, so
+    the model is evaluated once.
+
+    ``subset`` is the traced subset index; the slice start clamps for
+    the short tail block and the sliced ``os_id`` re-masks the weights,
+    so misaligned tail rows drop out exactly like the masked full pass.
+    Returns (JTJ [1, 8N], JTe, cost [1]) like normal_equations at
+    kmax == 1.
+    """
+    N = n_stations
+    B = x8.shape[0]
+    st = x8.dtype
+    acc = dtp.acc_dtype(st)
+    nb = row_period
+    os_id = jnp.asarray(os_id)
+    bs = ntper * nb                            # static subset row count
+    start = jnp.minimum(subset * bs, B - bs)   # clamped short-tail start
+    # ONE full-[B] model/residual pass: the acceptance cost needs it,
+    # and the subset's residual rows slice out of it for free
+    Jp = J[0][sta1]                            # kmax == 1
+    Jq = J[0][sta2]
+    Bm = Jp @ coh
+    V = Bm @ jnp.conj(jnp.swapaxes(Jq, -1, -2))
+    vf = V.reshape(-1, 4)
+    r = x8 - jnp.stack([vf.real, vf.imag], -1).reshape(-1, 8).astype(st)
+    rca = (r * cost_wt).astype(acc)
+    cost = jnp.sum(rca * rca).reshape(1)
+    # subset slices (all static-size dynamic slices over the row axis)
+    sl = lambda a: jax.lax.dynamic_slice_in_dim(a, start, bs, 0)
+    wts = sl(wt) * (sl(os_id) == subset).astype(st)[:, None]
+    rs = sl(r)
+    cohs = sl(coh)
+    Jqs = sl(Jq)
+    As = cohs @ jnp.conj(jnp.swapaxes(Jqs, -1, -2))
+    Bms = sl(Bm)
+    MA = _ma_factor(As).astype(st)
+    MB = _mb_factor(Bms).astype(st)
+    rws = rs * wts
+    JTJ, JTe = _reduced_gram_baseline_major(
+        wts, MA, MB, rws, ntper, nb, N, sl(sta1), sl(sta2), acc)
+    return JTJ, JTe, cost
+
+
+def _normal_equations_reduced(x8, J, coh, sta1, sta2, chunk_id, wt,
+                              n_stations: int, kmax: int, cost_wt=None,
+                              row_period: int = 0):
+    """Reduced-storage (bf16/f16) assembly with f32 accumulation.
+
+    Same weighted Gauss-Newton linearization as :func:`normal_equations`
+    (which dispatches here when ``x8`` carries a reduced storage dtype),
+    re-laid for the storage/accumulate split:
+
+    - the [B]-data arrays (x8, wt, residual stream) and the Wirtinger
+      factors MA/MB stay in the storage dtype;
+    - every contraction names an f32 accumulator, and — because XLA CPU
+      upconverts dot operands (a bf16 dot is priced and executed as an
+      f32 dot plus converts) — the weighted Gram operands are
+      materialized DIRECTLY in f32, in a baseline-major batch layout
+      whose dots read each operand exactly once. That re-lay is free to
+      differ from the f32 path's contraction order: the reduced policy
+      is trajectory-tolerance-gated (MIGRATION.md "Dtype policy"), not
+      bit-gated, while the f32 path above stays byte- and bit-frozen;
+    - the JTe gradient rides the Gram as a 5th column (one dot yields
+      pp AND jtep), and the station-pair cross blocks scatter straight
+      into the FINAL [K, N, 8, N, 8] layout (symmetrized by a second
+      scatter of the transposed updates), skipping the dense-expansion
+      transpose passes of the f32 path.
+
+    Complex coherencies stay c64 (XLA has no sub-f32 complex dtype);
+    their share of one priced LM trip is ~1%. The generic
+    (multi-chunk / no-row-period) branch keeps the scatter structure of
+    the f32 path with storage-dtype elementwise arrays and
+    ``preferred_element_type`` accumulators — its dots dominate its CPU
+    byte count either way (PERF.md round 9).
+    """
+    N = n_stations
+    B = x8.shape[0]
+    st = x8.dtype
+    acc = dtp.acc_dtype(st)
+    pet = dtp.pet(st)
+    Jp = J[chunk_id, sta1]                         # [B, 2, 2]
+    Jq = J[chunk_id, sta2]
+    A = coh @ jnp.conj(jnp.swapaxes(Jq, -1, -2))
+    Bm = Jp @ coh
+    V = Jp @ A
+    vf = V.reshape(-1, 4)
+    r = x8 - jnp.stack([vf.real, vf.imag], -1).reshape(-1, 8).astype(st)
+    rw = r * wt
+    MA = _ma_factor(A).astype(st)                  # [B, o, ri, 4] storage
+    MB = _mb_factor(Bm).astype(st)                 # [B, a, ri, 4] storage
+    rc = rw if cost_wt is None else r * cost_wt
+    rca = rc.astype(acc)
+
+    if kmax == 1 and row_period > 0 and B % row_period == 0:
+        # f32 Gram operands produced directly in their dot layouts (the
+        # transposed reads of the storage factors fuse into the
+        # producers; the upcast IS the storage->accumulate boundary).
+        # Each dot's contraction axes are MERGED into one trailing-K
+        # axis — the layout where XLA CPU's cost model (and its gemm)
+        # reads every operand exactly once; split contraction dims get
+        # re-read penalties (measured ~3x on the pp Gram). The cross
+        # blocks scatter straight into the final station-major matrix —
+        # no dense O buffer, no post-hoc transpose pass.
+        JTJ, JTe = _reduced_gram_baseline_major(
+            wt, MA, MB, rw, B // row_period, row_period, N, sta1, sta2,
+            acc)
+        cost = jnp.sum(rca * rca).reshape(1)
+        return JTJ, JTe, cost
+
+    # generic multi-chunk branch: f32-path scatter structure, storage
+    # elementwise arrays, f32 accumulators on every contraction
+    w2 = (wt * wt).reshape(B, 2, 2, 2)
+    rw2 = (rw * wt).reshape(B, 2, 2, 2)
+    WMA = w2[..., None] * MA[:, None]              # [B, a, o, ri, 4] st
+    WMB = w2[..., None] * MB[:, :, None]
+    pp = jnp.einsum("baori,borj->baij", WMA, MA, **pet)
+    qq = jnp.einsum("baorj,bari->boij", WMB, MB, **pet)
+    pq = jnp.einsum("baori,barj->baoij", WMA, MB, **pet)
+    jtep = jnp.einsum("baor,bori->bai", rw2, MA, **pet)
+    jteq = jnp.einsum("baor,bari->boi", rw2, MB, **pet)
+    D = jnp.zeros((kmax, N, 2, 4, 4), acc)
+    D = D.at[chunk_id, sta1].add(pp)
+    D = D.at[chunk_id, sta2].add(qq)
+    O = jnp.zeros((kmax, N, N, 2, 2, 4, 4), acc)
+    O = O.at[chunk_id, sta1, sta2].add(pq)
+    JTe = jnp.zeros((kmax, N, 2, 4), acc)
+    JTe = JTe.at[chunk_id, sta1].add(jtep)
+    JTe = JTe.at[chunk_id, sta2].add(jteq)
+    cost = jnp.zeros((kmax,), acc).at[chunk_id].add(
+        jnp.sum(rca * rca, axis=1))
+    Off = O.transpose(0, 1, 2, 3, 5, 4, 6).reshape(kmax, N, N, 8, 8)
+    JTJ = Off + jnp.swapaxes(jnp.swapaxes(Off, 1, 2), -1, -2)
+    eye2 = jnp.eye(2, dtype=acc)
+    Dfull = jnp.einsum("knaij,ab->knaibj", D, eye2).reshape(kmax, N, 8, 8)
+    idx = jnp.arange(N)
+    JTJ = JTJ.at[:, idx, idx].add(Dfull)
+    JTJ = JTJ.transpose(0, 1, 3, 2, 4).reshape(kmax, 8 * N, 8 * N)
+    return JTJ, JTe.reshape(kmax, 8 * N), cost
+
+
 def normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
                      kmax: int, cost_wt=None, row_period: int = 0):
     """Weighted Gauss-Newton normal equations, batched over time chunks.
@@ -180,7 +396,17 @@ def normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
     dense assembly 93 MB accessed per evaluation, structured scatter
     path 88 MB, baseline-major path 56 MB (tests/test_lm.py gates all
     three for equivalence).
+
+    Dtype policy: data arriving in a reduced storage dtype (bf16/f16,
+    sagecal_tpu.dtypes) dispatches to the storage/accumulate assembly
+    :func:`_normal_equations_reduced`; this f32/f64 path below is byte-
+    and bit-frozen (the default policy costs nothing).
     """
+    if dtp.is_reduced(x8.dtype):
+        return _normal_equations_reduced(x8, J, coh, sta1, sta2, chunk_id,
+                                         wt, n_stations, kmax,
+                                         cost_wt=cost_wt,
+                                         row_period=row_period)
     N = n_stations
     B = x8.shape[0]
     Jp = J[chunk_id, sta1]                         # [B, 2, 2]
@@ -267,8 +493,10 @@ def normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
 
 
 def weighted_cost(x8, J, coh, sta1, sta2, chunk_id, wt, kmax: int):
-    """Weighted residual cost per chunk [K] (no Jacobians)."""
-    r = residual8(x8, J, coh, sta1, sta2, chunk_id) * wt
+    """Weighted residual cost per chunk [K] (no Jacobians). The norm
+    reduction accumulates in the policy's accumulator dtype (dtp.acc is
+    the identity for f32/f64 data)."""
+    r = dtp.acc(residual8(x8, J, coh, sta1, sta2, chunk_id) * wt)
     return jnp.zeros((kmax,), r.dtype).at[chunk_id].add(jnp.sum(r * r, axis=1))
 
 
@@ -317,20 +545,31 @@ def gn_factors(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
     ``cost_wt``/``row_period`` follow normal_equations (the OS body's
     shared acceptance cost; the baseline-major aggregation for
     single-chunk clusters).
+
+    Dtype policy: reduced-storage data (bf16/f16) keeps MA/MB/w2 in the
+    storage dtype — the matrix-free operator's per-row factors are
+    exactly the arrays the traffic melt targets — while D/JTe/cost
+    accumulate f32 (``preferred_element_type`` on every contraction).
+    All casts below are identities for f32/f64 data.
     """
     N = n_stations
     B = x8.shape[0]
+    st = x8.dtype
+    acc = dtp.acc_dtype(st)
+    pet = dtp.pet(st)
     Jp = J[chunk_id, sta1]
     Jq = J[chunk_id, sta2]
     A = coh @ jnp.conj(jnp.swapaxes(Jq, -1, -2))
     Bm = Jp @ coh
     V = Jp @ A
     vf = V.reshape(-1, 4)
-    r = x8 - jnp.stack([vf.real, vf.imag], -1).reshape(-1, 8)
+    r = x8 - dtp.to_storage(
+        jnp.stack([vf.real, vf.imag], -1).reshape(-1, 8), st)
     rw = r * wt
-    MA = _ma_factor(A)                             # [B, o, ri, 4]
-    MB = _mb_factor(Bm)                            # [B, a, ri, 4]
+    MA = dtp.to_storage(_ma_factor(A), st)         # [B, o, ri, 4]
+    MB = dtp.to_storage(_mb_factor(Bm), st)        # [B, a, ri, 4]
     rc = rw if cost_wt is None else r * cost_wt
+    rca = dtp.acc(rc)
     w2 = (wt * wt).reshape(B, 2, 2, 2)             # [B, a, o, ri]
 
     if kmax == 1 and row_period > 0 and B % row_period == 0:
@@ -343,32 +582,32 @@ def gn_factors(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
         WMAh = wv[..., None] * MA.reshape(T, nb, 1, 2, 2, 4)
         WMBh = wv[..., None] * MB.reshape(T, nb, 2, 1, 2, 4)
         rwv = rw.reshape(T, nb, 2, 2, 2)
-        pp = jnp.einsum("tnaori,tnaorj->naij", WMAh, WMAh)
-        qq = jnp.einsum("tnaori,tnaorj->noij", WMBh, WMBh)
-        jtep = jnp.einsum("tnaori,tnaor->nai", WMAh, rwv)
-        jteq = jnp.einsum("tnaori,tnaor->noi", WMBh, rwv)
+        pp = jnp.einsum("tnaori,tnaorj->naij", WMAh, WMAh, **pet)
+        qq = jnp.einsum("tnaori,tnaorj->noij", WMBh, WMBh, **pet)
+        jtep = jnp.einsum("tnaori,tnaor->nai", WMAh, rwv, **pet)
+        jteq = jnp.einsum("tnaori,tnaor->noi", WMBh, rwv, **pet)
         s1b, s2b = sta1[:nb], sta2[:nb]
-        D = jnp.zeros((1, N, 2, 4, 4), rw.dtype)
+        D = jnp.zeros((1, N, 2, 4, 4), acc)
         D = D.at[0, s1b].add(pp).at[0, s2b].add(qq)
-        JTe = jnp.zeros((1, N, 2, 4), rw.dtype)
+        JTe = jnp.zeros((1, N, 2, 4), acc)
         JTe = JTe.at[0, s1b].add(jtep).at[0, s2b].add(jteq)
-        cost = jnp.sum(rc * rc).reshape(1)
+        cost = jnp.sum(rca * rca).reshape(1)
     else:
         rw2 = (rw * wt).reshape(B, 2, 2, 2)        # w^2 r
         WMA = w2[..., None] * MA[:, None]          # [B, a, o, ri, 4]
         WMB = w2[..., None] * MB[:, :, None]
-        pp = jnp.einsum("baori,borj->baij", WMA, MA)
-        qq = jnp.einsum("baorj,bari->boij", WMB, MB)
-        jtep = jnp.einsum("baor,bori->bai", rw2, MA)
-        jteq = jnp.einsum("baor,bari->boi", rw2, MB)
-        D = jnp.zeros((kmax, N, 2, 4, 4), rw.dtype)
+        pp = jnp.einsum("baori,borj->baij", WMA, MA, **pet)
+        qq = jnp.einsum("baorj,bari->boij", WMB, MB, **pet)
+        jtep = jnp.einsum("baor,bori->bai", rw2, MA, **pet)
+        jteq = jnp.einsum("baor,bari->boi", rw2, MB, **pet)
+        D = jnp.zeros((kmax, N, 2, 4, 4), acc)
         D = D.at[chunk_id, sta1].add(pp)
         D = D.at[chunk_id, sta2].add(qq)
-        JTe = jnp.zeros((kmax, N, 2, 4), rw.dtype)
+        JTe = jnp.zeros((kmax, N, 2, 4), acc)
         JTe = JTe.at[chunk_id, sta1].add(jtep)
         JTe = JTe.at[chunk_id, sta2].add(jteq)
-        cost = jnp.zeros((kmax,), rw.dtype).at[chunk_id].add(
-            jnp.sum(rc * rc, axis=1))
+        cost = jnp.zeros((kmax,), acc).at[chunk_id].add(
+            jnp.sum(rca * rca, axis=1))
 
     return GNFactors(MA=MA, MB=MB, w2=w2, D=D), \
         JTe.reshape(kmax, 8 * N), cost
@@ -391,6 +630,8 @@ def gn_matvec(fac: GNFactors, v, sta1, sta2, chunk_id, kmax: int,
     """
     N = n_stations
     B = fac.MA.shape[0]
+    st = fac.MA.dtype
+    pet = dtp.pet(st)
     vr = v.reshape(kmax, N, 2, 4)
     if kmax == 1 and row_period > 0 and B % row_period == 0:
         T = B // row_period
@@ -398,25 +639,28 @@ def gn_matvec(fac: GNFactors, v, sta1, sta2, chunk_id, kmax: int,
         s1b, s2b = sta1[:nb], sta2[:nb]
         MA_r = fac.MA.reshape(T, nb, 2, 2, 4)      # [t, n, o, ri, j]
         MB_r = fac.MB.reshape(T, nb, 2, 2, 4)      # [t, n, a, ri, j]
-        vpn = vr[0, s1b]                           # [n, a, j]
-        vqn = vr[0, s2b]                           # [n, o, j]
-        u = (jnp.einsum("tnorj,naj->tnaor", MA_r, vpn)
-             + jnp.einsum("tnarj,noj->tnaor", MB_r, vqn))
-        uw = u * fac.w2.reshape(T, nb, 2, 2, 2)
-        ypn = jnp.einsum("tnaor,tnorj->naj", uw, MA_r)
-        yqn = jnp.einsum("tnaor,tnarj->noj", uw, MB_r)
+        # storage-dtype Krylov operands (identity for f32/f64): under a
+        # reduced policy the per-product quantization of v rides the
+        # same trajectory-tolerance contract as the factors themselves
+        vpn = dtp.to_storage(vr[0, s1b], st)       # [n, a, j]
+        vqn = dtp.to_storage(vr[0, s2b], st)       # [n, o, j]
+        u = (jnp.einsum("tnorj,naj->tnaor", MA_r, vpn, **pet)
+             + jnp.einsum("tnarj,noj->tnaor", MB_r, vqn, **pet))
+        uw = dtp.to_storage(u * fac.w2.reshape(T, nb, 2, 2, 2), st)
+        ypn = jnp.einsum("tnaor,tnorj->naj", uw, MA_r, **pet)
+        yqn = jnp.einsum("tnaor,tnarj->noj", uw, MB_r, **pet)
         y = jnp.zeros((1, N, 2, 4), v.dtype)
         y = y.at[0, s1b].add(ypn).at[0, s2b].add(yqn)
     else:
-        vp = vr[chunk_id, sta1]                    # [B, a, j]
-        vq = vr[chunk_id, sta2]                    # [B, o, j]
+        vp = dtp.to_storage(vr[chunk_id, sta1], st)   # [B, a, j]
+        vq = dtp.to_storage(vr[chunk_id, sta2], st)   # [B, o, j]
         # u[b, a, o, ri] = (J v)_b: station-p block contracts MA over
         # its 4 free columns (block-diag over a), station-q over MB
-        u = (jnp.einsum("borj,baj->baor", fac.MA, vp)
-             + jnp.einsum("barj,boj->baor", fac.MB, vq))
-        uw = u * fac.w2
-        yp = jnp.einsum("baor,borj->baj", uw, fac.MA)
-        yq = jnp.einsum("baor,barj->boj", uw, fac.MB)
+        u = (jnp.einsum("borj,baj->baor", fac.MA, vp, **pet)
+             + jnp.einsum("barj,boj->baor", fac.MB, vq, **pet))
+        uw = dtp.to_storage(u * fac.w2, st)
+        yp = jnp.einsum("baor,borj->baj", uw, fac.MA, **pet)
+        yq = jnp.einsum("baor,barj->boj", uw, fac.MB, **pet)
         y = jnp.zeros((kmax, N, 2, 4), v.dtype)
         y = y.at[chunk_id, sta1].add(yp).at[chunk_id, sta2].add(yq)
     y = y.reshape(kmax, 8 * N)

@@ -13,6 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from sagecal_tpu import dtypes as dtp
 from sagecal_tpu.solvers import lm as lm_mod
 from sagecal_tpu.solvers import normal_eq as ne
 
@@ -94,7 +95,10 @@ def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         e = ne.residual8(x8, J, coh, sta1, sta2, chunk_id)
         w = update_weights(e, nu)
         w = jnp.where(first, jnp.ones_like(w), w)
-        wt = wt_base * jnp.sqrt(w)
+        # IRLS weights fold back into the STORAGE dtype (identity for
+        # f32/f64): the E-step itself ran in the accumulator dtype (w
+        # promotes through nu), only the [B]-resident product quantizes
+        wt = dtp.to_storage(wt_base * jnp.sqrt(w), wt_base.dtype)
         # distinct subset draws per IRLS round
         os_r = (os._replace(key=jax.random.fold_in(os.key, 7919 + rs))
                 if os is not None else None)
@@ -112,7 +116,8 @@ def robust_lm_solve(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
                                                    info["cg_iters"])
 
     (J, nu, _), costs = jax.lax.scan(
-        round_body, (J0, jnp.asarray(nu0, x8.dtype), jnp.ones((), bool)),
+        round_body, (J0, jnp.asarray(nu0, dtp.acc_dtype(x8.dtype)),
+                     jnp.ones((), bool)),
         jnp.arange(wt_rounds))
     # "iters": executed inner-LM damping iterations summed over IRLS
     # rounds; "cg_iters": executed PCG trips under config.inner="cg"

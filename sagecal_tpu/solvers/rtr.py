@@ -43,6 +43,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from sagecal_tpu import dtypes as dtp
 from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.solvers import robust as rb
 
@@ -65,6 +66,11 @@ class RTRConfig(NamedTuple):
     # SAME linear operator to fp reordering, so unlike lm.py's
     # inexact-Newton path this changes traffic, not trajectory class.
     inner: str = "chol"
+    # storage dtype policy (sagecal_tpu.dtypes; see lm.LMConfig): the
+    # [B]-data and Wirtinger-factor storage quantize under bf16/f16
+    # while the manifold point, tangent vectors and every accumulator
+    # stay f32; "f32" is the bit-frozen identity
+    dtype_policy: str = "f32"
 
 
 class NSDConfig(NamedTuple):
@@ -119,10 +125,12 @@ def station_precond(wt, sta1, sta2, chunk_id, kmax, n_stations):
     """iw diagonal preconditioner: 1 / (# live baselines per station) per
     chunk, replicated over the station's 8 params (rtr_solve.c fns_fcount,
     count_baselines baseline_utils.c)."""
-    live = (jnp.sum(wt, axis=-1) > 0).astype(wt.dtype)
+    # baseline counts accumulate in the acc dtype: a bf16 scatter-add
+    # goes inexact past 256 rows/station (storage-accum boundary)
+    live = (jnp.sum(wt, axis=-1) > 0).astype(dtp.acc_dtype(wt.dtype))
     flat1 = chunk_id * n_stations + sta1
     flat2 = chunk_id * n_stations + sta2
-    cnt = (jnp.zeros((kmax * n_stations,), wt.dtype)
+    cnt = (jnp.zeros((kmax * n_stations,), live.dtype)
            .at[flat1].add(live).at[flat2].add(live))
     iw = 1.0 / jnp.maximum(cnt, 1.0)
     iw = iw / jnp.maximum(jnp.mean(iw), 1e-30)         # mean-normalized
@@ -145,7 +153,9 @@ def make_cost(x8, coh, sta1, sta2, chunk_id, wt, kmax, n_stations,
 
     def cost(p):
         J = ne.jones_r2c(p.reshape(kmax, n_stations, 8))
-        e = ne.residual8(x8, J, coh, sta1, sta2, chunk_id) * wt
+        # the residual stream stays in the data's storage dtype; the
+        # norm/robust reductions upcast (identity for f32/f64)
+        e = dtp.acc(ne.residual8(x8, J, coh, sta1, sta2, chunk_id) * wt)
         if robust_nu is None:
             per_row = jnp.sum(e * e, axis=-1)
         else:
@@ -239,7 +249,13 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     between calls). Returns (J [K,N,2,2], info).
     """
     kmax = J0.shape[0]
-    dtype = x8.dtype
+    # dtype policy: storage-quantize the data at entry (identity under
+    # "f32"); manifold point/tangents/costs live in the accumulator
+    # dtype (see lm.lm_solve)
+    stq = dtp.storage_dtype(config.dtype_policy, x8.dtype)
+    x8 = dtp.to_storage(x8, stq)
+    wt = dtp.to_storage(wt, stq)
+    dtype = dtp.acc_dtype(x8.dtype)
     D = n_stations * 8
     p0 = ne.jones_c2r(J0).reshape(kmax, -1).astype(dtype)
     if chunk_mask is None:
@@ -284,7 +300,11 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
             wt_eff = wt
         else:
             e = ne.residual8(x8, Jm, coh, sta1, sta2, chunk_id) * wt
-            wt_eff = wt * jnp.sqrt(robust_nu) / (robust_nu + e * e)
+            # keep the curvature weights in the storage dtype so the
+            # GN assembly below stays on the reduced path (identity
+            # for f32/f64)
+            wt_eff = dtp.to_storage(
+                wt * jnp.sqrt(robust_nu) / (robust_nu + e * e), wt.dtype)
         if config.inner == "cg":
             # matrix-free operator: JTJ @ v straight from the Wirtinger
             # factors (one [B]-pass per product), never forming the
@@ -394,7 +414,7 @@ def rtr_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
                               info["iters"])
 
     (J, nu), costs = jax.lax.scan(
-        round_body, (J0, jnp.asarray(nu0, x8.dtype)), None,
+        round_body, (J0, jnp.asarray(nu0, dtp.acc_dtype(x8.dtype))), None,
         length=wt_rounds)
     # "iters": executed outer TR iterations summed over IRLS rounds
     # (bench.py MFU trip accounting)
@@ -414,7 +434,7 @@ def nsd_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
 
     Returns (J, nu, info)."""
     kmax = J0.shape[0]
-    dtype = x8.dtype
+    dtype = dtp.acc_dtype(x8.dtype)
     p0 = ne.jones_c2r(J0).reshape(kmax, -1).astype(dtype)
     if chunk_mask is None:
         chunk_mask = jnp.ones((kmax,), bool)

@@ -48,6 +48,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from sagecal_tpu import dtypes as dtp
 from sagecal_tpu.config import SolverMode
 from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.solvers import lbfgs as lbfgs_mod
@@ -212,6 +213,15 @@ class SageConfig(NamedTuple):
     inner: str = "chol"
     cg_tol: float = 0.1           # inexact-Newton forcing eta (lm.py)
     cg_maxiter: int = 25          # static PCG trip cap per damping iter
+    # storage dtype policy (--dtype-policy; sagecal_tpu.dtypes): "f32"
+    # is the bit-frozen identity; "bf16"/"f16" store the visibility
+    # data, running residual and Wirtinger factors in the reduced dtype
+    # with f32 accumulation everywhere (Gram products, costs, residual
+    # norms, IRLS statistics). Solutions J stay c64; trajectories are
+    # gated by per-policy tolerance envelopes, not bit parity
+    # (MIGRATION.md "Dtype policy"; PERF.md round 9 for the measured
+    # Δbytes/Δwall/drift trade)
+    dtype_policy: str = "f32"
 
 
 _OS_MODES = (int(SolverMode.OSLM_LBFGS),
@@ -226,17 +236,24 @@ def _is_robust(mode: int) -> bool:
                     int(SolverMode.NSD_RLBFGS))
 
 
-def _model8(J_m, coh_m, sta1, sta2, cidx_m):
-    """One cluster's corrupted model as [B, 8] reals."""
-    Jp = J_m[cidx_m, sta1]
-    Jq = J_m[cidx_m, sta2]
-    V = Jp @ coh_m @ jnp.conj(jnp.swapaxes(Jq, -1, -2))
-    vf = V.reshape(-1, 4)
-    return jnp.stack([vf.real, vf.imag], -1).reshape(-1, 8)
+def _model8(J_m, coh_m, sta1, sta2, cidx_m, out_dtype=None):
+    """One cluster's corrupted model as [B, 8] reals.
+
+    Delegates to the rime-layer kernel (:func:`rime.predict.model8`) so
+    the storage-emission contract lives in ONE place: the model
+    quantizes to the running residual's storage dtype (``out_dtype``)
+    at the point it joins the [B]-stream — a no-op for f32/f64 — while
+    the complex evaluation stays c64."""
+    from sagecal_tpu.rime import predict as rp
+    return rp.model8(coh_m, J_m, sta1, sta2, cidx_m, out_dtype=out_dtype)
 
 
 def full_model8(J, coh, sta1, sta2, chunk_idx):
-    """Sum of all clusters' corrupted models [B, 8] (minimize_viz_full_pth)."""
+    """Sum of all clusters' corrupted models [B, 8] (minimize_viz_full_pth).
+
+    The cluster sum ACCUMULATES in the model-eval dtype (f32 from c64)
+    regardless of the storage policy — callers emit to storage at the
+    residual subtraction (dtp.to_storage), not inside the sum."""
     def body(acc, xs):
         J_m, coh_m, cidx_m = xs
         return acc + _model8(J_m, coh_m, sta1, sta2, cidx_m), None
@@ -260,7 +277,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
     """
     lm_cfg = lm_mod.LMConfig(itmax=itcap, inner=config.inner,
                              cg_tol=config.cg_tol,
-                             cg_maxiter=config.cg_maxiter)
+                             cg_maxiter=config.cg_maxiter,
+                             dtype_policy=config.dtype_policy)
     nbase = int(config.nbase)
     zero_i = jnp.zeros((), jnp.int32)
 
@@ -283,7 +301,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
                 info["iters"], info["cg_iters"])
 
     if mode == int(SolverMode.RTR_OSLM_LBFGS):
-        rtr_cfg = rtr_mod.RTRConfig(itmax=itcap, inner=config.inner)
+        rtr_cfg = rtr_mod.RTRConfig(itmax=itcap, inner=config.inner,
+                                    dtype_policy=config.dtype_policy)
         Jn, info = rtr_mod.rtr_solve(
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             chunk_mask=cmask_m, config=rtr_cfg, itmax_dynamic=itermax,
@@ -292,7 +311,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
                 info["iters"], zero_i)
 
     if mode == int(SolverMode.RTR_OSRLM_RLBFGS):
-        rtr_cfg = rtr_mod.RTRConfig(itmax=itcap, inner=config.inner)
+        rtr_cfg = rtr_mod.RTRConfig(itmax=itcap, inner=config.inner,
+                                    dtype_policy=config.dtype_policy)
         Jn, nu_new, info = rtr_mod.rtr_solve_robust(
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             nu0=nu_cj, nulow=config.nulow, nuhigh=config.nuhigh,
@@ -390,14 +410,16 @@ def _cluster_update(cj, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     cmask_m = jnp.take(chunk_mask, cj, axis=0)
     J_m = jnp.take(J, cj, axis=0)
 
-    xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
+    xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m,
+                            out_dtype=xres.dtype)
     Jn, nu_new, dcost, its, cgs = _visit_solve(
         cj, xdummy, coh_m, cidx_m, cmask_m, J_m, jnp.take(nuM, cj),
         sta1, sta2, wt_base, n_stations, config, nerr_prev, weighted,
         last, key, admm, os_id, total_iter, iter_bar)
     nuM = nuM.at[cj].set(nu_new)
     nerr_acc = nerr_acc.at[cj].set(dcost)
-    xres = xdummy - _model8(Jn, coh_m, sta1, sta2, cidx_m)
+    xres = xdummy - _model8(Jn, coh_m, sta1, sta2, cidx_m,
+                            out_dtype=xres.dtype)
     J = J.at[cj].set(Jn)
     return J, xres, nerr_acc, nuM, tk.at[0].add(its).at[2].add(cgs)
 
@@ -439,7 +461,8 @@ def _sweep_g1(perm, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
 
     c0 = cl_of(0)
     coh0, cidx0, _ = gather(c0)
-    xd = xres + _model8(jnp.take(J0_, c0, axis=0), coh0, sta1, sta2, cidx0)
+    xd = xres + _model8(jnp.take(J0_, c0, axis=0), coh0, sta1, sta2, cidx0,
+                        out_dtype=xres.dtype)
 
     def body(j, inner):
         J, xd, nerr_acc, nuM, tk = inner
@@ -459,8 +482,9 @@ def _sweep_g1(perm, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
         cn = cl_of(j + 1)
         coh_n, cidx_n, _ = gather(cn)
         model_next = _model8(jnp.take(J, cn, axis=0), coh_n, sta1, sta2,
-                             cidx_n)
-        model_new = _model8(Jn, coh_m, sta1, sta2, cidx_m)
+                             cidx_n, out_dtype=xd.dtype)
+        model_new = _model8(Jn, coh_m, sta1, sta2, cidx_m,
+                            out_dtype=xd.dtype)
         xd = (xd - model_new) + jnp.where(j + 1 < M, model_next, 0.0)
         return J, xd, nerr_acc, nuM, tk.at[0].add(its).at[2].add(cgs)
 
@@ -482,10 +506,13 @@ def _omega_trial(w, Jo_g, Jn_g, coh_g, cidx_g, sta1, sta2, xres, vm,
     cond-cost; the PR 3 phantom-bytes class)."""
     Jr_g = Jo_g + w * (Jn_g - Jo_g)
     model_new = jax.vmap(
-        lambda Jm, cm, cim: _model8(Jm, cm, sta1, sta2, cim)
+        lambda Jm, cm, cim: _model8(Jm, cm, sta1, sta2, cim,
+                                    out_dtype=xres.dtype)
     )(Jr_g, coh_g, cidx_g)
-    xnew = xres + jnp.einsum("g,gbx->bx", vm, model_old - model_new)
-    rn = jnp.sum((xnew * wt_base) ** 2)
+    xnew = xres + dtp.to_storage(
+        jnp.einsum("g,gbx->bx", vm, model_old - model_new,
+                   **dtp.pet(xres.dtype)), xres.dtype)
+    rn = jnp.sum(dtp.acc(xnew * wt_base) ** 2)
     ok = (rn <= res_old * (1.0 + 1e-9)) | (rn <= 1.05 * anchor)
     return ok, xnew, Jr_g
 
@@ -554,7 +581,8 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
                 os_id=ids, n_subsets=int(n_sub),
                 key=jax.random.fold_in(key, cj),
                 randomize=config.randomize)
-        xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m)
+        xdummy = xres + _model8(J_m, coh_m, sta1, sta2, cidx_m,
+                                out_dtype=xres.dtype)
         itcap = int(config.max_iter) + iter_bar
         Jn, nu_new, init_cost, final_cost, its, cgs = _cluster_solve(
             mode, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m, wt_base,
@@ -570,7 +598,7 @@ def _group_update(cjs, state, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
     # no second RIME evaluation needed
     model_old = xd_g - xres[None]
     vm = valid.astype(xres.dtype)
-    res_old = jnp.sum((xres * wt_base) ** 2)
+    res_old = jnp.sum(dtp.acc(xres * wt_base) ** 2)
     anchor = res_old if res_anchor is None else res_anchor
 
     def try_omega(w):
@@ -721,15 +749,22 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
     M, B = coh.shape[0], coh.shape[1]
     kmax = J0.shape[1]
     n = B * 8
-    dtype = x8.dtype
+    # dtype policy: the [B]-data, weights and the running residual ride
+    # the storage dtype (identity under "f32"); the EM state (nerr,
+    # nuM, costs) lives in the accumulator dtype
+    stq = dtp.storage_dtype(config.dtype_policy, x8.dtype)
+    x8 = dtp.to_storage(x8, stq)
+    wt_base = dtp.to_storage(wt_base, stq)
+    dtype = dtp.acc_dtype(x8.dtype)
     robust = _is_robust(config.solver_mode)
     if nu0 is None:
         nu0 = config.nulow
     if key is None:
         key = jax.random.PRNGKey(42)
 
-    xres0 = x8 - full_model8(J0, coh, sta1, sta2, chunk_idx)
-    res_0 = jnp.linalg.norm(xres0 * wt_base) / n
+    xres0 = x8 - dtp.to_storage(
+        full_model8(J0, coh, sta1, sta2, chunk_idx), x8.dtype)
+    res_0 = jnp.linalg.norm(dtp.acc(xres0 * wt_base)) / n
 
     total_iter = M * config.max_iter
     iter_bar = int(-(-0.8 * total_iter // M))  # ceil(0.8/M * total), host-side
@@ -754,7 +789,7 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
                     else jnp.arange(M, dtype=jnp.int32))
             order_pad, n_groups = _pad_order(base, M, Gi)
             # sweep-entry anchor for the group-step safeguard
-            anchor = jnp.sum((xres * wt_base) ** 2)
+            anchor = jnp.sum(dtp.acc(xres * wt_base) ** 2)
 
             def group_step(g, inner):
                 cjs = jax.lax.dynamic_slice(order_pad, (g * Gi,), (Gi,))
@@ -805,7 +840,7 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
         J = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations, 2, 2)
 
     xres_f = x8 - full_model8(J, coh, sta1, sta2, chunk_idx)
-    res_1 = jnp.linalg.norm(xres_f * wt_base) / n
+    res_1 = jnp.linalg.norm(dtp.acc(xres_f * wt_base)) / n
     return J, {"res_0": res_0, "res_1": res_1, "mean_nu": mean_nu,
                "nerr": nerr, "solver_iters": tk[0],
                "rejected_groups": tk[1], "cg_iters": tk[2],
@@ -871,14 +906,14 @@ def _jit_em_sweep(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
 
     if G == 1:
         return _sweep_g1(
-            perm, (J, xres, jnp.zeros((M,), x8.dtype), nuM,
+            perm, (J, xres, jnp.zeros((M,), dtp.acc_dtype(x8.dtype)), nuM,
                    jnp.zeros((3,), jnp.int32)),
             x8, coh, sta1, sta2, chunk_idx, chunk_mask, wt_base,
             n_stations, config, nerr_prev, weighted, last, kci, None,
             os_id, total_iter, iter_bar)
 
     order_pad, n_groups = _pad_order(perm, M, G)
-    anchor = jnp.sum((xres * wt_base) ** 2)   # sweep-entry safeguard ref
+    anchor = jnp.sum(dtp.acc(xres * wt_base) ** 2)   # sweep-entry safeguard ref
 
     def group_step(g, inner):
         cjs = jax.lax.dynamic_slice(order_pad, (g * G,), (G,))
@@ -889,14 +924,16 @@ def _jit_em_sweep(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx, chunk_mask,
 
     return jax.lax.fori_loop(
         0, n_groups, group_step,
-        (J, xres, jnp.zeros((M,), x8.dtype), nuM,
+        (J, xres, jnp.zeros((M,), dtp.acc_dtype(x8.dtype)), nuM,
          jnp.zeros((3,), jnp.int32)))
 
 
 @jax.jit
 def _jit_prelude(x8, coh, sta1, sta2, chunk_idx, J0, wt_base):
-    xres0 = x8 - full_model8(J0, coh, sta1, sta2, chunk_idx)
-    return xres0, jnp.linalg.norm(xres0 * wt_base) / (x8.shape[0] * 8)
+    xres0 = x8 - dtp.to_storage(
+        full_model8(J0, coh, sta1, sta2, chunk_idx), x8.dtype)
+    return xres0, jnp.linalg.norm(dtp.acc(xres0 * wt_base)) \
+        / (x8.shape[0] * 8)
 
 
 @functools.partial(jax.jit, static_argnames=("n_stations", "config",
@@ -905,7 +942,7 @@ def _jit_prelude(x8, coh, sta1, sta2, chunk_idx, J0, wt_base):
 def _jit_refine(x8, coh, sta1, sta2, chunk_idx, J, wt_base, mean_nu,
                 n_stations, config, robust):
     M, kmax = J.shape[0], J.shape[1]
-    dtype = x8.dtype
+    dtype = dtp.acc_dtype(x8.dtype)
     shape = (M * kmax, n_stations, 8)
     p0 = ne.jones_c2r(J.reshape(M * kmax, n_stations, 2, 2)) \
         .reshape(-1).astype(dtype)
@@ -915,16 +952,16 @@ def _jit_refine(x8, coh, sta1, sta2, chunk_idx, J, wt_base, mean_nu,
                                 itmax=config.max_lbfgs, M=config.lbfgs_m,
                                 return_iters=True)
     Jn = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations, 2, 2)
-    res = jnp.linalg.norm(
-        (x8 - full_model8(Jn, coh, sta1, sta2, chunk_idx)) * wt_base) \
+    res = jnp.linalg.norm(dtp.acc(
+        (x8 - full_model8(Jn, coh, sta1, sta2, chunk_idx)) * wt_base)) \
         / (x8.shape[0] * 8)
     return Jn, res, k
 
 
 @jax.jit
 def _jit_res(x8, coh, sta1, sta2, chunk_idx, J, wt_base):
-    return jnp.linalg.norm(
-        (x8 - full_model8(J, coh, sta1, sta2, chunk_idx)) * wt_base) \
+    return jnp.linalg.norm(dtp.acc(
+        (x8 - full_model8(J, coh, sta1, sta2, chunk_idx)) * wt_base)) \
         / (x8.shape[0] * 8)
 
 
@@ -932,12 +969,13 @@ def _jit_res(x8, coh, sta1, sta2, chunk_idx, J, wt_base):
 def _jit_wres2(xres, wt_base):
     """Weighted residual L2^2 — the sweep-entry anchor the host group
     path feeds the group-step safeguard."""
-    return jnp.sum((xres * wt_base) ** 2)
+    return jnp.sum(dtp.acc(xres * wt_base) ** 2)
 
 
 @jax.jit
 def _jit_wres2_tiles(xres, wt_base):
-    return jax.vmap(lambda x, w: jnp.sum((x * w) ** 2))(xres, wt_base)
+    return jax.vmap(lambda x, w: jnp.sum(dtp.acc(x * w) ** 2))(xres,
+                                                               wt_base)
 
 
 def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
@@ -952,7 +990,14 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     mesh ADMM program must stay fully traced (use :func:`sagefit`).
     """
     M = coh.shape[0]
-    dtype = x8.dtype
+    # dtype policy: quantize the staged data once on entry (identity
+    # under "f32" / pre-quantized staging); host-side EM state in the
+    # accumulator dtype. The storage dtype rides the fusion/promotion
+    # cache keys below through str(x8.dtype).
+    x8 = dtp.to_storage(x8, dtp.storage_dtype(config.dtype_policy,
+                                              x8.dtype))
+    wt_base = dtp.to_storage(wt_base, x8.dtype)
+    dtype = dtp.acc_dtype(x8.dtype)
     robust = _is_robust(config.solver_mode)
     if nu0 is None:
         nu0 = config.nulow
@@ -988,8 +1033,8 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     # first-tile EM boost and the rest-tiles share one verdict; the
     # promotion key must include the budget — it bounds a WHOLE solve.
     # The force knobs ("on"/"off") bypass the caches entirely.
-    fuse_key = (M, x8.shape, n_stations, chunk_mask.shape, str(dtype),
-                dev_config, os_id is None, os_nsub)
+    fuse_key = (M, x8.shape, n_stations, chunk_mask.shape,
+                str(x8.dtype), dev_config, os_id is None, os_nsub)
     promote_key = fuse_key + (config.max_emiter, config.max_lbfgs)
     promoted = promote_mode == "on" or (
         promote_mode == "auto" and _PROMOTE_CACHE.get(promote_key, False))
@@ -1176,14 +1221,15 @@ def _jit_em_sweep_tiles(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx,
 
         if G == 1:
             return _sweep_g1(
-                perm_t, (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t,
+                perm_t, (J_t, xres_t,
+                         jnp.zeros((M,), dtp.acc_dtype(x8.dtype)), nuM_t,
                          jnp.zeros((3,), jnp.int32)),
                 x8_t, coh_t, sta1, sta2, chunk_idx, chunk_mask, wt_t,
                 n_stations, config, nerr_t, weighted, last, key_t, None,
                 os_id, total_iter, iter_bar)
 
         order_pad, n_groups = _pad_order(perm_t, M, G)
-        anchor = jnp.sum((xres_t * wt_t) ** 2)   # per-tile sweep anchor
+        anchor = jnp.sum(dtp.acc(xres_t * wt_t) ** 2)   # per-tile sweep anchor
 
         def group_step(g, inner):
             cjs = jax.lax.dynamic_slice(order_pad, (g * G,), (G,))
@@ -1194,7 +1240,7 @@ def _jit_em_sweep_tiles(J, xres, nuM, x8, coh, sta1, sta2, chunk_idx,
                                  res_anchor=anchor)
         return jax.lax.fori_loop(
             0, n_groups, group_step,
-            (J_t, xres_t, jnp.zeros((M,), x8.dtype), nuM_t,
+            (J_t, xres_t, jnp.zeros((M,), dtp.acc_dtype(x8.dtype)), nuM_t,
              jnp.zeros((3,), jnp.int32)))
     return jax.vmap(one)(J, xres, nuM, x8, coh, wt_base, nerr_prev, keys,
                          perm)
@@ -1273,7 +1319,10 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                                  os_id=os_id, key=keys[0])
         info = {k: jnp.asarray(v)[None] for k, v in info1.items()}
         return J1[None], info
-    dtype = x8.dtype
+    x8 = dtp.to_storage(x8, dtp.storage_dtype(config.dtype_policy,
+                                              x8.dtype))
+    wt_base = dtp.to_storage(wt_base, x8.dtype)
+    dtype = dtp.acc_dtype(x8.dtype)
     robust = _is_robust(config.solver_mode)
     if nu0 is None:
         nu0 = config.nulow
@@ -1290,8 +1339,9 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     chunk_idx = jnp.asarray(chunk_idx)
     chunk_mask = jnp.asarray(chunk_mask)
 
-    fuse_key = (M, x8.shape, n_stations, chunk_mask.shape, str(dtype),
-                dev_config, os_id is None, os_nsub, "tiles")
+    fuse_key = (M, x8.shape, n_stations, chunk_mask.shape,
+                str(x8.dtype), dev_config, os_id is None, os_nsub,
+                "tiles")
     promote_key = fuse_key + (config.max_emiter, config.max_lbfgs)
     promoted = promote_mode == "on" or (
         promote_mode == "auto" and _PROMOTE_CACHE.get(promote_key, False))
@@ -1469,7 +1519,10 @@ def bfgsfit(x8, coh, sta1, sta2, chunk_idx, J0, n_stations: int,
     Student's-t cost when the solver mode is robust. Residual figures
     use the same B*8 normalization as :func:`sagefit`.
     """
-    dtype = x8.dtype
+    x8 = dtp.to_storage(x8, dtp.storage_dtype(config.dtype_policy,
+                                              x8.dtype))
+    wt_base = dtp.to_storage(wt_base, x8.dtype)
+    dtype = dtp.acc_dtype(x8.dtype)
     M, kmax = J0.shape[0], J0.shape[1]
     n = x8.shape[0] * 8
     robust = _is_robust(config.solver_mode)
@@ -1485,12 +1538,12 @@ def bfgsfit(x8, coh, sta1, sta2, chunk_idx, J0, n_stations: int,
             return jnp.sum(jnp.log1p(r * r / nu))
         return jnp.sum(r * r)
 
-    res_0 = jnp.linalg.norm(
-        (x8 - full_model8(J0, coh, sta1, sta2, chunk_idx)) * wt_base) / n
+    res_0 = jnp.linalg.norm(dtp.acc(
+        (x8 - full_model8(J0, coh, sta1, sta2, chunk_idx)) * wt_base)) / n
     p1, k = lbfgs_mod.lbfgs_fit(cost_fn, jax.grad(cost_fn), p0,
                                 itmax=config.max_lbfgs, M=config.lbfgs_m,
                                 return_iters=True)
     J = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations, 2, 2)
-    res_1 = jnp.linalg.norm(
-        (x8 - full_model8(J, coh, sta1, sta2, chunk_idx)) * wt_base) / n
+    res_1 = jnp.linalg.norm(dtp.acc(
+        (x8 - full_model8(J, coh, sta1, sta2, chunk_idx)) * wt_base)) / n
     return J, {"res_0": res_0, "res_1": res_1, "lbfgs_iters": k}

@@ -203,7 +203,9 @@ def make_band_solver(dsky, n_stations: int, chunk_idx, chunk_mask,
                              per_channel_flux=True, beam=beam,
                              dobeam=dobeam, tslot=tslot,
                              sta1=sta1, sta2=sta2)       # [M, B, Fp, 2, 2]
-        nreal = jnp.maximum(jnp.sum(wtF > 0), 1).astype(x8F.dtype)
+        from sagecal_tpu import dtypes as _dtp
+        nreal = jnp.maximum(jnp.sum(wtF > 0), 1).astype(
+            _dtp.acc_dtype(x8F.dtype))
         cost_fn = cost_of(x8F, coh, wtF, sta1, sta2, Y=Y, BZ=BZ, rho=rho)
         grad_fn = jax.grad(cost_fn)
         p0f = p0.reshape(-1)
@@ -276,6 +278,16 @@ class _StochasticRunner:
                     if (_jax.devices()[0].platform == "cpu"
                         and _jax.config.read("jax_enable_x64"))
                     else jnp.float32)
+        # --dtype-policy storage dtype for staged visibilities/weights
+        # and the residual readback (sagecal_tpu.dtypes; identity at
+        # "f32", so sdt == rdt on default runs)
+        from sagecal_tpu import dtypes as _dtp
+        _pol = getattr(cfg, "dtype_policy", "f32")
+        if _pol != "f32" and self.rdt == jnp.float64:
+            # reduced policies pair with the f32/c64 pipeline (the
+            # accumulator contract is f32; see pipeline.py)
+            self.rdt = jnp.float32
+        self.sdt = _dtp.storage_dtype(_pol, self.rdt)
         self.dsky = rp.sky_to_device(sky, self.rdt)
         self.n = meta["n_stations"]
         self.nbase = meta["nbase"]
@@ -403,8 +415,9 @@ class _StochasticRunner:
                 freqsF = np.full(self.fpad, self.freqs[c0], np.float64)
                 freqsF[:nc] = self.freqs[c0:c0 + nc]
                 self._tile_inputs[(nmb, b)] = (
-                    jnp.asarray(x8F, rdt), uj, vj, wj, s1j, s2j,
-                    jnp.asarray(wtF, rdt), jnp.asarray(freqsF, rdt), tsj)
+                    jnp.asarray(x8F, self.sdt), uj, vj, wj, s1j, s2j,
+                    jnp.asarray(wtF, self.sdt), jnp.asarray(freqsF, rdt),
+                    tsj)
 
     def band_inputs(self, nmb: int, band: int):
         return self._tile_inputs[(nmb, band)]
@@ -459,7 +472,9 @@ class _StochasticRunner:
                 beam=beam, dobeam=self.dobeam,
                 tslot=tslot)
             B, F = x8F.shape[0], x8F.shape[1]
-            return utils.c2r(res.reshape(B, F, 4)).reshape(B, F, 8)
+            # storage-dtype writeback emission (identity at "f32")
+            return rr.residual_writeback(
+                res.reshape(B, F, 4), self.sdt).reshape(B, F, 8)
 
         return jax.jit(resid)
 
@@ -498,8 +513,10 @@ class _StochasticRunner:
         with dtrace.phase("write", tile=ti, bg=bg):
             xout = np.array(tile.x)
             for r0, nrow, c0, nc, out in jobs:
-                res = utils.r2c(
-                    np.asarray(out).reshape(self.bmb, self.fpad, 4, 2))
+                # fetch through float64: numpy-side r2c has no ml_dtypes
+                # bf16 path, and the MS stores complex128
+                res = utils.r2c(np.asarray(out, np.float64).reshape(
+                    self.bmb, self.fpad, 4, 2))
                 xout[r0:r0 + nrow, c0:c0 + nc] = res.reshape(
                     self.bmb, self.fpad, 2, 2)[:nrow, :nc]
             tile.x = xout

@@ -368,6 +368,80 @@ def test_dtype_derivation_and_explicit_clean(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# storage-accum (the dtype-policy storage/accumulate boundary, ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def test_storage_accum_silent_reduction_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    from sagecal_tpu import dtypes as dtp
+
+    @jax.jit
+    def kern(x8, wt, st):
+        xs = dtp.to_storage(x8, st)
+        rw = xs * wt
+        total = jnp.sum(rw * rw)
+        gram = jnp.einsum("bi,bj->ij", rw, rw)
+        return total, gram
+    """)
+    assert _rules(f) == ["storage-accum", "storage-accum"]
+    assert "f32 accumulator" in f[0].message
+
+
+def test_storage_accum_scatter_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    from sagecal_tpu import dtypes as dtp
+
+    @jax.jit
+    def kern(x8, idx):
+        st = x8.dtype
+        r = x8.astype(st) * 2.0
+        acc0 = jnp.zeros((4,), st)
+        return acc0.at[idx].add(r)
+    """)
+    assert _rules(f) == ["storage-accum"]
+    assert "scatter-accumulation" in f[0].message
+
+
+def test_storage_accum_suppressed_twin(tmp_path):
+    f, s = _lint(tmp_path, """
+    from sagecal_tpu import dtypes as dtp
+
+    @jax.jit
+    def kern(x8, st):
+        xs = dtp.to_storage(x8, st)
+        # jaxlint: disable=storage-accum -- 8-element row reduce, exact in bf16
+        return jnp.sum(xs * xs)
+    """)
+    assert f == []
+    assert len(s) == 1 and s[0][0].rule == "storage-accum"
+
+
+def test_storage_accum_clean_twins(tmp_path):
+    f, _ = _lint(tmp_path, """
+    from sagecal_tpu import dtypes as dtp
+
+    @jax.jit
+    def kern(x8, wt, st):
+        pet = dtp.pet(st)
+        xs = dtp.to_storage(x8, st)
+        rw = xs * wt
+        gram = jnp.einsum("bi,bj->ij", rw, rw, **pet)          # ** splat
+        named = jnp.einsum("bi,bj->ij", rw, rw,
+                           preferred_element_type=jnp.float32)  # explicit
+        rca = dtp.acc(rw)
+        total = jnp.sum(rca * rca)                              # upcast
+        upc = jnp.sum(rw.astype(jnp.float32) ** 2)              # astype acc
+        return gram, named, total, upc
+
+    @jax.jit
+    def untouched(x8):
+        # no storage casts in scope: the rule never seeds from params
+        return jnp.sum(x8 * x8)
+    """)
+    assert f == []
+
+
+# ---------------------------------------------------------------------------
 # cond-cost
 # ---------------------------------------------------------------------------
 

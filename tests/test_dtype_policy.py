@@ -1,0 +1,391 @@
+"""Dtype-policy gates (ISSUE 6): storage/accumulate contract.
+
+Three contract families, mirroring the PR 3 cg-vs-chol pattern
+(MIGRATION.md "Dtype policy"):
+
+- **f32 identity**: the policy plumbing must cost the default path
+  nothing — ``dtype_policy="f32"`` is BIT-identical to a call without
+  any policy anywhere (the helpers are literal identities);
+- **trajectory tolerance**: reduced policies (bf16/f16) are gated by
+  per-policy residual envelopes against the f32 chain, NOT bit parity —
+  the reduced path is free to re-lay contractions (normal_eq reduced
+  assembly, LU damped solve, OS subset slicing);
+- **traffic**: the priced config-1 LM trip's ``bytes_accessed`` must
+  drop >= 30% under bf16 at equal trip counts (the roofline is
+  dtype-aware; bench.solver_trip_cost prices the body lm.py executes).
+
+All tests run f32 DATA built explicitly (the suite enables x64; the
+policy entry-cast covers the staging half of the contract).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sagecal_tpu import dtypes as dtp
+from sagecal_tpu.solvers import lm as lm_mod
+from sagecal_tpu.solvers import normal_eq as ne
+from sagecal_tpu.solvers import robust as rb
+from sagecal_tpu.solvers import rtr as rtr_mod
+from sagecal_tpu.solvers import sage
+
+# residual-drift envelopes per policy (|res/res_f32 - 1|): bf16 keeps
+# 8 mantissa bits, f16 11 — sized ~4x above the measured drifts below
+# so noise never flaps, while a broken solve (O(1) drift) always trips
+ENVELOPE = {"bf16": 0.25, "f16": 0.10}
+
+
+def _toy(N=8, T=4, K=1, seed=0, noise=0.02):
+    rng = np.random.default_rng(seed)
+    p, q = np.triu_indices(N, k=1)
+    nbase = len(p)
+    sta1 = np.tile(p, T).astype(np.int32)
+    sta2 = np.tile(q, T).astype(np.int32)
+    B = nbase * T
+    chunk_id = ((np.arange(B) // nbase) * K // T).astype(np.int32)
+    coh = rng.normal(size=(B, 2, 2)) + 1j * rng.normal(size=(B, 2, 2))
+    Jtrue = (rng.normal(size=(K, N, 2, 2)) * 0.3
+             + 1j * rng.normal(size=(K, N, 2, 2)) * 0.3 + np.eye(2))
+    V = (Jtrue[chunk_id, sta1] @ coh
+         @ np.conj(Jtrue[chunk_id, sta2].transpose(0, 2, 1)))
+    V = V + noise * (rng.normal(size=V.shape) + 1j * rng.normal(size=V.shape))
+    x8 = np.stack([V.reshape(B, 4).real, V.reshape(B, 4).imag],
+                  axis=-1).reshape(B, 8)
+    return (jnp.asarray(x8, jnp.float32),
+            jnp.asarray(coh, jnp.complex64),
+            jnp.asarray(sta1), jnp.asarray(sta2), jnp.asarray(chunk_id),
+            nbase)
+
+
+def _wt(x8):
+    return jnp.ones(x8.shape, jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# helper identities (the f32 policy must be a literal no-op)
+# ---------------------------------------------------------------------------
+
+def test_policy_helpers_identity():
+    x = jnp.ones((5, 8), jnp.float32)
+    assert dtp.storage_dtype("f32", x.dtype) == x.dtype
+    assert dtp.storage_dtype("f32", jnp.float64) == jnp.dtype(jnp.float64)
+    assert dtp.to_storage(x, jnp.float32) is x
+    assert dtp.acc(x) is x
+    assert dtp.pet(jnp.float32) == {}
+    assert dtp.pet(jnp.float64) == {}
+    xb = x.astype(jnp.bfloat16)
+    assert dtp.acc_dtype(xb.dtype) == jnp.dtype(jnp.float32)
+    assert dtp.is_reduced(xb.dtype) and not dtp.is_reduced(x.dtype)
+    assert "preferred_element_type" in dtp.pet(jnp.bfloat16)
+    with pytest.raises(ValueError):
+        dtp.validate("f8")
+
+
+def test_f32_policy_bit_identical_lm():
+    x8, coh, sta1, sta2, cid, nbase = _toy()
+    J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex64), (1, 8, 1, 1))
+    wt = _wt(x8)
+    J_a, info_a = lm_mod.lm_solve(x8, coh, sta1, sta2, cid, wt, J0, 8,
+                                  config=lm_mod.LMConfig(itmax=8),
+                                  row_period=nbase)
+    J_b, info_b = lm_mod.lm_solve(x8, coh, sta1, sta2, cid, wt, J0, 8,
+                                  config=lm_mod.LMConfig(
+                                      itmax=8, dtype_policy="f32"),
+                                  row_period=nbase)
+    assert bool(jnp.all(J_a == J_b))
+    assert bool(jnp.all(info_a["final_cost"] == info_b["final_cost"]))
+
+
+# ---------------------------------------------------------------------------
+# reduced assembly correctness (vs the f32 reference, quantization-level)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("policy,tol", [("bf16", 2e-2), ("f16", 4e-3)])
+def test_normal_equations_reduced_close(policy, tol):
+    x8, coh, sta1, sta2, cid, nbase = _toy(N=6, T=4)
+    wt = _wt(x8) * 0.7
+    J = jnp.asarray(np.eye(2) + 0.1 * np.random.default_rng(1).normal(
+        size=(1, 6, 2, 2)), jnp.complex64)
+    st = dtp.storage_dtype(policy, jnp.float32)
+    ref = jax.jit(lambda: ne.normal_equations(
+        x8, J, coh, sta1, sta2, cid, wt, 6, 1, row_period=nbase))()
+    # baseline-major reduced path
+    red = jax.jit(lambda: ne.normal_equations(
+        x8.astype(st), J, coh, sta1, sta2, cid, wt.astype(st), 6, 1,
+        row_period=nbase))()
+    # generic reduced path (no row_period)
+    red_g = jax.jit(lambda: ne.normal_equations(
+        x8.astype(st), J, coh, sta1, sta2, cid, wt.astype(st), 6, 1))()
+    for out in (red, red_g):
+        for a, b in zip(out, ref):
+            assert a.dtype == jnp.float32          # f32 accumulators
+            rel = float(jnp.linalg.norm(a - b) / jnp.linalg.norm(b))
+            assert rel < tol, rel
+
+
+def test_os_subset_equations_exact_vs_masked():
+    """The reduced OS fast path (subset-sliced assembly) must equal the
+    masked full-[B] pass to quantization: zero-weight rows contribute
+    nothing, so slicing is exact up to summation order."""
+    x8, coh, sta1, sta2, cid, nbase = _toy(N=6, T=5)
+    wt = _wt(x8)
+    J = jnp.asarray(np.eye(2) + 0.1 * np.random.default_rng(2).normal(
+        size=(1, 6, 2, 2)), jnp.complex64)
+    os_ids, ns = lm_mod.os_subset_ids(5, nbase)
+    os_ids = jnp.asarray(os_ids)
+    ntper = -(-5 // ns)
+    st = jnp.bfloat16
+    for l in (0, ns - 1):
+        wmask = wt * (os_ids == l).astype(jnp.float32)[:, None]
+        ref = jax.jit(lambda w: ne.normal_equations(
+            x8, J, coh, sta1, sta2, cid, w, 6, 1, cost_wt=wt,
+            row_period=nbase))(wmask)
+        out = jax.jit(lambda li: ne.os_subset_equations(
+            x8.astype(st), J, coh, sta1, sta2, wt.astype(st), os_ids,
+            li, ntper, nbase, 6, wt.astype(st)))(jnp.asarray(l, jnp.int32))
+        for a, b in zip(out, ref):
+            rel = float(jnp.linalg.norm(a - b)
+                        / jnp.maximum(jnp.linalg.norm(b), 1e-30))
+            assert rel < 2e-2, (l, rel)
+
+
+def test_gn_factors_matvec_reduced_close():
+    x8, coh, sta1, sta2, cid, nbase = _toy(N=6, T=4)
+    wt = _wt(x8)
+    J = jnp.asarray(np.eye(2) + 0.1 * np.random.default_rng(3).normal(
+        size=(1, 6, 2, 2)), jnp.complex64)
+    fac0, jte0, c0 = jax.jit(lambda: ne.gn_factors(
+        x8, J, coh, sta1, sta2, cid, wt, 6, 1, row_period=nbase))()
+    facr, jter, cr = jax.jit(lambda: ne.gn_factors(
+        x8.astype(jnp.bfloat16), J, coh, sta1, sta2,
+        cid, wt.astype(jnp.bfloat16), 6, 1, row_period=nbase))()
+    assert facr.MA.dtype == jnp.bfloat16           # storage factors
+    assert facr.D.dtype == jnp.float32             # f32 accumulator
+    assert float(jnp.linalg.norm(jter - jte0)
+                 / jnp.linalg.norm(jte0)) < 2e-2
+    v = jnp.asarray(np.random.default_rng(4).normal(size=(1, 48)),
+                    jnp.float32)
+    y0 = jax.jit(lambda f, w: ne.gn_matvec(f, w, sta1, sta2, cid, 1, 6,
+                                           row_period=nbase))(fac0, v)
+    yr = jax.jit(lambda f, w: ne.gn_matvec(f, w, sta1, sta2, cid, 1, 6,
+                                           row_period=nbase))(facr, v)
+    assert yr.dtype == jnp.float32
+    assert float(jnp.linalg.norm(yr - y0) / jnp.linalg.norm(y0)) < 3e-2
+
+
+# ---------------------------------------------------------------------------
+# per-policy trajectory-tolerance gates (LM / robust / RTR / OS-LM)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["bf16", "f16"])
+def test_lm_trajectory_envelope(policy):
+    x8, coh, sta1, sta2, cid, nbase = _toy(seed=5)
+    J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex64), (1, 8, 1, 1))
+    wt = _wt(x8)
+    _, inf_f = lm_mod.lm_solve(x8, coh, sta1, sta2, cid, wt, J0, 8,
+                               config=lm_mod.LMConfig(itmax=10),
+                               row_period=nbase)
+    _, inf_p = lm_mod.lm_solve(x8, coh, sta1, sta2, cid, wt, J0, 8,
+                               config=lm_mod.LMConfig(
+                                   itmax=10, dtype_policy=policy),
+                               row_period=nbase)
+    cf = float(inf_f["final_cost"][0])
+    cp = float(inf_p["final_cost"][0])
+    assert abs(cp / cf - 1.0) < ENVELOPE[policy], (cf, cp)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["bf16", "f16"])
+def test_os_lm_trajectory_envelope(policy):
+    """The subset-sliced reduced OS body tracks the f32 masked chain."""
+    x8, coh, sta1, sta2, cid, nbase = _toy(N=8, T=6, seed=6)
+    J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex64), (1, 8, 1, 1))
+    wt = _wt(x8)
+    os_ids, ns = lm_mod.os_subset_ids(6, nbase)
+    osc = lm_mod.OSConfig(os_id=jnp.asarray(os_ids), n_subsets=ns,
+                          key=jax.random.PRNGKey(11))
+    _, inf_f = lm_mod.lm_solve(x8, coh, sta1, sta2, cid, wt, J0, 8,
+                               config=lm_mod.LMConfig(itmax=12), os=osc,
+                               row_period=nbase)
+    _, inf_p = lm_mod.lm_solve(x8, coh, sta1, sta2, cid, wt, J0, 8,
+                               config=lm_mod.LMConfig(
+                                   itmax=12, dtype_policy=policy),
+                               os=osc, row_period=nbase)
+    cf = float(inf_f["final_cost"][0])
+    cp = float(inf_p["final_cost"][0])
+    assert abs(cp / cf - 1.0) < ENVELOPE[policy], (cf, cp)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["bf16"])
+def test_robust_lm_trajectory_envelope(policy):
+    x8, coh, sta1, sta2, cid, nbase = _toy(seed=7, noise=0.05)
+    J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex64), (1, 8, 1, 1))
+    wt = _wt(x8)
+    _, nu_f, inf_f = rb.robust_lm_solve(
+        x8, coh, sta1, sta2, cid, wt, J0, 8,
+        config=lm_mod.LMConfig(itmax=6), row_period=nbase)
+    _, nu_p, inf_p = rb.robust_lm_solve(
+        x8, coh, sta1, sta2, cid, wt, J0, 8,
+        config=lm_mod.LMConfig(itmax=6, dtype_policy=policy),
+        row_period=nbase)
+    assert nu_p.dtype == jnp.float32               # nu never quantizes
+    cf = float(inf_f["final_cost"][0])
+    cp = float(inf_p["final_cost"][0])
+    assert abs(cp / cf - 1.0) < ENVELOPE[policy], (cf, cp)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["bf16", "f16"])
+def test_rtr_trajectory_envelope(policy):
+    # noise floor + enough TR iterations that both chains CONVERGE:
+    # at tiny noise the envelope would race convergence rates, not
+    # compare converged residuals (measured: itmax=6 noiseless drifts
+    # 59% from unfinished descent; itmax=12 at the 0.05 floor, 0.4%)
+    x8, coh, sta1, sta2, cid, nbase = _toy(seed=8, noise=0.05)
+    J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex64), (1, 8, 1, 1))
+    wt = _wt(x8)
+    _, nu_f, inf_f = rtr_mod.rtr_solve_robust(
+        x8, coh, sta1, sta2, cid, wt, J0, 8,
+        config=rtr_mod.RTRConfig(itmax=12), row_period=nbase)
+    _, nu_p, inf_p = rtr_mod.rtr_solve_robust(
+        x8, coh, sta1, sta2, cid, wt, J0, 8,
+        config=rtr_mod.RTRConfig(itmax=12, dtype_policy=policy),
+        row_period=nbase)
+    cf = float(jnp.sum(inf_f["final_cost"]))
+    cp = float(jnp.sum(inf_p["final_cost"]))
+    assert abs(cp / cf - 1.0) < ENVELOPE[policy], (cf, cp)
+
+
+# ---------------------------------------------------------------------------
+# SAGE chain + one ADMM chain
+# ---------------------------------------------------------------------------
+
+def _sage_problem(M=3, N=8, T=4, seed=9):
+    rng = np.random.default_rng(seed)
+    p, q = np.triu_indices(N, k=1)
+    nbase = len(p)
+    sta1 = np.tile(p, T).astype(np.int32)
+    sta2 = np.tile(q, T).astype(np.int32)
+    B = nbase * T
+    coh = rng.normal(size=(M, B, 2, 2)) + 1j * rng.normal(size=(M, B, 2, 2))
+    Jtrue = (rng.normal(size=(M, 1, N, 2, 2)) * 0.2
+             + 1j * rng.normal(size=(M, 1, N, 2, 2)) * 0.2 + np.eye(2))
+    cidx = np.zeros((M, B), np.int32)
+    V = np.zeros((B, 2, 2), complex)
+    for m in range(M):
+        V += (Jtrue[m, 0][sta1] @ coh[m]
+              @ np.conj(Jtrue[m, 0][sta2].transpose(0, 2, 1)))
+    V += 0.02 * (rng.normal(size=V.shape) + 1j * rng.normal(size=V.shape))
+    x8 = np.stack([V.reshape(B, 4).real, V.reshape(B, 4).imag],
+                  axis=-1).reshape(B, 8)
+    cmask = np.ones((M, 1), bool)
+    J0 = np.tile(np.eye(2, dtype=np.complex64), (M, 1, N, 1, 1))
+    return (jnp.asarray(x8, jnp.float32), jnp.asarray(coh, jnp.complex64),
+            jnp.asarray(sta1), jnp.asarray(sta2), jnp.asarray(cidx),
+            jnp.asarray(cmask), jnp.asarray(J0), nbase)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["bf16", "f16"])
+def test_sagefit_trajectory_envelope(policy):
+    x8, coh, sta1, sta2, cidx, cmask, J0, nbase = _sage_problem()
+    wt = jnp.ones(x8.shape, jnp.float32)
+    cfg = sage.SageConfig(max_emiter=2, max_iter=6, max_lbfgs=4,
+                          solver_mode=3, nbase=nbase)
+    os_id = lm_mod.os_subset_ids(4, nbase)
+    _, inf_f = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask, J0, 8, wt,
+                            config=cfg, os_id=os_id)
+    _, inf_p = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask, J0, 8, wt,
+                            config=cfg._replace(dtype_policy=policy),
+                            os_id=os_id)
+    rf = float(inf_f["res_1"])
+    rp = float(inf_p["res_1"])
+    assert abs(rp / rf - 1.0) < ENVELOPE[policy], (rf, rp)
+
+
+@pytest.mark.slow
+def test_admm_chain_bf16_envelope():
+    """One consensus-augmented solve chain under bf16: the Y/BZ state
+    stays f32 and the augmented trajectory holds its envelope."""
+    x8, coh, sta1, sta2, cidx, cmask, J0, nbase = _sage_problem(seed=12)
+    wt = jnp.ones(x8.shape, jnp.float32)
+    M, N = 3, 8
+    Y = jnp.zeros((M, 1, N, 8), jnp.float32)
+    BZ = jnp.asarray(ne.jones_c2r(J0.reshape(M, 1, N, 2, 2)), jnp.float32)
+    rho = jnp.full((M,), 2.0, jnp.float32)
+    cfg = sage.SageConfig(max_emiter=2, max_iter=6, max_lbfgs=0,
+                          solver_mode=1, nbase=nbase)
+    _, inf_f = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask, J0, 8, wt,
+                            config=cfg, admm=(Y, BZ, rho))
+    _, inf_p = sage.sagefit(x8, coh, sta1, sta2, cidx, cmask, J0, 8, wt,
+                            config=cfg._replace(dtype_policy="bf16"),
+                            admm=(Y, BZ, rho))
+    rf = float(inf_f["res_1"])
+    rp = float(inf_p["res_1"])
+    assert abs(rp / rf - 1.0) < ENVELOPE["bf16"], (rf, rp)
+
+
+# ---------------------------------------------------------------------------
+# staging: DonatedRing slots + prefetch bit-identity under bf16
+# ---------------------------------------------------------------------------
+
+def test_donated_ring_carries_storage_dtype():
+    from sagecal_tpu import sched
+    ring = sched.DonatedRing(2)
+    buf = jnp.ones((16, 8), jnp.bfloat16)
+    ring.stage(0, buf)
+    out = ring.take(0)
+    assert out.dtype == jnp.bfloat16
+
+
+@pytest.mark.slow
+def test_pipeline_overlap_bit_identical_bf16(tmp_path):
+    """--prefetch 0 vs 2 under --dtype-policy bf16: written residuals
+    and solutions stay bit-identical (only data movement overlaps; the
+    storage dtype rides the ring slots and the residual readback)."""
+    from tests.test_overlap import _make_dataset, _cfg, _assert_bitident
+    from sagecal_tpu import pipeline, skymodel
+    from sagecal_tpu.io import dataset as ds
+    msdir, skyf, clusf = _make_dataset(tmp_path)
+    cfg = _cfg(msdir, skyf, clusf, extra=("--dtype-policy", "bf16"))
+    ms = ds.SimMS(msdir)
+    sky = skymodel.read_sky_cluster(skyf, clusf, ms.meta["ra0"],
+                                    ms.meta["dec0"], ms.meta["freq0"])
+    pipe = pipeline.FullBatchPipeline(cfg, ms, sky, log=lambda *a: None)
+    assert pipe.sdt == jnp.dtype(jnp.bfloat16)
+    assert pipe.base_cfg.dtype_policy == "bf16"
+
+    def run(depth, sol):
+        return pipe.run(solution_path=sol, prefetch=depth,
+                        log=lambda *a: None)
+
+    h = _assert_bitident(msdir, 3, tmp_path, run, tag="bf16")
+    assert all(np.isfinite(x["res_1"]) for x in h)
+
+
+# ---------------------------------------------------------------------------
+# traffic: the priced config-1 trip melts >= 30% under bf16
+# ---------------------------------------------------------------------------
+
+def test_config1_trip_bytes_drop_30pct():
+    """Equal-trip-count roofline gate: one priced LM damping trip at the
+    bench config-1 shape (N=62, B=18910, mode 3, baseline-major) must
+    cost >= 30% fewer bytes under bf16 than the f32 reference — the
+    XLA cost analysis is dtype-aware, so this asserts the melt the
+    bank (BENCH_CPU_r09.json) records, without running the bench."""
+    import importlib.util, os, sys
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("bench", bench)
+    spec.loader.exec_module(bench)
+    f32 = bench.solver_trip_cost(3, 1, 62, 18910, jnp.float32, nbase=1891)
+    bf16 = bench.solver_trip_cost(3, 1, 62, 18910, jnp.bfloat16,
+                                  nbase=1891)
+    assert f32 and bf16, "trip pricing unavailable"
+    drop = 1.0 - bf16["bytes_accessed"] / f32["bytes_accessed"]
+    assert drop >= 0.30, f"bf16 trip bytes drop {drop:.1%} < 30%"

@@ -1600,6 +1600,221 @@ def config7_dtype(device, dtype):
     return out
 
 
+_SERVE_SKY = """\
+P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6
+P1A 1 20 0 38 0 0 2.5 0 0 0 0 0 0 0 0 150e6
+"""
+_SERVE_CLUSTER = "0 1 P0A\n1 2 P1A\n"
+
+
+def config8_serve(device, dtype):
+    """Round-10 config: calibration-as-a-service throughput (ISSUE 8).
+
+    FOUR synthetic jobs in TWO shape buckets (2x tilesz 4, 2x tilesz
+    6 — two program-cache keys, sharing within each bucket) run (a)
+    serially through the batch pipeline (the 4-solo-CLI-runs
+    reference, same process so both legs enjoy the same warm compile
+    cache — the comparison isolates the SCHEDULING win, interleaving
+    one job's ready tiles into another's host stalls, from the
+    compile-sharing win the cache hit rate reports separately) and
+    (b) concurrently through the live serve daemon (socket protocol
+    and all). Banks jobs/hour, the device-busy fraction and the
+    compile-cache hit rate, REFUSES to bank unless every daemon job's
+    written residuals and solutions are bit-identical to its serial
+    run. Settle-then-alternate timing, min-of-2 per leg (config 6
+    contract: compiles never land in a timed rep)."""
+    import math as _math
+    import shutil
+    import tempfile
+    import jax.numpy as jnp
+    from sagecal_tpu import pipeline as pl
+    from sagecal_tpu import skymodel
+    from sagecal_tpu.io import dataset as ds_mod
+    from sagecal_tpu.rime import predict as rp_mod
+    from sagecal_tpu.serve import cache as pcache
+    from sagecal_tpu.serve.api import Client, Server, config_from_dict
+
+    tmpd = tempfile.mkdtemp(prefix="sagecal_serve_")
+    skyf = os.path.join(tmpd, "sky.txt")
+    clusf = skyf + ".cluster"
+    with open(skyf, "w") as f:
+        f.write(_SERVE_SKY)
+    with open(clusf, "w") as f:
+        f.write(_SERVE_CLUSTER)
+    ra0 = (41 / 60) * _math.pi / 12
+    dec0 = 40 * _math.pi / 180
+    srcs = skymodel.parse_sky_model(skyf, ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(clusf))
+    dsky = rp_mod.sky_to_device(sky, jnp.float32)
+    # streaming-shaped jobs (the config-6 lesson): many short solve
+    # intervals over a wide band, where io+stage+residual-fetch+write
+    # is a real share of wall — the share the daemon can fill with a
+    # neighbour's ready tile. Tiny 0.5 s jobs measure only the
+    # daemon's fixed per-job costs
+    n_stations, n_tiles, nchan = 16, 8, 24
+    Jt = ds_mod.random_jones(sky.n_clusters, sky.nchunk, n_stations,
+                             seed=5, scale=0.15)
+    freqs = np.linspace(149e6, 151e6, nchan)
+    jobs = []          # (name, tilesz, serial msdir, daemon msdir)
+    for jn, tilesz in enumerate((4, 4, 6, 6)):
+        tiles = [ds_mod.simulate_dataset(
+            dsky, n_stations=n_stations, tilesz=tilesz, freqs=freqs,
+            ra0=ra0, dec0=dec0, jones=Jt, nchunk=sky.nchunk,
+            noise_sigma=0.02, seed=SEED + 80 + 10 * jn + t)
+            for t in range(n_tiles)]
+        ms_s = os.path.join(tmpd, f"job{jn}_serial.ms")
+        ds_mod.SimMS.create(ms_s, tiles)
+        ms_d = os.path.join(tmpd, f"job{jn}_daemon.ms")
+        shutil.copytree(ms_s, ms_d)
+        jobs.append((f"job{jn}", tilesz, ms_s, ms_d))
+    noop = (lambda *a: None)
+
+    def job_cfg(tilesz, msdir, sol):
+        # prefetch 2 on BOTH legs (bit-identical by the overlap
+        # contract): the scheduler's sticky bound is depth + 1, so a
+        # deeper per-job prefetch trades a little staging memory for
+        # fewer compiled-program alternations between shape buckets
+        return dict(ms=msdir, sky_model=skyf, cluster_file=clusf,
+                    solver_mode=0, max_em_iter=1, max_iter=4,
+                    max_lbfgs=2, tile_size=tilesz, solutions_file=sol,
+                    prefetch=2)
+
+    def run_serial():
+        t0 = time.perf_counter()
+        for name, tilesz, ms_s, _ in jobs:
+            cfg = config_from_dict(job_cfg(
+                tilesz, ms_s, os.path.join(tmpd, f"{name}_serial.sol")))
+            pl.run(cfg, log=noop)
+        return time.perf_counter() - t0
+
+    def run_serial_cli():
+        # the ISSUE's reference leg and the production UX the service
+        # replaces: each job is its OWN CLI process with a cold jax
+        # import and compile cache — the loop-turnaround price
+        # (CubiCal arXiv:1805.03410 / SKA-GPU arXiv:1910.13908) that
+        # the daemon's warm process amortizes across tenants. Measured
+        # once: the compile wall dominates and dwarfs rep noise.
+        t0 = time.perf_counter()
+        for name, tilesz, ms_s, _ in jobs:
+            argv = [sys.executable, "-m", "sagecal_tpu.cli",
+                    "-d", ms_s, "-s", skyf, "-c", clusf,
+                    "-j", "0", "-e", "1", "-g", "4", "-l", "2",
+                    "-t", str(tilesz), "--prefetch", "2",
+                    "-p", os.path.join(tmpd, f"{name}_serial.sol")]
+            if device.platform == "cpu":
+                argv += ["--platform", "cpu"]
+            r = subprocess.run(argv, capture_output=True, text=True)
+            if r.returncode:
+                raise RuntimeError(
+                    f"serial CLI {name} rc={r.returncode}: "
+                    f"{(r.stderr or '')[-200:]}")
+        return time.perf_counter() - t0
+
+    def run_daemon():
+        # the server is PERSISTENT by definition — its thread/socket
+        # startup is amortized over a process lifetime, so the timed
+        # wall is steady-state submit -> all-done
+        srv = Server(port=0, max_inflight=4)
+        srv.start()
+        try:
+            with Client(port=srv.port) as c:
+                c.request(op="ping")
+                # the DAEMON LEG's own compile-cache traffic: the
+                # ProgramCache is a process singleton also warmed by
+                # the serial control legs, so the banked hit rate must
+                # be the delta across this leg, not the process total
+                cs0 = pcache.PROGRAMS.stats()
+                t0 = time.perf_counter()
+                ids = [c.submit(job_cfg(
+                    tilesz, ms_d,
+                    os.path.join(tmpd, f"{name}_daemon.sol")))
+                    for name, tilesz, _, ms_d in jobs]
+                # drain(wait) blocks server-side until every accepted
+                # job finished — the completion signal, with NO status
+                # polling stealing host cycles from the solve
+                c.drain(wait=True)
+                wall = time.perf_counter() - t0
+                m = c.metrics()
+                cs1 = pcache.PROGRAMS.stats()
+                dh = cs1["hits"] - cs0["hits"]
+                dm = cs1["misses"] - cs0["misses"]
+                m["hit_rate"] = dh / (dh + dm) if dh + dm else 1.0
+                m["hits"], m["misses"] = dh, dm
+                for jid in ids:
+                    snap = c.status(jid)
+                    if snap["state"] != "done":
+                        raise RuntimeError(
+                            f"daemon job {jid}: {snap['state']} "
+                            f"({snap.get('error')})")
+        finally:
+            srv.stop()
+        return wall, m
+
+    # settle: both legs once, untimed — both shape buckets compile
+    # here, never inside a timed rep
+    t_w0 = time.perf_counter()
+    run_serial()
+    run_daemon()
+    comp_wall = time.perf_counter() - t_w0
+    walls_s, walls_d, metrics_d = [], [], None
+    for _rep in range(3):
+        walls_s.append(run_serial())
+        wall, m = run_daemon()
+        walls_d.append(wall)
+        metrics_d = m
+    wall_serial = min(walls_s)
+    wall_conc = min(walls_d)
+    # the headline serial leg LAST: it rewrites the *_serial outputs
+    # (same bits — identical configs/data), so the bit-identity gate
+    # below compares the daemon against actual CLI-process output
+    wall_cli = run_serial_cli()
+
+    # bit-identity gate: every daemon job vs its serial (solo) run
+    for name, _tilesz, ms_s, ms_d in jobs:
+        out_s = ds_mod.SimMS(ms_s, data_column="CORRECTED_DATA")
+        out_d = ds_mod.SimMS(ms_d, data_column="CORRECTED_DATA")
+        for i in range(n_tiles):
+            if not np.array_equal(out_s.read_tile(i).x,
+                                  out_d.read_tile(i).x):
+                return {"error": f"{name}: daemon residuals NOT "
+                                 "bit-identical to the serial run"}
+        with open(os.path.join(tmpd, f"{name}_serial.sol")) as f0, \
+                open(os.path.join(tmpd, f"{name}_daemon.sol")) as f1:
+            if f0.read() != f1.read():
+                return {"error": f"{name}: daemon solutions NOT "
+                                 "bit-identical to the serial run"}
+
+    rec = dict(
+        value=len(jobs) / wall_conc * 3600.0, unit="jobs/h",
+        step_s=wall_conc / len(jobs),
+        compile_s=max(comp_wall - wall_serial - wall_conc, 0.0),
+        n_jobs=len(jobs), shape_buckets=2,
+        # the acceptance comparison (ISSUE 8): the same 4 jobs run
+        # serially via the CLI — 4 cold processes, the production UX
+        wall_serial_cli_s=wall_cli,
+        dwall_pct=100.0 * (wall_conc - wall_cli) / wall_cli,
+        # the equal-warmth scheduling-only comparison (in-process
+        # serial sharing the same warm cache): on a host whose
+        # "device" shares cores with the reader threads this is
+        # parity within noise — recorded, not hidden
+        wall_serial_warm_s=wall_serial,
+        dwall_warm_pct=100.0 * (wall_conc - wall_serial) / wall_serial,
+        wall_concurrent_s=wall_conc,
+        walls_serial_warm=[round(w, 3) for w in walls_s],
+        walls_concurrent=[round(w, 3) for w in walls_d],
+        device_busy_frac=metrics_d["device_busy_frac"],
+        cache_hit_rate=metrics_d["hit_rate"],
+        cache_hits=metrics_d["hits"], cache_misses=metrics_d["misses"],
+        tiles_total=metrics_d["tiles_done"],
+        bit_identical=True,
+        shape=f"4 jobs x {n_tiles}tiles N={n_stations} M=2 F={nchan} "
+              f"tilesz 4,4,6,6 -j0 e1g4l2 daemon-vs-cli-serial")
+    prog = pcache.PROGRAMS.stats()
+    rec["program_cache"] = prog
+    return rec
+
+
 CONFIGS = [
     ("1-fullbatch-lm", config1_fullbatch_lm),
     ("2-stochastic-lbfgs", config2_stochastic),
@@ -1608,6 +1823,7 @@ CONFIGS = [
     ("5-admm-32subband", config5_admm32),
     ("6-overlap-e2e", config6_overlap),
     ("7-dtype-melt", config7_dtype),
+    ("8-serve-throughput", config8_serve),
 ]
 
 

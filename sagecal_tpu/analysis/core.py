@@ -54,8 +54,10 @@ RULES = {
 # modules whose host loops are hot-path territory for host-sync, and
 # whose traced kernels the dtype lint covers (ISSUE 4 scope; sched.py
 # joined in ISSUE 5 — the overlap layer's thread loops must never grow
-# a per-iteration sync)
-_HOT_SEGMENTS = ("solvers", "consensus", "rime")
+# a per-iteration sync; serve/ joined in ISSUE 8 — the device-owner
+# scheduler loop and the per-job thread code sit upstream of EVERY
+# job's solve, so a sync or a use-after-donate there taxes all tenants)
+_HOT_SEGMENTS = ("solvers", "consensus", "rime", "serve")
 _HOT_BASENAMES = ("pipeline.py", "sched.py")
 
 

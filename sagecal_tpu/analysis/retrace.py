@@ -98,11 +98,32 @@ def _cached_once(ctx, call) -> bool:
     return False
 
 
+#: call targets whose thunk argument builds a program ONCE per content
+#: key in the process-wide cache (serve/cache.py ProgramCache) — a jit
+#: constructed inside such a thunk is the blessed keyed-cache idiom,
+#: the replacement for the per-instance lazy cache this rule polices
+_CACHE_BUILDERS = ("_jit_cached", "PROGRAMS.get")
+
+
+def _cache_build_thunk(ctx, call) -> bool:
+    """True when ``call`` sits inside a lambda/def passed to a program
+    cache's build slot (``self._jit_cached(..., lambda: jax.jit(f))``
+    or ``PROGRAMS.get(key, lambda: ...)``)."""
+    for fn in ctx.enclosing_functions(call):
+        parent = ctx.parents.get(fn)
+        if isinstance(parent, ast.Call):
+            d = dotted(parent.func) or ""
+            if any(d == b or d.endswith("." + b)
+                   for b in _CACHE_BUILDERS):
+                return True
+    return False
+
+
 def _check_construction(ctx, findings):
     for call in _jit_ctor_calls(ctx):
         if _in_decorator(ctx, call) or _in_return(ctx, call):
             continue
-        if _cached_once(ctx, call):
+        if _cached_once(ctx, call) or _cache_build_thunk(ctx, call):
             continue
         encl = ctx.enclosing_functions(call)
         if not encl:

@@ -78,6 +78,12 @@ def build_parser() -> argparse.ArgumentParser:
     a("--inflight", type=int, default=1,
       help="clusters solved concurrently per SAGE sweep step (block-"
            "Jacobi groups); 1 = reference Gauss-Seidel sequencing")
+    a("--tile-bucket", type=int, default=0, metavar="T",
+      help="pad each solve interval to T timeslots with zero-weight "
+           "rows so bucket-compatible jobs share compiled programs "
+           "(sagecal_tpu.serve compile cache; 0 = exact shapes, "
+           "-1 = next power of two; outputs are bit-identical to any "
+           "solo run at the SAME bucket)")
     a("--prefetch", type=int, default=1, metavar="N",
       help="overlapped execution depth (sagecal_tpu.sched): read + "
            "host-prepare tile t+N on a background thread while tile t "
@@ -189,6 +195,7 @@ def config_from_args(args) -> RunConfig:
         cluster_inflight=args.inflight,
         solver_inner=args.inner,
         dtype_policy=args.dtype_policy,
+        tile_bucket=args.tile_bucket,
         prefetch=args.prefetch,
         shard_baselines=bool(args.shard_baselines))
 

@@ -161,6 +161,15 @@ class RunConfig:
     # envelopes and what never quantizes: solutions J, consensus
     # state, uvw geometry, the robust-nu root-find)
     dtype_policy: str = "f32"
+    # --tile-bucket : pad each staged solve interval to this many
+    # timeslots (whole zero-weight timeslot blocks; serve/cache.py) so
+    # jobs whose shapes differ only in tilesz share one set of
+    # compiled programs in the service's compile cache. 0 = off (exact
+    # shapes, the bit-frozen default); -1 = next power of two; an
+    # explicit value must be >= tilesz. Changing the bucket changes
+    # the OS-subset partition, so outputs are bit-identical to a solo
+    # run AT THE SAME BUCKET (MIGRATION.md "Service mode")
+    tile_bucket: int = 0
     # --prefetch : overlapped execution depth (sagecal_tpu.sched).
     # N>0: tile t+N is read + host-prepared on a background thread
     # while tile t solves, and residual/solution writes run on an

@@ -65,6 +65,45 @@ REQUIRED_FIELDS = ("t", "ev")
 
 _TRACER = None          # module-level singleton; None = disabled
 
+# thread-scoped tracer override (serve: per-job --diag routing). The
+# server runs many jobs through one process; each job's records go to
+# its OWN trace file. A scope installed on a thread (the device-owner
+# thread around a job's step, the job's reader thread, its writer-
+# thread jobs) routes that thread's emits to the job tracer; threads
+# without a scope keep the process tracer. Stored as a stack so scopes
+# nest (a server-level tracer can wrap a job-level one).
+_SCOPED = threading.local()
+
+
+def _current():
+    st = getattr(_SCOPED, "stack", None)
+    return st[-1] if st else _TRACER
+
+
+class _Scope:
+    __slots__ = ("_t",)
+
+    def __init__(self, tracer):
+        self._t = tracer
+
+    def __enter__(self):
+        st = getattr(_SCOPED, "stack", None)
+        if st is None:
+            st = _SCOPED.stack = []
+        st.append(self._t)
+        return self._t
+
+    def __exit__(self, *exc):
+        _SCOPED.stack.pop()
+        return False
+
+
+def scope(tracer):
+    """Route THIS thread's emits to ``tracer`` while the context is
+    live (``None`` silences them). Per-job trace routing for the serve
+    scheduler; nests, and never touches other threads."""
+    return _Scope(tracer)
+
 
 class Tracer:
     """Append-only JSONL event writer with monotonic phase timers."""
@@ -158,26 +197,29 @@ def disable() -> None:
 
 
 def get() -> Tracer | None:
-    return _TRACER
+    return _current()
 
 
 def active() -> bool:
-    """True when a tracer is installed. Emitting sites whose field
-    conversion is itself costly (device->host syncs) gate on this."""
-    return _TRACER is not None
+    """True when a tracer is installed (process-wide or scoped onto
+    this thread). Emitting sites whose field conversion is itself
+    costly (device->host syncs) gate on this."""
+    return _current() is not None
 
 
 def emit(ev: str, **fields) -> None:
     """Module-level emit: one line when enabled, no-op otherwise."""
-    if _TRACER is not None:
-        _TRACER.emit(ev, **fields)
+    t = _current()
+    if t is not None:
+        t.emit(ev, **fields)
 
 
 def phase(name: str, **fields):
     """Module-level phase timer; a shared null context when disabled."""
-    if _TRACER is None:
+    t = _current()
+    if t is None:
         return _NULL_PHASE
-    return _TRACER.phase(name, **fields)
+    return t.phase(name, **fields)
 
 
 def overlap_stats(recs: list) -> dict:

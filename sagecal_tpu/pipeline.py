@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from sagecal_tpu import coords, dtypes as dtp, sched, skymodel, utils
 from sagecal_tpu.config import RunConfig, SimulationMode, SolverMode
+from sagecal_tpu.serve import cache as pcache
 from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.io import dataset as ds
@@ -121,10 +122,34 @@ class FullBatchPipeline:
         meta = ms.meta
         self.kmax = int(sky.nchunk.max())
         self.cmask = np.arange(self.kmax)[None, :] < sky.nchunk[:, None]
-        self.cidx = rp.chunk_indices(meta["tilesz"], meta["nbase"],
+        # --tile-bucket: pad each staged interval to a common timeslot
+        # bucket (whole zero-WEIGHT timeslot blocks, serve/cache.py) so
+        # bucket-compatible jobs share one set of compiled programs.
+        # Every tilesz-derived static below (cidx, tslot, OS subsets)
+        # is built at the BUCKET size; staging pads, residual write
+        # slices the real rows back out. Exactness argument: a
+        # zero-weight row contributes nothing to any weighted
+        # reduction (the PR 6 OS-slicing / sharded-padding precedent).
+        tb = int(getattr(cfg, "tile_bucket", 0) or 0)
+        self.tilesz_eff = int(meta["tilesz"])
+        if tb:
+            unsupported = (cfg.per_channel_bfgs
+                           or getattr(cfg, "shard_baselines", False)
+                           or int(cfg.beam_mode)
+                           or int(getattr(cfg, "tile_batch", 1)) > 1
+                           or cfg.simulation != SimulationMode.OFF)
+            if unsupported:
+                log("tile-bucket: per-channel/sharded/beam/tile-batch/"
+                    "simulation paths stage exact shapes; bucketing off")
+            else:
+                self.tilesz_eff = pcache.resolve_bucket(meta["tilesz"],
+                                                        tb)
+        self.pad_rows = (self.tilesz_eff - int(meta["tilesz"])) \
+            * int(meta["nbase"])
+        self.cidx = rp.chunk_indices(self.tilesz_eff, meta["nbase"],
                                      sky.nchunk)
         self.n = meta["n_stations"]
-        self.tslot = ds.row_tslot(meta["tilesz"] * meta["nbase"],
+        self.tslot = ds.row_tslot(self.tilesz_eff * meta["nbase"],
                                   meta["nbase"])
         # beam (-B): stored metadata, else synthetic (set_elementcoeffs +
         # readAuxData-with-beam analogue; fullbatch_mode.cpp:56-70)
@@ -193,6 +218,29 @@ class FullBatchPipeline:
             nbase=int(meta["nbase"]))
         self.boost = first_tile_boost(self.n)
 
+        # process-wide program-cache key (serve/cache.py): tokens EVERY
+        # closure constant the per-pipeline jitted programs capture —
+        # the post-precession device sky, shape statics at the BUCKET
+        # tilesz, dtype policy, solver flags, and the residual/
+        # simulation knobs — so a second job with an equal key shares
+        # the first job's warm-compiled wrappers (zero new compiles,
+        # asserted via diag/guard) and an unequal key can never reuse a
+        # stale closure. The cache may keep a prior pipeline (and its
+        # dataset handle) alive through a cached bound method; the LRU
+        # bound in serve.cache caps that retention.
+        self._ckey = pcache.token(
+            [np.asarray(a) for a in self.dsky],
+            dict(freq0=meta["freq0"], fdelta=meta["fdelta"],
+                 freqs=list(meta["freqs"]), tilesz=self.tilesz_eff,
+                 nbase=int(meta["nbase"]), n=self.n),
+            self.cidx, self.cmask, sky.cluster_ids, sky.nchunk,
+            str(np.dtype(self.rdt)), str(np.dtype(self.sdt)),
+            self.dtype_policy, int(self.dobeam), bool(self.use_pallas),
+            tuple(self.base_cfg),
+            dict(mmse_rho=cfg.mmse_rho, correct=cfg.correct_cluster,
+                 phase_only=bool(cfg.phase_only),
+                 sim=int(cfg.simulation)))
+
         # --tile-batch: T>1 solves T intervals as one vmapped program
         # (sagefit_host_tiles) — the utilization lever for small solves.
         # The beam path batches too (only the per-tile gmst track
@@ -215,8 +263,11 @@ class FullBatchPipeline:
         # input (same [B, F, ..] real shape) instead of allocating a
         # second tile-sized buffer per interval — callers stage x_r
         # fresh from tile.x and only ever read the output back
-        self._residual_fn = jax.jit(self._residuals, donate_argnums=(1,))
-        self._sim_jit = None       # built lazily by run_simulation
+        self._residual_fn = self._jit_cached(
+            "residual",
+            lambda: jax.jit(self._residuals, donate_argnums=(1,)))
+        self._sim_jit = None       # bound by run_simulation via the
+        #                            program cache (keyed, not per-instance)
         self._chan_solver = None
         self._chan_residual_fn = None
         if cfg.per_channel_bfgs:
@@ -226,6 +277,15 @@ class FullBatchPipeline:
     # NOTE on jit boundaries: complex arrays cannot cross host<->device on
     # the axon TPU runtime, so solvers take/return Jones as [.., N, 8]
     # reals and visibilities as stacked [..., 2] real pairs (utils.c2r).
+
+    def _jit_cached(self, kind: str, build, *extra):
+        """A jit wrapper shared through the process-wide program cache:
+        ``build()`` runs once per (kind, content key, extra); every
+        later pipeline with an equal key — another job in the same
+        server, or this pipeline rebuilt — reuses the warm wrapper
+        instead of silently re-tracing (serve/cache.py)."""
+        return pcache.PROGRAMS.get(("prog", kind, self._ckey) + extra,
+                                   build)
 
     def _inflight_downgrade(self, log=print) -> None:
         """Divergence guard for --inflight (VERDICT r5 item 6): a
@@ -264,21 +324,25 @@ class FullBatchPipeline:
 
         tslot = jnp.asarray(self.tslot)
         # ordered-subsets partition for solver modes 1/2/3 (P4,
-        # clmfit.c:1074); harmless to pass for other modes
-        os_info = lm_mod.os_subset_ids(meta["tilesz"], meta["nbase"])
+        # clmfit.c:1074); harmless to pass for other modes. Built at
+        # the BUCKET tilesz: staged rows are padded to it
+        os_info = lm_mod.os_subset_ids(self.tilesz_eff, meta["nbase"])
 
         if self.use_pallas:
             pg, rest = self._pallas_skies
-            coh_fn = jax.jit(lambda u, v, w, sta1, sta2, beam: (
-                rp.coherencies_split(pg, rest, u, v, w,
-                                     jnp.asarray([freq0], self.rdt),
-                                     fdelta)[:, :, 0]))
+            coh_fn = self._jit_cached("coh", lambda: jax.jit(
+                lambda u, v, w, sta1, sta2, beam: (
+                    rp.coherencies_split(pg, rest, u, v, w,
+                                         jnp.asarray([freq0], self.rdt),
+                                         fdelta)[:, :, 0])))
         else:
-            coh_fn = jax.jit(lambda u, v, w, sta1, sta2, beam: (
-                rp.coherencies(self.dsky, u, v, w,
-                               jnp.asarray([freq0], self.rdt),
-                               fdelta, beam=beam, dobeam=self.dobeam,
-                               tslot=tslot, sta1=sta1, sta2=sta2)[:, :, 0]))
+            coh_fn = self._jit_cached("coh", lambda: jax.jit(
+                lambda u, v, w, sta1, sta2, beam: (
+                    rp.coherencies(self.dsky, u, v, w,
+                                   jnp.asarray([freq0], self.rdt),
+                                   fdelta, beam=beam, dobeam=self.dobeam,
+                                   tslot=tslot, sta1=sta1,
+                                   sta2=sta2)[:, :, 0])))
 
         def solve(x8, u, v, w, sta1, sta2, wt, J0_r8, beam, tile_idx=0):
             # host-driven EM: one bounded device execution per cluster
@@ -311,7 +375,7 @@ class FullBatchPipeline:
         fdelta = meta["fdelta"]
         cidx = jnp.asarray(self.cidx)
         cmask = jnp.asarray(self.cmask)
-        os_info = lm_mod.os_subset_ids(meta["tilesz"], meta["nbase"])
+        os_info = lm_mod.os_subset_ids(self.tilesz_eff, meta["nbase"])
         freq = jnp.asarray([freq0], self.rdt)
 
         tslot = jnp.asarray(self.tslot)
@@ -335,11 +399,12 @@ class FullBatchPipeline:
         # (stations/elements/pattern are tile-invariant), so the batch
         # carries ONE BeamArrays with a [T, tilesz] gmst and each tile's
         # predict slices its row at trace time
-        coh_fn = jax.jit(lambda u, v, w, beamT, s1, s2: jnp.stack(
-            [coh_one(u[t], v[t], w[t],
-                     (None if beamT is None
-                      else beamT._replace(gmst=beamT.gmst[t])), s1, s2)
-             for t in range(T)]))
+        coh_fn = self._jit_cached("coh_tiles", lambda: jax.jit(
+            lambda u, v, w, beamT, s1, s2: jnp.stack(
+                [coh_one(u[t], v[t], w[t],
+                         (None if beamT is None
+                          else beamT._replace(gmst=beamT.gmst[t])), s1, s2)
+                 for t in range(T)])), T)
 
         def solve(x8T, uT, vT, wT, sta1, sta2, wtT, J0_r8T, tile_ids,
                   beamT=None):
@@ -489,9 +554,9 @@ class FullBatchPipeline:
 
     def _build_chan_residual(self):
         """All channels' residuals in one program (vmap over channels)."""
-        return jax.jit(jax.vmap(
+        return self._jit_cached("chan_residual", lambda: jax.jit(jax.vmap(
             self._chan_residual,
-            in_axes=(0, 0, None, None, None, None, None, 0, None)))
+            in_axes=(0, 0, None, None, None, None, None, 0, None))))
 
     def _build_chan_solver(self):
         """Per-channel bandpass solve (-b 1, fullbatch_mode.cpp:442-488):
@@ -523,9 +588,11 @@ class FullBatchPipeline:
                                    config=scfg, nu=self.cfg.robust_nulow)
             return ne.jones_c2r(J), info["res_0"], info["res_1"]
 
-        return jax.jit(jax.vmap(
-            solve, in_axes=(0, 0, 0, None, None, None, None, None, None,
-                            None)))
+        return self._jit_cached(
+            "chan_solver", lambda: jax.jit(jax.vmap(
+                solve, in_axes=(0, 0, 0, None, None, None, None, None,
+                                None, None))),
+            int(self.cfg.max_lbfgs), float(self.cfg.robust_nulow))
 
     def initial_jones(self) -> np.ndarray:
         M = self.sky.n_clusters
@@ -573,10 +640,14 @@ class FullBatchPipeline:
         synchronous path; the "write" phase covers fetch + disk so the
         sync attribution shows the full data-movement stall."""
         with dtrace.phase("write", tile=ti, bg=bg):
+            n_rows = tile.x.shape[0]
             # fetch through float64: numpy-side r2c on ml_dtypes bf16
             # arrays is not supported, and the MS stores complex128
-            tile.x = utils.r2c(np.asarray(res_r, np.float64)).astype(
+            x = utils.r2c(np.asarray(res_r, np.float64)).astype(
                 np.complex128)
+            # tile-bucket padding rows (zero weight, never solved on)
+            # are sliced off before the MS sees them
+            tile.x = x[:n_rows]
             self.ms.write_tile(ti, tile)
 
     def _run_batched(self, write_residuals, solution_path, max_tiles, log,
@@ -753,6 +824,20 @@ class FullBatchPipeline:
                     writer.close()
         return history
 
+    def stepper(self, write_residuals: bool = True, solution_path=None,
+                max_tiles=None, log=print, prefetch=None,
+                trace_ctx=None) -> "TileStepper":
+        """The sequential driver as a resumable per-tile unit: the
+        serve scheduler owns ``stage``/``step``/``close`` and may
+        interleave many jobs' tiles through one device while each
+        job's warm-start/PRNG chain stays sequential inside its own
+        :class:`TileStepper`."""
+        return TileStepper(self, write_residuals=write_residuals,
+                           solution_path=solution_path,
+                           max_tiles=max_tiles, log=log,
+                           depth=self._prefetch_depth(prefetch),
+                           trace_ctx=trace_ctx)
+
     def run(self, write_residuals: bool = True, solution_path=None,
             max_tiles=None, log=print, prefetch=None):
         """``prefetch``: overlap depth override (None = cfg.prefetch;
@@ -762,246 +847,37 @@ class FullBatchPipeline:
         if getattr(self, "batch_ok", False):
             return self._run_batched(write_residuals, solution_path,
                                      max_tiles, log, prefetch)
-        cfg, ms, sky = self.cfg, self.ms, self.sky
-        meta = ms.meta
         depth = self._prefetch_depth(prefetch)
-
-        pinit = self.initial_jones()
-        J = pinit.copy()
+        st = self.stepper(write_residuals, solution_path, max_tiles,
+                          log, prefetch=depth)
         # --profile: capture an XLA/device timeline of the FIRST solve
         # interval (SURVEY.md section 5 tracing — the reference has only
         # wall-clock prints; a jax.profiler trace is the superset).
         # Bounded to one tile so trace size stays sane.
-        prof_dir = getattr(cfg, "profile_dir", None)
+        prof_dir = getattr(self.cfg, "profile_dir", None)
         prof_live = False
         if prof_dir:
             import jax.profiler
             jax.profiler.start_trace(prof_dir)
             prof_live = True
             log(f"profiling first solve interval -> {prof_dir}")
-        writer = None
-        if solution_path:
-            writer = sol.SolutionWriter(
-                solution_path, meta["freq0"], meta["fdelta"],
-                meta["tilesz"] * meta["tdelta"] / 60.0, self.n,
-                sky.n_clusters, sky.n_eff_clusters)
-
-        res_prev = None
-        first = True
-        history = []
-        # donated-staging ring + ordered writer thread (sched): under
-        # overlap the next tile reads + stages on a background thread
-        # while this one solves, and residual/solution writes drain on
-        # the writer thread — strictly in tile order, failures
-        # re-raised at the next tile boundary
-        ring = sched.DonatedRing(depth + 2)
-        aw = sched.AsyncWriter(enabled=depth > 0)
-        stage_xr = write_residuals and not cfg.per_channel_bfgs
-
-        def stage(ti, tile):
-            t_stage = time.perf_counter()
-            u = jnp.asarray(tile.u, self.rdt)
-            v = jnp.asarray(tile.v, self.rdt)
-            w = jnp.asarray(tile.w, self.rdt)
-            # shared staging decision (VisTile.solve_input): native
-            # per-channel-flag packing when applicable, plain mean else;
-            # stored uv-cut rows survive either way
-            x8_np, rowflags, _good = tile.solve_input(
-                uvtaper_m=cfg.uvtaper)
-            # dtype-policy storage staging (see the batched driver)
-            x8 = jnp.asarray(x8_np, self.sdt)
-            flags = rp.uvcut_flags(jnp.asarray(rowflags, jnp.int32), u, v,
-                                   jnp.asarray(tile.freqs, self.rdt),
-                                   cfg.uvmin, cfg.uvmax)
-            if cfg.whiten:
-                # -W: uv-density whitening of the solve input only
-                # (fullbatch_mode.cpp applies whiten_data to the averaged x)
-                from sagecal_tpu.solvers import robust as rb
-                x8 = rb.whiten_data(x8, u, v, meta["freq0"])
-            stg = dict(u=u, v=v, w=w, x8=x8, flags=flags,
-                       wt=lm_mod.make_weights(flags, self.sdt),
-                       sta1=jnp.asarray(tile.sta1),
-                       sta2=jnp.asarray(tile.sta2),
-                       beam=self._tile_beam(tile))
-            if stage_xr:
-                # residual input staged ahead; DONATED to the residual
-                # program (ring: no read-after-donate, no aliasing)
-                ring.stage(ti, jnp.asarray(utils.c2r(tile.x), self.sdt))
-            dtrace.emit("phase", name="stage", tile=ti,
-                        dur_s=time.perf_counter() - t_stage, bg=depth > 0)
-            return stg
-
         try:
             for ti, tile, stg, io_wait in self._tile_source(
-                    stage, max_tiles, depth):
-                aw.check()  # async write failure -> fail at the boundary
-                bubble = io_wait
-                t0 = time.time()
-                u, v, w = stg["u"], stg["v"], stg["w"]
-                sta1, sta2 = stg["sta1"], stg["sta2"]
-                x8, flags, wt = stg["x8"], stg["flags"], stg["wt"]
-                tile_beam = stg["beam"]
-
-                solver = self._solve_first if first else self._solve_rest
-                J_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
-                t_solve = time.perf_counter()
-                Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8,
-                                     tile_beam, tile_idx=ti)
-                first = False
-                res_0 = float(info["res_0"])
-                res_1 = float(info["res_1"])
-                mean_nu = float(info["mean_nu"])
-                J = utils.jones_r2c_np(np.asarray(Jd_r8))
-                dtrace.emit("phase", name="solve", tile=ti,
-                            dur_s=time.perf_counter() - t_solve)
-
-                # divergence reset (fullbatch_mode.cpp:605-621)
-                if res_1 == 0.0 or not np.isfinite(res_1) or (
-                        res_prev is not None and res_1 > RES_RATIO * res_prev):
-                    log(f"tile {ti}: Resetting Solution")
-                    if res_1 != 0.0:   # zero = flagged data, not divergence
-                        self._inflight_downgrade(log)
-                    J = pinit.copy()
-                    first = True
-                    res_prev = res_1 if np.isfinite(res_1) else None
-                else:
-                    res_prev = res_1 if res_prev is None else min(res_prev, res_1)
-
-                if cfg.per_channel_bfgs:
-                    # -b 1: per-channel LBFGS re-solve + per-channel residual
-                    # (fullbatch_mode.cpp:442-488). Channels are independent
-                    # (each warm-starts from the same joint solution), so the
-                    # whole channel axis runs as ONE vmapped solve + ONE
-                    # vmapped residual program instead of a sequential loop.
-                    # The last channel's solutions become the carried/written
-                    # solutions (fullbatch_mode.cpp:485 memcpy).
-                    J0c_r8 = jnp.asarray(utils.jones_c2r_np(J), self.rdt)
-                    flags_np = np.asarray(flags)
-                    F = len(tile.freqs)
-                    Bn = tile.x.shape[0]
-                    x8C = np.zeros((F, Bn, 8))
-                    xC = np.zeros((F, Bn, 2, 2), np.complex128)
-                    badC = np.zeros((F, Bn), bool)
-                    for ci_ch in range(F):
-                        xc = np.array(tile.x[:, ci_ch])
-                        # per-channel flags (same data the joint pack path
-                        # zeroes) + row flags
-                        bad = flags_np == 1
-                        if tile.cflags is not None:
-                            bad = bad | (tile.cflags[:, ci_ch] != 0)
-                        xc[bad] = 0.0
-                        x8C[ci_ch] = utils.vis_to_x8(xc)
-                        xC[ci_ch] = xc
-                        badC[ci_ch] = bad
-                    x8C_d = jnp.asarray(x8C, self.rdt)
-                    if cfg.whiten:
-                        from sagecal_tpu.solvers import robust as rb
-                        x8C_d = jax.vmap(
-                            lambda x: rb.whiten_data(x, u, v, meta["freq0"])
-                        )(x8C_d)
-                    # channel-flagged rows carry zero weight in THEIR
-                    # channel's solve (zeroed data must not pull the fit)
-                    wtC = wt[None] * jnp.asarray(~badC, self.rdt)[:, :, None]
-                    freqsC = jnp.asarray(tile.freqs, self.rdt)
-                    # blocks of channels: one vmapped execution per block so a
-                    # wide band cannot exceed the tunneled chip's per-execution
-                    # wall-clock kill; the last block is padded (zero weight)
-                    # to keep one compiled program
-                    CB = min(F, 16)
-                    nblk = -(-F // CB)
-                    Fp = nblk * CB
-                    if Fp != F:
-                        padc = Fp - F
-                        x8C_d = jnp.concatenate(
-                            [x8C_d, jnp.zeros((padc,) + x8C_d.shape[1:],
-                                              x8C_d.dtype)])
-                        wtC = jnp.concatenate(
-                            [wtC, jnp.zeros((padc,) + wtC.shape[1:],
-                                            wtC.dtype)])
-                        freqsC = jnp.concatenate(
-                            [freqsC, jnp.full((padc,), freqsC[-1],
-                                              freqsC.dtype)])
-                    JC_blocks, res_blocks = [], []
-                    x_rC_full = None
-                    if write_residuals:
-                        x_rC_full = jnp.asarray(utils.c2r(xC[:, :, None]),
-                                                self.rdt)
-                        if Fp != F:
-                            x_rC_full = jnp.concatenate(
-                                [x_rC_full,
-                                 jnp.zeros((Fp - F,) + x_rC_full.shape[1:],
-                                           x_rC_full.dtype)])
-                    for blk in range(nblk):
-                        sl = slice(blk * CB, (blk + 1) * CB)
-                        JC_b, _, _ = self._chan_solver(
-                            x8C_d[sl], wtC[sl], freqsC[sl], u, v, w, sta1,
-                            sta2, J0c_r8, tile_beam)
-                        JC_blocks.append(np.asarray(JC_b))
-                        if write_residuals:
-                            res_b = self._chan_residual_fn(
-                                JC_b, x_rC_full[sl], u, v, w, sta1, sta2,
-                                freqsC[sl], tile_beam)
-                            res_blocks.append(np.asarray(res_b))
-                    JC_r8 = np.concatenate(JC_blocks)[:F]
-                    if write_residuals:
-                        resC = np.concatenate(res_blocks)[:F]
-                        # [F, B, 1, 2, 2] complex -> [B, F, 2, 2]
-                        tile.x = np.moveaxis(
-                            utils.r2c(resC)[:, :, 0], 0, 1
-                        ).astype(np.complex128)
-                        bubble += aw.submit(ms.write_tile, ti, tile)
-                    J = utils.jones_r2c_np(np.asarray(JC_r8[-1]))
-                    if writer:
-                        bubble += aw.submit(writer.write_interval, J,
-                                            sky.nchunk)
-                else:
-                    if writer:
-                        bubble += aw.submit(writer.write_interval, J,
-                                            sky.nchunk)
-
-                    if write_residuals:
-                        t_res = time.perf_counter()
-                        res_r = self._residual_fn(
-                            jnp.asarray(utils.jones_c2r_np(J), self.rdt),
-                            ring.take(ti),
-                            u, v, w, sta1, sta2, tile_beam)
-                        dtrace.emit("phase", name="residual", tile=ti,
-                                    dur_s=time.perf_counter() - t_res)
-                        if depth > 0:
-                            # non-blocking d->h copy now; fetch + MS
-                            # write on the ordered writer thread
-                            sched.start_host_copy(res_r)
-                            bubble += aw.submit(
-                                self._write_residual_tile, ti, tile,
-                                res_r)
-                        else:
-                            self._write_residual_tile(ti, tile, res_r,
-                                                      bg=False)
-
-                dt = (time.time() - t0) / 60.0
-                log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
-                    f"final={res_1:.6g}, Time spent={dt:.3g} minutes, "
-                    f"nu={mean_nu:.2f}")
-                history.append({"tile": ti, "res_0": res_0, "res_1": res_1,
-                                "mean_nu": mean_nu, "minutes": dt})
-                _emit_tile_record(ti, res_0, res_1, mean_nu, info, dt,
-                                  bubble_s=bubble, overlap=depth)
+                    st.stage, max_tiles, depth):
+                st.step(ti, tile, stg, io_wait)
                 if prof_live:
                     import jax.profiler
                     jax.profiler.stop_trace()
                     prof_live = False
                     log(f"profile trace written to {prof_dir}")
-
         finally:
             try:
-                aw.close()
+                st.close()
             finally:
                 if prof_live:   # abnormal exit or 0-tile run:
                     import jax.profiler
                     jax.profiler.stop_trace()  # close the trace
-        if writer:
-            writer.close()
-        return history
+        return st.history
 
     def run_simulation(self, log=print):
         """Simulation modes -a 1/2/3 (fullbatch_mode.cpp:524-578)."""
@@ -1030,12 +906,15 @@ class FullBatchPipeline:
                 tslot=jnp.asarray(self.tslot))
             return utils.c2r(out)
 
-        # built once per pipeline and cached: a fresh jit wrapper per
-        # run_simulation call would re-trace every tile shape on every
-        # call (jaxlint retrace); cfg/sky are fixed for this instance
-        # so the cached program stays valid
-        if self._sim_jit is None:
-            self._sim_jit = jax.jit(sim_fn)
+        # keyed through the process-wide program cache (serve/cache.py)
+        # instead of the old per-instance lazy attribute: a second job
+        # in the same process used to re-trace every tile shape, and a
+        # REUSED pipeline could serve a stale ignore_mask closure — the
+        # key tokens the sim mode and the ignore mask (the content key
+        # already covers sky/shape/dtype), so neither can happen
+        self._sim_jit = self._jit_cached(
+            "sim", lambda: jax.jit(sim_fn),
+            pcache.token(ignore_mask, int(cfg.simulation)))
         sim_jit = self._sim_jit
         for ti, tile in ms.tiles():
             J_r8 = None
@@ -1051,6 +930,317 @@ class FullBatchPipeline:
             tile.x = utils.r2c(np.asarray(out_r)).astype(np.complex128)
             ms.write_tile(ti, tile)
             log(f"Timeslot: {ti} simulated (mode={int(cfg.simulation)})")
+
+
+class TileStepper:
+    """One job's resumable per-tile execution unit (sequential driver).
+
+    The serve scheduler's contract (serve/scheduler.py): ``stage(ti,
+    tile)`` may run on a background reader thread; ``step(ti, tile,
+    staged, io_wait)`` runs on the device-owner thread, strictly in
+    tile order *within this job*; ``close()`` flushes the job's
+    ordered writer and solution file. All mutable solve state (the
+    warm-start Jones chain, divergence-reset bookkeeping, the donated
+    staging ring, the per-job AsyncWriter) lives HERE, so interleaving
+    tiles from many jobs through one device changes nothing about any
+    single job's chain — per-job outputs are bit-identical to a solo
+    ``FullBatchPipeline.run`` by construction (and by gate,
+    tests/test_serve.py).
+    """
+
+    def __init__(self, pipe: "FullBatchPipeline", write_residuals=True,
+                 solution_path=None, max_tiles=None, log=print,
+                 depth: int = 0, trace_ctx=None):
+        self.p = pipe
+        self.log = log
+        self.depth = int(depth)
+        self.write_residuals = write_residuals
+        ms, sky = pipe.ms, pipe.sky
+        meta = ms.meta
+        self.n_tiles = ms.n_tiles
+        if max_tiles:
+            self.n_tiles = min(self.n_tiles, int(max_tiles))
+        self.writer = None
+        if solution_path:
+            self.writer = sol.SolutionWriter(
+                solution_path, meta["freq0"], meta["fdelta"],
+                meta["tilesz"] * meta["tdelta"] / 60.0, pipe.n,
+                sky.n_clusters, sky.n_eff_clusters)
+        self.pinit = pipe.initial_jones()
+        self.J = self.pinit.copy()
+        self.first = True
+        self.res_prev = None
+        self.history = []
+        # donated-staging ring + ordered writer thread (sched): under
+        # overlap the next tile reads + stages on a background thread
+        # while this one solves, and residual/solution writes drain on
+        # the writer thread — strictly in tile order, failures
+        # re-raised at the next tile boundary (AsyncWriter.check in
+        # step(); per-job, so one job's write failure never touches a
+        # neighbour's writer)
+        self.ring = sched.DonatedRing(self.depth + 2)
+        # trace_ctx: zero-arg diag-scope factory so the writer thread's
+        # emits route to the owning job's tracer (serve scheduler)
+        self.aw = sched.AsyncWriter(enabled=self.depth > 0,
+                                    context=trace_ctx)
+        self.stage_xr = write_residuals and not pipe.cfg.per_channel_bfgs
+
+    # -- reader-thread half -------------------------------------------------
+
+    def stage(self, ti, tile):
+        p = self.p
+        cfg, meta = p.cfg, p.ms.meta
+        t_stage = time.perf_counter()
+        pad = p.pad_rows
+        u_np, v_np, w_np = tile.u, tile.v, tile.w
+        sta1_np, sta2_np = tile.sta1, tile.sta2
+        # shared staging decision (VisTile.solve_input): native
+        # per-channel-flag packing when applicable, plain mean else;
+        # stored uv-cut rows survive either way
+        x8_np, rowflags, _good = tile.solve_input(uvtaper_m=cfg.uvtaper)
+        if pad:
+            # tile-bucket padding (serve/cache.py): geometry rows
+            # repeat real rows (finite uvw, in-range stations), data
+            # rows are zero, and the row flag 1 gives them ZERO weight
+            # — they enter no reduction, exactly like the sharded
+            # path's mesh padding
+            u_np = pcache.pad_rows_repeat(u_np, pad)
+            v_np = pcache.pad_rows_repeat(v_np, pad)
+            w_np = pcache.pad_rows_repeat(w_np, pad)
+            sta1_np = pcache.pad_rows_repeat(sta1_np, pad)
+            sta2_np = pcache.pad_rows_repeat(sta2_np, pad)
+            x8_np = pcache.pad_rows_zero(x8_np, pad)
+            rowflags = np.concatenate(
+                [rowflags, np.ones(pad, np.asarray(rowflags).dtype)])
+        u = jnp.asarray(u_np, p.rdt)
+        v = jnp.asarray(v_np, p.rdt)
+        w = jnp.asarray(w_np, p.rdt)
+        # dtype-policy storage staging (see the batched driver)
+        x8 = jnp.asarray(x8_np, p.sdt)
+        flags = rp.uvcut_flags(jnp.asarray(rowflags, jnp.int32), u, v,
+                               jnp.asarray(tile.freqs, p.rdt),
+                               cfg.uvmin, cfg.uvmax)
+        if cfg.whiten:
+            # -W: uv-density whitening of the solve input only
+            # (fullbatch_mode.cpp applies whiten_data to the averaged x)
+            from sagecal_tpu.solvers import robust as rb
+            x8 = rb.whiten_data(x8, u, v, meta["freq0"])
+        stg = dict(u=u, v=v, w=w, x8=x8, flags=flags,
+                   wt=lm_mod.make_weights(flags, p.sdt),
+                   sta1=jnp.asarray(sta1_np),
+                   sta2=jnp.asarray(sta2_np),
+                   beam=p._tile_beam(tile))
+        if self.stage_xr:
+            # residual input staged ahead; DONATED to the residual
+            # program (ring: no read-after-donate, no aliasing)
+            x_r = tile.x if not pad else pcache.pad_rows_zero(tile.x, pad)
+            self.ring.stage(ti, jnp.asarray(utils.c2r(x_r), p.sdt))
+        dtrace.emit("phase", name="stage", tile=ti,
+                    dur_s=time.perf_counter() - t_stage,
+                    bg=self.depth > 0)
+        return stg
+
+    # -- device-owner half --------------------------------------------------
+
+    def step(self, ti, tile, stg, io_wait=0.0):
+        p = self.p
+        cfg, ms, sky, meta = p.cfg, p.ms, p.sky, p.ms.meta
+        log = self.log
+        self.aw.check()  # async write failure -> fail at the boundary
+        bubble = io_wait
+        t0 = time.time()
+        u, v, w = stg["u"], stg["v"], stg["w"]
+        sta1, sta2 = stg["sta1"], stg["sta2"]
+        x8, flags, wt = stg["x8"], stg["flags"], stg["wt"]
+        tile_beam = stg["beam"]
+
+        solver = p._solve_first if self.first else p._solve_rest
+        J_r8 = jnp.asarray(utils.jones_c2r_np(self.J), p.rdt)
+        t_solve = time.perf_counter()
+        Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8,
+                             tile_beam, tile_idx=ti)
+        self.first = False
+        res_0 = float(info["res_0"])
+        res_1 = float(info["res_1"])
+        mean_nu = float(info["mean_nu"])
+        self.J = utils.jones_r2c_np(np.asarray(Jd_r8))
+        dtrace.emit("phase", name="solve", tile=ti,
+                    dur_s=time.perf_counter() - t_solve)
+
+        # divergence reset (fullbatch_mode.cpp:605-621)
+        if res_1 == 0.0 or not np.isfinite(res_1) or (
+                self.res_prev is not None
+                and res_1 > RES_RATIO * self.res_prev):
+            log(f"tile {ti}: Resetting Solution")
+            if res_1 != 0.0:   # zero = flagged data, not divergence
+                p._inflight_downgrade(log)
+            self.J = self.pinit.copy()
+            self.first = True
+            self.res_prev = res_1 if np.isfinite(res_1) else None
+        else:
+            self.res_prev = (res_1 if self.res_prev is None
+                             else min(self.res_prev, res_1))
+
+        if cfg.per_channel_bfgs:
+            bubble += self._step_per_channel(ti, tile, stg, info)
+        else:
+            if self.writer:
+                bubble += self.aw.submit(self.writer.write_interval,
+                                         self.J, sky.nchunk)
+
+            if self.write_residuals:
+                t_res = time.perf_counter()
+                res_r = p._residual_fn(
+                    jnp.asarray(utils.jones_c2r_np(self.J), p.rdt),
+                    self.ring.take(ti),
+                    u, v, w, sta1, sta2, tile_beam)
+                dtrace.emit("phase", name="residual", tile=ti,
+                            dur_s=time.perf_counter() - t_res)
+                if self.depth > 0:
+                    # non-blocking d->h copy now; fetch + MS
+                    # write on the ordered writer thread
+                    sched.start_host_copy(res_r)
+                    bubble += self.aw.submit(
+                        p._write_residual_tile, ti, tile, res_r)
+                else:
+                    p._write_residual_tile(ti, tile, res_r, bg=False)
+
+        dt = (time.time() - t0) / 60.0
+        log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
+            f"final={res_1:.6g}, Time spent={dt:.3g} minutes, "
+            f"nu={mean_nu:.2f}")
+        rec = {"tile": ti, "res_0": res_0, "res_1": res_1,
+               "mean_nu": mean_nu, "minutes": dt}
+        self.history.append(rec)
+        _emit_tile_record(ti, res_0, res_1, mean_nu, info, dt,
+                          bubble_s=bubble, overlap=self.depth)
+        return rec
+
+    def _step_per_channel(self, ti, tile, stg, info):
+        # -b 1: per-channel LBFGS re-solve + per-channel residual
+        # (fullbatch_mode.cpp:442-488). Channels are independent
+        # (each warm-starts from the same joint solution), so the
+        # whole channel axis runs as ONE vmapped solve + ONE
+        # vmapped residual program instead of a sequential loop.
+        # The last channel's solutions become the carried/written
+        # solutions (fullbatch_mode.cpp:485 memcpy).
+        p = self.p
+        cfg, ms, sky, meta = p.cfg, p.ms, p.sky, p.ms.meta
+        bubble = 0.0
+        u, v, w = stg["u"], stg["v"], stg["w"]
+        sta1, sta2 = stg["sta1"], stg["sta2"]
+        wt, flags, tile_beam = stg["wt"], stg["flags"], stg["beam"]
+        J0c_r8 = jnp.asarray(utils.jones_c2r_np(self.J), p.rdt)
+        flags_np = np.asarray(flags)
+        F = len(tile.freqs)
+        Bn = tile.x.shape[0]
+        x8C = np.zeros((F, Bn, 8))
+        xC = np.zeros((F, Bn, 2, 2), np.complex128)
+        badC = np.zeros((F, Bn), bool)
+        for ci_ch in range(F):
+            xc = np.array(tile.x[:, ci_ch])
+            # per-channel flags (same data the joint pack path
+            # zeroes) + row flags
+            bad = flags_np == 1
+            if tile.cflags is not None:
+                bad = bad | (tile.cflags[:, ci_ch] != 0)
+            xc[bad] = 0.0
+            x8C[ci_ch] = utils.vis_to_x8(xc)
+            xC[ci_ch] = xc
+            badC[ci_ch] = bad
+        x8C_d = jnp.asarray(x8C, p.rdt)
+        if cfg.whiten:
+            from sagecal_tpu.solvers import robust as rb
+            x8C_d = jax.vmap(
+                lambda x: rb.whiten_data(x, u, v, meta["freq0"])
+            )(x8C_d)
+        # channel-flagged rows carry zero weight in THEIR
+        # channel's solve (zeroed data must not pull the fit)
+        wtC = wt[None] * jnp.asarray(~badC, p.rdt)[:, :, None]
+        freqsC = jnp.asarray(tile.freqs, p.rdt)
+        # blocks of channels: one vmapped execution per block so a
+        # wide band cannot exceed the tunneled chip's per-execution
+        # wall-clock kill; the last block is padded (zero weight)
+        # to keep one compiled program
+        CB = min(F, 16)
+        nblk = -(-F // CB)
+        Fp = nblk * CB
+        if Fp != F:
+            padc = Fp - F
+            x8C_d = jnp.concatenate(
+                [x8C_d, jnp.zeros((padc,) + x8C_d.shape[1:],
+                                  x8C_d.dtype)])
+            wtC = jnp.concatenate(
+                [wtC, jnp.zeros((padc,) + wtC.shape[1:],
+                                wtC.dtype)])
+            freqsC = jnp.concatenate(
+                [freqsC, jnp.full((padc,), freqsC[-1],
+                                  freqsC.dtype)])
+        JC_blocks, res_blocks = [], []
+        x_rC_full = None
+        if self.write_residuals:
+            # PR 6 known limit made EXPLICIT: the per-channel residual
+            # assembly moves axes host-side with numpy, which has no
+            # bf16/f16 — this branch stages and ships PIPELINE-dtype
+            # bytes regardless of --dtype-policy. One-time warning +
+            # diag record of the un-melted traffic, so a service job
+            # running -b 1 under a reduced policy never reports byte
+            # savings it didn't get.
+            x_rC_full = jnp.asarray(utils.c2r(xC[:, :, None]), p.rdt)
+            if p.dtype_policy != "f32" and not getattr(
+                    p, "_warned_b1_dtype", False):
+                p._warned_b1_dtype = True
+                unmelted = int(x_rC_full.size) * (
+                    np.dtype(p.rdt).itemsize - np.dtype(p.sdt).itemsize)
+                self.log(
+                    f"dtype-policy {p.dtype_policy}: the -b 1 "
+                    "per-channel residual assembly is host-side numpy "
+                    "(no bf16/f16) and stays at the pipeline dtype — "
+                    f"~{unmelted / 1e6:.1f} MB/tile of residual "
+                    "traffic is NOT melted by the storage policy")
+                dtrace.emit("dtype_fallback", what="per_channel_residual",
+                            policy=p.dtype_policy, tile=ti,
+                            unmelted_bytes_per_tile=unmelted)
+            if Fp != F:
+                x_rC_full = jnp.concatenate(
+                    [x_rC_full,
+                     jnp.zeros((Fp - F,) + x_rC_full.shape[1:],
+                               x_rC_full.dtype)])
+        for blk in range(nblk):
+            sl = slice(blk * CB, (blk + 1) * CB)
+            JC_b, _, _ = p._chan_solver(
+                x8C_d[sl], wtC[sl], freqsC[sl], u, v, w, sta1,
+                sta2, J0c_r8, tile_beam)
+            JC_blocks.append(np.asarray(JC_b))
+            if self.write_residuals:
+                res_b = p._chan_residual_fn(
+                    JC_b, x_rC_full[sl], u, v, w, sta1, sta2,
+                    freqsC[sl], tile_beam)
+                res_blocks.append(np.asarray(res_b))
+        JC_r8 = np.concatenate(JC_blocks)[:F]
+        if self.write_residuals:
+            resC = np.concatenate(res_blocks)[:F]
+            # [F, B, 1, 2, 2] complex -> [B, F, 2, 2]
+            tile.x = np.moveaxis(
+                utils.r2c(resC)[:, :, 0], 0, 1
+            ).astype(np.complex128)
+            bubble += self.aw.submit(ms.write_tile, ti, tile)
+        self.J = utils.jones_r2c_np(np.asarray(JC_r8[-1]))
+        if self.writer:
+            bubble += self.aw.submit(self.writer.write_interval,
+                                     self.J, sky.nchunk)
+        return bubble
+
+    def close(self, raise_pending: bool = True):
+        """Flush + close the job's writer thread and solution file.
+        Re-raises a pending async-write failure (unless told not to —
+        the scheduler's failed-job teardown path, where the failure
+        was already recorded and a second raise would mask cleanup)."""
+        try:
+            self.aw.close(raise_pending=raise_pending)
+        finally:
+            if self.writer:
+                self.writer.close()
 
 
 def run(cfg: RunConfig, log=print):

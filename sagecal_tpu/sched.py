@@ -67,14 +67,31 @@ class Prefetcher:
     (``close()``/GC) cancels the thread.
     """
 
-    def __init__(self, fn, n: int, depth: int = 1, name: str = "read"):
+    #: poll() sentinels (serve scheduler protocol)
+    EMPTY = object()    # production still in flight — try again later
+    DONE = object()     # all n items consumed
+
+    def __init__(self, fn, n: int, depth: int = 1, name: str = "read",
+                 context=None, ready_event=None):
         self.fn = fn
         self.n = int(n)
         self.depth = int(depth)
         self.name = name
+        # zero-arg context-manager factory entered for the producer
+        # thread's lifetime (serve: routes the thread's diag emits to
+        # the owning job's tracer via dtrace.scope)
+        self._ctx = context
+        # optional shared Event set after every successful production:
+        # a poll()-driven consumer (the serve device-owner loop) waits
+        # on it instead of sleeping a fixed quantum, so a staged tile
+        # wakes the device immediately — the poll-path equivalent of
+        # the iterator's blocking get()
+        self._ready = ready_event
         self._cancel = threading.Event()
         self._q: queue.Queue = queue.Queue(maxsize=max(self.depth, 1))
         self._thread = None
+        self._poll_next = 0       # inline (depth<=0) poll cursor
+        self._poll_done = False
         if self.depth > 0:
             self._thread = threading.Thread(
                 target=self._producer, name=f"prefetch-{name}",
@@ -87,12 +104,20 @@ class Prefetcher:
         while not self._cancel.is_set():
             try:
                 self._q.put(item, timeout=0.2)
+                if self._ready is not None:
+                    self._ready.set()
                 return True
             except queue.Full:
                 continue
         return False
 
     def _producer(self):
+        if self._ctx is not None:
+            with self._ctx():
+                return self._produce_loop()
+        return self._produce_loop()
+
+    def _produce_loop(self):
         try:
             for i in range(self.n):
                 if self._cancel.is_set():
@@ -132,6 +157,38 @@ class Prefetcher:
         finally:
             self.close()
 
+    def poll(self):
+        """Non-blocking consumption for the serve scheduler's
+        device-owner loop: returns ``(i, item, wait_s)`` when the next
+        item is ready, :attr:`EMPTY` while production is still in
+        flight (the scheduler moves on to another job's ready tile
+        instead of blocking the device here), or :attr:`DONE` after
+        item ``n - 1``. Producer exceptions re-raise at the poll that
+        would have returned their item. ``depth <= 0`` produces inline
+        (always "ready"; ``wait_s`` is then the production time).
+        Items arrive strictly in index order, same as iteration — a
+        consumer uses EITHER the iterator OR poll(), never both."""
+        if self._poll_done:
+            return self.DONE
+        if self.depth <= 0:
+            if self._poll_next >= self.n:
+                self._poll_done = True
+                return self.DONE
+            i = self._poll_next
+            self._poll_next += 1
+            t0 = time.perf_counter()
+            return i, self.fn(i), time.perf_counter() - t0
+        try:
+            i, item = self._q.get_nowait()
+        except queue.Empty:
+            return self.EMPTY
+        if i is None:
+            self._poll_done = True
+            if item is not None:
+                raise item
+            return self.DONE
+        return i, item, 0.0
+
     def close(self):
         self._cancel.set()
         while True:                     # unblock a full queue
@@ -159,8 +216,12 @@ class AsyncWriter:
 
     _STOP = object()
 
-    def __init__(self, enabled: bool = True, maxsize: int = 4):
+    def __init__(self, enabled: bool = True, maxsize: int = 4,
+                 context=None):
         self.enabled = bool(enabled)
+        # zero-arg context-manager factory entered for the writer
+        # thread's lifetime (serve: per-job diag scope, as Prefetcher)
+        self._ctx = context
         self._exc = None
         self._raised = False
         self._q: queue.Queue = queue.Queue(maxsize=max(maxsize, 1))
@@ -171,6 +232,12 @@ class AsyncWriter:
             self._thread.start()
 
     def _worker(self):
+        if self._ctx is not None:
+            with self._ctx():
+                return self._work_loop()
+        return self._work_loop()
+
+    def _work_loop(self):
         while True:
             job = self._q.get()
             try:

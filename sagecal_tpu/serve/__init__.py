@@ -1,0 +1,27 @@
+"""Calibration-as-a-service: a persistent multi-tenant job server.
+
+The batch pipeline solves one MS per process and throws every compiled
+program away at exit. This package keeps the device busy across *jobs*:
+
+- :mod:`sagecal_tpu.serve.cache` — the process-wide compile cache keyed
+  by shape-bucket + solver flags, so concurrent jobs with
+  bucket-compatible shapes share warm-compiled programs (hits are
+  assertable via the ``diag.guard`` compile counter);
+- :mod:`sagecal_tpu.serve.queue` — job registry + FIFO-with-priorities
+  queue with admission control (bounded in-flight jobs and bounded
+  staged bytes) and fail-stop per-job isolation;
+- :mod:`sagecal_tpu.serve.scheduler` — the one device-owner loop that
+  interleaves ready tiles from many jobs through per-job
+  ``sched.Prefetcher`` instances and one ordered ``sched.AsyncWriter``
+  per job, preserving each job's sequential warm-start/PRNG chain
+  (per-job outputs are bit-identical to a solo CLI run);
+- :mod:`sagecal_tpu.serve.api` — a zero-dependency JSON-lines protocol
+  over a local socket (submit/status/cancel/drain/metrics) with
+  graceful drain on SIGTERM.
+
+Run it: ``python -m sagecal_tpu.serve --socket /tmp/sagecal.sock``.
+See MIGRATION.md "Service mode" for the protocol and the per-job
+bit-identity / bucketing contracts.
+"""
+
+from sagecal_tpu.serve import cache  # noqa: F401

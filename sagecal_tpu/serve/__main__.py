@@ -1,0 +1,76 @@
+"""``python -m sagecal_tpu.serve``: the calibration job server.
+
+Example::
+
+    python -m sagecal_tpu.serve --socket /tmp/sagecal.sock &
+    printf '%s\\n' '{"op": "submit", "config": {"ms": "sim.ms", \
+"sky_model": "sky.txt", "cluster_file": "sky.txt.cluster"}}' \
+        | nc -U /tmp/sagecal.sock
+
+SIGTERM drains gracefully: in-flight tiles finish, writers flush, new
+submissions are refused, then the process exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m sagecal_tpu.serve",
+        description="persistent multi-tenant calibration job server "
+                    "(JSON-lines over a local socket)")
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--socket", metavar="PATH",
+                   help="unix socket path to listen on")
+    g.add_argument("--port", type=int,
+                   help="TCP port on 127.0.0.1 (0 = ephemeral)")
+    p.add_argument("--max-inflight", type=int, default=2,
+                   help="concurrently RUNNING jobs (admission control; "
+                        "queued jobs wait)")
+    p.add_argument("--max-staged-bytes", type=int, default=2 << 30,
+                   help="staged-tile byte budget across running jobs "
+                        "(each job stages ~(prefetch+3) tiles)")
+    p.add_argument("--diag", default=None, metavar="PATH",
+                   help="server-level JSONL trace (per-job traces come "
+                        "from each submit's 'trace' field)")
+    p.add_argument("--platform", default=None,
+                   help="force the jax platform (e.g. 'cpu')")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    if args.diag:
+        from sagecal_tpu.diag import trace as dtrace
+        dtrace.enable(args.diag, entry="sagecal-serve",
+                      argv=list(argv) if argv is not None
+                      else sys.argv[1:])
+    from sagecal_tpu.serve.api import Server
+    srv = Server(socket_path=args.socket, port=args.port,
+                 max_inflight=args.max_inflight,
+                 max_staged_bytes=args.max_staged_bytes)
+    # graceful drain on SIGTERM/SIGINT: finish in-flight tiles, flush
+    # writers, refuse new submissions, exit when idle
+    signal.signal(signal.SIGTERM, lambda *a: srv.drain())
+    signal.signal(signal.SIGINT, lambda *a: srv.drain())
+    srv.start()
+    where = args.socket or f"127.0.0.1:{srv.port}"
+    print(f"sagecal-serve: listening on {where} "
+          f"(max_inflight={args.max_inflight})", flush=True)
+    try:
+        srv.serve_forever()
+    finally:
+        if args.diag:
+            dtrace.disable()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,305 @@
+"""Zero-dependency JSON-lines protocol over a local socket.
+
+One request object per line, one response object per line (stdlib
+``socket`` + ``json`` only — a casacore-less cluster node can drive
+the server with ``nc``). Requests:
+
+===========  ==============================================================
+op           request fields / reply
+===========  ==============================================================
+``submit``   ``config``: RunConfig field dict (CLI-long names, e.g.
+             ``{"ms": ..., "sky_model": ..., "cluster_file": ...}``);
+             optional ``priority`` (int, higher first), ``trace``
+             (per-job --diag JSONL path), ``job_id``. Reply
+             ``{"ok": true, "job_id": ...}``. Refused while draining.
+``status``   optional ``job_id``; reply one snapshot or all of them
+``cancel``   ``job_id``; queued cancels now, running at its next tile
+             boundary (reply carries the state observed)
+``metrics``  queue depths, compile-cache hits/misses/hit_rate,
+             device-busy fraction, tiles/jobs done
+``drain``    refuse new submissions, finish accepted jobs, then exit;
+             ``wait: true`` blocks the reply until drained
+``ping``     liveness
+===========  ==============================================================
+
+SIGTERM == ``drain``: in-flight tiles finish, writers flush, new
+submissions are refused, the process exits when idle (MIGRATION.md
+"Service mode"). Bad requests get ``{"ok": false, "error": ...}`` on
+their own line; the connection stays up.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import socketserver
+import threading
+import uuid
+
+from sagecal_tpu.config import (BeamMode, RunConfig, SimulationMode,
+                                SolverMode)
+from sagecal_tpu.serve import queue as jq
+from sagecal_tpu.serve.scheduler import Scheduler
+
+_ENUMS = {"solver_mode": SolverMode, "simulation": SimulationMode,
+          "beam_mode": BeamMode}
+_FIELDS = {f.name for f in dataclasses.fields(RunConfig)} - {"precision"}
+
+
+def config_from_dict(d: dict) -> RunConfig:
+    """RunConfig from a request's ``config`` dict; unknown keys are an
+    error (a typo'd flag silently calibrating with defaults is exactly
+    the failure mode a service must refuse)."""
+    bad = set(d) - _FIELDS
+    if bad:
+        raise ValueError(f"unknown config fields: {sorted(bad)}")
+    kw = dict(d)
+    for k, enum in _ENUMS.items():
+        if k in kw:
+            kw[k] = enum(int(kw[k]))
+    if "spatialreg" in kw and kw["spatialreg"] is not None:
+        kw["spatialreg"] = tuple(kw["spatialreg"])
+    return RunConfig(**kw)
+
+
+def job_kind(cfg: RunConfig) -> str:
+    """Same dispatch as cli.main: stochastic if -N>0, simulation for
+    -a modes, fullbatch (tile-interleaved) otherwise."""
+    if cfg.n_epochs > 0:
+        return "stochastic"
+    if cfg.simulation != SimulationMode.OFF:
+        return "sim"
+    return "fullbatch"
+
+
+class Server:
+    """Queue + scheduler + socket listener, one process, one device."""
+
+    def __init__(self, socket_path: str | None = None,
+                 port: int | None = None, max_inflight: int = 2,
+                 max_staged_bytes: int = 2 << 30, log=print):
+        if (socket_path is None) == (port is None):
+            raise ValueError("exactly one of socket_path/port")
+        self.socket_path = socket_path
+        self.port = port
+        self.log = log
+        self.queue = jq.JobQueue(max_inflight=max_inflight,
+                                 max_staged_bytes=max_staged_bytes)
+        self.scheduler = Scheduler(self.queue, log=log)
+        self._drained = threading.Event()
+        self._sched_thread = threading.Thread(
+            target=self._run_scheduler, name="device-owner", daemon=True)
+        self._srv = None
+
+    # -- scheduler thread ---------------------------------------------------
+
+    def _run_scheduler(self):
+        try:
+            self.scheduler.run()
+        finally:
+            self._drained.set()
+
+    # -- request handling ---------------------------------------------------
+
+    def handle_request(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "pong": True}
+        if op == "submit":
+            if req.get("mpi_argv") is not None:
+                # the cli_mpi consensus interval loop as a submittable
+                # job: the raw argv, run as one opaque isolated unit.
+                # Flags that mutate PROCESS-global state are refused:
+                # --platform/--cpu-devices would re-point every
+                # tenant's device, and --diag installs (then closes)
+                # the process tracer, killing server-level tracing —
+                # per-job tracing is the submit 'trace' field.
+                argv = [str(a) for a in req["mpi_argv"]]
+                banned = {"--platform", "--cpu-devices", "--diag"}
+                bad = sorted(banned & {a.split("=", 1)[0] for a in argv})
+                if bad:
+                    raise ValueError(
+                        f"mpi_argv flags {bad} mutate process-global "
+                        "state inside a multi-tenant server; per-job "
+                        "tracing uses the submit 'trace' field")
+                job = jq.Job(req.get("job_id") or uuid.uuid4().hex[:12],
+                             cfg=None,
+                             priority=int(req.get("priority", 0)),
+                             trace_path=req.get("trace"), kind="mpi",
+                             argv=argv)
+                self.queue.submit(job)
+                self.log(f"[{job.job_id}] queued (mpi)")
+                return {"ok": True, "job_id": job.job_id}
+            cfg = config_from_dict(req.get("config") or {})
+            if (not cfg.ms and not cfg.ms_list) \
+                    or not cfg.sky_model or not cfg.cluster_file:
+                raise ValueError("config needs ms (or ms_list), "
+                                 "sky_model and cluster_file")
+            job = jq.Job(req.get("job_id") or uuid.uuid4().hex[:12],
+                         cfg, priority=int(req.get("priority", 0)),
+                         trace_path=req.get("trace"),
+                         kind=job_kind(cfg))
+            self.queue.submit(job)
+            self.log(f"[{job.job_id}] queued ({job.kind}, "
+                     f"priority {job.priority})")
+            return {"ok": True, "job_id": job.job_id}
+        if op == "status":
+            jid = req.get("job_id")
+            if jid:
+                return {"ok": True, "job": self.queue.get(jid).snapshot()}
+            return {"ok": True,
+                    "jobs": [j.snapshot() for j in self.queue.jobs()]}
+        if op == "cancel":
+            state = self.queue.cancel(req["job_id"])
+            return {"ok": True, "state": state}
+        if op == "metrics":
+            return {"ok": True, "metrics": self.scheduler.metrics()}
+        if op == "drain":
+            self.drain()
+            if req.get("wait"):
+                self._drained.wait()
+            return {"ok": True, "draining": True}
+        raise ValueError(f"unknown op {op!r}")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def drain(self) -> None:
+        """Graceful: refuse submissions, let accepted jobs finish; the
+        scheduler loop (and serve_forever) exits once idle."""
+        if not self.queue.draining:
+            self.log("drain: refusing new submissions, finishing "
+                     "in-flight jobs")
+        self.queue.start_drain()
+
+    def start(self) -> None:
+        server = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        resp = server.handle_request(json.loads(line))
+                    except Exception as e:
+                        resp = {"ok": False,
+                                "error": f"{type(e).__name__}: {e}"}
+                    self.wfile.write(
+                        (json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+
+        if self.socket_path:
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)
+
+            class Srv(socketserver.ThreadingUnixStreamServer):
+                daemon_threads = True
+                allow_reuse_address = True
+            self._srv = Srv(self.socket_path, Handler)
+        else:
+            class Srv(socketserver.ThreadingTCPServer):
+                daemon_threads = True
+                allow_reuse_address = True
+            self._srv = Srv(("127.0.0.1", self.port), Handler)
+            self.port = self._srv.server_address[1]
+        self._accept_thread = threading.Thread(
+            target=self._srv.serve_forever,
+            kwargs={"poll_interval": 0.1}, name="accept", daemon=True)
+        self._accept_thread.start()
+        self._sched_thread.start()
+
+    def serve_forever(self) -> None:
+        """Block until drained (SIGTERM or the drain op)."""
+        try:
+            self._drained.wait()
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+        if self.socket_path and os.path.exists(self.socket_path):
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        """Hard stop (tests): cancel running jobs, exit now."""
+        self.queue.start_drain()
+        self.scheduler.stop()
+        self._drained.wait(timeout=30.0)
+        self.close()
+
+
+class Client:
+    """Line-oriented client for the protocol above (tests, bench,
+    embedders). One socket, requests answered in order."""
+
+    def __init__(self, socket_path: str | None = None,
+                 port: int | None = None, timeout: float = 600.0):
+        if socket_path:
+            self._sock = socket.socket(socket.AF_UNIX)
+            self._sock.connect(socket_path)
+        else:
+            self._sock = socket.create_connection(("127.0.0.1", port))
+        self._sock.settimeout(timeout)
+        self._f = self._sock.makefile("rwb")
+
+    def request(self, **req) -> dict:
+        self._f.write((json.dumps(req) + "\n").encode())
+        self._f.flush()
+        line = self._f.readline()
+        if not line:
+            raise ConnectionError("server closed the connection")
+        resp = json.loads(line)
+        if not resp.get("ok"):
+            raise RuntimeError(resp.get("error", "request failed"))
+        return resp
+
+    def submit(self, config: dict, **kw) -> str:
+        return self.request(op="submit", config=config, **kw)["job_id"]
+
+    def status(self, job_id: str | None = None):
+        r = self.request(op="status",
+                         **({"job_id": job_id} if job_id else {}))
+        return r["job"] if job_id else r["jobs"]
+
+    def cancel(self, job_id: str) -> str:
+        return self.request(op="cancel", job_id=job_id)["state"]
+
+    def metrics(self) -> dict:
+        return self.request(op="metrics")["metrics"]
+
+    def drain(self, wait: bool = False) -> None:
+        self.request(op="drain", wait=wait)
+
+    def wait(self, job_id: str, timeout_s: float = 600.0,
+             poll_s: float = 0.05) -> dict:
+        """Block until the job reaches a terminal state."""
+        import time
+        t0 = time.time()
+        while True:
+            snap = self.status(job_id)
+            if snap["state"] in jq.TERMINAL:
+                return snap
+            if time.time() - t0 > timeout_s:
+                raise TimeoutError(
+                    f"job {job_id} still {snap['state']} "
+                    f"after {timeout_s}s")
+            time.sleep(poll_s)
+
+    def close(self) -> None:
+        self._f.close()
+        self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()

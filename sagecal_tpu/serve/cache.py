@@ -1,0 +1,207 @@
+"""Explicit compile cache keyed by shape-bucket + solver flags.
+
+Why it exists: every ``FullBatchPipeline`` used to build its own
+``jax.jit`` wrappers (coherency program, residual program, simulation
+program, per-channel solver). ``jax.jit`` caches compiled executables
+*per wrapper object*, so a second job in the same process — even with
+identical shapes, flags, and sky — re-traced and re-compiled everything
+(the jaxlint retrace class, at job granularity). The service promotes
+those wrappers into ONE process-wide :class:`ProgramCache` keyed by an
+explicit content key, so bucket-compatible jobs share warm programs.
+Hits and misses are counted here AND assertable from outside via the
+``diag/guard.py`` compile counter: a cache hit builds no new wrapper,
+so a second bucket-compatible job must add ZERO compile requests
+(tests/test_serve.py gates exactly that).
+
+Key discipline: a cached callable may close over device constants (the
+sky, chunk indices, beam tables, dtype policy). The key must therefore
+token EVERY closure-captured input — :func:`token` digests nested
+numpy/jax arrays by content, dataclasses/NamedTuples by field, scalars
+by value — so equal keys imply equivalent closures and sharing the
+first job's wrapper is semantics-preserving, never a stale-closure
+reuse. An input that cannot be tokened raises instead of silently
+keying by identity.
+
+Shape bucketing: jobs whose shapes differ only in ``tilesz`` can share
+programs by padding each staged interval up to a common bucket
+(``RunConfig.tile_bucket``). Padding appends whole timeslot blocks of
+ZERO-WEIGHT rows, which is tolerance-free by the same argument as the
+PR 6 ordered-subsets slicing: a zero-weight row contributes exactly
+nothing to any weighted reduction, and the padded residual rows are
+sliced off before write-back. Geometry rows repeat real rows (finite
+uvw, in-range station indices); data/weight rows are zero.
+
+Layering: numpy + stdlib only — the cache stores jax callables
+opaquely and never imports jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+# -- content tokens ---------------------------------------------------------
+
+
+def _update(h, obj) -> None:
+    """Feed ``obj`` into digest ``h``; raises TypeError on inputs whose
+    content cannot be captured (silently keying those by id() would
+    reintroduce the stale-closure bug this module exists to prevent)."""
+    if obj is None or isinstance(obj, (bool, int, float, complex, str,
+                                       bytes)):
+        h.update(f"{type(obj).__name__}:{obj!r};".encode())
+        return
+    if isinstance(obj, dict):
+        h.update(b"dict{")
+        for k in sorted(obj, key=repr):
+            _update(h, k)
+            _update(h, obj[k])
+        h.update(b"}")
+        return
+    if isinstance(obj, (list, tuple)):
+        h.update(f"seq{len(obj)}(".encode())
+        # NamedTuples keep their class name in the token: two different
+        # record types with equal fields must not collide
+        h.update(type(obj).__name__.encode())
+        for v in obj:
+            _update(h, v)
+        h.update(b")")
+        return
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        h.update(f"dc:{type(obj).__name__}(".encode())
+        for f in dataclasses.fields(obj):
+            _update(h, f.name)
+            _update(h, getattr(obj, f.name))
+        h.update(b")")
+        return
+    # numpy arrays, jax arrays, ml_dtypes scalars: everything that can
+    # materialize as an ndarray is digested by dtype + shape + bytes
+    try:
+        a = np.asarray(obj)
+    except Exception:
+        a = None
+    if a is not None and a.dtype != object:
+        h.update(f"arr:{a.dtype.str}:{a.shape};".encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+        return
+    # enums and friends: value + class name
+    val = getattr(obj, "value", None)
+    if val is not None and isinstance(val, (int, float, str)):
+        h.update(f"enum:{type(obj).__name__}:{val!r};".encode())
+        return
+    raise TypeError(
+        f"cache.token: cannot content-token {type(obj).__name__!r} — "
+        "a program key built from it would alias distinct closures")
+
+
+def token(*parts) -> str:
+    """Stable content digest of nested parts (hex, 16 bytes)."""
+    h = hashlib.sha256()
+    for p in parts:
+        _update(h, p)
+    return h.hexdigest()[:32]
+
+
+# -- the process-wide program cache -----------------------------------------
+
+
+class ProgramCache:
+    """LRU mapping explicit content keys -> built (jitted) callables.
+
+    ``get(key, build)`` returns the cached value or calls ``build()``
+    under the lock (pipelines are constructed on one thread; a slow
+    trace inside ``build`` must not let a racing second builder compile
+    the same program twice). Eviction drops only the cache's reference;
+    live pipelines keep theirs.
+    """
+
+    def __init__(self, maxsize: int = 64):
+        self.maxsize = int(maxsize)
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key, build):
+        with self._lock:
+            if key in self._d:
+                self.hits += 1
+                self._d.move_to_end(key)
+                return self._d[key]
+            self.misses += 1
+            val = build()
+            self._d[key] = val
+            while len(self._d) > self.maxsize:
+                self._d.popitem(last=False)
+            return val
+
+    def stats(self) -> dict:
+        with self._lock:
+            n = self.hits + self.misses
+            return {"entries": len(self._d), "hits": self.hits,
+                    "misses": self.misses,
+                    "hit_rate": (self.hits / n) if n else 0.0}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+            self.hits = 0
+            self.misses = 0
+
+
+#: the process singleton every pipeline keys its programs through
+PROGRAMS = ProgramCache()
+
+
+# -- shape buckets ----------------------------------------------------------
+
+#: default tilesz bucket ladder: next power of two. Coarser than a
+#: per-shape key (more sharing) while bounding padded waste at <2x.
+def bucket_tilesz(tilesz: int) -> int:
+    b = 1
+    while b < int(tilesz):
+        b *= 2
+    return b
+
+
+def resolve_bucket(tilesz: int, tile_bucket: int) -> int:
+    """Effective solve-interval size: ``tile_bucket`` 0 disables
+    bucketing (exact shapes), -1 takes the ladder, an explicit value
+    must be >= tilesz (a bucket below the tile size would TRUNCATE
+    data, never acceptable)."""
+    tb = int(tile_bucket)
+    if tb == 0:
+        return int(tilesz)
+    if tb < 0:
+        return bucket_tilesz(tilesz)
+    if tb < int(tilesz):
+        raise ValueError(
+            f"tile_bucket {tb} < tilesz {tilesz}: bucketing pads up, "
+            "never truncates")
+    return tb
+
+
+def pad_rows_repeat(a: np.ndarray, n_rows: int) -> np.ndarray:
+    """Append ``n_rows`` rows cycled from the front of ``a`` (geometry:
+    finite uvw / in-range station indices; values are irrelevant under
+    zero weight but must stay well-defined)."""
+    if n_rows <= 0:
+        return a
+    a = np.asarray(a)
+    reps = -(-n_rows // a.shape[0])
+    return np.concatenate([a, np.tile(a, (reps,) + (1,) * (a.ndim - 1))
+                           [:n_rows]], axis=0)
+
+
+def pad_rows_zero(a: np.ndarray, n_rows: int) -> np.ndarray:
+    """Append ``n_rows`` zero rows (data / weights / flags-as-flagged
+    are handled by the caller: padded rows must carry zero WEIGHT)."""
+    if n_rows <= 0:
+        return a
+    a = np.asarray(a)
+    return np.concatenate(
+        [a, np.zeros((n_rows,) + a.shape[1:], a.dtype)], axis=0)

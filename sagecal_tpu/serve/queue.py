@@ -1,0 +1,210 @@
+"""Job registry + FIFO-with-priorities queue with admission control.
+
+A *job* is one calibration request: one dataset + one RunConfig (the
+same pair a solo CLI invocation would get), plus service metadata —
+priority, output paths, per-job diag trace. The queue owns the job
+state machine::
+
+    queued -> running -> done
+          \\          \\-> failed      (fail-stop: THIS job only)
+           \\-> cancelled   (or running -> cancelled at a tile boundary)
+
+Admission control bounds what the device-owner loop may hold live at
+once, derived from the overlap machinery's memory model (MIGRATION.md
+"Overlapped execution"): each running fullbatch job stages up to
+``prefetch + 2`` tiles (its Prefetcher depth plus the DonatedRing
+slots), so the queue refuses to *start* — never to *accept* — a job
+whose staged-bytes estimate would push the running total over budget,
+and caps concurrently running jobs outright. One job is always
+admissible, however large: a request bigger than the budget must run
+solo, not starve forever.
+
+Fail-stop isolation: a job that raises (an MS-write failure surfacing
+at its next tile boundary, PR 5 writer semantics) moves to ``failed``
+with the original traceback recorded; its neighbours never see it.
+
+Layering: stdlib only. The scheduler drives the transitions; the API
+layer only reads snapshots and submits/cancels.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import traceback
+
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+CANCELLED = "cancelled"
+
+#: states a job can never leave
+TERMINAL = (DONE, FAILED, CANCELLED)
+
+
+class Job:
+    """One submitted calibration request + its service lifecycle."""
+
+    def __init__(self, job_id: str, cfg, priority: int = 0,
+                 trace_path: str | None = None, kind: str = "fullbatch",
+                 argv: list | None = None):
+        self.job_id = job_id
+        self.cfg = cfg
+        self.priority = int(priority)
+        self.kind = kind            # fullbatch | stochastic | sim | mpi
+        self.argv = argv            # mpi jobs: the raw cli_mpi argv
+        self.trace_path = trace_path
+        self.state = QUEUED
+        self.error: str | None = None
+        self.error_tb: str | None = None
+        self.cancel_requested = False
+        self.submitted_t = time.time()
+        self.started_t: float | None = None
+        self.finished_t: float | None = None
+        self.tiles_done = 0
+        self.n_tiles: int | None = None
+        self.staged_bytes = 0             # live estimate while running
+        self.est_bytes: int | None = None  # admission price, cached
+        #   (the estimate opens the dataset header — once per job,
+        #   never per scheduler-loop iteration)
+        self.history: list = []           # per-tile convergence records
+
+    def snapshot(self) -> dict:
+        """JSON-serializable status row (the api `status` reply)."""
+        return {
+            "job_id": self.job_id, "state": self.state,
+            "kind": self.kind, "priority": self.priority,
+            "ms": getattr(self.cfg, "ms", None),
+            "tiles_done": self.tiles_done, "n_tiles": self.n_tiles,
+            "submitted_t": self.submitted_t,
+            "started_t": self.started_t, "finished_t": self.finished_t,
+            "error": self.error,
+            # the ORIGINAL traceback (fail-stop contract): a client
+            # debugging a failed tenant job gets the failing frames,
+            # not just the exception type
+            "error_tb": self.error_tb,
+        }
+
+
+class JobQueue:
+    """Registry + priority-FIFO + admission control (thread-safe)."""
+
+    def __init__(self, max_inflight: int = 2,
+                 max_staged_bytes: int = 2 << 30):
+        self.max_inflight = max(1, int(max_inflight))
+        self.max_staged_bytes = int(max_staged_bytes)
+        self._jobs: dict[str, Job] = {}
+        self._order = itertools.count()   # FIFO tiebreak within priority
+        self._seq: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._draining = False
+
+    # -- submission / lookup ------------------------------------------------
+
+    def submit(self, job: Job) -> Job:
+        with self._lock:
+            if self._draining:
+                raise RuntimeError("server is draining; submission refused")
+            if job.job_id in self._jobs:
+                raise ValueError(f"duplicate job id {job.job_id!r}")
+            self._jobs[job.job_id] = job
+            self._seq[job.job_id] = next(self._order)
+            return job
+
+    def get(self, job_id: str) -> Job:
+        with self._lock:
+            return self._jobs[job_id]
+
+    def jobs(self) -> list:
+        with self._lock:
+            return list(self._jobs.values())
+
+    def counts(self) -> dict:
+        with self._lock:
+            out: dict = {s: 0 for s in
+                         (QUEUED, RUNNING, DONE, FAILED, CANCELLED)}
+            for j in self._jobs.values():
+                out[j.state] += 1
+            out["staged_bytes"] = sum(
+                j.staged_bytes for j in self._jobs.values()
+                if j.state == RUNNING)
+            return out
+
+    # -- drain / cancel -----------------------------------------------------
+
+    def start_drain(self) -> None:
+        """Refuse new submissions; queued jobs still run to completion
+        (graceful drain finishes accepted work; SIGTERM path)."""
+        with self._lock:
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not any(j.state in (QUEUED, RUNNING)
+                           for j in self._jobs.values())
+
+    def cancel(self, job_id: str) -> str:
+        """Queued jobs cancel immediately; running jobs get the
+        cooperative flag (the scheduler honours it at the next tile
+        boundary — in-flight writes for completed tiles still land).
+        Returns the state observed at the call."""
+        with self._lock:
+            job = self._jobs[job_id]
+            if job.state == QUEUED:
+                job.state = CANCELLED
+                job.finished_t = time.time()
+            elif job.state == RUNNING:
+                job.cancel_requested = True
+            return job.state
+
+    # -- admission (scheduler side) -----------------------------------------
+
+    def next_admissible(self, est_bytes_fn) -> Job | None:
+        """Highest-priority queued job that fits the running budget
+        (FIFO within a priority level), or None. ``est_bytes_fn(job)``
+        prices the job's staged working set once (cached on the job);
+        the estimate is recorded in ``staged_bytes`` so the budget
+        accounting survives until the job finishes. A lone job always
+        admits (no starvation by size), and admission is strict
+        head-of-line: a budget-blocked job BLOCKS everything behind it
+        rather than letting a stream of smaller lower-priority jobs
+        backfill past it forever — its reservation is honoured as
+        soon as enough running jobs finish."""
+        with self._lock:
+            running = [j for j in self._jobs.values()
+                       if j.state == RUNNING]
+            if len(running) >= self.max_inflight:
+                return None
+            queued = [j for j in self._jobs.values() if j.state == QUEUED]
+            queued.sort(key=lambda j: (-j.priority, self._seq[j.job_id]))
+            used = sum(j.staged_bytes for j in running)
+            for job in queued:
+                if job.est_bytes is None:
+                    job.est_bytes = int(est_bytes_fn(job))
+                if running and used + job.est_bytes > self.max_staged_bytes:
+                    return None
+                job.staged_bytes = job.est_bytes
+                job.state = RUNNING
+                job.started_t = time.time()
+                return job
+            return None
+
+    # -- terminal transitions (scheduler side) ------------------------------
+
+    def finish(self, job: Job, state: str,
+               exc: BaseException | None = None) -> None:
+        assert state in TERMINAL, state
+        with self._lock:
+            job.state = state
+            job.finished_t = time.time()
+            job.staged_bytes = 0
+            if exc is not None:
+                job.error = f"{type(exc).__name__}: {exc}"
+                job.error_tb = "".join(traceback.format_exception(
+                    type(exc), exc, exc.__traceback__))

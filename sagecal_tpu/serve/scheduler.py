@@ -1,0 +1,347 @@
+"""The device-owner loop: many jobs' tiles through ONE device.
+
+Exactly one thread (the one inside :meth:`Scheduler.run`) dispatches
+device programs. Per job it owns a :class:`pipeline.TileStepper`
+(solve state), a per-job ``sched.Prefetcher`` (read + host-stage on
+the job's reader thread) and the stepper's per-job ordered
+``sched.AsyncWriter`` (MS residual tiles + solution rows). The loop
+round-robins over running jobs and steps whichever has a staged tile
+READY (``Prefetcher.poll``), so one job's slow IO never parks the
+device while another job has work.
+
+Bit-identity argument: a job's tiles are staged and stepped strictly
+in its own tile order; its warm-start Jones chain, divergence resets,
+and the ``fold_in(199, tile_idx)`` PRNG stream live inside its
+stepper and never observe the interleaving. Program *compilations*
+are shared through ``serve.cache`` — sharing a compiled program
+changes which bytes were compiled when, never what a call computes.
+Gated end-to-end by tests/test_serve.py (solutions AND written
+residuals vs solo runs, plus the zero-new-compiles assert).
+
+Failure model (fail-stop, per job): any exception out of a job's
+stage/step/write path — including an async MS-write failure
+re-raised at the job's next tile boundary (PR 5 semantics) — moves
+THAT job to ``failed`` with the original traceback recorded, tears
+down its threads, and the loop keeps serving its neighbours. No
+later write of a failed job executes (AsyncWriter fail-stop).
+
+Stochastic / simulation jobs reuse their existing whole-run drivers
+as one OPAQUE unit: correct and isolated, but not tile-interleaved
+(documented in MIGRATION.md "Service mode").
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from sagecal_tpu import sched
+from sagecal_tpu.diag import trace as dtrace
+from sagecal_tpu.serve import cache as pcache
+from sagecal_tpu.serve import queue as jq
+
+
+class _RunningJob:
+    """Scheduler-side live state of one running fullbatch job."""
+
+    def __init__(self, job, pipe, stepper, prefetcher, tracer):
+        self.job = job
+        self.pipe = pipe
+        self.stepper = stepper
+        self.pf = prefetcher
+        self.tracer = tracer
+
+    def teardown(self, raise_pending: bool = False):
+        self.pf.close()
+        try:
+            self.stepper.close(raise_pending=raise_pending)
+        finally:
+            if self.tracer is not None:
+                self.tracer.close()
+
+
+def estimate_staged_bytes(job) -> int:
+    """Admission-control price of a job's staged working set: the
+    overlap machinery holds up to ``prefetch + 2`` (ring) + 1
+    (in-flight) tiles, each carrying the solve input [B, 8], the
+    staged residual rows [B, F, 8] and uvw [B, 3]. Meta comes from the
+    dataset header only (cheap); an unreadable dataset prices at 0 and
+    fails properly at job start instead of blocking admission."""
+    try:
+        from sagecal_tpu.io import dataset as ds
+        cfg = job.cfg
+        ms = ds.open_dataset(cfg.ms, cfg.ms_list, tilesz=cfg.tile_size,
+                             data_column=cfg.input_column,
+                             out_column=cfg.output_column)
+        meta = ms.meta
+        rows = int(meta["tilesz"]) * int(meta["nbase"])
+        F = len(meta["freqs"])
+        from sagecal_tpu import dtypes as dtp
+        itemsize = np.dtype(dtp.storage_dtype(
+            getattr(cfg, "dtype_policy", "f32"), np.float32)).itemsize
+        per_tile = rows * (8 + 8 * F) * itemsize + rows * 3 * 4
+        live = int(getattr(cfg, "prefetch", 1)) + 3
+        return per_tile * live
+    except Exception:
+        return 0
+
+
+class Scheduler:
+    """Owns the device; drives :class:`serve.queue.JobQueue` jobs."""
+
+    def __init__(self, queue: jq.JobQueue, log=print,
+                 idle_sleep_s: float = 0.002):
+        self.q = queue
+        self.log = log
+        self.idle_sleep_s = float(idle_sleep_s)
+        self._stop = threading.Event()
+        self._running: list[_RunningJob] = []
+        # set by every job's reader thread after staging a tile: the
+        # idle path waits on it (then re-polls) instead of sleeping a
+        # fixed quantum — a ready tile wakes the device immediately
+        self._ready = threading.Event()
+        # server-level accounting (the metrics op): device-driving
+        # seconds vs loop wall — the service's busy fraction
+        self.t0 = time.time()
+        self.busy_s = 0.0
+        self.tiles_done = 0
+        self.jobs_done = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def stop(self) -> None:
+        """Hard stop: the loop exits at the next boundary. Running jobs
+        are torn down as CANCELLED (graceful drain is the queue's
+        ``start_drain`` + letting the loop run dry instead)."""
+        self._stop.set()
+
+    def metrics(self) -> dict:
+        wall = time.time() - self.t0
+        out = dict(self.q.counts())
+        out.update(pcache.PROGRAMS.stats())
+        out.update(wall_s=wall, busy_s=self.busy_s,
+                   device_busy_frac=(self.busy_s / wall) if wall else 0.0,
+                   tiles_done=self.tiles_done, jobs_done=self.jobs_done,
+                   running=len(self._running))
+        return out
+
+    # -- job start ----------------------------------------------------------
+
+    def _job_log(self, job):
+        return lambda *a: self.log(f"[{job.job_id}]", *a)
+
+    def _start_job(self, job) -> _RunningJob | None:
+        """Open the dataset, build (or cache-hit) the pipeline, wire
+        the per-job reader thread. Raises propagate to the caller's
+        fail-stop handler."""
+        from sagecal_tpu import pipeline, skymodel
+        from sagecal_tpu.io import dataset as ds
+        cfg = job.cfg
+        tracer = None
+        if job.trace_path:
+            tracer = dtrace.Tracer(job.trace_path, entry="serve",
+                                   job=job.job_id)
+        ctx = (lambda: dtrace.scope(tracer))
+        with dtrace.scope(tracer):
+            # opaque kinds — plus fullbatch with tile_batch > 1: the
+            # batched driver's warm start is BATCH-granular, so
+            # running such a job through the sequential stepper would
+            # silently produce different (non-CLI-identical) output;
+            # pipeline.run dispatches to the same driver the CLI uses
+            if (job.kind in ("stochastic", "sim", "mpi")
+                    or int(getattr(cfg, "tile_batch", 1) or 1) > 1):
+                self._run_opaque(job, tracer)
+                return None
+            ms = ds.open_dataset(cfg.ms, cfg.ms_list,
+                                 tilesz=cfg.tile_size,
+                                 data_column=cfg.input_column,
+                                 out_column=cfg.output_column)
+            meta = ms.meta
+            sky = skymodel.read_sky_cluster(
+                cfg.sky_model, cfg.cluster_file, meta["ra0"],
+                meta["dec0"], meta["freq0"], cfg.format_3)
+            pipe = pipeline.FullBatchPipeline(cfg, ms, sky,
+                                              log=self._job_log(job))
+            st = pipe.stepper(
+                write_residuals=True, solution_path=cfg.solutions_file,
+                max_tiles=cfg.max_timeslots or None,
+                log=self._job_log(job), trace_ctx=ctx)
+            job.n_tiles = st.n_tiles
+
+            def produce(i, _ms=ms, _st=st):
+                tile = _ms.read_tile(i)
+                return tile, _st.stage(i, tile)
+
+            pf = sched.Prefetcher(produce, st.n_tiles, depth=st.depth,
+                                  name=f"job-{job.job_id}", context=ctx,
+                                  ready_event=self._ready)
+        return _RunningJob(job, pipe, st, pf, tracer)
+
+    def _run_opaque(self, job, tracer) -> None:
+        """Stochastic / simulation / mpi / tile-batch jobs: the
+        existing whole-run drivers as one opaque, isolated unit on the
+        device-owner thread. An opaque job has no tile boundary the
+        scheduler owns, so a cancel arriving AFTER this point cannot
+        take effect until the run completes (documented limitation,
+        MIGRATION.md "Service mode"); one arriving before it is
+        honoured here."""
+        t0 = time.perf_counter()
+        try:
+            if job.cancel_requested:
+                self.q.finish(job, jq.CANCELLED)
+                return
+            cfg = job.cfg
+            if job.kind == "mpi":
+                # the consensus interval loop, reused verbatim as a
+                # job (cli_mpi.main owns its own diag/--platform flags)
+                from sagecal_tpu import cli_mpi
+                rc = cli_mpi.main(job.argv)
+                if rc:
+                    raise RuntimeError(f"cli_mpi exited rc={rc}")
+            elif job.kind == "stochastic":
+                from sagecal_tpu import stochastic
+                if cfg.n_admm > 1 and cfg.channel_avg_per_band > 1:
+                    job.history = stochastic.run_minibatch_consensus(
+                        cfg, log=self._job_log(job)) or []
+                else:
+                    job.history = stochastic.run_minibatch(
+                        cfg, log=self._job_log(job)) or []
+            else:
+                from sagecal_tpu import pipeline
+                pipeline.run(cfg, log=self._job_log(job))
+            self.q.finish(job, jq.DONE)
+            self.jobs_done += 1
+        except BaseException as e:
+            self.q.finish(job, jq.FAILED, exc=e)
+            self.log(f"[{job.job_id}] FAILED: {job.error}")
+        finally:
+            self.busy_s += time.perf_counter() - t0
+            if tracer is not None:
+                tracer.close()
+
+    # -- the loop -----------------------------------------------------------
+
+    def _admit(self) -> bool:
+        admitted = False
+        while True:
+            job = self.q.next_admissible(estimate_staged_bytes)
+            if job is None:
+                return admitted
+            try:
+                rj = self._start_job(job)
+            except BaseException as e:
+                self.q.finish(job, jq.FAILED, exc=e)
+                self.log(f"[{job.job_id}] FAILED at start: {job.error}")
+                continue
+            if rj is not None:          # opaque jobs already finished
+                self._running.append(rj)
+                self.log(f"[{job.job_id}] running "
+                         f"({job.n_tiles} tiles, "
+                         f"~{job.staged_bytes / 1e6:.0f} MB staged)")
+            admitted = True
+
+    def _finish(self, rj, state, exc=None) -> None:
+        self._running.remove(rj)
+        if state == jq.DONE:
+            try:
+                # close raises a still-pending async-write failure:
+                # the job's LAST tiles' writes must land before "done"
+                rj.teardown(raise_pending=True)
+            except BaseException as e:
+                state, exc = jq.FAILED, e
+        else:
+            try:
+                rj.teardown(raise_pending=False)
+            except BaseException as e:
+                # a failed/cancelled job's teardown (writer flush on a
+                # full disk, tracer close) must not escape and kill
+                # the device-owner thread — the job is already
+                # terminal; record the teardown error alongside
+                self.log(f"[{rj.job.job_id}] teardown error ignored: "
+                         f"{type(e).__name__}: {e}")
+        job = rj.job
+        job.history = rj.stepper.history
+        self.q.finish(job, state, exc=exc)
+        if state == jq.DONE:
+            self.jobs_done += 1
+        self.log(f"[{job.job_id}] {state}"
+                 + (f": {job.error}" if exc is not None else ""))
+
+    def _step_ready(self) -> bool:
+        """One pass over running jobs; True if any made progress.
+
+        STICKY within the pass, BOUNDED: a job steps up to
+        ``depth + 1`` consecutive tiles while they are already staged,
+        then the pass moves on even if more are ready. Jobs in
+        different shape buckets run different compiled programs, so
+        per-tile alternation thrashes the host's code/data caches
+        (measured +5% on the serve bench) — but UNbounded stickiness
+        would let a job whose reader keeps pace with the device run to
+        completion, starving its neighbours' staged tiles and
+        deferring cancel/stop/drain for its whole runtime. The bound
+        keeps the alternation win while guaranteeing every running
+        job (and every control signal) is visited at least once per
+        ``depth + 1`` tiles."""
+        progressed = False
+        for rj in list(self._running):
+            job = rj.job
+            for _ in range(rj.stepper.depth + 1):
+                if job.cancel_requested:
+                    self._finish(rj, jq.CANCELLED)
+                    progressed = True
+                    break
+                try:
+                    with dtrace.scope(rj.tracer):
+                        r = rj.pf.poll()
+                        if r is sched.Prefetcher.EMPTY:
+                            break
+                        if r is sched.Prefetcher.DONE:
+                            self._finish(rj, jq.DONE)
+                            progressed = True
+                            break
+                        ti, (tile, stg), wait = r
+                        t0 = time.perf_counter()
+                        rj.stepper.step(ti, tile, stg, wait)
+                        self.busy_s += time.perf_counter() - t0
+                    job.tiles_done += 1
+                    self.tiles_done += 1
+                    progressed = True
+                except BaseException as e:
+                    # fail-stop isolation: THIS job only; neighbours
+                    # keep solving and the loop keeps serving
+                    self._finish(rj, jq.FAILED, exc=e)
+                    progressed = True
+                    break
+        return progressed
+
+    def run(self) -> None:
+        """Drive jobs until stopped, or — when the queue is draining —
+        until everything accepted has finished."""
+        while True:
+            if self._stop.is_set():
+                for rj in list(self._running):
+                    self._finish(rj, jq.CANCELLED)
+                # queued jobs will never run either: leave none
+                # stranded in a non-terminal state a client would
+                # poll forever
+                for job in self.q.jobs():
+                    if job.state == jq.QUEUED:
+                        self.q.finish(job, jq.CANCELLED)
+                return
+            self._admit()
+            progressed = self._step_ready()
+            if not self._running:
+                if self.q.draining and self.q.idle():
+                    return
+                if not progressed:
+                    time.sleep(self.idle_sleep_s * 5)
+            elif not progressed:
+                # every running job is waiting on its reader thread:
+                # genuine pipeline bubble at server level. Wait for a
+                # producer's ready signal (with a timeout backstop),
+                # then clear and re-poll — a tile staged during the
+                # poll pass leaves the event set, so nothing is lost
+                self._ready.wait(timeout=0.05)
+                self._ready.clear()

@@ -1909,6 +1909,12 @@ def write_table(results, platform, date=None, stamp=False):
         "GFLOP/s | GB/s | Δbytes | bound | MFU≥ | shape |",
         "|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
+    # the sentinel reads its toleranced metrics out of the banked
+    # records this table renders; assert the column mapping here so
+    # a renamed/dropped column can never silently orphan a tolerance
+    # (tests/test_obs.py pins the mapping itself)
+    from sagecal_tpu.obs import sentinel as _sentinel
+    _sentinel.assert_table_contract(lines[-2])
     for name, r in results.items():
         if "error" in r:
             lines.append(f"| {name} | FAILED | — | — | — | — | — | — | — "
@@ -2146,6 +2152,13 @@ def main():
     # delta vs the bank, so the tentpole's fewer-bytes claim is asserted
     # by the bench record itself rather than by prose
     bytes_bank = {p: _bytes_baseline(p) for p in ("cpu", "tpu")}
+    # the sentinel's fuller bank snapshot (wall/bytes/busy/cache per
+    # config): every fresh result is compared as it lands and the
+    # violations ride the stamped record — the post-run half of the
+    # obs/sentinel.py contract (CI runs the --fast half)
+    from sagecal_tpu.obs import sentinel as _sentinel
+    sent_bank = {p: _sentinel.newest_bank_results(p)
+                 for p in ("cpu", "tpu")}
     # initial probe capped at ~10% of budget (2 x 75 s worst case):
     # round 4's 3 x 75 s opener cost 245 s and was part of why config 5
     # starved (VERDICT weak 1/6). The mid-run re-probe below still
@@ -2172,6 +2185,15 @@ def main():
             log(f"# {name}: {r['value']:.1f} {r['unit']} "
                 f"(res {r.get('res_0', 0):.4g}->{r.get('res_1', 0):.4g}, "
                 f"total {r['total_s']}s)")
+            viol = _sentinel.compare(
+                {name: r}, sent_bank.get(r.get("platform", ""), {}))
+            if viol:
+                # recorded, not fatal: a bench round must never zero
+                # itself — the regression is named in the stamped JSON
+                # and the CI sentinel lane judges the committed bank
+                r["sentinel"] = [v["msg"] for v in viol]
+                for v in viol:
+                    log(f"# SENTINEL REGRESSION: {v['msg']}")
             if r.get("platform") and allow_drift:
                 # record the platform the config ACTUALLY ran on —
                 # except deliberate CPU repair runs while the chip is

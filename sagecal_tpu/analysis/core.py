@@ -56,8 +56,11 @@ RULES = {
 # joined in ISSUE 5 — the overlap layer's thread loops must never grow
 # a per-iteration sync; serve/ joined in ISSUE 8 — the device-owner
 # scheduler loop and the per-job thread code sit upstream of EVERY
-# job's solve, so a sync or a use-after-donate there taxes all tenants)
-_HOT_SEGMENTS = ("solvers", "consensus", "rime", "serve")
+# job's solve, so a sync or a use-after-donate there taxes all tenants;
+# obs/ joined in ISSUE 9 — the metrics layer runs inside every hot
+# loop it instruments, so an un-gated device read there would tax
+# exactly the paths it exists to observe)
+_HOT_SEGMENTS = ("solvers", "consensus", "rime", "serve", "obs")
 _HOT_BASENAMES = ("pipeline.py", "sched.py")
 
 
@@ -422,20 +425,34 @@ class ModuleCtx:
             cur = self.parents.get(cur)
         return None
 
+    @staticmethod
+    def _is_active_gate(test) -> bool:
+        """A blessed telemetry-gate test: ``<mod>.active()`` — the
+        diag tracer's ``dtrace.active()`` AND the obs registry's
+        ``obs.active()`` (obs/metrics.py keeps the identical contract)
+        — or a BoolOp combining only such calls (``dtrace.active() or
+        obs.active()``: the body still executes only when telemetry is
+        on, so its syncs never run on the disabled path)."""
+        if isinstance(test, ast.Call):
+            return (dotted(test.func) or "").endswith(".active")
+        if isinstance(test, ast.BoolOp):
+            return all(ModuleCtx._is_active_gate(v) for v in test.values)
+        return False
+
     def under_trace_gate(self, node) -> bool:
-        """True inside an ``if dtrace.active():`` block — the blessed
-        telemetry gate (diag/trace.py): statements there only execute
-        when tracing is on. ``with dtrace.phase(...)`` does NOT gate:
-        its body runs unconditionally (null context when tracing is
-        off), so syncs inside a phase body are still leaks."""
+        """True inside an ``if dtrace.active():`` / ``if obs.active():``
+        block (or a BoolOp of such gates) — the blessed telemetry
+        gates (diag/trace.py, obs/metrics.py): statements there only
+        execute when telemetry is on. ``with dtrace.phase(...)`` does
+        NOT gate: its body runs unconditionally (null context when
+        tracing is off), so syncs inside a phase body are still
+        leaks."""
         cur = node
         while cur is not None:
             parent = self.parents.get(cur)
             if isinstance(parent, ast.If):
-                test = parent.test
-                if (isinstance(test, ast.Call)
-                        and (dotted(test.func) or "").endswith(".active")
-                        and cur in parent.body):
+                if self._is_active_gate(parent.test) \
+                        and cur in parent.body:
                     return True
             cur = parent
         return False

@@ -68,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
     a("--diag", default=None, metavar="PATH",
       help="write a JSONL diagnostic trace (phase timers + per-iteration "
            "convergence records, sagecal_tpu.diag.trace) to PATH")
+    a("--metrics", default=None, metavar="PATH",
+      help="enable the obs metrics registry for this run and dump it "
+           "as JSON to PATH at exit (counters, gauges, latency "
+           "histograms with p50/p90/p99 — sagecal_tpu.obs.metrics; "
+           "off = zero overhead, bit-identical)")
     a("--tile-batch", type=int, default=1,
       help=">1: solve this many intervals as one batched device program "
            "(throughput lever; warm start becomes batch-granular)")
@@ -221,6 +226,9 @@ def main(argv=None) -> int:
         from sagecal_tpu.diag import trace as dtrace
         dtrace.enable(args.diag, entry="sagecal-tpu",
                       argv=list(argv) if argv is not None else sys.argv[1:])
+    if args.metrics:
+        from sagecal_tpu.obs import metrics as ometrics
+        ometrics.enable()
 
     from sagecal_tpu import pipeline
     try:
@@ -235,6 +243,8 @@ def main(argv=None) -> int:
     finally:
         if args.diag:
             dtrace.disable()
+        if args.metrics:
+            ometrics.dump_to(args.metrics)
     return 0
 
 

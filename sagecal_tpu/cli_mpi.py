@@ -23,6 +23,7 @@ import numpy as np
 
 from sagecal_tpu import skymodel, utils
 from sagecal_tpu.config import SolverMode
+from sagecal_tpu.obs import metrics as obs
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -140,6 +141,10 @@ def build_parser() -> argparse.ArgumentParser:
       help="write a JSONL diagnostic trace (phase timers, per-ADMM-"
            "iteration convergence records, staging bytes-accounting; "
            "sagecal_tpu.diag.trace) to PATH")
+    a("--metrics", default=None, metavar="PATH",
+      help="enable the obs metrics registry for this run and dump it "
+           "as JSON to PATH at exit (ADMM consensus residual gauges, "
+           "latency histograms; sagecal_tpu.obs.metrics)")
     return p
 
 
@@ -186,11 +191,15 @@ def main(argv=None) -> int:
     if args.diag:
         dtrace.enable(args.diag, entry="sagecal-tpu-mpi",
                       argv=list(argv) if argv is not None else sys.argv[1:])
+    if args.metrics:
+        obs.enable()
     try:
         return _main_consensus(args, dtrace)
     finally:
         if args.diag:
             dtrace.disable()
+        if args.metrics:
+            obs.dump_to(args.metrics)
 
 
 def _main_consensus(args, dtrace) -> int:
@@ -667,17 +676,22 @@ def _main_consensus(args, dtrace) -> int:
             res1 = np.asarray(r1s)[-1] if cfg.n_admm > 1 else np.asarray(res1)
             duals = np.asarray(duals)
 
-            if dtrace.active():
+            if dtrace.active() or obs.active():
                 # per-ADMM-iteration convergence records from the fetched
                 # telemetry. The host-loop and blocked runners already emit
-                # live per-iteration records (admm.py), so only the fully
-                # traced mesh program needs the post-hoc emission.
+                # live per-iteration records (admm.py feeds BOTH the trace
+                # and the obs gauges there), so only the fully traced mesh
+                # program needs the post-hoc emission.
                 if not args.host_loop and not args.block_f:
                     for k in range(np.asarray(r1s).shape[0]):
-                        dtrace.emit(
-                            "admm_iter", interval=ti, iter=k + 1,
-                            r1_mean=float(np.asarray(r1s)[k].mean()),
-                            dual=float(duals[k]) if len(duals) else 0.0)
+                        r1m = float(np.asarray(r1s)[k].mean())
+                        du = float(duals[k]) if len(duals) else 0.0
+                        dtrace.emit("admm_iter", interval=ti, iter=k + 1,
+                                    r1_mean=r1m, dual=du)
+                        if obs.active():
+                            obs.inc("admm_iterations_total")
+                            obs.set_gauge("admm_primal_residual", r1m)
+                            obs.set_gauge("admm_dual_residual", du)
                 # interval summary with the consensus primal residual
                 # ||J - BZ|| (the reference master's convergence axis)
                 BZf = np.einsum("fp,mpknr->fmknr", Bpoly, np.asarray(Z))
@@ -687,6 +701,9 @@ def _main_consensus(args, dtrace) -> int:
                             res_1=float(res1.mean()), primal=primal,
                             rho_mean=float(np.asarray(fetch(rhoF))[:nf]
                                            .mean()))
+                if obs.active():
+                    obs.inc("tiles_solved_total")
+                    obs.set_gauge("consensus_primal_residual", primal)
 
             # warm-start the next interval; per-subband divergence reset
             # (slave :680-683 res_ratio check; fullbatch warm-start analogue)

@@ -45,6 +45,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from sagecal_tpu.consensus import manifold as mf
 from sagecal_tpu.consensus import poly as cpoly
 from sagecal_tpu.diag import trace as dtrace
+from sagecal_tpu.obs import metrics as obs
 from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.solvers import sage
 
@@ -141,17 +142,27 @@ def _emit_deferred(pend, interval):
     in ONE batched device->host fetch AFTER the loop (overlap-
     preserving: tracing never serializes the ADMM dispatch chain
     behind per-iteration float() syncs). ``pend``: (iter, r1_mean,
-    dual|None, rho_mean) device scalars, copies started async."""
+    dual|None, rho_mean) device scalars, copies started async.
+    Feeds BOTH telemetry sinks — the diag trace (a no-op without a
+    tracer) and the obs registry (consensus-residual gauges + the
+    iteration counter); ``pend`` is only collected when one of the two
+    is active, so the disabled path stays sync-free."""
     if not pend:
         return
     from sagecal_tpu import sched as _sched
     _sched.start_host_copy(*[x for rec in pend for x in rec[1:]
                              if x is not None])
     for it, r1m, dual, rhom in pend:
+        r1 = float(np.asarray(r1m))
+        du = 0.0 if dual is None else float(np.asarray(dual))
+        rho = float(np.asarray(rhom))
         dtrace.emit("admm_iter", interval=interval, iter=it,
-                    r1_mean=float(np.asarray(r1m)),
-                    dual=0.0 if dual is None else float(np.asarray(dual)),
-                    rho_mean=float(np.asarray(rhom)), deferred=True)
+                    r1_mean=r1, dual=du, rho_mean=rho, deferred=True)
+        if obs.active():
+            obs.inc("admm_iterations_total")
+            obs.set_gauge("admm_primal_residual", r1)
+            obs.set_gauge("admm_dual_residual", du)
+            obs.set_gauge("admm_rho_mean", rho)
 
 
 def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
@@ -577,7 +588,7 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         # batched transfer after the loop, so tracing never inserts a
         # per-iteration host sync into the ADMM chain
         pend = []
-        if dtrace.active():
+        if dtrace.active() or obs.active():
             pend.append((0, jnp.mean(res1), None, jnp.mean(carry[3])))
         r1s, duals = [], []
         for it in range(1, max(cfg.n_admm, 1)):
@@ -588,7 +599,7 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
             carry, (_, r1, dual) = out[:9], out[9:]
             r1s.append(r1)
             duals.append(dual)
-            if dtrace.active():
+            if dtrace.active() or obs.active():
                 pend.append((it, jnp.mean(r1), dual,
                              jnp.mean(carry[3])))
         _emit_deferred(pend, interval)
@@ -738,7 +749,7 @@ def make_admm_runner_blocked(dsky, sta1, sta2, cidx, cmask,
             _t(f"cons[{it}]", t0, carry[2])
             r1h.append(r1)
             dualh.append(dual)
-            if dtrace.active():
+            if dtrace.active() or obs.active():
                 pend.append((it, jnp.mean(r1), dual,
                              jnp.mean(carry[3])))
         _emit_deferred(pend, interval)

@@ -72,6 +72,17 @@ _TRACER = None          # module-level singleton; None = disabled
 # thread jobs) routes that thread's emits to the job tracer; threads
 # without a scope keep the process tracer. Stored as a stack so scopes
 # nest (a server-level tracer can wrap a job-level one).
+#
+# CONTRACT (metrics-era, tests/test_diag.py pins it): scope stacks are
+# STRICTLY thread-local. Entering a scope on thread A changes nothing
+# about thread B's routing — not even when B was spawned by A while
+# the scope was live (threading.local starts empty per thread; a new
+# thread that must attribute to a job enters the job's own scope via
+# the sched context= / trace_ctx= factories, see
+# serve.scheduler.job_telemetry_ctx). obs.metrics.scope_labels keeps
+# the identical stack semantics, so a metric emitted inside a scoped
+# thread attributes to the owning job exactly when a trace record
+# routed there would.
 _SCOPED = threading.local()
 
 

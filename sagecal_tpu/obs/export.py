@@ -1,0 +1,148 @@
+"""Prometheus text exposition + the daemon's HTTP observability port.
+
+Two halves, both stdlib-only:
+
+- :func:`render_prometheus` serializes an :class:`obs.metrics.Registry`
+  into Prometheus text format 0.0.4 (``# TYPE`` headers,
+  ``_bucket{le="..."}`` / ``_sum`` / ``_count`` histogram series) so
+  the serve daemon is scrapeable by stock tooling. Every metric name
+  is prefixed ``sagecal_`` at render time; emit sites keep short
+  names.
+- :class:`ObsHTTPServer` is a tiny threaded ``http.server`` exposing
+
+  - ``GET /metrics``  — text format; the provider callback runs first
+    so point-in-time gauges (queue depth, device busy) are fresh;
+  - ``GET /healthz``  — JSON; HTTP 200 when healthy, 503 when the
+    provider reports ``status: degraded`` (a stalled/diverging job, a
+    stuck device) — the shape load balancers and probes expect.
+
+  It serves observability ONLY: no request mutates server state, so
+  binding it wider than localhost leaks information, not control.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+PREFIX = "sagecal_"
+
+
+def _fmt_value(v: float) -> str:
+    if v != v:
+        return "NaN"
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_labels(pairs) -> str:
+    if not pairs:
+        return ""
+    body = ",".join(
+        '%s="%s"' % (k, str(v).replace("\\", r"\\").replace('"', r"\"")
+                     .replace("\n", r"\n"))
+        for k, v in pairs)
+    return "{" + body + "}"
+
+
+def render_prometheus(registry) -> str:
+    """Text exposition of every metric in ``registry`` (sorted, so the
+    output is diffable and the golden test is stable)."""
+    from sagecal_tpu.obs.metrics import Counter, Gauge, Histogram
+    lines = []
+    with registry._lock:
+        for name, m in sorted(registry._metrics.items()):
+            full = PREFIX + name
+            if m.help:
+                lines.append(f"# HELP {full} {m.help}")
+            lines.append(f"# TYPE {full} {m.kind}")
+            for key, s in sorted(m.series().items()):
+                if isinstance(m, (Counter, Gauge)):
+                    lines.append(
+                        f"{full}{_fmt_labels(key)} {_fmt_value(s[0])}")
+                elif isinstance(m, Histogram):
+                    cum = 0
+                    for ub, c in zip(list(m.buckets) + [float("inf")],
+                                     s.counts):
+                        cum += c
+                        le = _fmt_value(ub) if ub != float("inf") \
+                            else "+Inf"
+                        lines.append(
+                            f"{full}_bucket"
+                            f"{_fmt_labels(list(key) + [('le', le)])} "
+                            f"{cum}")
+                    lines.append(f"{full}_sum{_fmt_labels(key)} "
+                                 f"{_fmt_value(s.sum)}")
+                    lines.append(f"{full}_count{_fmt_labels(key)} "
+                                 f"{s.count}")
+    return "\n".join(lines) + "\n"
+
+
+class ObsHTTPServer:
+    """Threaded HTTP endpoint for ``/metrics`` + ``/healthz``.
+
+    ``metrics_provider()`` -> Prometheus text (str);
+    ``health_provider()`` -> JSON-serializable dict whose ``status``
+    key selects the HTTP code (``ok`` -> 200, anything else -> 503).
+    Provider exceptions answer 500 with the error text instead of
+    killing the serving thread.
+    """
+
+    def __init__(self, port: int, metrics_provider, health_provider,
+                 host: str = "127.0.0.1"):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):        # quiet: probes are chatty
+                pass
+
+            def _reply(self, code, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        body = outer.metrics_provider().encode()
+                        self._reply(200, body,
+                                    "text/plain; version=0.0.4; "
+                                    "charset=utf-8")
+                    elif path == "/healthz":
+                        h = outer.health_provider()
+                        code = 200 if h.get("status") == "ok" else 503
+                        self._reply(code,
+                                    (json.dumps(h) + "\n").encode(),
+                                    "application/json")
+                    else:
+                        self._reply(404, b"not found\n", "text/plain")
+                except Exception as e:      # keep the probe port alive
+                    self._reply(500, f"{type(e).__name__}: {e}\n"
+                                .encode(), "text/plain")
+
+        self.metrics_provider = metrics_provider
+        self.health_provider = health_provider
+
+        class Srv(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._srv = Srv((host, int(port)), Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever,
+            kwargs={"poll_interval": 0.2}, name="obs-http", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

@@ -1,0 +1,121 @@
+"""Live convergence health: streaming stall/divergence detection.
+
+A calibration job emits a residual stream while it runs — per-solve-
+interval ``res_1`` records (pipeline tile records, the serve
+scheduler's per-step history) and per-sweep reductions. Today those
+are only readable after the fact from a ``--diag`` trace;
+:class:`ConvergenceHealth` folds the same stream *live* into one of
+three states so a diverging job is visible before it burns its full
+tile budget:
+
+- ``ok``        — the monotone-residual watermark (best residual seen)
+                  improved within the last ``patience`` observations;
+- ``stalled``   — ``patience`` consecutive observations without a
+                  relative improvement of at least ``min_improvement``
+                  over the watermark;
+- ``diverging`` — a non-finite residual, or a residual more than
+                  ``divergence_ratio`` times the watermark (the same
+                  ratio the pipeline's divergence reset keys on,
+                  pipeline.RES_RATIO).
+
+``stalled`` is advisory (a flat residual can be a converged job — a
+steady-state stream fluctuating around its noise floor stops beating
+the all-time-best watermark and WILL read stalled); ``diverging`` is
+the alarm. Accordingly only :data:`DEGRADED` (diverging) flips
+``/healthz`` to 503 — the LB-probe path must not page on converged
+jobs — while :data:`UNHEALTHY` (stalled too) drives the advisory
+``unhealthy_jobs`` listing. Both are annotations, never interventions:
+the fail-stop / divergence-reset machinery stays where it is, this
+class only makes its inputs observable. The serve scheduler feeds one
+update per completed tile and surfaces the state as the job's
+``health`` field in status responses and ``/healthz``
+(MIGRATION.md "Observability").
+
+Stdlib only; a caller with a finished ``--diag`` trace can replay it
+through :func:`health_of_records`.
+"""
+
+from __future__ import annotations
+
+import time
+
+OK = "ok"
+STALLED = "stalled"
+DIVERGING = "diverging"
+
+#: states worth SURFACING (the /healthz unhealthy_jobs listing)
+UNHEALTHY = (STALLED, DIVERGING)
+
+#: states worth PAGING on (/healthz answers 503): stalled is excluded
+#: — a converged job's flat residual reads stalled by construction
+DEGRADED = (DIVERGING,)
+
+
+class ConvergenceHealth:
+    """Streaming residual-watermark health over one job's solves."""
+
+    def __init__(self, patience: int = 3, min_improvement: float = 1e-3,
+                 divergence_ratio: float = 5.0):
+        self.patience = max(1, int(patience))
+        self.min_improvement = float(min_improvement)
+        self.divergence_ratio = float(divergence_ratio)
+        self.best: float | None = None    # monotone-residual watermark
+        self.last: float | None = None
+        self.stale = 0                    # observations since progress
+        self.n = 0
+        self.state = OK
+        self.last_progress_t = time.time()
+
+    def update(self, res: float, t: float | None = None) -> str:
+        """Fold one residual observation; returns the new state.
+
+        A residual of exactly 0.0 means fully flagged data, not
+        convergence (the pipeline reset convention) — it is recorded
+        but neither progresses nor diverges the watermark."""
+        t = time.time() if t is None else float(t)
+        res = float(res)
+        self.n += 1
+        self.last = res
+        if res != res or res in (float("inf"), float("-inf")):
+            self.state = DIVERGING
+            return self.state
+        if res == 0.0:
+            return self.state
+        if self.best is None:
+            self.best = res
+            self.last_progress_t = t
+            self.state = OK
+            return self.state
+        if res > self.divergence_ratio * self.best:
+            self.state = DIVERGING
+            return self.state
+        if res < self.best * (1.0 - self.min_improvement):
+            self.best = res
+            self.stale = 0
+            self.last_progress_t = t
+            self.state = OK
+        else:
+            self.stale += 1
+            if self.stale >= self.patience:
+                self.state = STALLED
+            elif self.state != DIVERGING:
+                self.state = OK
+        return self.state
+
+    def snapshot(self) -> dict:
+        """JSON-serializable detail for status responses."""
+        return {"state": self.state, "best": self.best,
+                "last": self.last, "stale": self.stale,
+                "observations": self.n,
+                "last_progress_t": self.last_progress_t}
+
+
+def health_of_records(recs, **kw) -> ConvergenceHealth:
+    """Replay a diag trace's residual stream (``tile`` records'
+    ``res_1``, in order) through a fresh monitor — post-hoc triage of
+    a finished run with the same thresholds the live path used."""
+    h = ConvergenceHealth(**kw)
+    for r in recs:
+        if r.get("ev") == "tile" and "res_1" in r:
+            h.update(float(r["res_1"]), t=r.get("t"))
+    return h

@@ -1,0 +1,418 @@
+"""Perf-regression sentinel over the round-stamped bench bank.
+
+The repo's perf discipline lives in the committed
+``BENCH_<PLAT>_rNN.json`` records: every round banks wall-clock,
+bytes/step (XLA cost analysis + executed-trip pricing), pipeline
+bubble / device-busy fractions and compile-cache hit rates, and
+CHANGES.md has enforced "no silent regression" by hand ever since the
+Δbytes column landed. This module machine-enforces it:
+
+- :func:`compare` — live-vs-bank comparison of one results dict
+  against another, per-metric tolerances (:data:`TOLERANCES`),
+  direction-aware (an *improvement* never fails). Records are only
+  compared when their ``shape`` strings match: a re-shaped config is
+  a different experiment, not a regression.
+- **Cross-round check** — for every config, its newest banked
+  occurrence is compared against the most recent earlier round that
+  carried it, so a PR that banks a regressed round fails CI at the
+  bank, before anyone reads the table.
+- **Live probes** — fast in-process re-measurements of the two
+  structural metrics that can rot without any bank being written:
+  the overlap machinery still overlaps (``sched`` primitives hide a
+  producer behind a consumer) and the serve program cache still
+  shares (a second bucket-compatible pipeline adds ZERO compiles).
+- **Full mode** (no ``--fast``) — additionally re-runs the fast bench
+  configs (:data:`RERUN_CONFIGS`) through bench.py's subprocess
+  driver and compares the fresh numbers against the bank.
+
+Exit status: 0 clean, 1 regression (each violation printed with its
+named metric), 2 usage / unreadable bank. Wired as a CI lane
+(``python -m sagecal_tpu.obs.sentinel --fast``) and as bench.py's
+post-run check (each fresh record is compared as it lands and the
+violations are stored in the stamped JSON).
+
+Tolerances are deliberately asymmetric per metric: bytes/step comes
+from XLA cost analysis and is near-deterministic (2%), wall-clock on
+shared hosts is noisy (30%), busy/cache fractions get small absolute
+slack. :data:`TABLE_COLUMNS` names the BENCH_TABLE.md column each
+toleranced metric is read from; ``bench.write_table`` asserts the
+mapping against the header it renders, so the sentinel can never
+drift from the table silently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+#: per-metric regression tolerances. ``rel`` = fraction of the banked
+#: value, ``abs`` = absolute slack; ``better`` gives the healthy
+#: direction (the other direction is never a violation).
+TOLERANCES = {
+    "wall": dict(field="step_s", rel=0.30, better="lower"),
+    "bytes": dict(field="bytes_accessed", rel=0.02, better="lower"),
+    "bubble": dict(field="device_busy_frac", abs=0.05, better="higher"),
+    "cache": dict(field="cache_hit_rate", abs=0.02, better="higher"),
+}
+
+#: BENCH_TABLE.md column each toleranced metric is read from (None:
+#: the metric lives in the record / shape column only). bench.write_table
+#: asserts this mapping against the header it renders.
+TABLE_COLUMNS = {"wall": "step", "bytes": "Δbytes",
+                 "bubble": None, "cache": None}
+
+#: bench configs cheap enough to re-run live in full (non ``--fast``)
+#: mode — minutes, not the full bench's half hour.
+RERUN_CONFIGS = ("2-stochastic-lbfgs", "6-overlap-e2e")
+
+
+def assert_table_contract(header: str) -> None:
+    """Every toleranced metric with a named table column must find it
+    in the header bench.write_table is about to render."""
+    for metric, col in TABLE_COLUMNS.items():
+        if col is not None and col not in header:
+            raise AssertionError(
+                f"sentinel metric {metric!r} reads BENCH_TABLE column "
+                f"{col!r}, absent from the rendered header: {header}")
+    missing = set(TOLERANCES) - set(TABLE_COLUMNS)
+    if missing:
+        raise AssertionError(
+            f"sentinel tolerances {sorted(missing)} have no "
+            f"TABLE_COLUMNS entry")
+
+
+# ---------------------------------------------------------------------------
+# bank loading
+# ---------------------------------------------------------------------------
+
+def load_banks(platform: str, bank_dir: str = HERE):
+    """All round-stamped records of ``platform``, oldest first:
+    ``[(round, path, results_dict), ...]``. Records whose declared
+    platform mismatches their filename are skipped (the bank-hygiene
+    rule bench.py enforces on write)."""
+    out = []
+    pat = os.path.join(bank_dir, f"BENCH_{platform.upper()}_r*.json")
+    for p in sorted(glob.glob(pat)):
+        m = re.search(r"_r(\d+)\.json$", p)
+        if not m:
+            continue
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except Exception:
+            continue
+        if d.get("platform") != platform:
+            continue
+        res = d.get("results")
+        if isinstance(res, dict) and res:
+            out.append((int(m.group(1)), p, res))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def newest_bank_results(platform: str, bank_dir: str = HERE) -> dict:
+    """Per-config newest banked record across all rounds (a config
+    absent from the newest round keeps its last banked occurrence) —
+    what a live run measures against."""
+    merged: dict = {}
+    for _, _, res in load_banks(platform, bank_dir):
+        for name, rec in res.items():
+            if isinstance(rec, dict) and "error" not in rec:
+                merged[name] = rec
+    return merged
+
+
+# ---------------------------------------------------------------------------
+# comparison core
+# ---------------------------------------------------------------------------
+
+def _limit(banked: float, spec: dict) -> float:
+    slack = banked * spec["rel"] if "rel" in spec else spec["abs"]
+    return banked + slack if spec["better"] == "lower" else banked - slack
+
+
+def compare(live: dict, bank: dict, tolerances: dict | None = None,
+            source: str = "bank") -> list:
+    """Violations of ``live`` results vs ``bank`` results (both
+    ``{config: record}``). Shape-guarded: records with differing
+    ``shape`` strings are different experiments and are skipped, as
+    are FAILED records and absent fields. Returns a list of dicts,
+    each carrying the NAMED metric (the acceptance contract: a
+    failure must say which metric regressed where)."""
+    tolerances = TOLERANCES if tolerances is None else tolerances
+    out = []
+    for name, lrec in live.items():
+        brec = bank.get(name)
+        if not isinstance(lrec, dict) or not isinstance(brec, dict):
+            continue
+        if "error" in lrec or "error" in brec:
+            continue
+        if lrec.get("shape") != brec.get("shape"):
+            continue                      # re-shaped config: no claim
+        for metric, spec in tolerances.items():
+            lv, bv = lrec.get(spec["field"]), brec.get(spec["field"])
+            if lv is None or bv is None:
+                continue
+            lv, bv = float(lv), float(bv)
+            lim = _limit(bv, spec)
+            bad = lv > lim if spec["better"] == "lower" else lv < lim
+            if bad:
+                out.append({
+                    "config": name, "metric": metric,
+                    "field": spec["field"], "live": lv, "banked": bv,
+                    "limit": lim, "source": source,
+                    "msg": (f"{name}/{metric} ({spec['field']}): "
+                            f"live {lv:.6g} vs {source} {bv:.6g} "
+                            f"(limit {lim:.6g})")})
+    return out
+
+
+def cross_round_check(platform: str, bank_dir: str = HERE) -> list:
+    """For every config: its NEWEST banked occurrence vs the most
+    recent earlier round carrying it. Only the final pair is judged —
+    the check exists to stop the next regression from landing, not to
+    relitigate host changes deep in the committed history (the r05->
+    r06 CPU wall jump was a different machine and predates the
+    sentinel; it stays banked, annotated by its round's PERF.md)."""
+    occ: dict = {}              # config -> [(round, record), ...]
+    for rnd, _path, res in load_banks(platform, bank_dir):
+        for name, rec in res.items():
+            if isinstance(rec, dict) and "error" not in rec:
+                occ.setdefault(name, []).append((rnd, rec))
+    viol = []
+    for name, pairs in occ.items():
+        if len(pairs) < 2:
+            continue
+        (prnd, prev), (rnd, rec) = pairs[-2], pairs[-1]
+        for v in compare({name: rec}, {name: prev},
+                         source=f"r{prnd:02d}"):
+            v["round"] = rnd
+            v["msg"] = f"r{rnd:02d} " + v["msg"]
+            viol.append(v)
+    return viol
+
+
+# ---------------------------------------------------------------------------
+# live probes (fast): the structural metrics that rot without a bank
+# ---------------------------------------------------------------------------
+
+def probe_overlap() -> list:
+    """The sched primitives still hide the producer behind the
+    consumer: a sleep-shaped stream (8 items, 30 ms produce / 30 ms
+    consume) must run well under the MEASURED serial reference (the
+    same stream at depth 0 — the synchronous path). Both sides are
+    measured on the same host moments apart, so load stretches them
+    alike and the 0.9 bound only fails when overlap is structurally
+    gone (prefetch serialized)."""
+    from sagecal_tpu import sched
+    n, dt = 8, 0.03
+
+    def produce(i):
+        time.sleep(dt)
+        return i
+
+    def run(depth):
+        t0 = time.perf_counter()
+        for _i, _item, _w in sched.Prefetcher(produce, n, depth=depth,
+                                              name="sentinel"):
+            time.sleep(dt)
+        return time.perf_counter() - t0
+
+    serial = run(0)
+    wall = run(2)
+    if wall >= 0.9 * serial:
+        return [{"config": "probe", "metric": "bubble",
+                 "field": "overlap_wall_s", "live": wall,
+                 "banked": serial, "limit": 0.9 * serial,
+                 "source": "probe",
+                 "msg": (f"probe/bubble: overlapped stream took "
+                         f"{wall:.3f}s of a measured {serial:.3f}s "
+                         f"serial run — prefetch no longer overlaps")}]
+    return []
+
+
+def probe_cache(workdir: str | None = None) -> list:
+    """The serve program cache still shares: a second bucket-compatible
+    pipeline over a tiny synthetic dataset must add ZERO compiles and
+    land only cache hits (the tests/test_serve.py gate, portable to a
+    bare ``--fast`` run outside pytest)."""
+    import math
+    import tempfile
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from sagecal_tpu import pipeline, skymodel
+    from sagecal_tpu.diag import guard
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.rime import predict as rp
+    from sagecal_tpu.serve import cache as pcache
+    from sagecal_tpu.serve.api import config_from_dict
+
+    with tempfile.TemporaryDirectory(dir=workdir) as tmp:
+        sky_path = os.path.join(tmp, "sky.txt")
+        with open(sky_path, "w") as f:
+            f.write("P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6\n")
+        clus_path = sky_path + ".cluster"
+        with open(clus_path, "w") as f:
+            f.write("0 1 P0A\n")
+        ra0 = (41 / 60) * math.pi / 12
+        dec0 = 40 * math.pi / 180
+        srcs = skymodel.parse_sky_model(sky_path, ra0, dec0, 150e6)
+        sky = skymodel.build_cluster_sky(
+            srcs, skymodel.parse_cluster_file(clus_path))
+        dsky = rp.sky_to_device(sky, jnp.float64)
+        Jt = ds.random_jones(1, sky.nchunk, 5, seed=5, scale=0.1)
+
+        def make_ms(name, seed):
+            tiles = [ds.simulate_dataset(
+                dsky, n_stations=5, tilesz=2,
+                freqs=np.array([150e6]), ra0=ra0, dec0=dec0, jones=Jt,
+                nchunk=sky.nchunk, noise_sigma=0.01, seed=seed)]
+            msdir = os.path.join(tmp, name)
+            ds.SimMS.create(msdir, tiles)
+            return msdir
+
+        def run_pipe(msdir):
+            cfg = config_from_dict(dict(
+                ms=msdir, sky_model=sky_path, cluster_file=clus_path,
+                solver_mode=0, max_em_iter=1, max_iter=2, max_lbfgs=0,
+                tile_size=2, solve_fuse="on", solve_promote="off"))
+            ms = ds.SimMS(msdir)
+            pipe = pipeline.FullBatchPipeline(cfg, ms, sky,
+                                             log=lambda *a: None)
+            pipe.run(log=lambda *a: None)
+
+        # both datasets simulated BEFORE the guard: simulate_dataset
+        # compiles its own programs per call and is not under test
+        ms_a, ms_b = make_ms("a.ms", 11), make_ms("b.ms", 50)
+        run_pipe(ms_a)                         # warm: compiles allowed
+        h0 = pcache.PROGRAMS.stats()["hits"]
+        with guard.CompileGuard() as g:
+            run_pipe(ms_b)
+        hits = pcache.PROGRAMS.stats()["hits"] - h0
+    viol = []
+    if g.compiles != 0:
+        viol.append({"config": "probe", "metric": "cache",
+                     "field": "compiles", "live": float(g.compiles),
+                     "banked": 0.0, "limit": 0.0, "source": "probe",
+                     "msg": (f"probe/cache: second bucket-compatible "
+                             f"pipeline added {g.compiles} compiles — "
+                             f"the program cache no longer shares")})
+    if hits <= 0:
+        viol.append({"config": "probe", "metric": "cache",
+                     "field": "cache_hits", "live": float(hits),
+                     "banked": 1.0, "limit": 1.0, "source": "probe",
+                     "msg": "probe/cache: second pipeline produced no "
+                            "program-cache hits"})
+    return viol
+
+
+# ---------------------------------------------------------------------------
+# full mode: re-run the fast bench configs and compare to the bank
+# ---------------------------------------------------------------------------
+
+def rerun_check(platform: str, bank_dir: str = HERE,
+                timeout_s: int = 300, log=print) -> list:
+    bank = newest_bank_results(platform, bank_dir)
+    if not bank:
+        return []
+    # bench.py lives at the repo root, NOT necessarily next to the
+    # bank records (--bank-dir may point at a copied/doctored set)
+    sys.path.insert(0, HERE)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    viol = []
+    for name in RERUN_CONFIGS:
+        if name not in bank:
+            continue
+        log(f"sentinel: re-running {name} ({platform})")
+        r = bench.run_config_subprocess(name, timeout_s=timeout_s,
+                                        cpu=platform != "tpu")
+        if "error" in r:
+            viol.append({"config": name, "metric": "wall",
+                         "field": "error", "live": None, "banked": None,
+                         "limit": None, "source": "rerun",
+                         "msg": f"{name}: re-run FAILED: {r['error']}"})
+            continue
+        viol.extend(compare({name: r}, bank, source="bank"))
+    return viol
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m sagecal_tpu.obs.sentinel",
+        description="perf-regression sentinel over the round-stamped "
+                    "bench bank (non-zero exit + named metric on "
+                    "regression)")
+    p.add_argument("--fast", action="store_true",
+                   help="bank integrity + cross-round check + live "
+                        "probes only (the CI lane); without it the "
+                        "fast bench configs are also re-run and "
+                        "compared")
+    p.add_argument("--platform", default="all",
+                   choices=("cpu", "tpu", "all"),
+                   help="which banked platform(s) to check")
+    p.add_argument("--bank-dir", default=HERE, metavar="DIR",
+                   help="directory holding BENCH_<PLAT>_rNN.json "
+                        "(default: the repo root)")
+    p.add_argument("--no-probes", action="store_true",
+                   help="skip the live overlap/cache probes (bank-only "
+                        "checks; used by tests that doctor a bank)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the violation list as JSON on stdout")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    platforms = ("cpu", "tpu") if args.platform == "all" \
+        else (args.platform,)
+    checked_any = False
+    viol = []
+    for plat in platforms:
+        banks = load_banks(plat, args.bank_dir)
+        if not banks:
+            continue
+        checked_any = True
+        newest = banks[-1]
+        print(f"sentinel: {plat} bank r{newest[0]:02d} "
+              f"({len(banks)} rounds, {os.path.basename(newest[1])})")
+        viol.extend(cross_round_check(plat, args.bank_dir))
+        if not args.fast:
+            viol.extend(rerun_check(plat, args.bank_dir))
+    if not checked_any:
+        print(f"sentinel: no round-stamped bank under {args.bank_dir}",
+              file=sys.stderr)
+        return 2
+    if not args.no_probes:
+        viol.extend(probe_overlap())
+        viol.extend(probe_cache())
+    if args.json:
+        print(json.dumps(viol, indent=1))
+    for v in viol:
+        print(f"SENTINEL REGRESSION: {v['msg']}", file=sys.stderr)
+    if viol:
+        print(f"sentinel: FAIL ({len(viol)} violation(s))",
+              file=sys.stderr)
+        return 1
+    print("sentinel: OK (bank consistent, probes green)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,6 +31,7 @@ from sagecal_tpu import coords, dtypes as dtp, sched, skymodel, utils
 from sagecal_tpu.config import RunConfig, SimulationMode, SolverMode
 from sagecal_tpu.serve import cache as pcache
 from sagecal_tpu.diag import trace as dtrace
+from sagecal_tpu.obs import metrics as obs
 from sagecal_tpu.solvers import normal_eq as ne
 from sagecal_tpu.io import dataset as ds
 from sagecal_tpu.io import solutions as sol
@@ -52,10 +53,20 @@ RES_RATIO = 5.0  # fullbatch_mode.cpp:239
 def _emit_tile_record(ti, res_0, res_1, mean_nu, info, minutes,
                       bubble_s=None, overlap=None):
     """Per-solve-interval convergence record (gated on an active tracer
-    so the extra device->host syncs never run otherwise). ``bubble_s``
-    / ``overlap`` are the overlapped-execution accounting pair: host
-    seconds blocked on data movement for this tile, and the prefetch
-    depth it ran under (0 = synchronous reference loop)."""
+    / metrics registry so the extra device->host syncs never run
+    otherwise). ``bubble_s`` / ``overlap`` are the overlapped-execution
+    accounting pair: host seconds blocked on data movement for this
+    tile, and the prefetch depth it ran under (0 = synchronous
+    reference loop)."""
+    if not (dtrace.active() or obs.active()):
+        return
+    trips = lm_mod.executed_trips(info)
+    if obs.active():
+        obs.inc("tiles_solved_total")
+        if bubble_s is not None:
+            obs.inc("tile_bubble_seconds_total", float(bubble_s))
+        for k, v in trips.items():
+            obs.inc(f"solver_{k}_total", v)
     if not dtrace.active():
         return
     rec = dict(tile=ti, res_0=res_0, res_1=res_1, mean_nu=mean_nu,
@@ -63,10 +74,11 @@ def _emit_tile_record(ti, res_0, res_1, mean_nu, info, minutes,
     if bubble_s is not None:
         rec["bubble_s"] = float(bubble_s)
         rec["overlap"] = int(overlap or 0)
-    # host-driver extras (the sharded solver reports only residuals)
+    # host-driver extras (the sharded solver reports only residuals);
+    # the trace schema keeps its original two trip fields
     for k in ("solver_iters", "lbfgs_iters"):
-        if isinstance(info, dict) and k in info:
-            rec[k] = int(np.asarray(info[k]).sum())
+        if k in trips:
+            rec[k] = trips[k]
     dtrace.emit("tile", **rec)
 
 
@@ -639,6 +651,7 @@ class FullBatchPipeline:
         writer-thread job under overlap (``bg=True``) or inline on the
         synchronous path; the "write" phase covers fetch + disk so the
         sync attribution shows the full data-movement stall."""
+        t_write = time.perf_counter()
         with dtrace.phase("write", tile=ti, bg=bg):
             n_rows = tile.x.shape[0]
             # fetch through float64: numpy-side r2c on ml_dtypes bf16
@@ -649,6 +662,7 @@ class FullBatchPipeline:
             # are sliced off before the MS sees them
             tile.x = x[:n_rows]
             self.ms.write_tile(ti, tile)
+        obs.observe("tile_write_seconds", time.perf_counter() - t_write)
 
     def _run_batched(self, write_residuals, solution_path, max_tiles, log,
                      prefetch=None):
@@ -705,8 +719,10 @@ class FullBatchPipeline:
                 # input; the ring keeps overlapped staging from ever
                 # aliasing an in-flight donated buffer
                 ring.stage(ti, jnp.asarray(utils.c2r(tile.x), self.sdt))
+            dur = time.perf_counter() - t_stage
             dtrace.emit("phase", name="stage", tile=ti,
-                        dur_s=time.perf_counter() - t_stage, bg=depth > 0)
+                        dur_s=dur, bg=depth > 0)
+            obs.observe("tile_stage_seconds", dur)
             return out
 
         def post(stg, res_0, res_1, mean_nu, Jnew, minutes):
@@ -763,6 +779,7 @@ class FullBatchPipeline:
                                  J_r8, stg["beam"], tile_idx=stg["ti"])
             dtrace.emit("phase", name="solve", tile=stg["ti"],
                         dur_s=time.time() - t0)
+            obs.observe("tile_solve_seconds", time.time() - t0)
             state["first"] = False
             post(stg, float(info["res_0"]), float(info["res_1"]),
                  float(info["mean_nu"]),
@@ -798,6 +815,13 @@ class FullBatchPipeline:
             mnu = np.asarray(info["mean_nu"])
             dtrace.emit("phase", name="solve", tiles=T,
                         dur_s=time.time() - t0)
+            if obs.active():
+                # one amortized observation PER TILE, so the histogram
+                # count stays equal to tiles_solved_total under
+                # --tile-batch too
+                dur = (time.time() - t0) / T
+                for _ in range(T):
+                    obs.observe("tile_solve_seconds", dur)
             minutes = (time.time() - t0) / 60.0 / T
             for t, stg in enumerate(group):
                 post(stg, float(r0[t]), float(r1[t]), float(mnu[t]),
@@ -1035,9 +1059,10 @@ class TileStepper:
             # program (ring: no read-after-donate, no aliasing)
             x_r = tile.x if not pad else pcache.pad_rows_zero(tile.x, pad)
             self.ring.stage(ti, jnp.asarray(utils.c2r(x_r), p.sdt))
+        dur = time.perf_counter() - t_stage
         dtrace.emit("phase", name="stage", tile=ti,
-                    dur_s=time.perf_counter() - t_stage,
-                    bg=self.depth > 0)
+                    dur_s=dur, bg=self.depth > 0)
+        obs.observe("tile_stage_seconds", dur)
         return stg
 
     # -- device-owner half --------------------------------------------------
@@ -1066,6 +1091,8 @@ class TileStepper:
         self.J = utils.jones_r2c_np(np.asarray(Jd_r8))
         dtrace.emit("phase", name="solve", tile=ti,
                     dur_s=time.perf_counter() - t_solve)
+        obs.observe("tile_solve_seconds",
+                    time.perf_counter() - t_solve)
 
         # divergence reset (fullbatch_mode.cpp:605-621)
         if res_1 == 0.0 or not np.isfinite(res_1) or (

@@ -42,6 +42,7 @@ import threading
 import time
 
 from sagecal_tpu.diag import trace as dtrace
+from sagecal_tpu.obs import metrics as obs
 
 
 def start_host_copy(*arrays) -> None:
@@ -126,8 +127,10 @@ class Prefetcher:
                 item = self.fn(i)
                 # the background production time — NOT the consumer's
                 # io wait; tagged bg so attribution stays honest
+                dur = time.perf_counter() - t0
                 dtrace.emit("phase", name=self.name, tile=i,
-                            dur_s=time.perf_counter() - t0, bg=True)
+                            dur_s=dur, bg=True)
+                obs.observe("prefetch_read_seconds", dur)
                 if not self._put((i, item)):
                     return
         except BaseException as e:      # surface in the consumer
@@ -266,7 +269,14 @@ class AsyncWriter:
             return 0.0
         t0 = time.perf_counter()
         self._q.put((fn, args, kwargs))
-        return time.perf_counter() - t0
+        wait = time.perf_counter() - t0
+        if wait > 1e-3:
+            # writer backpressure: the producer outran the disk and
+            # blocked on a full queue — bubble time for the caller and
+            # an SLO signal for the serve daemon. The 1 ms floor keeps
+            # the lock-free fast path (sub-µs put) out of the counter.
+            obs.inc("writer_backpressure_seconds_total", wait)
+        return wait
 
     def drain(self) -> float:
         """Block until every submitted job ran; returns the wait."""

@@ -37,6 +37,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--diag", default=None, metavar="PATH",
                    help="server-level JSONL trace (per-job traces come "
                         "from each submit's 'trace' field)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve Prometheus /metrics and /healthz over "
+                        "HTTP on 127.0.0.1:PORT (0 = ephemeral; "
+                        "default: no HTTP endpoint — the JSON-lines "
+                        "'metrics'/'metrics_full' ops always work)")
     p.add_argument("--platform", default=None,
                    help="force the jax platform (e.g. 'cpu')")
     return p
@@ -55,7 +61,8 @@ def main(argv=None) -> int:
     from sagecal_tpu.serve.api import Server
     srv = Server(socket_path=args.socket, port=args.port,
                  max_inflight=args.max_inflight,
-                 max_staged_bytes=args.max_staged_bytes)
+                 max_staged_bytes=args.max_staged_bytes,
+                 metrics_port=args.metrics_port)
     # graceful drain on SIGTERM/SIGINT: finish in-flight tiles, flush
     # writers, refuse new submissions, exit when idle
     signal.signal(signal.SIGTERM, lambda *a: srv.drain())

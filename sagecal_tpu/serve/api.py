@@ -16,11 +16,23 @@ op           request fields / reply
 ``cancel``   ``job_id``; queued cancels now, running at its next tile
              boundary (reply carries the state observed)
 ``metrics``  queue depths, compile-cache hits/misses/hit_rate,
-             device-busy fraction, tiles/jobs done
+             device-busy fraction, tiles/jobs done, last-progress
+             watermark, unhealthy jobs
+``metrics_full``  the ``metrics`` payload PLUS the full obs registry
+             dump: every counter/gauge, and per-job SLO histograms
+             (queue-wait / run / end-to-end latency) with
+             p50/p90/p99 readout (obs/metrics.py)
 ``drain``    refuse new submissions, finish accepted jobs, then exit;
              ``wait: true`` blocks the reply until drained
 ``ping``     liveness
 ===========  ==============================================================
+
+HTTP observability (``metrics_port=`` / ``--metrics-port``): a stdlib
+HTTP listener on localhost serving ``GET /metrics`` (Prometheus text
+format — the same registry, scrapeable by stock tooling) and ``GET
+/healthz`` (JSON: queue depth, device-busy fraction, last-progress
+watermark, stalled/diverging jobs; HTTP 200 healthy / 503 degraded).
+Point-in-time gauges are refreshed from the scheduler on each scrape.
 
 SIGTERM == ``drain``: in-flight tiles finish, writers flush, new
 submissions are refused, the process exits when idle (MIGRATION.md
@@ -36,10 +48,13 @@ import os
 import socket
 import socketserver
 import threading
+import time
 import uuid
 
 from sagecal_tpu.config import (BeamMode, RunConfig, SimulationMode,
                                 SolverMode)
+from sagecal_tpu.obs import export as oexport
+from sagecal_tpu.obs import metrics as ometrics
 from sagecal_tpu.serve import queue as jq
 from sagecal_tpu.serve.scheduler import Scheduler
 
@@ -79,15 +94,22 @@ class Server:
 
     def __init__(self, socket_path: str | None = None,
                  port: int | None = None, max_inflight: int = 2,
-                 max_staged_bytes: int = 2 << 30, log=print):
+                 max_staged_bytes: int = 2 << 30, log=print,
+                 metrics_port: int | None = None):
         if (socket_path is None) == (port is None):
             raise ValueError("exactly one of socket_path/port")
         self.socket_path = socket_path
         self.port = port
         self.log = log
+        # the daemon is the production surface: the obs registry is
+        # always live here (solo CLI runs keep the disabled default —
+        # MIGRATION.md "Observability")
+        self.registry = ometrics.enable()
         self.queue = jq.JobQueue(max_inflight=max_inflight,
                                  max_staged_bytes=max_staged_bytes)
         self.scheduler = Scheduler(self.queue, log=log)
+        self.metrics_port = metrics_port
+        self._obs_http = None
         self._drained = threading.Event()
         self._sched_thread = threading.Thread(
             target=self._run_scheduler, name="device-owner", daemon=True)
@@ -116,8 +138,12 @@ class Server:
                 # tenant's device, and --diag installs (then closes)
                 # the process tracer, killing server-level tracing —
                 # per-job tracing is the submit 'trace' field.
+                # --metrics joins the ban for the same reason as
+                # --diag: it would dump-and-DISABLE the daemon's
+                # process registry when the job ends
                 argv = [str(a) for a in req["mpi_argv"]]
-                banned = {"--platform", "--cpu-devices", "--diag"}
+                banned = {"--platform", "--cpu-devices", "--diag",
+                          "--metrics"}
                 bad = sorted(banned & {a.split("=", 1)[0] for a in argv})
                 if bad:
                     raise ValueError(
@@ -156,12 +182,71 @@ class Server:
             return {"ok": True, "state": state}
         if op == "metrics":
             return {"ok": True, "metrics": self.scheduler.metrics()}
+        if op == "metrics_full":
+            # scheduler snapshot + the full registry dump (counters,
+            # gauges, per-job SLO histograms with p50/p90/p99); ONE
+            # snapshot feeds both views so they cannot disagree
+            m = self._refresh_gauges()
+            return {"ok": True, "metrics": m,
+                    "registry": self.registry.dump(),
+                    "health": self.healthz(m)}
         if op == "drain":
             self.drain()
             if req.get("wait"):
                 self._drained.wait()
             return {"ok": True, "draining": True}
         raise ValueError(f"unknown op {op!r}")
+
+    # -- observability (obs/export.py endpoint) -----------------------------
+
+    def _refresh_gauges(self) -> dict:
+        """Fold the scheduler's point-in-time snapshot into registry
+        gauges (runs per scrape / metrics_full request, so pull-style
+        readers always see fresh depths); returns the snapshot."""
+        m = self.scheduler.metrics()
+        for state in (jq.QUEUED, jq.RUNNING, jq.DONE, jq.FAILED,
+                      jq.CANCELLED):
+            ometrics.set_gauge("serve_jobs", float(m[state]),
+                               state=state)
+        ometrics.set_gauge("serve_staged_bytes", m["staged_bytes"])
+        ometrics.set_gauge("serve_device_busy_frac",
+                           m["device_busy_frac"])
+        ometrics.set_gauge("serve_program_cache_hit_rate",
+                           m["hit_rate"])
+        ometrics.set_gauge("serve_last_progress_age_seconds",
+                           max(0.0, time.time() - m["last_progress_t"]))
+        ometrics.set_gauge("serve_unhealthy_jobs",
+                           float(len(m["unhealthy_jobs"])))
+        return m
+
+    def render_metrics(self) -> str:
+        self._refresh_gauges()
+        return oexport.render_prometheus(self.registry)
+
+    def healthz(self, m: dict | None = None) -> dict:
+        """Liveness/degradation snapshot. ``unhealthy_jobs`` lists
+        every running stalled/diverging job (visible BEFORE the job
+        burns its tile budget), but ``status`` degrades — and the
+        HTTP endpoint answers 503 — only on DIVERGING
+        (obs/health.DEGRADED): a converged job's flat residual reads
+        stalled by construction and must not page the LB probe.
+        ``m``: an already-taken scheduler snapshot to reuse."""
+        from sagecal_tpu.obs import health as ohealth
+        if m is None:
+            m = self.scheduler.metrics()
+        unhealthy = m["unhealthy_jobs"]
+        degraded = any(j["health"] in ohealth.DEGRADED
+                       for j in unhealthy)
+        return {
+            "status": "degraded" if degraded else "ok",
+            "queued": m[jq.QUEUED], "running": m[jq.RUNNING],
+            "device_busy_frac": m["device_busy_frac"],
+            "last_progress_t": m["last_progress_t"],
+            "last_progress_age_s":
+                max(0.0, time.time() - m["last_progress_t"]),
+            "unhealthy_jobs": unhealthy,
+            "draining": self.queue.draining,
+        }
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -209,6 +294,12 @@ class Server:
             target=self._srv.serve_forever,
             kwargs={"poll_interval": 0.1}, name="accept", daemon=True)
         self._accept_thread.start()
+        if self.metrics_port is not None:
+            self._obs_http = oexport.ObsHTTPServer(
+                self.metrics_port, self.render_metrics, self.healthz)
+            self.metrics_port = self._obs_http.port
+            self.log(f"observability: /metrics and /healthz on "
+                     f"127.0.0.1:{self.metrics_port}")
         self._sched_thread.start()
 
     def serve_forever(self) -> None:
@@ -219,6 +310,9 @@ class Server:
             self.close()
 
     def close(self) -> None:
+        if self._obs_http is not None:
+            self._obs_http.close()
+            self._obs_http = None
         if self._srv is not None:
             self._srv.shutdown()
             self._srv.server_close()
@@ -275,6 +369,12 @@ class Client:
 
     def metrics(self) -> dict:
         return self.request(op="metrics")["metrics"]
+
+    def metrics_full(self) -> dict:
+        """Scheduler snapshot + registry dump + health (the full
+        observability payload; registry histograms carry p50/p90/p99)."""
+        r = self.request(op="metrics_full")
+        return {k: r[k] for k in ("metrics", "registry", "health")}
 
     def drain(self, wait: bool = False) -> None:
         self.request(op="drain", wait=wait)

@@ -32,7 +32,8 @@ sliced off before write-back. Geometry rows repeat real rows (finite
 uvw, in-range station indices); data/weight rows are zero.
 
 Layering: numpy + stdlib only — the cache stores jax callables
-opaquely and never imports jax.
+opaquely and never imports jax (obs.metrics, the hit/miss counter
+sink, is itself stdlib-only and a no-op unless a registry is live).
 """
 
 from __future__ import annotations
@@ -43,6 +44,8 @@ import threading
 from collections import OrderedDict
 
 import numpy as np
+
+from sagecal_tpu.obs import metrics as obs
 
 # -- content tokens ---------------------------------------------------------
 
@@ -130,9 +133,11 @@ class ProgramCache:
         with self._lock:
             if key in self._d:
                 self.hits += 1
+                obs.inc("serve_program_cache_hits_total")
                 self._d.move_to_end(key)
                 return self._d[key]
             self.misses += 1
+            obs.inc("serve_program_cache_misses_total")
             val = build()
             self._d[key] = val
             while len(self._d) > self.maxsize:

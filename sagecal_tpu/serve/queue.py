@@ -23,8 +23,10 @@ Fail-stop isolation: a job that raises (an MS-write failure surfacing
 at its next tile boundary, PR 5 writer semantics) moves to ``failed``
 with the original traceback recorded; its neighbours never see it.
 
-Layering: stdlib only. The scheduler drives the transitions; the API
-layer only reads snapshots and submits/cancels.
+Layering: stdlib only (obs.metrics — the per-job SLO histograms and
+admission counters — is itself stdlib-only and no-op when disabled).
+The scheduler drives the transitions; the API layer only reads
+snapshots and submits/cancels.
 """
 
 from __future__ import annotations
@@ -33,6 +35,16 @@ import itertools
 import threading
 import time
 import traceback
+
+from sagecal_tpu.obs import metrics as obs
+
+#: bucket ladder for the per-job SLO histograms (queue-wait / run /
+#: end-to-end): JOB scale, 100 ms .. 24 h — a production calibration
+#: job runs minutes to hours, and the registry's default 600 s latency
+#: ladder would clamp every such job into the +Inf bucket, pinning
+#: p50/p90/p99 at 600 no matter how long jobs actually take
+JOB_SLO_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0,
+                   1800.0, 3600.0, 7200.0, 14400.0, 43200.0, 86400.0)
 
 QUEUED = "queued"
 RUNNING = "running"
@@ -70,6 +82,12 @@ class Job:
         #   (the estimate opens the dataset header — once per job,
         #   never per scheduler-loop iteration)
         self.history: list = []           # per-tile convergence records
+        # live convergence health (obs/health.py): the scheduler folds
+        # the job's per-tile residual stream into ok|stalled|diverging;
+        # None until the first solved tile (opaque jobs stay None)
+        self.health: str | None = None
+        self.health_detail: dict | None = None
+        self._adm_deferred = False        # budget-deferral counted once
 
     def snapshot(self) -> dict:
         """JSON-serializable status row (the api `status` reply)."""
@@ -85,6 +103,10 @@ class Job:
             # debugging a failed tenant job gets the failing frames,
             # not just the exception type
             "error_tb": self.error_tb,
+            # live convergence health annotation: a stalled/diverging
+            # job is visible from `status` BEFORE it burns its budget
+            "health": self.health,
+            "health_detail": self.health_detail,
         }
 
 
@@ -95,6 +117,15 @@ class JobQueue:
                  max_staged_bytes: int = 2 << 30):
         self.max_inflight = max(1, int(max_inflight))
         self.max_staged_bytes = int(max_staged_bytes)
+        # declare the SLO histograms at job-scale buckets BEFORE the
+        # first observe (declaration is first-wins); no-op when the
+        # registry is disabled — the server enables it first
+        reg = obs.get()
+        if reg is not None:
+            for name in ("serve_job_queue_wait_seconds",
+                         "serve_job_run_seconds",
+                         "serve_job_e2e_seconds"):
+                reg.histogram(name, buckets=JOB_SLO_BUCKETS)
         self._jobs: dict[str, Job] = {}
         self._order = itertools.count()   # FIFO tiebreak within priority
         self._seq: dict[str, int] = {}
@@ -106,11 +137,16 @@ class JobQueue:
     def submit(self, job: Job) -> Job:
         with self._lock:
             if self._draining:
+                obs.inc("serve_admission_rejections_total",
+                        reason="draining")
                 raise RuntimeError("server is draining; submission refused")
             if job.job_id in self._jobs:
+                obs.inc("serve_admission_rejections_total",
+                        reason="duplicate_id")
                 raise ValueError(f"duplicate job id {job.job_id!r}")
             self._jobs[job.job_id] = job
             self._seq[job.job_id] = next(self._order)
+            obs.inc("serve_jobs_submitted_total")
             return job
 
     def get(self, job_id: str) -> Job:
@@ -157,8 +193,10 @@ class JobQueue:
         with self._lock:
             job = self._jobs[job_id]
             if job.state == QUEUED:
-                job.state = CANCELLED
-                job.finished_t = time.time()
+                # same terminal accounting as the scheduler-side
+                # finish(): the SLO histograms / jobs_total counters
+                # and q.counts() must agree on every path
+                self._finish_locked(job, CANCELLED)
             elif job.state == RUNNING:
                 job.cancel_requested = True
             return job.state
@@ -188,10 +226,19 @@ class JobQueue:
                 if job.est_bytes is None:
                     job.est_bytes = int(est_bytes_fn(job))
                 if running and used + job.est_bytes > self.max_staged_bytes:
+                    if not job._adm_deferred:
+                        # counted once per job, not once per scheduler
+                        # pass: the SLO question is "how many jobs hit
+                        # the budget wall", not how often we re-polled
+                        job._adm_deferred = True
+                        obs.inc("serve_admission_deferrals_total",
+                                reason="staged_bytes")
                     return None
                 job.staged_bytes = job.est_bytes
                 job.state = RUNNING
                 job.started_t = time.time()
+                obs.observe("serve_job_queue_wait_seconds",
+                            job.started_t - job.submitted_t)
                 return job
             return None
 
@@ -199,12 +246,24 @@ class JobQueue:
 
     def finish(self, job: Job, state: str,
                exc: BaseException | None = None) -> None:
-        assert state in TERMINAL, state
         with self._lock:
-            job.state = state
-            job.finished_t = time.time()
-            job.staged_bytes = 0
-            if exc is not None:
-                job.error = f"{type(exc).__name__}: {exc}"
-                job.error_tb = "".join(traceback.format_exception(
-                    type(exc), exc, exc.__traceback__))
+            self._finish_locked(job, state, exc)
+
+    def _finish_locked(self, job: Job, state: str,
+                       exc: BaseException | None = None) -> None:
+        assert state in TERMINAL, state
+        job.state = state
+        job.finished_t = time.time()
+        job.staged_bytes = 0
+        if exc is not None:
+            job.error = f"{type(exc).__name__}: {exc}"
+            job.error_tb = "".join(traceback.format_exception(
+                type(exc), exc, exc.__traceback__))
+        # per-job SLO latency histograms: run (device-owner time)
+        # and end-to-end (submit -> terminal, the tenant's view)
+        obs.inc("serve_jobs_total", state=state)
+        if job.started_t is not None:
+            obs.observe("serve_job_run_seconds",
+                        job.finished_t - job.started_t)
+        obs.observe("serve_job_e2e_seconds",
+                    job.finished_t - job.submitted_t)

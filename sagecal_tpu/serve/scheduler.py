@@ -32,6 +32,7 @@ as one OPAQUE unit: correct and isolated, but not tile-interleaved
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
@@ -39,19 +40,41 @@ import numpy as np
 
 from sagecal_tpu import sched
 from sagecal_tpu.diag import trace as dtrace
+from sagecal_tpu.obs import health as ohealth
+from sagecal_tpu.obs import metrics as obs
 from sagecal_tpu.serve import cache as pcache
 from sagecal_tpu.serve import queue as jq
+
+
+def job_telemetry_ctx(tracer, job_id):
+    """Zero-arg factory for ONE job's telemetry context: routes the
+    entering thread's diag emits to the job tracer (``dtrace.scope``)
+    and labels its obs metric emissions with the owning job
+    (``obs.scope_labels``). The SAME factory serves the device-owner
+    thread around a step, the job's reader thread (Prefetcher
+    ``context=``), and its writer thread (TileStepper ``trace_ctx=``)
+    — one definition, so per-job attribution cannot drift between the
+    three thread roles (the satellite-1 regression class: a refactor
+    that scopes one role and not the others)."""
+    @contextlib.contextmanager
+    def ctx():
+        with dtrace.scope(tracer), obs.scope_labels(job=job_id):
+            yield
+    return ctx
 
 
 class _RunningJob:
     """Scheduler-side live state of one running fullbatch job."""
 
-    def __init__(self, job, pipe, stepper, prefetcher, tracer):
+    def __init__(self, job, pipe, stepper, prefetcher, tracer, ctx):
         self.job = job
         self.pipe = pipe
         self.stepper = stepper
         self.pf = prefetcher
         self.tracer = tracer
+        self.ctx = ctx                  # per-job telemetry context
+        # live convergence health over the per-tile residual stream
+        self.health = ohealth.ConvergenceHealth()
 
     def teardown(self, raise_pending: bool = False):
         self.pf.close()
@@ -108,6 +131,10 @@ class Scheduler:
         self.busy_s = 0.0
         self.tiles_done = 0
         self.jobs_done = 0
+        # last-progress watermark: wall time of the most recent
+        # completed tile / opaque job (the /healthz liveness signal —
+        # a wedged device stops moving it while the loop stays alive)
+        self.last_progress_t = self.t0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -124,8 +151,17 @@ class Scheduler:
         out.update(wall_s=wall, busy_s=self.busy_s,
                    device_busy_frac=(self.busy_s / wall) if wall else 0.0,
                    tiles_done=self.tiles_done, jobs_done=self.jobs_done,
-                   running=len(self._running))
+                   running=len(self._running),
+                   last_progress_t=self.last_progress_t,
+                   unhealthy_jobs=self.unhealthy_jobs())
         return out
+
+    def unhealthy_jobs(self) -> list:
+        """RUNNING jobs whose convergence health is stalled/diverging
+        (the /healthz degradation signal)."""
+        return [{"job_id": j.job_id, "health": j.health}
+                for j in self.q.jobs()
+                if j.state == jq.RUNNING and j.health in ohealth.UNHEALTHY]
 
     # -- job start ----------------------------------------------------------
 
@@ -143,17 +179,22 @@ class Scheduler:
         if job.trace_path:
             tracer = dtrace.Tracer(job.trace_path, entry="serve",
                                    job=job.job_id)
-        ctx = (lambda: dtrace.scope(tracer))
-        with dtrace.scope(tracer):
-            # opaque kinds — plus fullbatch with tile_batch > 1: the
-            # batched driver's warm start is BATCH-granular, so
-            # running such a job through the sequential stepper would
-            # silently produce different (non-CLI-identical) output;
-            # pipeline.run dispatches to the same driver the CLI uses
-            if (job.kind in ("stochastic", "sim", "mpi")
-                    or int(getattr(cfg, "tile_batch", 1) or 1) > 1):
-                self._run_opaque(job, tracer)
-                return None
+        # ONE per-job context factory for every thread role (device-
+        # owner, reader, writer) — entered here so the pipeline build
+        # and opaque run bodies attribute to the job too
+        ctx = job_telemetry_ctx(tracer, job.job_id)
+        # opaque kinds — plus fullbatch with tile_batch > 1: the
+        # batched driver's warm start is BATCH-granular, so
+        # running such a job through the sequential stepper would
+        # silently produce different (non-CLI-identical) output;
+        # pipeline.run dispatches to the same driver the CLI uses.
+        # Dispatched OUTSIDE ctx: the queue's terminal transitions
+        # (finish -> SLO histograms) must aggregate un-labeled
+        if (job.kind in ("stochastic", "sim", "mpi")
+                or int(getattr(cfg, "tile_batch", 1) or 1) > 1):
+            self._run_opaque(job, tracer, ctx)
+            return None
+        with ctx():
             ms = ds.open_dataset(cfg.ms, cfg.ms_list,
                                  tilesz=cfg.tile_size,
                                  data_column=cfg.input_column,
@@ -177,47 +218,56 @@ class Scheduler:
             pf = sched.Prefetcher(produce, st.n_tiles, depth=st.depth,
                                   name=f"job-{job.job_id}", context=ctx,
                                   ready_event=self._ready)
-        return _RunningJob(job, pipe, st, pf, tracer)
+        return _RunningJob(job, pipe, st, pf, tracer, ctx)
 
-    def _run_opaque(self, job, tracer) -> None:
+    def _run_opaque(self, job, tracer, ctx) -> None:
         """Stochastic / simulation / mpi / tile-batch jobs: the
         existing whole-run drivers as one opaque, isolated unit on the
         device-owner thread. An opaque job has no tile boundary the
         scheduler owns, so a cancel arriving AFTER this point cannot
         take effect until the run completes (documented limitation,
         MIGRATION.md "Service mode"); one arriving before it is
-        honoured here."""
+        honoured here. Only the run BODY enters the per-job telemetry
+        context; the queue's terminal transitions stay outside it so
+        the SLO histograms aggregate un-labeled, same as the
+        tile-interleaved path."""
         t0 = time.perf_counter()
         try:
             if job.cancel_requested:
                 self.q.finish(job, jq.CANCELLED)
                 return
             cfg = job.cfg
-            if job.kind == "mpi":
-                # the consensus interval loop, reused verbatim as a
-                # job (cli_mpi.main owns its own diag/--platform flags)
-                from sagecal_tpu import cli_mpi
-                rc = cli_mpi.main(job.argv)
-                if rc:
-                    raise RuntimeError(f"cli_mpi exited rc={rc}")
-            elif job.kind == "stochastic":
-                from sagecal_tpu import stochastic
-                if cfg.n_admm > 1 and cfg.channel_avg_per_band > 1:
-                    job.history = stochastic.run_minibatch_consensus(
-                        cfg, log=self._job_log(job)) or []
+            with ctx():
+                if job.kind == "mpi":
+                    # the consensus interval loop, reused verbatim as
+                    # a job (cli_mpi.main owns its own diag/--platform
+                    # flags)
+                    from sagecal_tpu import cli_mpi
+                    rc = cli_mpi.main(job.argv)
+                    if rc:
+                        raise RuntimeError(f"cli_mpi exited rc={rc}")
+                elif job.kind == "stochastic":
+                    from sagecal_tpu import stochastic
+                    if cfg.n_admm > 1 and cfg.channel_avg_per_band > 1:
+                        job.history = \
+                            stochastic.run_minibatch_consensus(
+                                cfg, log=self._job_log(job)) or []
+                    else:
+                        job.history = stochastic.run_minibatch(
+                            cfg, log=self._job_log(job)) or []
                 else:
-                    job.history = stochastic.run_minibatch(
-                        cfg, log=self._job_log(job)) or []
-            else:
-                from sagecal_tpu import pipeline
-                pipeline.run(cfg, log=self._job_log(job))
+                    from sagecal_tpu import pipeline
+                    pipeline.run(cfg, log=self._job_log(job))
             self.q.finish(job, jq.DONE)
             self.jobs_done += 1
         except BaseException as e:
             self.q.finish(job, jq.FAILED, exc=e)
             self.log(f"[{job.job_id}] FAILED: {job.error}")
         finally:
-            self.busy_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.busy_s += dt
+            self.last_progress_t = time.time()
+            obs.inc("serve_device_busy_seconds_total", dt)
             if tracer is not None:
                 tracer.close()
 
@@ -293,18 +343,31 @@ class Scheduler:
                     progressed = True
                     break
                 try:
-                    with dtrace.scope(rj.tracer):
+                    with rj.ctx():
                         r = rj.pf.poll()
                         if r is sched.Prefetcher.EMPTY:
                             break
-                        if r is sched.Prefetcher.DONE:
-                            self._finish(rj, jq.DONE)
-                            progressed = True
-                            break
-                        ti, (tile, stg), wait = r
-                        t0 = time.perf_counter()
-                        rj.stepper.step(ti, tile, stg, wait)
-                        self.busy_s += time.perf_counter() - t0
+                        if r is not sched.Prefetcher.DONE:
+                            ti, (tile, stg), wait = r
+                            t0 = time.perf_counter()
+                            rec = rj.stepper.step(ti, tile, stg, wait)
+                            dt = time.perf_counter() - t0
+                            self.busy_s += dt
+                    if r is sched.Prefetcher.DONE:
+                        # outside the job label scope: the queue's SLO
+                        # histograms (run / e2e latency) aggregate
+                        # across jobs un-labeled
+                        self._finish(rj, jq.DONE)
+                        progressed = True
+                        break
+                    # live convergence health: fold this tile's final
+                    # residual into the job's stall/divergence monitor
+                    # and annotate the job for status/healthz readers
+                    job.health = rj.health.update(rec["res_1"])
+                    job.health_detail = rj.health.snapshot()
+                    self.last_progress_t = time.time()
+                    obs.inc("serve_device_busy_seconds_total", dt)
+                    obs.inc("serve_tiles_done_total", job=job.job_id)
                     job.tiles_done += 1
                     self.tiles_done += 1
                     progressed = True

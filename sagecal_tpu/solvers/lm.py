@@ -33,9 +33,33 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from sagecal_tpu import dtypes as dtp
 from sagecal_tpu.solvers import normal_eq as ne
+
+#: executed-iteration counters a solver info dict may carry; the keys
+#: the host-side telemetry (diag tile records, obs trip counters, the
+#: bench's trip-corrected roofline) reads through executed_trips()
+TRIP_KEYS = ("solver_iters", "cg_iters", "lbfgs_iters",
+             "rejected_groups")
+
+
+def executed_trips(info) -> dict:
+    """Host-side executed-trip totals from a solver ``info`` dict.
+
+    Sums each :data:`TRIP_KEYS` entry present (device arrays fetch
+    here — callers gate on ``dtrace.active() or obs.active()``, so the
+    telemetry-off path never pays the sync). One definition shared by
+    the tile-record emitter and the obs counters, so "trips" can never
+    mean two different things in two readouts."""
+    out = {}
+    if not isinstance(info, dict):
+        return out
+    for k in TRIP_KEYS:
+        if k in info:
+            out[k] = int(np.asarray(info[k]).sum())
+    return out
 
 
 class LMConfig(NamedTuple):

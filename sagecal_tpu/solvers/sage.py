@@ -51,6 +51,7 @@ import jax.numpy as jnp
 from sagecal_tpu import dtypes as dtp
 from sagecal_tpu.config import SolverMode
 from sagecal_tpu.diag import trace as dtrace
+from sagecal_tpu.obs import metrics as obs
 from sagecal_tpu.solvers import lbfgs as lbfgs_mod
 from sagecal_tpu.solvers import lm as lm_mod
 from sagecal_tpu.solvers import normal_eq as ne
@@ -1127,14 +1128,20 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                 _FUSION_CACHE[fuse_key] = fused
                 _learned("fuse", fuse_key, fused)
         total = jnp.sum(nerr_acc)
-        if dtrace.active():
+        if dtrace.active() or obs.active():
             # convergence record per EM sweep; the float()/int() syncs
-            # are behind the active() gate so disabled runs pay nothing
-            dtrace.emit("em_sweep", sweep=ci,
-                        wall_s=time.perf_counter() - t_sweep,
+            # are behind the active() gates so disabled runs pay nothing
+            sweep_wall = time.perf_counter() - t_sweep
+            trips = int(tk_total[0])
+            err_red = float(total)
+            dtrace.emit("em_sweep", sweep=ci, wall_s=sweep_wall,
                         fused=bool(ran_fused), groups=int(Gi),
-                        err_reduction=float(total),
-                        solver_iters=int(tk_total[0]))
+                        err_reduction=err_red, solver_iters=trips)
+            if obs.active():
+                obs.inc("solver_sweeps_total")
+                obs.observe("em_sweep_seconds", sweep_wall)
+                obs.set_gauge("em_sweep_err_reduction", err_red)
+                obs.set_gauge("em_sweep_solver_iters", trips)
         # normalization stays on device (the float(total) sync here was
         # a per-sweep dispatch stall — jaxlint host-sync); same guarded
         # formula as the tiles driver below
@@ -1427,12 +1434,18 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
                 _FUSION_CACHE[fuse_key] = fused
                 _learned("fuse", fuse_key, fused)
         total = jnp.sum(nerr_acc, axis=1, keepdims=True)
-        if dtrace.active():
-            dtrace.emit("em_sweep", sweep=ci,
-                        wall_s=time.perf_counter() - t_sweep,
+        if dtrace.active() or obs.active():
+            sweep_wall = time.perf_counter() - t_sweep
+            trips = int(jnp.sum(tk_total[:, 0]))
+            err_red = float(jnp.sum(total))
+            dtrace.emit("em_sweep", sweep=ci, wall_s=sweep_wall,
                         fused=bool(ran_fused), groups=int(Gi), tiles=T,
-                        err_reduction=float(jnp.sum(total)),
-                        solver_iters=int(jnp.sum(tk_total[:, 0])))
+                        err_reduction=err_red, solver_iters=trips)
+            if obs.active():
+                obs.inc("solver_sweeps_total")
+                obs.observe("em_sweep_seconds", sweep_wall)
+                obs.set_gauge("em_sweep_err_reduction", err_red)
+                obs.set_gauge("em_sweep_solver_iters", trips)
         nerr = jnp.where(total > 0, nerr_acc / jnp.maximum(total, 1e-30),
                          nerr_acc)
 

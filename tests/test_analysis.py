@@ -283,6 +283,58 @@ def test_hostsync_gated_and_cold_path_clean(tmp_path):
     assert f == []
 
 
+def test_hostsync_obs_gate_blessed_ungated_metric_flagged(tmp_path):
+    """ISSUE 9 metrics-era twins: obs.active()-gated emission is the
+    same blessed pattern as dtrace.active() (obs/metrics.py keeps the
+    identical no-op-when-disabled contract), INCLUDING the combined
+    ``dtrace.active() or obs.active()`` BoolOp gate — while an
+    un-gated per-iteration metric read in a solver loop stays a
+    finding."""
+    # positive twin: un-gated float(jnp...) feeding a metric observe
+    f, _ = _lint(tmp_path, """
+    def sweep(xs, obs):
+        for x in xs:
+            obs.observe("residual", float(jnp.sum(x)))
+    """)
+    assert _rules(f) == ["host-sync"]
+    # clean twin: the obs.active() gate
+    f, _ = _lint(tmp_path, """
+    def sweep(xs, obs):
+        for x in xs:
+            if obs.active():
+                obs.observe("residual", float(jnp.sum(x)))
+    """)
+    assert f == []
+    # clean twin: the combined gate the instrumented emit sites use
+    # (solvers/sage.py, consensus/admm.py)
+    f, _ = _lint(tmp_path, """
+    def sweep(xs, obs, dtrace):
+        for x in xs:
+            if dtrace.active() or obs.active():
+                v = float(jnp.sum(x))
+                dtrace.emit("em_sweep", err=v)
+                obs.set_gauge("err", v)
+    """)
+    assert f == []
+    # a BoolOp mixing an active() gate with a NON-gate must not bless
+    f, _ = _lint(tmp_path, """
+    def sweep(xs, obs, verbose):
+        for x in xs:
+            if obs.active() or verbose:
+                obs.observe("residual", float(jnp.sum(x)))
+    """)
+    assert _rules(f) == ["host-sync"]
+
+
+def test_obs_package_is_hot_path_scope():
+    """ISSUE 9: obs/ joined the hot-path scope — the metrics layer
+    runs inside every loop it instruments, so an un-gated device read
+    there is exactly as costly as one in the loop itself."""
+    assert core.is_hot_path("sagecal_tpu/obs/metrics.py")
+    assert core.is_hot_path("sagecal_tpu/obs/health.py")
+    assert not core.is_hot_path("sagecal_tpu/tools/fits.py")
+
+
 def test_hostsync_block_in_loop_flagged_async_readback_blessed(tmp_path):
     """ISSUE 5 overlap contract: a per-iteration block_until_ready in
     a hot host loop is a finding, while the BLESSED async-readback API

@@ -366,6 +366,105 @@ def test_overlap_stats_math():
     assert st2["bubble_s"] == 1.0       # io 0.25 + write 0.75
 
 
+# ---------------------------------------------------------------------------
+# scope-stack thread-locality + per-job obs attribution (ISSUE 9 sat. 2)
+# ---------------------------------------------------------------------------
+
+def test_scope_stacks_strictly_thread_local(tmp_path):
+    """The metrics-era contract pinned in trace.py: a dtrace.scope
+    entered on one thread changes NOTHING about any other thread's
+    routing — not the main thread's, and not a thread spawned WHILE
+    the scope is live (threading.local starts empty per thread)."""
+    import threading
+
+    trace.enable(str(tmp_path / "proc.jsonl"))
+    trA = trace.Tracer(str(tmp_path / "a.jsonl"))
+    trB = trace.Tracer(str(tmp_path / "b.jsonl"))
+    inner_tracer = []
+    barrier = threading.Barrier(2, timeout=10)
+
+    def worker(tr, name):
+        with trace.scope(tr):
+            barrier.wait()        # both scopes live simultaneously
+            trace.emit("tile", tile=0, who=name)
+            if name == "a":
+                # a thread spawned inside a live scope must NOT
+                # inherit it: it sees the process tracer
+                t = threading.Thread(
+                    target=lambda: inner_tracer.append(trace.get()))
+                t.start()
+                t.join()
+
+    ths = [threading.Thread(target=worker, args=(trA, "a")),
+           threading.Thread(target=worker, args=(trB, "b"))]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    # the main thread never saw a scope
+    assert trace.get() is not None and trace.get().path.endswith(
+        "proc.jsonl")
+    trace.emit("tile", tile=0, who="main")
+    trA.close()
+    trB.close()
+    trace.disable()
+
+    for path, who in ((tmp_path / "a.jsonl", "a"),
+                      (tmp_path / "b.jsonl", "b"),
+                      (tmp_path / "proc.jsonl", "main")):
+        tiles = [r for r in trace.read(str(path)) if r["ev"] == "tile"]
+        assert [r["who"] for r in tiles] == [who], (path, tiles)
+    # the spawned-inside-a-scope thread resolved the PROCESS tracer
+    assert len(inner_tracer) == 1
+    assert inner_tracer[0].path.endswith("proc.jsonl")
+
+
+def test_obs_emission_in_scoped_thread_attributes_to_job(tmp_path):
+    """obs metric emission inside a job-scoped thread attributes to
+    the owning job (scope_labels keeps the same thread-local stack
+    semantics as dtrace.scope); the serve scheduler's ONE context
+    factory (job_telemetry_ctx) installs both scopes together."""
+    import threading
+
+    from sagecal_tpu.obs import metrics as ometrics
+    from sagecal_tpu.serve.scheduler import job_telemetry_ctx
+
+    reg = ometrics.enable()
+    try:
+        trA = trace.Tracer(str(tmp_path / "ja.jsonl"))
+        ctxA = job_telemetry_ctx(trA, "job-a")
+        ctxB = job_telemetry_ctx(None, "job-b")
+        barrier = threading.Barrier(2, timeout=10)
+
+        def worker(ctx, n):
+            with ctx():
+                barrier.wait()
+                for _ in range(n):
+                    ometrics.inc("tiles_solved_total")
+                trace.emit("tile", tile=0)
+
+        ths = [threading.Thread(target=worker, args=(ctxA, 2)),
+               threading.Thread(target=worker, args=(ctxB, 3))]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        # unscoped main-thread emission: no job label
+        ometrics.inc("tiles_solved_total")
+        c = reg.get("tiles_solved_total")
+        assert c.value(job="job-a") == 2.0
+        assert c.value(job="job-b") == 3.0
+        assert c.value() == 1.0
+        # and the trace records went ONLY to job A's tracer (job B has
+        # none; the process tracer is off in this test)
+        trA.close()
+        tiles = [r for r in trace.read(str(tmp_path / "ja.jsonl"))
+                 if r["ev"] == "tile"]
+        assert len(tiles) == 1
+    finally:
+        ometrics.disable()
+
+
 def test_cli_legacy_flag_warning(capsys):
     from sagecal_tpu import cli
 

@@ -42,6 +42,17 @@ P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6
 P1A 1 20 0 38 0 0 2.5 0 0 0 0 0 0 0 0 150e6
 """
 
+
+@pytest.fixture(autouse=True)
+def _fresh_obs_registry():
+    """Server() enables the process-global obs registry; give every
+    test a clean slate and never leak a live registry (and its
+    accumulated per-job series) into other test modules."""
+    from sagecal_tpu.obs import metrics as ometrics
+    ometrics.disable()
+    yield
+    ometrics.disable()
+
 CLUSTER = """\
 0 1 P0A
 1 2 P1A
@@ -368,6 +379,115 @@ def test_serve_cancel_and_graceful_drain(tmp_path, server):
         assert snapA["state"] == jq.DONE       # accepted work finished
         assert snapA["tiles_done"] == 3
         c.request(op="drain", wait=True)       # drained: queue idle
+
+
+def test_serve_metrics_surface_and_health(tmp_path):
+    """ISSUE 9 serve metrics surface: after one job through a server
+    with ``metrics_port``, (a) ``metrics_full`` carries per-job SLO
+    latency percentiles and job-attributed solve histograms, (b) GET
+    /metrics serves Prometheus text with the expected series, (c) GET
+    /healthz answers 200 ok — and flips to 503 degraded when an
+    injected stalled job is present, BEFORE that job completes."""
+    import http.client
+    import json as _json
+
+    srv = Server(port=0, max_inflight=2, metrics_port=0)
+    srv.start()
+    try:
+        msA, skyf, clusf = _make_dataset(tmp_path, "ma.ms", seed=11)
+        base = _base_config(skyf, clusf)
+        with Client(port=srv.port) as c:
+            ja = c.submit(dict(base, ms=msA))
+            snap = c.wait(ja, timeout_s=300)
+            assert snap["state"] == jq.DONE
+            # status carries the live health annotation (satellite c)
+            assert snap["health"] == "ok"
+            assert snap["health_detail"]["observations"] == 3
+
+            full = c.metrics_full()
+            reg = full["registry"]
+            # per-job SLO histograms with percentile readout
+            e2e = reg["serve_job_e2e_seconds"]["series"][""]
+            assert e2e["count"] == 1 and e2e["p50"] is not None
+            qw = reg["serve_job_queue_wait_seconds"]["series"][""]
+            assert qw["count"] == 1
+            assert reg["serve_jobs_total"]["series"]["state=done"] == 1
+            assert reg["serve_jobs_submitted_total"]["series"][""] == 1
+            # per-tile solve latency ATTRIBUTED to the owning job (the
+            # scheduler's job_telemetry_ctx label scope)
+            solve = reg["tile_solve_seconds"]["series"][f"job={ja}"]
+            assert solve["count"] == 3
+            assert reg["serve_tiles_done_total"]["series"][
+                f"job={ja}"] == 3
+            assert full["health"]["status"] == "ok"
+            assert full["metrics"]["last_progress_t"] > 0
+
+        def get(path):
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv.metrics_port, timeout=10)
+            conn.request("GET", path)
+            r = conn.getresponse()
+            body = r.read().decode()
+            conn.close()
+            return r.status, body
+
+        # Prometheus text format golden (stock-tooling scrapeable)
+        code, text = get("/metrics")
+        assert code == 200
+        assert "# TYPE sagecal_serve_jobs_total counter" in text
+        assert 'sagecal_serve_jobs_total{state="done"} 1' in text
+        assert "# TYPE sagecal_serve_job_e2e_seconds histogram" in text
+        assert 'sagecal_serve_job_e2e_seconds_bucket{le="+Inf"} 1' \
+            in text
+        # SLO histograms use JOB-scale buckets (hours, not the 600 s
+        # latency ladder — percentiles must not clamp for real jobs)
+        assert 'sagecal_serve_job_e2e_seconds_bucket{le="86400"} 1' \
+            in text
+        assert 'sagecal_tile_solve_seconds_bucket{job="' in text
+        assert "sagecal_serve_program_cache_hit_rate" in text
+        assert "sagecal_serve_last_progress_age_seconds" in text
+
+        code, body = get("/healthz")
+        h = _json.loads(body)
+        assert code == 200 and h["status"] == "ok"
+        assert h["queued"] == 0 and h["running"] == 0
+        assert h["last_progress_age_s"] >= 0.0
+
+        # inject a stalled RUNNING job: flagged unhealthy (listed in
+        # unhealthy_jobs, health annotation visible) while the job is
+        # still mid-flight — but /healthz stays 200: a converged
+        # job's flat residual reads stalled by construction, so
+        # stalled is advisory, never a page (obs/health.DEGRADED)
+        # state set BEFORE submit: the live scheduler keeps admitting,
+        # and a briefly-QUEUED cfg=None job could be popped and failed
+        # in the window (submit never inspects state)
+        bad = jq.Job("stalled-job", cfg=None)
+        bad.state = jq.RUNNING
+        srv.queue.submit(bad)
+        from sagecal_tpu.obs import health as ohealth
+        mon = ohealth.ConvergenceHealth(patience=2)
+        for res in (5.0, 5.0, 5.0):        # flat residual stream
+            bad.health = mon.update(res)
+        assert bad.health == "stalled"
+        code, body = get("/healthz")
+        h = _json.loads(body)
+        assert code == 200 and h["status"] == "ok"
+        assert h["unhealthy_jobs"] == [
+            {"job_id": "stalled-job", "health": "stalled"}]
+        # a DIVERGING residual stream is the alarm: 503 before the
+        # job burns its tile budget
+        bad.health = mon.update(5.0 * 5.0 + 1.0)
+        assert bad.health == "diverging"
+        code, body = get("/healthz")
+        h = _json.loads(body)
+        assert code == 503 and h["status"] == "degraded"
+        assert {"job_id": "stalled-job", "health": "diverging"} \
+            in h["unhealthy_jobs"]
+        srv.queue.finish(bad, jq.CANCELLED)   # let the drain go idle
+        code, body = get("/healthz")
+        assert code == 200
+    finally:
+        srv.stop()
 
 
 @pytest.mark.slow

@@ -59,9 +59,12 @@ RULES = {
 # job's solve, so a sync or a use-after-donate there taxes all tenants;
 # obs/ joined in ISSUE 9 — the metrics layer runs inside every hot
 # loop it instruments, so an un-gated device read there would tax
-# exactly the paths it exists to observe)
+# exactly the paths it exists to observe; faults.py joined in ISSUE 10
+# — the injection/retry layer wraps every I/O seam's hot loop, and its
+# ``faults.active()`` gate is blessed alongside ``dtrace.active()`` /
+# ``obs.active()`` by _is_active_gate's ``.active`` suffix match)
 _HOT_SEGMENTS = ("solvers", "consensus", "rime", "serve", "obs")
-_HOT_BASENAMES = ("pipeline.py", "sched.py")
+_HOT_BASENAMES = ("pipeline.py", "sched.py", "faults.py")
 
 
 def is_hot_path(relpath: str) -> bool:
@@ -428,11 +431,13 @@ class ModuleCtx:
     @staticmethod
     def _is_active_gate(test) -> bool:
         """A blessed telemetry-gate test: ``<mod>.active()`` — the
-        diag tracer's ``dtrace.active()`` AND the obs registry's
-        ``obs.active()`` (obs/metrics.py keeps the identical contract)
-        — or a BoolOp combining only such calls (``dtrace.active() or
-        obs.active()``: the body still executes only when telemetry is
-        on, so its syncs never run on the disabled path)."""
+        diag tracer's ``dtrace.active()``, the obs registry's
+        ``obs.active()``, and the fault harness's ``faults.active()``
+        (obs/metrics.py and faults.py keep the identical
+        no-op-when-disabled contract) — or a BoolOp combining only
+        such calls (``dtrace.active() or obs.active()``: the body
+        still executes only when telemetry is on, so its syncs never
+        run on the disabled path)."""
         if isinstance(test, ast.Call):
             return (dotted(test.func) or "").endswith(".active")
         if isinstance(test, ast.BoolOp):

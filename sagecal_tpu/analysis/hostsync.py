@@ -7,8 +7,9 @@ stay fast by keeping the device queue full; one stray ``.item()`` or
 ``dtrace.active()`` gate around the telemetry emits — that gate is the
 blessed pattern and such blocks are exempt here; ``obs.active()``
 keeps the identical no-op-when-disabled contract for the metrics
-registry, and a BoolOp of such gates — ``dtrace.active() or
-obs.active()`` — gates the same way, see ModuleCtx._is_active_gate).
+registry, ``faults.active()`` for the fault-injection harness, and a
+BoolOp of such gates — ``dtrace.active() or obs.active()`` — gates
+the same way, see ModuleCtx._is_active_gate).
 Two scopes:
 
 - inside TRACED bodies, any host-crossing call is a bug outright:

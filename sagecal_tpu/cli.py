@@ -89,6 +89,18 @@ def build_parser() -> argparse.ArgumentParser:
            "(sagecal_tpu.serve compile cache; 0 = exact shapes, "
            "-1 = next power of two; outputs are bit-identical to any "
            "solo run at the SAME bucket)")
+    a("--resume", action="store_true",
+      help="re-enter a killed/failed run from its tile-boundary "
+           "checkpoint (the <solutions>.ckpt.npz sidecar next to -p): "
+           "completed tiles are skipped and the final residuals + "
+           "solutions are bit-identical to an uninterrupted run "
+           "(sequential fullbatch driver; MIGRATION.md 'Fault "
+           "tolerance'). No checkpoint = start fresh")
+    a("--faults", default=None, metavar="SPEC",
+      help="deterministic fault-injection plan (sagecal_tpu.faults): "
+           "a JSON list of rules, {'seed':..,'rules':[..]}, or a path/"
+           "@path to a file holding either — chaos testing only; "
+           "absent = zero cost, bit-identical")
     a("--prefetch", type=int, default=1, metavar="N",
       help="overlapped execution depth (sagecal_tpu.sched): read + "
            "host-prepare tile t+N on a background thread while tile t "
@@ -202,6 +214,7 @@ def config_from_args(args) -> RunConfig:
         dtype_policy=args.dtype_policy,
         tile_bucket=args.tile_bucket,
         prefetch=args.prefetch,
+        resume=bool(args.resume),
         shard_baselines=bool(args.shard_baselines))
 
 
@@ -229,6 +242,9 @@ def main(argv=None) -> int:
     if args.metrics:
         from sagecal_tpu.obs import metrics as ometrics
         ometrics.enable()
+    if args.faults:
+        from sagecal_tpu import faults
+        faults.enable_spec(args.faults)
 
     from sagecal_tpu import pipeline
     try:

@@ -145,6 +145,10 @@ def build_parser() -> argparse.ArgumentParser:
       help="enable the obs metrics registry for this run and dump it "
            "as JSON to PATH at exit (ADMM consensus residual gauges, "
            "latency histograms; sagecal_tpu.obs.metrics)")
+    a("--faults", default=None, metavar="SPEC",
+      help="deterministic fault-injection plan (sagecal_tpu.faults; "
+           "JSON rules or a path to them) — chaos testing of the "
+           "interval loop's read/write seams; absent = zero cost")
     return p
 
 
@@ -193,6 +197,9 @@ def main(argv=None) -> int:
                       argv=list(argv) if argv is not None else sys.argv[1:])
     if args.metrics:
         obs.enable()
+    if args.faults:
+        from sagecal_tpu import faults
+        faults.enable_spec(args.faults)
     try:
         return _main_consensus(args, dtrace)
     finally:

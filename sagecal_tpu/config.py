@@ -170,6 +170,13 @@ class RunConfig:
     # the OS-subset partition, so outputs are bit-identical to a solo
     # run AT THE SAME BUCKET (MIGRATION.md "Service mode")
     tile_bucket: int = 0
+    # --resume : re-enter a killed/failed/deadline-expired run from
+    # its tile-boundary checkpoint (the <solutions>.ckpt.npz sidecar
+    # written next to -p): completed tiles are skipped and the final
+    # residuals + solutions are bit-identical to an uninterrupted run
+    # (sequential fullbatch driver only; MIGRATION.md "Fault
+    # tolerance"). No checkpoint found = start fresh.
+    resume: bool = False
     # --prefetch : overlapped execution depth (sagecal_tpu.sched).
     # N>0: tile t+N is read + host-prepared on a background thread
     # while tile t solves, and residual/solution writes run on an

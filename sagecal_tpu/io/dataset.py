@@ -26,6 +26,8 @@ import os
 
 import numpy as np
 
+from sagecal_tpu import faults
+
 C_M_S = 299792458.0
 OMEGA_E = 7.2921150e-5  # earth angular velocity rad/s
 
@@ -355,6 +357,9 @@ class SimMS:
         return self.meta["n_tiles"]
 
     def read_tile(self, i: int) -> VisTile:
+        # ms_read: the transient-read chaos seam (sagecal_tpu.faults);
+        # recovery lives in the caller's retry layer (sched.Prefetcher)
+        faults.inject("ms_read", key=i)
         z = np.load(os.path.join(self.path, f"tile{i:05d}.npz"))
         key = self._col_key(self.data_column)
         if key not in z.files:
@@ -379,6 +384,10 @@ class SimMS:
         ``out_column``). Any other data columns already stored in the
         tile file are preserved (Data::writeData writes only OutField,
         data.cpp:1259)."""
+        # ms_write: the transient-write chaos seam; the write below is
+        # write-then-rename atomic, so the AsyncWriter retry layer can
+        # safely re-run this whole method
+        faults.inject("ms_write", key=i)
         key = self._col_key(column or self.out_column)
         kw = {}
         path = os.path.join(self.path, f"tile{i:05d}.npz")

@@ -13,12 +13,26 @@ The 8 reals per station map to the 2x2 Jones as
 ``[S0+jS1, S4+jS5; S2+jS3, S6+jS7]``.
 
 This text file doubles as the framework's checkpoint/warm-start state
-(``-p`` / ``-q``), exactly as in the reference.
+(``-p`` / ``-q``), exactly as in the reference — but NOT bit-exactly:
+the ``%e`` text format truncates mantissas, so resuming a killed run
+from it could never reproduce an uninterrupted run bit for bit. The
+tile-boundary checkpoint lives in a binary sidecar instead
+(:func:`save_checkpoint` / :func:`load_checkpoint`,
+``<solutions>.ckpt.npz``): the tile watermark, the full-precision
+warm-start Jones chain, divergence-reset bookkeeping, and the
+solutions file's valid byte length — everything a ``resume=true``
+resubmission needs to skip completed tiles and produce bit-identical
+outputs (MIGRATION.md "Fault tolerance").
 """
 
 from __future__ import annotations
 
+import json
+import os
+
 import numpy as np
+
+from sagecal_tpu import faults
 
 
 def jones_to_columns(J: np.ndarray, nchunk: np.ndarray) -> np.ndarray:
@@ -97,10 +111,27 @@ class SolutionWriter:
                          f"{interval_min:f} {n_stations} {n_clusters} "
                          f"{n_eff_clusters}\n")
 
+    @classmethod
+    def open_resume(cls, path: str, n_stations: int) -> "SolutionWriter":
+        """Reopen an existing solutions file for APPENDING (the
+        checkpoint/resume path): the header and the completed
+        intervals' blocks are already on disk — the caller truncated
+        the file to the checkpoint's byte watermark first — so this
+        writer only appends the remaining intervals."""
+        w = cls.__new__(cls)
+        w.f = open(path, "a")
+        w.n_stations = n_stations
+        return w
+
     def _write_cols(self, cols: np.ndarray) -> None:
-        for r in range(cols.shape[0]):
-            vals = " ".join(f"{x:e}" for x in cols[r])
-            self.f.write(f"{r} {vals}\n")
+        # solutions_write: the chaos seam fires BEFORE any byte lands,
+        # and the block goes down as ONE write call — so the
+        # AsyncWriter transient-retry layer re-runs an injected
+        # failure without duplicating rows
+        faults.inject("solutions_write")
+        self.f.write("".join(
+            f"{r} " + " ".join(f"{x:e}" for x in cols[r]) + "\n"
+            for r in range(cols.shape[0])))
         self.f.flush()
 
     def write_interval(self, J: np.ndarray, nchunk: np.ndarray) -> None:
@@ -205,3 +236,60 @@ def read_solutions(path: str, nchunk: np.ndarray):
             f"solution file {path!r} ends mid-interval "
             f"({len(rows)}/{n8} rows); truncated checkpoint?")
     return header, blocks
+
+
+# ---------------------------------------------------------------------------
+# tile-boundary checkpoint sidecar (resume=true)
+# ---------------------------------------------------------------------------
+
+def checkpoint_path(solution_path: str) -> str:
+    """The binary checkpoint sidecar next to a solutions file."""
+    return solution_path + ".ckpt.npz"
+
+
+def save_checkpoint(path: str, *, tile: int, J: np.ndarray, first: bool,
+                    res_prev: float | None, inflight: int,
+                    sol_bytes: int, meta: dict) -> None:
+    """Persist one tile boundary's resumable state, atomically
+    (write-then-rename, like ``SimMS.write_tile``): a kill between
+    checkpoints can only lose whole tiles, never corrupt one.
+
+    Written on the job's ordered writer thread AFTER the tile's
+    solution/residual writes, so the watermark only ever covers tiles
+    whose outputs durably landed. ``J`` is the full-precision
+    warm-start chain (the text solutions file is lossy); ``sol_bytes``
+    is the solutions file's valid length at the watermark — resume
+    truncates a possibly-further-written file back to it; ``meta``
+    identifies the run shape so a mismatched resume is refused."""
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, J=np.asarray(J, np.complex128), tile=int(tile),
+             first=int(bool(first)),
+             res_prev=np.float64(np.nan if res_prev is None
+                                 else res_prev),
+             inflight=int(inflight), sol_bytes=int(sol_bytes),
+             meta=json.dumps(meta, sort_keys=True))
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str, expect_meta: dict | None = None):
+    """Load a checkpoint sidecar -> state dict, or None when absent.
+    With ``expect_meta``, every given key must match the stored run
+    identity — resuming a job against a different dataset/sky/solver
+    shape must fail loudly, not warm-start garbage."""
+    if not os.path.exists(path):
+        return None
+    with np.load(path) as z:
+        meta = json.loads(str(z["meta"]))
+        if expect_meta is not None:
+            for k, v in expect_meta.items():
+                if meta.get(k) != v:
+                    raise ValueError(
+                        f"checkpoint {path!r} was written by a "
+                        f"different run: {k}={meta.get(k)!r} vs "
+                        f"expected {v!r}")
+        rp = float(z["res_prev"])
+        return dict(tile=int(z["tile"]), J=np.array(z["J"]),
+                    first=bool(int(z["first"])),
+                    res_prev=None if np.isnan(rp) else rp,
+                    inflight=int(z["inflight"]),
+                    sol_bytes=int(z["sol_bytes"]), meta=meta)

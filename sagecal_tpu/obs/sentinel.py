@@ -16,11 +16,14 @@ CHANGES.md has enforced "no silent regression" by hand ever since the
   occurrence is compared against the most recent earlier round that
   carried it, so a PR that banks a regressed round fails CI at the
   bank, before anyone reads the table.
-- **Live probes** — fast in-process re-measurements of the two
+- **Live probes** — fast in-process re-measurements of the three
   structural metrics that can rot without any bank being written:
   the overlap machinery still overlaps (``sched`` primitives hide a
-  producer behind a consumer) and the serve program cache still
-  shares (a second bucket-compatible pipeline adds ZERO compiles).
+  producer behind a consumer), the serve program cache still shares
+  (a second bucket-compatible pipeline adds ZERO compiles), and the
+  fault-injection layer stays compile-free (a run under an inert
+  fault plan adds ZERO compiles — the faults-off zero-cost
+  contract, ISSUE 10).
 - **Full mode** (no ``--fast``) — additionally re-runs the fast bench
   configs (:data:`RERUN_CONFIGS`) through bench.py's subprocess
   driver and compares the fresh numbers against the bank.
@@ -239,58 +242,69 @@ def probe_overlap() -> list:
     return []
 
 
-def probe_cache(workdir: str | None = None) -> list:
-    """The serve program cache still shares: a second bucket-compatible
-    pipeline over a tiny synthetic dataset must add ZERO compiles and
-    land only cache hits (the tests/test_serve.py gate, portable to a
-    bare ``--fast`` run outside pytest)."""
+def _mini_pipeline_env(tmp):
+    """A tiny synthetic calibration environment shared by the live
+    probes: returns ``(make_ms, run_pipe)`` over a one-source sky in
+    ``tmp`` — small enough that a probe run is seconds, real enough
+    that it exercises the whole staged-solve-residual chain."""
     import math
-    import tempfile
 
     import numpy as np
     import jax.numpy as jnp
 
     from sagecal_tpu import pipeline, skymodel
-    from sagecal_tpu.diag import guard
     from sagecal_tpu.io import dataset as ds
     from sagecal_tpu.rime import predict as rp
-    from sagecal_tpu.serve import cache as pcache
     from sagecal_tpu.serve.api import config_from_dict
 
+    sky_path = os.path.join(tmp, "sky.txt")
+    with open(sky_path, "w") as f:
+        f.write("P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6\n")
+    clus_path = sky_path + ".cluster"
+    with open(clus_path, "w") as f:
+        f.write("0 1 P0A\n")
+    ra0 = (41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(sky_path, ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(clus_path))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jt = ds.random_jones(1, sky.nchunk, 5, seed=5, scale=0.1)
+
+    def make_ms(name, seed):
+        tiles = [ds.simulate_dataset(
+            dsky, n_stations=5, tilesz=2,
+            freqs=np.array([150e6]), ra0=ra0, dec0=dec0, jones=Jt,
+            nchunk=sky.nchunk, noise_sigma=0.01, seed=seed)]
+        msdir = os.path.join(tmp, name)
+        ds.SimMS.create(msdir, tiles)
+        return msdir
+
+    def run_pipe(msdir):
+        cfg = config_from_dict(dict(
+            ms=msdir, sky_model=sky_path, cluster_file=clus_path,
+            solver_mode=0, max_em_iter=1, max_iter=2, max_lbfgs=0,
+            tile_size=2, solve_fuse="on", solve_promote="off"))
+        ms = ds.SimMS(msdir)
+        pipe = pipeline.FullBatchPipeline(cfg, ms, sky,
+                                          log=lambda *a: None)
+        pipe.run(log=lambda *a: None)
+
+    return make_ms, run_pipe
+
+
+def probe_cache(workdir: str | None = None) -> list:
+    """The serve program cache still shares: a second bucket-compatible
+    pipeline over a tiny synthetic dataset must add ZERO compiles and
+    land only cache hits (the tests/test_serve.py gate, portable to a
+    bare ``--fast`` run outside pytest)."""
+    import tempfile
+
+    from sagecal_tpu.diag import guard
+    from sagecal_tpu.serve import cache as pcache
+
     with tempfile.TemporaryDirectory(dir=workdir) as tmp:
-        sky_path = os.path.join(tmp, "sky.txt")
-        with open(sky_path, "w") as f:
-            f.write("P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6\n")
-        clus_path = sky_path + ".cluster"
-        with open(clus_path, "w") as f:
-            f.write("0 1 P0A\n")
-        ra0 = (41 / 60) * math.pi / 12
-        dec0 = 40 * math.pi / 180
-        srcs = skymodel.parse_sky_model(sky_path, ra0, dec0, 150e6)
-        sky = skymodel.build_cluster_sky(
-            srcs, skymodel.parse_cluster_file(clus_path))
-        dsky = rp.sky_to_device(sky, jnp.float64)
-        Jt = ds.random_jones(1, sky.nchunk, 5, seed=5, scale=0.1)
-
-        def make_ms(name, seed):
-            tiles = [ds.simulate_dataset(
-                dsky, n_stations=5, tilesz=2,
-                freqs=np.array([150e6]), ra0=ra0, dec0=dec0, jones=Jt,
-                nchunk=sky.nchunk, noise_sigma=0.01, seed=seed)]
-            msdir = os.path.join(tmp, name)
-            ds.SimMS.create(msdir, tiles)
-            return msdir
-
-        def run_pipe(msdir):
-            cfg = config_from_dict(dict(
-                ms=msdir, sky_model=sky_path, cluster_file=clus_path,
-                solver_mode=0, max_em_iter=1, max_iter=2, max_lbfgs=0,
-                tile_size=2, solve_fuse="on", solve_promote="off"))
-            ms = ds.SimMS(msdir)
-            pipe = pipeline.FullBatchPipeline(cfg, ms, sky,
-                                             log=lambda *a: None)
-            pipe.run(log=lambda *a: None)
-
+        make_ms, run_pipe = _mini_pipeline_env(tmp)
         # both datasets simulated BEFORE the guard: simulate_dataset
         # compiles its own programs per call and is not under test
         ms_a, ms_b = make_ms("a.ms", 11), make_ms("b.ms", 50)
@@ -314,6 +328,39 @@ def probe_cache(workdir: str | None = None) -> list:
                      "msg": "probe/cache: second pipeline produced no "
                             "program-cache hits"})
     return viol
+
+
+def probe_faults(workdir: str | None = None) -> list:
+    """The fault-injection layer's zero-cost contract (ISSUE 10):
+    with a LIVE-but-inert fault plan installed (rules that never
+    match), a calibration run must add ZERO compiles — the injection
+    seams are host-side and may never reach a traced body. Probed
+    live because no bank records it and a regression (a seam moved
+    inside jit) would silently retrace every tenant's solve."""
+    import tempfile
+
+    from sagecal_tpu import faults
+    from sagecal_tpu.diag import guard
+
+    with tempfile.TemporaryDirectory(dir=workdir) as tmp:
+        make_ms, run_pipe = _mini_pipeline_env(tmp)
+        ms_a, ms_b = make_ms("fa.ms", 11), make_ms("fb.ms", 50)
+        run_pipe(ms_a)                         # warm: compiles allowed
+        faults.enable([{"point": "ms_read", "at": [10 ** 9]}])
+        try:
+            with guard.CompileGuard() as g:
+                run_pipe(ms_b)
+        finally:
+            faults.disable()
+    if g.compiles:
+        return [{"config": "probe", "metric": "cache",
+                 "field": "compiles", "live": float(g.compiles),
+                 "banked": 0.0, "limit": 0.0, "source": "probe",
+                 "msg": (f"probe/faults: a run under an inert fault "
+                         f"plan added {g.compiles} compiles — the "
+                         "faults-off/inert path is no longer "
+                         "compile-free")}]
+    return []
 
 
 # ---------------------------------------------------------------------------
@@ -402,6 +449,7 @@ def main(argv=None) -> int:
     if not args.no_probes:
         viol.extend(probe_overlap())
         viol.extend(probe_cache())
+        viol.extend(probe_faults())
     if args.json:
         print(json.dumps(viol, indent=1))
     for v in viol:

@@ -21,13 +21,14 @@ static per dataset); host streams tiles and writes residuals back.
 
 from __future__ import annotations
 
+import os
 import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from sagecal_tpu import coords, dtypes as dtp, sched, skymodel, utils
+from sagecal_tpu import coords, dtypes as dtp, faults, sched, skymodel, utils
 from sagecal_tpu.config import RunConfig, SimulationMode, SolverMode
 from sagecal_tpu.serve import cache as pcache
 from sagecal_tpu.diag import trace as dtrace
@@ -626,22 +627,25 @@ class FullBatchPipeline:
             prefetch = getattr(self.cfg, "prefetch", 1)
         return max(0, int(prefetch))
 
-    def _tile_source(self, stage_fn, max_tiles, depth):
+    def _tile_source(self, stage_fn, max_tiles, depth, start=0):
         """Yield ``(ti, tile, staged, io_wait_s)`` with read + host
         staging running ``depth`` tiles ahead on a background thread
         (depth 0: inline — the synchronous reference path). The io
         wait is the consumer's bubble; the thread's own read+stage
-        time is emitted as a ``bg``-tagged "read" phase."""
+        time is emitted as a ``bg``-tagged "read" phase. ``start``:
+        first tile to produce (checkpoint resume skips completed
+        tiles); the produced payload carries the ABSOLUTE tile id."""
         n = self.ms.n_tiles
         if max_tiles is not None:
             n = min(n, max_tiles)
 
-        def produce(i):
+        def produce(j):
+            i = start + j
             tile = self.ms.read_tile(i)
-            return tile, stage_fn(i, tile)
+            return i, tile, stage_fn(i, tile)
 
-        for ti, (tile, stg), wait in sched.Prefetcher(produce, n,
-                                                      depth=depth):
+        for _j, (ti, tile, stg), wait in sched.Prefetcher(
+                produce, max(0, n - start), depth=depth):
             dtrace.emit("phase", name="io", tile=ti, dur_s=wait)
             yield ti, tile, stg, wait
 
@@ -653,6 +657,11 @@ class FullBatchPipeline:
         sync attribution shows the full data-movement stall."""
         t_write = time.perf_counter()
         with dtrace.phase("write", tile=ti, bg=bg):
+            # residual_fetch: the d->h readback chaos seam; this whole
+            # method runs as one idempotent writer job (pure fetch +
+            # atomic MS write), so the writer retry layer recovers a
+            # transient fault here
+            faults.inject("residual_fetch", key=ti)
             n_rows = tile.x.shape[0]
             # fetch through float64: numpy-side r2c on ml_dtypes bf16
             # arrays is not supported, and the MS stores complex128
@@ -758,10 +767,11 @@ class FullBatchPipeline:
                     # start the non-blocking device->host copy, hand
                     # fetch + MS write to the ordered writer thread
                     sched.start_host_copy(res_r)
-                    stg["bubble"] += aw.submit(
-                        self._write_residual_tile, ti, tile, res_r)
-                else:
-                    self._write_residual_tile(ti, tile, res_r, bg=False)
+                # depth 0 runs the same job inline through submit —
+                # one path, so the transient-retry layer covers both
+                stg["bubble"] += aw.submit(
+                    self._write_residual_tile, ti, tile, res_r,
+                    bg=depth > 0)
             log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
                 f"final={res_1:.6g}, Time spent={minutes:.3g} minutes, "
                 f"nu={mean_nu:.2f}")
@@ -850,17 +860,20 @@ class FullBatchPipeline:
 
     def stepper(self, write_residuals: bool = True, solution_path=None,
                 max_tiles=None, log=print, prefetch=None,
-                trace_ctx=None) -> "TileStepper":
+                trace_ctx=None, on_diverge: str = "reset") -> "TileStepper":
         """The sequential driver as a resumable per-tile unit: the
         serve scheduler owns ``stage``/``step``/``close`` and may
         interleave many jobs' tiles through one device while each
         job's warm-start/PRNG chain stays sequential inside its own
-        :class:`TileStepper`."""
+        :class:`TileStepper`. ``on_diverge``: the divergence policy —
+        "reset" (the reference's solution reset) or "quarantine" (keep
+        the last-good chain, flag the tile; serve jobs select it per
+        submission)."""
         return TileStepper(self, write_residuals=write_residuals,
                            solution_path=solution_path,
                            max_tiles=max_tiles, log=log,
                            depth=self._prefetch_depth(prefetch),
-                           trace_ctx=trace_ctx)
+                           trace_ctx=trace_ctx, on_diverge=on_diverge)
 
     def run(self, write_residuals: bool = True, solution_path=None,
             max_tiles=None, log=print, prefetch=None):
@@ -869,6 +882,11 @@ class FullBatchPipeline:
         across depths — only data movement overlaps; the warm-start
         solve chain stays sequential (tests/test_overlap.py)."""
         if getattr(self, "batch_ok", False):
+            if getattr(self.cfg, "resume", False):
+                # the batched driver's warm start is batch-granular;
+                # a tile-granular checkpoint cannot reproduce it
+                log("resume: unsupported on the --tile-batch driver; "
+                    "starting fresh")
             return self._run_batched(write_residuals, solution_path,
                                      max_tiles, log, prefetch)
         depth = self._prefetch_depth(prefetch)
@@ -887,7 +905,7 @@ class FullBatchPipeline:
             log(f"profiling first solve interval -> {prof_dir}")
         try:
             for ti, tile, stg, io_wait in self._tile_source(
-                    st.stage, max_tiles, depth):
+                    st.stage, max_tiles, depth, start=st.start_tile):
                 st.step(ti, tile, stg, io_wait)
                 if prof_live:
                     import jax.profiler
@@ -974,26 +992,84 @@ class TileStepper:
 
     def __init__(self, pipe: "FullBatchPipeline", write_residuals=True,
                  solution_path=None, max_tiles=None, log=print,
-                 depth: int = 0, trace_ctx=None):
+                 depth: int = 0, trace_ctx=None,
+                 on_diverge: str = "reset"):
+        if on_diverge not in ("reset", "quarantine"):
+            raise ValueError(f"on_diverge {on_diverge!r}: "
+                             "expected 'reset' or 'quarantine'")
         self.p = pipe
         self.log = log
         self.depth = int(depth)
         self.write_residuals = write_residuals
+        self.on_diverge = on_diverge
         ms, sky = pipe.ms, pipe.sky
         meta = ms.meta
         self.n_tiles = ms.n_tiles
         if max_tiles:
             self.n_tiles = min(self.n_tiles, int(max_tiles))
+        # tile-boundary checkpoint/resume (MIGRATION.md "Fault
+        # tolerance"): the sidecar lives next to the solutions file —
+        # no solutions file, no checkpoint. The identity meta refuses
+        # resuming against a different dataset/sky/solver shape.
+        self._ckpt_meta = dict(
+            n_tiles=int(self.n_tiles), n_stations=int(pipe.n),
+            n_clusters=int(sky.n_clusters), kmax=int(pipe.kmax),
+            tilesz=int(meta["tilesz"]))
+        self.ckpt_path = (sol.checkpoint_path(solution_path)
+                          if solution_path else None)
+        ck = None
+        if getattr(pipe.cfg, "resume", False):
+            if self.ckpt_path is None:
+                log("resume: no solutions file -> no checkpoint; "
+                    "starting fresh")
+            else:
+                ck = sol.load_checkpoint(self.ckpt_path,
+                                         expect_meta=self._ckpt_meta)
+                if ck is None:
+                    log("resume: no checkpoint found; starting fresh")
         self.writer = None
         if solution_path:
-            self.writer = sol.SolutionWriter(
-                solution_path, meta["freq0"], meta["fdelta"],
-                meta["tilesz"] * meta["tdelta"] / 60.0, pipe.n,
-                sky.n_clusters, sky.n_eff_clusters)
+            if ck is not None:
+                # a kill can land between a solution write and its
+                # checkpoint: truncate the file back to the byte
+                # watermark of the last CHECKPOINTED interval, then
+                # append — the final file is byte-identical to an
+                # uninterrupted run's
+                size = os.path.getsize(solution_path)
+                if size < ck["sol_bytes"]:
+                    raise ValueError(
+                        f"resume: {solution_path!r} is shorter "
+                        f"({size} B) than its checkpoint watermark "
+                        f"({ck['sol_bytes']} B); refusing to resume "
+                        "from inconsistent state")
+                with open(solution_path, "r+") as f:
+                    f.truncate(ck["sol_bytes"])
+                self.writer = sol.SolutionWriter.open_resume(
+                    solution_path, pipe.n)
+            else:
+                self.writer = sol.SolutionWriter(
+                    solution_path, meta["freq0"], meta["fdelta"],
+                    meta["tilesz"] * meta["tdelta"] / 60.0, pipe.n,
+                    sky.n_clusters, sky.n_eff_clusters)
         self.pinit = pipe.initial_jones()
         self.J = self.pinit.copy()
         self.first = True
         self.res_prev = None
+        self.start_tile = 0
+        if ck is not None:
+            # restore the EXACT chain state at the watermark: the
+            # warm-start Jones (full precision — the text file is
+            # lossy), the boost/reset flag, the divergence watermark,
+            # and a sticky inflight downgrade
+            self.start_tile = ck["tile"] + 1
+            self.J = ck["J"]
+            self.first = ck["first"]
+            self.res_prev = ck["res_prev"]
+            if ck["inflight"] < pipe.base_cfg.inflight:
+                pipe._inflight_downgrade(log)
+            log(f"resume: checkpoint at tile {ck['tile']}; skipping "
+                f"{self.start_tile}/{self.n_tiles} completed tiles")
+        self._last_tile = self.start_tile - 1
         self.history = []
         # donated-staging ring + ordered writer thread (sched): under
         # overlap the next tile reads + stages on a background thread
@@ -1049,6 +1125,10 @@ class TileStepper:
             # (fullbatch_mode.cpp applies whiten_data to the averaged x)
             from sagecal_tpu.solvers import robust as rb
             x8 = rb.whiten_data(x8, u, v, meta["freq0"])
+        # beam_stage: the beam-table staging chaos seam; it fires
+        # BEFORE the ring stages this tile's residual input below, so
+        # the reader-thread retry can safely re-run the whole stage
+        faults.inject("beam_stage", key=ti)
         stg = dict(u=u, v=v, w=w, x8=x8, flags=flags,
                    wt=lm_mod.make_weights(flags, p.sdt),
                    sta1=jnp.asarray(sta1_np),
@@ -1080,6 +1160,7 @@ class TileStepper:
         tile_beam = stg["beam"]
 
         solver = p._solve_first if self.first else p._solve_rest
+        J_prev = self.J          # the last-good chain (quarantine)
         J_r8 = jnp.asarray(utils.jones_c2r_np(self.J), p.rdt)
         t_solve = time.perf_counter()
         Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8,
@@ -1093,11 +1174,33 @@ class TileStepper:
                     dur_s=time.perf_counter() - t_solve)
         obs.observe("tile_solve_seconds",
                     time.perf_counter() - t_solve)
+        # solve_nan: the poisoned-tile chaos seam (a NaN/nonfinite
+        # residual drives the divergence policy below)
+        if faults.active() and faults.fires("solve_nan", key=ti):
+            res_1 = float("nan")
 
-        # divergence reset (fullbatch_mode.cpp:605-621)
-        if res_1 == 0.0 or not np.isfinite(res_1) or (
+        # divergence handling (fullbatch_mode.cpp:605-621): res_1 of
+        # exactly 0.0 means fully flagged data and always takes the
+        # reference reset; a genuinely divergent solve takes the
+        # configured policy
+        quarantined = False
+        diverged = res_1 == 0.0 or not np.isfinite(res_1) or (
                 self.res_prev is not None
-                and res_1 > RES_RATIO * self.res_prev):
+                and res_1 > RES_RATIO * self.res_prev)
+        if diverged and res_1 != 0.0 and self.on_diverge == "quarantine":
+            # quarantine: the poisoned solve never enters the chain —
+            # this tile's solutions/residuals come from the LAST-GOOD
+            # Jones, the divergence watermark and boost state stay
+            # untouched, and the tile is flagged in the diag trace
+            # instead of writing poisoned residuals
+            quarantined = True
+            log(f"tile {ti}: Quarantined (divergent solve "
+                f"res_1={res_1:.6g}; continuing from last-good "
+                "solutions)")
+            self.J = J_prev
+            obs.inc("tiles_quarantined_total")
+            dtrace.emit("quarantine", tile=ti, res_1=res_1)
+        elif diverged:
             log(f"tile {ti}: Resetting Solution")
             if res_1 != 0.0:   # zero = flagged data, not divergence
                 p._inflight_downgrade(log)
@@ -1127,21 +1230,46 @@ class TileStepper:
                     # non-blocking d->h copy now; fetch + MS
                     # write on the ordered writer thread
                     sched.start_host_copy(res_r)
-                    bubble += self.aw.submit(
-                        p._write_residual_tile, ti, tile, res_r)
-                else:
-                    p._write_residual_tile(ti, tile, res_r, bg=False)
+                # depth 0 runs the same job inline through submit —
+                # one path, so the transient-retry layer covers both
+                bubble += self.aw.submit(
+                    p._write_residual_tile, ti, tile, res_r,
+                    bg=self.depth > 0)
 
+        if self.writer and self.ckpt_path:
+            # checkpoint this tile boundary. Submitted to the SAME
+            # ordered writer queue AFTER the tile's solution/residual
+            # writes: the watermark can only ever name tiles whose
+            # outputs durably landed (a failed write skips every later
+            # job, checkpoint included — AsyncWriter fail-stop)
+            bubble += self.aw.submit(
+                self._save_checkpoint,
+                dict(tile=ti, J=self.J.copy(), first=self.first,
+                     res_prev=self.res_prev,
+                     inflight=int(p.base_cfg.inflight)))
+
+        self._last_tile = ti
         dt = (time.time() - t0) / 60.0
         log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
             f"final={res_1:.6g}, Time spent={dt:.3g} minutes, "
             f"nu={mean_nu:.2f}")
         rec = {"tile": ti, "res_0": res_0, "res_1": res_1,
                "mean_nu": mean_nu, "minutes": dt}
+        if quarantined:
+            rec["quarantined"] = True
         self.history.append(rec)
         _emit_tile_record(ti, res_0, res_1, mean_nu, info, dt,
                           bubble_s=bubble, overlap=self.depth)
         return rec
+
+    def _save_checkpoint(self, state: dict) -> None:
+        """Writer-thread half of the checkpoint: runs strictly after
+        this tile's writes, reads the solutions file's live byte
+        position (accurate — ``_write_cols`` flushed), and lands the
+        sidecar atomically."""
+        sol.save_checkpoint(self.ckpt_path,
+                            sol_bytes=self.writer.f.tell(),
+                            meta=self._ckpt_meta, **state)
 
     def _step_per_channel(self, ti, tile, stg, info):
         # -b 1: per-channel LBFGS re-solve + per-channel residual
@@ -1262,12 +1390,21 @@ class TileStepper:
         """Flush + close the job's writer thread and solution file.
         Re-raises a pending async-write failure (unless told not to —
         the scheduler's failed-job teardown path, where the failure
-        was already recorded and a second raise would mask cleanup)."""
+        was already recorded and a second raise would mask cleanup).
+        A COMPLETED run (every tile stepped, writes flushed clean)
+        removes its checkpoint sidecar; a failed/killed run keeps it —
+        that file IS the ``resume=true`` re-entry point."""
         try:
             self.aw.close(raise_pending=raise_pending)
         finally:
             if self.writer:
                 self.writer.close()
+        if raise_pending and self.ckpt_path \
+                and self._last_tile >= self.n_tiles - 1:
+            try:
+                os.remove(self.ckpt_path)
+            except OSError:
+                pass
 
 
 def run(cfg: RunConfig, log=print):

@@ -30,19 +30,42 @@ write jobs execute strictly in submission order; the warm-start solve
 chain stays sequential — only data movement overlaps. Memory cost is
 bounded: ``depth`` extra staged tiles plus the writer queue.
 
-Layering: stdlib + diag.trace only. Device arrays pass through
-opaquely; the non-blocking device->host copy (``copy_to_host_async``)
-is started by callers before submitting a fetch job here.
+Fault tolerance (MIGRATION.md "Fault tolerance"): producer calls and
+writer jobs run under ``faults.retry_transient`` — a transient
+read/write failure retries with bounded exponential backoff before
+the fail-stop paths above fire with the original traceback — and the
+``reader_thread``/``writer_thread`` injection points let the chaos
+harness kill either thread deterministically. Expired thread joins at
+close() are LOUD (stderr warning + ``thread_join_timeouts_total``).
+
+Layering: stdlib + faults + diag.trace only. Device arrays pass
+through opaquely; the non-blocking device->host copy
+(``copy_to_host_async``) is started by callers before submitting a
+fetch job here.
 """
 
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 import time
 
+from sagecal_tpu import faults
 from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.obs import metrics as obs
+
+
+def _warn_join_timeout(role: str, name: str, timeout_s: float) -> None:
+    """A ``join(timeout=...)`` that expired used to abandon the hung
+    thread SILENTLY — the leak was invisible until the process ran out
+    of threads. Now it is loud (stderr) and counted
+    (``thread_join_timeouts_total{role=}``) so leaked threads show up
+    in /metrics (MIGRATION.md "Fault tolerance")."""
+    obs.inc("thread_join_timeouts_total", role=role)
+    print(f"WARNING: {role} thread {name!r} did not exit within "
+          f"{timeout_s:.0f}s; abandoning it (leak counted in "
+          f"thread_join_timeouts_total)", file=sys.stderr)
 
 
 def start_host_copy(*arrays) -> None:
@@ -73,11 +96,13 @@ class Prefetcher:
     DONE = object()     # all n items consumed
 
     def __init__(self, fn, n: int, depth: int = 1, name: str = "read",
-                 context=None, ready_event=None):
+                 context=None, ready_event=None,
+                 join_timeout_s: float = 5.0):
         self.fn = fn
         self.n = int(n)
         self.depth = int(depth)
         self.name = name
+        self.join_timeout_s = float(join_timeout_s)
         # zero-arg context-manager factory entered for the producer
         # thread's lifetime (serve: routes the thread's diag emits to
         # the owning job's tracer via dtrace.scope)
@@ -100,6 +125,19 @@ class Prefetcher:
             self._thread.start()
 
     # -- producer thread ---------------------------------------------------
+
+    def _call(self, i):
+        """One production, with the fault-tolerance layer around it:
+        the ``reader_thread`` injection point (thread-death chaos
+        lever), then bounded transient retry — a flaky read/stage
+        recovers here with backoff instead of killing the run; a
+        non-transient or budget-exhausted failure re-raises with its
+        original traceback into the existing propagation path.
+        Retrying the whole ``fn(i)`` is safe by the staging contract:
+        reads are pure and a producer's only durable side effect
+        (``DonatedRing.stage``) is its final statement."""
+        faults.inject("reader_thread", key=i)
+        return faults.retry_transient(self.fn, (i,), what="read", key=i)
 
     def _put(self, item) -> bool:
         while not self._cancel.is_set():
@@ -124,7 +162,7 @@ class Prefetcher:
                 if self._cancel.is_set():
                     return
                 t0 = time.perf_counter()
-                item = self.fn(i)
+                item = self._call(i)
                 # the background production time — NOT the consumer's
                 # io wait; tagged bg so attribution stays honest
                 dur = time.perf_counter() - t0
@@ -144,7 +182,7 @@ class Prefetcher:
         if self.depth <= 0:
             for i in range(self.n):
                 t0 = time.perf_counter()
-                item = self.fn(i)
+                item = self._call(i)
                 yield i, item, time.perf_counter() - t0
             return
         try:
@@ -180,7 +218,7 @@ class Prefetcher:
             i = self._poll_next
             self._poll_next += 1
             t0 = time.perf_counter()
-            return i, self.fn(i), time.perf_counter() - t0
+            return i, self._call(i), time.perf_counter() - t0
         try:
             i, item = self._q.get_nowait()
         except queue.Empty:
@@ -200,7 +238,11 @@ class Prefetcher:
             except queue.Empty:
                 break
         if self._thread is not None:
-            self._thread.join(timeout=5.0)
+            self._thread.join(timeout=self.join_timeout_s)
+            if self._thread.is_alive():
+                _warn_join_timeout("reader", f"prefetch-{self.name}",
+                                   self.join_timeout_s)
+            self._thread = None
 
 
 class AsyncWriter:
@@ -220,8 +262,9 @@ class AsyncWriter:
     _STOP = object()
 
     def __init__(self, enabled: bool = True, maxsize: int = 4,
-                 context=None):
+                 context=None, join_timeout_s: float = 10.0):
         self.enabled = bool(enabled)
+        self.join_timeout_s = float(join_timeout_s)
         # zero-arg context-manager factory entered for the writer
         # thread's lifetime (serve: per-job diag scope, as Prefetcher)
         self._ctx = context
@@ -248,7 +291,14 @@ class AsyncWriter:
                     return
                 if self._exc is None:   # fail-stop: drain, don't run
                     fn, args, kwargs = job
-                    fn(*args, **kwargs)
+                    # writer_thread: the thread-death injection point;
+                    # then bounded transient retry — submitted jobs are
+                    # idempotent (atomic MS tile writes, single-call
+                    # solution/checkpoint writes), so a flaky disk
+                    # recovers here instead of failing the run
+                    faults.inject("writer_thread")
+                    faults.retry_transient(fn, args, kwargs,
+                                           what="write")
             except BaseException as e:
                 self._exc = e
             finally:
@@ -265,7 +315,10 @@ class AsyncWriter:
     def submit(self, fn, *args, **kwargs) -> float:
         self.check()
         if not self.enabled:
-            fn(*args, **kwargs)
+            # inline (--prefetch 0) execution keeps the SAME transient
+            # retry as the writer thread; a non-transient failure
+            # raises here at the call site (the debugging contract)
+            faults.retry_transient(fn, args, kwargs, what="write")
             return 0.0
         t0 = time.perf_counter()
         self._q.put((fn, args, kwargs))
@@ -286,11 +339,40 @@ class AsyncWriter:
         self.check()
         return time.perf_counter() - t0
 
+    def _join_queue(self, timeout_s: float) -> bool:
+        """``Queue.join`` with a deadline (the stdlib one has none): a
+        writer job hung on dead storage must not hang ``close`` — and
+        the whole run's teardown — forever. Uses the queue's own
+        ``all_tasks_done`` condition, the documented synchronization
+        primitive behind ``join``."""
+        deadline = time.perf_counter() + timeout_s
+        with self._q.all_tasks_done:
+            while self._q.unfinished_tasks:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    return False
+                self._q.all_tasks_done.wait(remaining)
+        return True
+
     def close(self, raise_pending: bool = True) -> None:
         if self._thread is not None:
-            self._q.join()
-            self._q.put(self._STOP)
-            self._thread.join(timeout=10.0)
+            flushed = self._join_queue(self.join_timeout_s)
+            if flushed:
+                self._q.put(self._STOP)
+                self._thread.join(timeout=self.join_timeout_s)
+            if not flushed or self._thread.is_alive():
+                _warn_join_timeout("writer", "async-writer",
+                                   self.join_timeout_s)
+                if self._exc is None:
+                    # an abandoned flush means submitted writes may
+                    # never have landed: that is a FAILURE the
+                    # raise_pending path must surface — a run whose
+                    # last writes hang must not report success (and
+                    # must not delete its resume checkpoint)
+                    self._exc = TimeoutError(
+                        "async-writer failed to flush within "
+                        f"{self.join_timeout_s:.0f}s; submitted "
+                        "writes may not have landed")
             self._thread = None
         if raise_pending:
             self.check()

@@ -10,8 +10,15 @@ op           request fields / reply
 ``submit``   ``config``: RunConfig field dict (CLI-long names, e.g.
              ``{"ms": ..., "sky_model": ..., "cluster_file": ...}``);
              optional ``priority`` (int, higher first), ``trace``
-             (per-job --diag JSONL path), ``job_id``. Reply
-             ``{"ok": true, "job_id": ...}``. Refused while draining.
+             (per-job --diag JSONL path), ``job_id``, ``deadline_s``
+             (seconds from submission; an expired job stops at its
+             next tile boundary as ``deadline_exceeded``),
+             ``on_diverge`` (``none`` advisory / ``fail``
+             circuit-break / ``quarantine`` per-tile last-good
+             fallback). ``config`` may carry ``resume: true`` to
+             re-enter a killed/failed job from its checkpoint
+             sidecar. Reply ``{"ok": true, "job_id": ...}``.
+             Refused while draining.
 ``status``   optional ``job_id``; reply one snapshot or all of them
 ``cancel``   ``job_id``; queued cancels now, running at its next tile
              boundary (reply carries the state observed)
@@ -51,6 +58,7 @@ import threading
 import time
 import uuid
 
+from sagecal_tpu import faults
 from sagecal_tpu.config import (BeamMode, RunConfig, SimulationMode,
                                 SolverMode)
 from sagecal_tpu.obs import export as oexport
@@ -140,10 +148,12 @@ class Server:
                 # per-job tracing is the submit 'trace' field.
                 # --metrics joins the ban for the same reason as
                 # --diag: it would dump-and-DISABLE the daemon's
-                # process registry when the job ends
+                # process registry when the job ends; --faults would
+                # install a process-global fault plan under every
+                # tenant
                 argv = [str(a) for a in req["mpi_argv"]]
                 banned = {"--platform", "--cpu-devices", "--diag",
-                          "--metrics"}
+                          "--metrics", "--faults"}
                 bad = sorted(banned & {a.split("=", 1)[0] for a in argv})
                 if bad:
                     raise ValueError(
@@ -154,7 +164,8 @@ class Server:
                              cfg=None,
                              priority=int(req.get("priority", 0)),
                              trace_path=req.get("trace"), kind="mpi",
-                             argv=argv)
+                             argv=argv,
+                             deadline_s=req.get("deadline_s"))
                 self.queue.submit(job)
                 self.log(f"[{job.job_id}] queued (mpi)")
                 return {"ok": True, "job_id": job.job_id}
@@ -166,7 +177,9 @@ class Server:
             job = jq.Job(req.get("job_id") or uuid.uuid4().hex[:12],
                          cfg, priority=int(req.get("priority", 0)),
                          trace_path=req.get("trace"),
-                         kind=job_kind(cfg))
+                         kind=job_kind(cfg),
+                         deadline_s=req.get("deadline_s"),
+                         on_diverge=req.get("on_diverge", "none"))
             self.queue.submit(job)
             self.log(f"[{job.job_id}] queued ({job.kind}, "
                      f"priority {job.priority})")
@@ -267,6 +280,11 @@ class Server:
                     line = line.strip()
                     if not line:
                         continue
+                    # socket_drop: the connection-loss chaos seam —
+                    # the raise escapes handle(), socketserver closes
+                    # the connection, and the Client's bounded
+                    # reconnect-with-backoff must recover
+                    faults.inject("socket_drop")
                     try:
                         resp = server.handle_request(json.loads(line))
                     except Exception as e:
@@ -333,31 +351,96 @@ class Server:
 
 class Client:
     """Line-oriented client for the protocol above (tests, bench,
-    embedders). One socket, requests answered in order."""
+    embedders). One socket, requests answered in order.
+
+    Robustness: a transient socket failure (connection reset, dropped
+    connection, EOF mid-reply) no longer raises on the first
+    ``ConnectionError`` — the client reconnects with bounded
+    exponential backoff and re-sends the request, up to
+    ``reconnects`` total tries, then re-raises. Re-sending is made
+    safe for the one non-idempotent op by :meth:`submit` always
+    attaching a client-generated ``job_id``: a retry whose first send
+    actually landed gets the server's "duplicate job id" refusal and
+    treats it as success."""
 
     def __init__(self, socket_path: str | None = None,
-                 port: int | None = None, timeout: float = 600.0):
+                 port: int | None = None, timeout: float = 600.0,
+                 reconnects: int = 3, reconnect_base_s: float = 0.1):
+        self._addr = (socket_path, port)
+        self._timeout = float(timeout)
+        self._reconnects = max(1, int(reconnects))
+        self._reconnect_base_s = float(reconnect_base_s)
+        self._sock = None
+        self._f = None
+        self._connect()
+
+    def _connect(self) -> None:
+        socket_path, port = self._addr
         if socket_path:
-            self._sock = socket.socket(socket.AF_UNIX)
-            self._sock.connect(socket_path)
+            s = socket.socket(socket.AF_UNIX)
+            s.connect(socket_path)
         else:
-            self._sock = socket.create_connection(("127.0.0.1", port))
-        self._sock.settimeout(timeout)
-        self._f = self._sock.makefile("rwb")
+            s = socket.create_connection(("127.0.0.1", port))
+        s.settimeout(self._timeout)
+        self._sock = s
+        self._f = s.makefile("rwb")
+
+    def _drop(self) -> None:
+        for o in (self._f, self._sock):
+            try:
+                if o is not None:
+                    o.close()
+            except OSError:
+                pass
+        self._f = self._sock = None
 
     def request(self, **req) -> dict:
-        self._f.write((json.dumps(req) + "\n").encode())
-        self._f.flush()
-        line = self._f.readline()
-        if not line:
-            raise ConnectionError("server closed the connection")
+        payload = (json.dumps(req) + "\n").encode()
+        self._last_request_resent = False
+        for attempt in range(self._reconnects):
+            try:
+                if self._f is None:
+                    self._connect()
+                if attempt > 0:
+                    # the request body went out more than once — the
+                    # signal submit() needs to tell a retry-induced
+                    # duplicate-id refusal from a genuine one
+                    self._last_request_resent = True
+                self._f.write(payload)
+                self._f.flush()
+                line = self._f.readline()
+                if not line:
+                    raise ConnectionError(
+                        "server closed the connection")
+                break
+            except (ConnectionError, OSError):
+                # transient socket failure: drop the dead socket and
+                # reconnect with bounded backoff; the last attempt
+                # re-raises (the caller's fail-stop path)
+                self._drop()
+                if attempt == self._reconnects - 1:
+                    raise
+                time.sleep(self._reconnect_base_s * (2 ** attempt))
         resp = json.loads(line)
         if not resp.get("ok"):
             raise RuntimeError(resp.get("error", "request failed"))
         return resp
 
     def submit(self, config: dict, **kw) -> str:
-        return self.request(op="submit", config=config, **kw)["job_id"]
+        # a client-side job_id makes submit idempotent under the
+        # reconnect-and-resend path (see the class docstring)
+        kw.setdefault("job_id", uuid.uuid4().hex[:12])
+        try:
+            return self.request(op="submit", config=config,
+                                **kw)["job_id"]
+        except RuntimeError as e:
+            # only a RESENT request may read the duplicate refusal as
+            # "the first send landed" — on a clean first attempt it is
+            # a genuine collision the caller must see
+            if self._last_request_resent \
+                    and "duplicate job id" in str(e):
+                return kw["job_id"]
+            raise
 
     def status(self, job_id: str | None = None):
         r = self.request(op="status",
@@ -381,22 +464,23 @@ class Client:
 
     def wait(self, job_id: str, timeout_s: float = 600.0,
              poll_s: float = 0.05) -> dict:
-        """Block until the job reaches a terminal state."""
-        import time
-        t0 = time.time()
+        """Block until the job reaches a terminal state. Elapsed time
+        is measured with ``time.monotonic`` — a wall-clock jump (NTP
+        step, suspend/resume) must neither fire the timeout early nor
+        stretch it."""
+        t0 = time.monotonic()
         while True:
             snap = self.status(job_id)
             if snap["state"] in jq.TERMINAL:
                 return snap
-            if time.time() - t0 > timeout_s:
+            if time.monotonic() - t0 > timeout_s:
                 raise TimeoutError(
                     f"job {job_id} still {snap['state']} "
                     f"after {timeout_s}s")
             time.sleep(poll_s)
 
     def close(self) -> None:
-        self._f.close()
-        self._sock.close()
+        self._drop()
 
     def __enter__(self):
         return self

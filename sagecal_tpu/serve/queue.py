@@ -7,7 +7,9 @@ state machine::
 
     queued -> running -> done
           \\          \\-> failed      (fail-stop: THIS job only)
-           \\-> cancelled   (or running -> cancelled at a tile boundary)
+          |\\-> cancelled   (or running -> cancelled at a tile boundary)
+           \\-> deadline_exceeded      (queued expiry at admission, or
+                running -> deadline_exceeded at a tile boundary)
 
 Admission control bounds what the device-owner loop may hold live at
 once, derived from the overlap machinery's memory model (MIGRATION.md
@@ -51,9 +53,14 @@ RUNNING = "running"
 DONE = "done"
 FAILED = "failed"
 CANCELLED = "cancelled"
+#: the job's deadline passed before it finished: queued jobs expire at
+#: admission, running jobs at their next tile boundary — both through
+#: the same ``_finish_locked`` accounting as cancel, so the SLO
+#: histograms / jobs_total counters / counts() agree on every path
+DEADLINE_EXCEEDED = "deadline_exceeded"
 
 #: states a job can never leave
-TERMINAL = (DONE, FAILED, CANCELLED)
+TERMINAL = (DONE, FAILED, CANCELLED, DEADLINE_EXCEEDED)
 
 
 class Job:
@@ -61,18 +68,35 @@ class Job:
 
     def __init__(self, job_id: str, cfg, priority: int = 0,
                  trace_path: str | None = None, kind: str = "fullbatch",
-                 argv: list | None = None):
+                 argv: list | None = None,
+                 deadline_s: float | None = None,
+                 on_diverge: str = "none"):
         self.job_id = job_id
         self.cfg = cfg
         self.priority = int(priority)
         self.kind = kind            # fullbatch | stochastic | sim | mpi
         self.argv = argv            # mpi jobs: the raw cli_mpi argv
         self.trace_path = trace_path
+        # per-job deadline, relative to submission; the scheduler stops
+        # dispatching an expired job's tiles at the next boundary (an
+        # OPAQUE job already mid-run cannot be interrupted — same
+        # documented limitation as cancel)
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        # divergence policy (obs/health.py DIVERGING wired to action):
+        # "none" = advisory only (healthz/status annotation, the PR 8
+        # behavior), "fail" = circuit-break the job at the boundary,
+        # "quarantine" = per-tile last-good fallback (TileStepper)
+        if on_diverge not in ("none", "fail", "quarantine"):
+            raise ValueError(f"on_diverge {on_diverge!r}: expected "
+                             "'none', 'fail' or 'quarantine'")
+        self.on_diverge = on_diverge
         self.state = QUEUED
         self.error: str | None = None
         self.error_tb: str | None = None
         self.cancel_requested = False
         self.submitted_t = time.time()
+        self.deadline_t = (None if self.deadline_s is None
+                           else self.submitted_t + self.deadline_s)
         self.started_t: float | None = None
         self.finished_t: float | None = None
         self.tiles_done = 0
@@ -98,6 +122,8 @@ class Job:
             "tiles_done": self.tiles_done, "n_tiles": self.n_tiles,
             "submitted_t": self.submitted_t,
             "started_t": self.started_t, "finished_t": self.finished_t,
+            "deadline_s": self.deadline_s, "deadline_t": self.deadline_t,
+            "on_diverge": self.on_diverge,
             "error": self.error,
             # the ORIGINAL traceback (fail-stop contract): a client
             # debugging a failed tenant job gets the failing frames,
@@ -108,6 +134,12 @@ class Job:
             "health": self.health,
             "health_detail": self.health_detail,
         }
+
+    def expired(self, now: float | None = None) -> bool:
+        """True when the job's deadline has passed."""
+        if self.deadline_t is None:
+            return False
+        return (time.time() if now is None else now) >= self.deadline_t
 
 
 class JobQueue:
@@ -160,7 +192,8 @@ class JobQueue:
     def counts(self) -> dict:
         with self._lock:
             out: dict = {s: 0 for s in
-                         (QUEUED, RUNNING, DONE, FAILED, CANCELLED)}
+                         (QUEUED, RUNNING, DONE, FAILED, CANCELLED,
+                          DEADLINE_EXCEEDED)}
             for j in self._jobs.values():
                 out[j.state] += 1
             out["staged_bytes"] = sum(
@@ -215,6 +248,13 @@ class JobQueue:
         backfill past it forever — its reservation is honoured as
         soon as enough running jobs finish."""
         with self._lock:
+            # expire queued jobs whose deadline already passed — they
+            # must never consume a device slot, and their clients must
+            # observe a terminal state instead of polling forever
+            now = time.time()
+            for j in self._jobs.values():
+                if j.state == QUEUED and j.expired(now):
+                    self._finish_locked(j, DEADLINE_EXCEEDED)
             running = [j for j in self._jobs.values()
                        if j.state == RUNNING]
             if len(running) >= self.max_inflight:

@@ -20,10 +20,15 @@ residuals vs solo runs, plus the zero-new-compiles assert).
 
 Failure model (fail-stop, per job): any exception out of a job's
 stage/step/write path — including an async MS-write failure
-re-raised at the job's next tile boundary (PR 5 semantics) — moves
-THAT job to ``failed`` with the original traceback recorded, tears
-down its threads, and the loop keeps serving its neighbours. No
-later write of a failed job executes (AsyncWriter fail-stop).
+re-raised at the job's next tile boundary (PR 5 semantics), after
+the sched layer's bounded transient retries gave up — moves THAT
+job to ``failed`` with the original traceback recorded, tears down
+its threads, and the loop keeps serving its neighbours. No later
+write of a failed job executes (AsyncWriter fail-stop). Per-job
+deadlines and the divergence circuit-breaker (``on_diverge=fail``)
+take effect at the same tile boundaries; a job with a checkpoint
+sidecar can be resubmitted with ``resume=true`` and skips its
+completed tiles bit-identically (MIGRATION.md "Fault tolerance").
 
 Stochastic / simulation jobs reuse their existing whole-run drivers
 as one OPAQUE unit: correct and isolated, but not tile-interleaved
@@ -208,14 +213,26 @@ class Scheduler:
             st = pipe.stepper(
                 write_residuals=True, solution_path=cfg.solutions_file,
                 max_tiles=cfg.max_timeslots or None,
-                log=self._job_log(job), trace_ctx=ctx)
+                log=self._job_log(job), trace_ctx=ctx,
+                # divergence quarantine is the stepper's policy; the
+                # job-level "fail" circuit-breaker lives in _step_ready
+                on_diverge=("quarantine"
+                            if job.on_diverge == "quarantine"
+                            else "reset"))
             job.n_tiles = st.n_tiles
+            # checkpoint resume (resume=true): completed tiles are
+            # already on disk — report them done and only produce the
+            # remainder
+            job.tiles_done = st.start_tile
 
-            def produce(i, _ms=ms, _st=st):
+            def produce(j, _ms=ms, _st=st):
+                i = _st.start_tile + j
                 tile = _ms.read_tile(i)
-                return tile, _st.stage(i, tile)
+                return i, tile, _st.stage(i, tile)
 
-            pf = sched.Prefetcher(produce, st.n_tiles, depth=st.depth,
+            pf = sched.Prefetcher(produce,
+                                  st.n_tiles - st.start_tile,
+                                  depth=st.depth,
                                   name=f"job-{job.job_id}", context=ctx,
                                   ready_event=self._ready)
         return _RunningJob(job, pipe, st, pf, tracer, ctx)
@@ -235,6 +252,12 @@ class Scheduler:
         try:
             if job.cancel_requested:
                 self.q.finish(job, jq.CANCELLED)
+                return
+            if job.expired():
+                # a deadline arriving AFTER this point cannot take
+                # effect until the opaque run completes — the same
+                # documented limitation as cancel
+                self.q.finish(job, jq.DEADLINE_EXCEEDED)
                 return
             cfg = job.cfg
             with ctx():
@@ -342,13 +365,21 @@ class Scheduler:
                     self._finish(rj, jq.CANCELLED)
                     progressed = True
                     break
+                if job.expired():
+                    # per-job deadline at the tile boundary: stop
+                    # dispatching this job's tiles, release its
+                    # admission budget, record deadline_exceeded
+                    # through the same _finish accounting as cancel
+                    self._finish(rj, jq.DEADLINE_EXCEEDED)
+                    progressed = True
+                    break
                 try:
                     with rj.ctx():
                         r = rj.pf.poll()
                         if r is sched.Prefetcher.EMPTY:
                             break
                         if r is not sched.Prefetcher.DONE:
-                            ti, (tile, stg), wait = r
+                            _j, (ti, tile, stg), wait = r
                             t0 = time.perf_counter()
                             rec = rj.stepper.step(ti, tile, stg, wait)
                             dt = time.perf_counter() - t0
@@ -362,15 +393,31 @@ class Scheduler:
                         break
                     # live convergence health: fold this tile's final
                     # residual into the job's stall/divergence monitor
-                    # and annotate the job for status/healthz readers
-                    job.health = rj.health.update(rec["res_1"])
-                    job.health_detail = rj.health.snapshot()
+                    # and annotate the job for status/healthz readers.
+                    # A QUARANTINED tile's poisoned residual never
+                    # entered the chain, so it must not poison the
+                    # health watermark either — it is already counted
+                    # in tiles_quarantined_total and the diag trace.
+                    if not rec.get("quarantined"):
+                        job.health = rj.health.update(rec["res_1"])
+                        job.health_detail = rj.health.snapshot()
                     self.last_progress_t = time.time()
                     obs.inc("serve_device_busy_seconds_total", dt)
                     obs.inc("serve_tiles_done_total", job=job.job_id)
                     job.tiles_done += 1
                     self.tiles_done += 1
                     progressed = True
+                    if job.health == ohealth.DIVERGING \
+                            and job.on_diverge == "fail":
+                        # divergence circuit-breaker: the advisory
+                        # health signal wired into action — this job
+                        # stops at the boundary instead of burning its
+                        # remaining tile budget on a diverged chain
+                        self._finish(rj, jq.FAILED, exc=RuntimeError(
+                            "divergence circuit-breaker: residual "
+                            f"{rec['res_1']:.6g} against best "
+                            f"{rj.health.best}"))
+                        break
                 except BaseException as e:
                     # fail-stop isolation: THIS job only; neighbours
                     # keep solving and the loop keeps serving

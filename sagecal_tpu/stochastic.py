@@ -588,6 +588,10 @@ class _StochasticRunner:
 
 
 def _open(cfg: RunConfig, log):
+    if getattr(cfg, "resume", False):
+        # checkpoint/resume is a sequential-fullbatch contract (the
+        # minibatch epoch/PRNG chain has no tile-boundary watermark)
+        log("resume: unsupported in stochastic mode; starting fresh")
     ms = ds.open_dataset(cfg.ms, cfg.ms_list, tilesz=cfg.tile_size,
                          data_column=cfg.input_column,
                          out_column=cfg.output_column)

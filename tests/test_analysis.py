@@ -335,6 +335,30 @@ def test_obs_package_is_hot_path_scope():
     assert not core.is_hot_path("sagecal_tpu/tools/fits.py")
 
 
+def test_hostsync_faults_gate_blessed_and_faults_hot_scope(tmp_path):
+    """ISSUE 10: the fault-injection harness keeps the
+    no-op-when-disabled contract, so ``faults.active()`` blesses a
+    gated block exactly like ``dtrace.active()``/``obs.active()`` —
+    and faults.py itself sits in the hot-path scope (the retry layer
+    wraps every I/O seam's hot loop)."""
+    assert core.is_hot_path("sagecal_tpu/faults.py")
+    # clean twin: a faults.active()-gated sync in a hot loop
+    f, _ = _lint(tmp_path, """
+    def sweep(xs, faults, poison):
+        for x in xs:
+            if faults.active():
+                poison(float(jnp.sum(x)))
+    """)
+    assert f == []
+    # positive twin: the same sync un-gated stays a finding
+    f, _ = _lint(tmp_path, """
+    def sweep(xs, poison):
+        for x in xs:
+            poison(float(jnp.sum(x)))
+    """)
+    assert _rules(f) == ["host-sync"]
+
+
 def test_hostsync_block_in_loop_flagged_async_readback_blessed(tmp_path):
     """ISSUE 5 overlap contract: a per-iteration block_until_ready in
     a hot host loop is a finding, while the BLESSED async-readback API

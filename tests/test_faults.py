@@ -1,0 +1,701 @@
+"""Fault-tolerance gates (sagecal_tpu.faults, ISSUE 10).
+
+The contracts under test (MIGRATION.md "Fault tolerance"):
+
+- the injection harness itself: named points, deterministic
+  (order-independent) firing, bounded counts, spec parsing;
+- retry-with-backoff: transient faults at every I/O seam (MS read,
+  beam stage, residual d->h fetch, MS write, solutions write) recover
+  with ``retries_total`` counted and BIT-IDENTICAL outputs; permanent
+  faults reach the existing fail-stop paths with the ORIGINAL
+  traceback after ``gave_up_total``;
+- thread death: an injected reader/writer-thread failure propagates
+  and never hangs ``--prefetch N``; expired thread joins are loud
+  (``thread_join_timeouts_total``);
+- NaN tile: the divergence policy — reference reset, or quarantine
+  (last-good solutions written, tile flagged, chain untouched);
+- deadlines: queued jobs expire at admission, running jobs stop at
+  the next tile boundary, both as ``deadline_exceeded`` through the
+  same accounting as cancel; the budget is released;
+- checkpoint/resume: a killed job resubmitted with ``resume=true``
+  skips completed tiles and produces residuals + solutions
+  bit-identical to an uninterrupted run;
+- socket drop: the serve client reconnects with bounded backoff;
+- zero cost: an inert fault plan is bit-identical and adds zero
+  compiles (the diag/obs no-op-when-disabled contract).
+"""
+
+import math
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from sagecal_tpu import cli, faults, pipeline, sched, skymodel  # noqa: E402
+from sagecal_tpu.diag import guard  # noqa: E402
+from sagecal_tpu.diag import trace as dtrace  # noqa: E402
+from sagecal_tpu.io import dataset as ds  # noqa: E402
+from sagecal_tpu.io import solutions as sol  # noqa: E402
+from sagecal_tpu.obs import metrics as ometrics  # noqa: E402
+from sagecal_tpu.rime import predict as rp  # noqa: E402
+from sagecal_tpu.serve import queue as jq  # noqa: E402
+from sagecal_tpu.serve.api import Client, Server, config_from_dict  # noqa: E402
+
+SKY = """\
+P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6
+P1A 1 20 0 38 0 0 2.5 0 0 0 0 0 0 0 0 150e6
+"""
+
+CLUSTER = """\
+0 1 P0A
+1 2 P1A
+"""
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state(monkeypatch):
+    """Every test gets a pristine fault plan + obs registry, fast
+    retry backoff, and never leaks either into other modules."""
+    faults.disable()
+    ometrics.disable()
+    monkeypatch.setattr(faults, "RETRY_BASE_S", 0.005)
+    yield
+    faults.disable()
+    ometrics.disable()
+
+
+def _make_dataset(tmp_path, name, n_tiles=3, n_stations=8, tilesz=4,
+                  nchan=2, seed=11):
+    sky_path = tmp_path / "sky.txt"
+    if not sky_path.exists():
+        sky_path.write_text(SKY)
+        (tmp_path / "sky.txt.cluster").write_text(CLUSTER)
+    ra0 = (41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(sky_path), ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(str(tmp_path / "sky.txt.cluster")))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jt = ds.random_jones(sky.n_clusters, sky.nchunk, n_stations, seed=5,
+                         scale=0.15)
+    freqs = np.linspace(149e6, 151e6, nchan)
+    tiles = [ds.simulate_dataset(dsky, n_stations=n_stations,
+                                 tilesz=tilesz, freqs=freqs, ra0=ra0,
+                                 dec0=dec0, jones=Jt, nchunk=sky.nchunk,
+                                 noise_sigma=0.02, seed=seed + t)
+             for t in range(n_tiles)]
+    msdir = tmp_path / name
+    ds.SimMS.create(str(msdir), tiles)
+    return str(msdir), str(sky_path), str(tmp_path / "sky.txt.cluster")
+
+
+def _base_config(skyf, clusf, **kw):
+    # solve plan pinned so compile-guard gates stay deterministic
+    # (the test_serve.py precedent)
+    cfg = dict(sky_model=skyf, cluster_file=clusf, solver_mode=0,
+               max_em_iter=1, max_iter=4, max_lbfgs=2, tile_size=4,
+               solve_fuse="on", solve_promote="off")
+    cfg.update(kw)
+    return cfg
+
+
+def _run(cfg_dict, msdir, sol_path=None, prefetch=None):
+    extra = {} if prefetch is None else {"prefetch": prefetch}
+    cfg = config_from_dict(dict(cfg_dict, ms=msdir,
+                                solutions_file=sol_path, **extra))
+    return pipeline.run(cfg, log=lambda *a: None)
+
+
+def _corrected(msdir):
+    out = ds.SimMS(msdir, data_column="CORRECTED_DATA")
+    return [out.read_tile(i).x.copy() for i in range(out.n_tiles)]
+
+
+def _counter(name, **labels):
+    reg = ometrics.get()
+    m = reg.get(name) if reg else None
+    return m.value(**labels) if m is not None else 0.0
+
+
+# ---------------------------------------------------------------------------
+# harness units: rules, determinism, spec parsing, retry core
+# ---------------------------------------------------------------------------
+
+def test_rule_validation_and_spec_parsing(tmp_path):
+    with pytest.raises(ValueError, match="unknown injection point"):
+        faults.Rule("no_such_point")
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        faults.Rule("ms_read", kind="sideways")
+
+    faults.enable_spec('[{"point": "ms_read", "at": [1], "times": 2}]')
+    assert faults.active()
+    assert faults.get().rules[0].at == frozenset({1})
+
+    faults.enable_spec('{"seed": 9, "rules": [{"point": "ms_write"}]}')
+    assert faults.get().seed == 9
+
+    p = tmp_path / "plan.json"
+    p.write_text('[{"point": "socket_drop", "kind": "fatal"}]')
+    faults.enable_spec(str(p))
+    assert faults.get().rules[0].kind == "fatal"
+    faults.enable_spec("@" + str(p))
+    assert faults.get().rules[0].point == "socket_drop"
+
+
+def test_plan_counting_keys_and_determinism():
+    # bounded count at a specific key
+    faults.enable([{"point": "ms_read", "at": [1], "times": 2}])
+    assert not faults.fires("ms_read", 0)       # key mismatch
+    assert not faults.fires("ms_write", 1)      # point mismatch
+    assert faults.fires("ms_read", 1)
+    assert faults.fires("ms_read", 1)
+    assert not faults.fires("ms_read", 1)       # budget spent
+
+    def draw_set(seed):
+        faults.enable([{"point": "ms_read", "p": 0.4, "times": None}],
+                      seed=seed)
+        return {k for k in range(64) if faults.fires("ms_read", k)}
+
+    a, b = draw_set(3), draw_set(3)
+    assert a == b and 0 < len(a) < 64          # deterministic, partial
+    assert draw_set(4) != a                    # seed-sensitive
+
+    # inject raises typed faults
+    faults.enable([{"point": "ms_read", "kind": "transient"},
+                   {"point": "ms_write", "kind": "fatal"}])
+    with pytest.raises(faults.TransientFault):
+        faults.inject("ms_read", key=0)
+    with pytest.raises(faults.FatalFault):
+        faults.inject("ms_write", key=0)
+    faults.disable()
+    faults.inject("ms_read", key=0)            # disabled: no-op
+
+
+def test_retry_transient_core():
+    ometrics.enable()
+    calls = []
+
+    def flaky(x):
+        calls.append(x)
+        if len(calls) < 3:
+            raise faults.TransientFault("flaky")
+        return x * 2
+
+    assert faults.retry_transient(flaky, (21,), what="t") == 42
+    assert len(calls) == 3
+    assert _counter("retries_total", what="t") == 2
+
+    # budget exhausted: ORIGINAL exception + gave_up counted
+    def always(x):
+        raise ConnectionResetError("down")
+
+    with pytest.raises(ConnectionResetError, match="down"):
+        faults.retry_transient(always, (1,), what="t", attempts=2)
+    assert _counter("gave_up_total", what="t") == 1
+
+    # non-transient: immediate, uncounted
+    calls.clear()
+
+    def broken(x):
+        calls.append(x)
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        faults.retry_transient(broken, (1,), what="nt")
+    assert len(calls) == 1
+    assert _counter("retries_total", what="nt") == 0
+
+    assert faults.is_transient(faults.TransientFault("x"))
+    assert not faults.is_transient(faults.FatalFault("x"))
+    assert faults.is_transient(TimeoutError())
+    assert faults.is_transient(OSError("io"))
+    assert not faults.is_transient(PermissionError())
+    assert not faults.is_transient(ValueError("logic"))
+
+
+# ---------------------------------------------------------------------------
+# sched-level: retry wiring + thread death + loud join timeouts
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_retries_transient_reads():
+    ometrics.enable()
+    attempts = []
+
+    def produce(i):
+        attempts.append(i)
+        if i == 1 and attempts.count(1) < 3:
+            raise faults.TransientFault("flaky read")
+        return i * 10
+
+    out = list(sched.Prefetcher(produce, 3, depth=1))
+    assert [(i, v) for i, v, _ in out] == [(0, 0), (1, 10), (2, 20)]
+    assert _counter("retries_total", what="read") == 2
+
+
+def test_asyncwriter_retries_transient_then_failstop():
+    ometrics.enable()
+    done = []
+    flaky_calls = []
+
+    def flaky(k):
+        flaky_calls.append(k)
+        if len(flaky_calls) < 2:
+            raise faults.TransientFault("flaky write")
+        done.append(k)
+
+    aw = sched.AsyncWriter(enabled=True)
+    aw.submit(flaky, 7)
+    aw.drain()
+    assert done == [7]
+    assert _counter("retries_total", what="write") == 1
+
+    # injected writer-thread death reaches the boundary check
+    faults.enable([{"point": "writer_thread", "kind": "fatal"}])
+    aw.submit(done.append, 8)
+    aw.submit(done.append, 9)          # never runs after the death
+    with pytest.raises(faults.FatalFault):
+        aw.drain()
+    assert 8 not in done and 9 not in done
+    aw.close(raise_pending=False)
+
+
+def test_thread_join_timeouts_are_loud():
+    ometrics.enable()
+    ev = threading.Event()
+    pf = sched.Prefetcher(lambda i: ev.wait(), 2, depth=1,
+                          join_timeout_s=0.2)
+    time.sleep(0.05)                   # let the producer enter fn
+    pf.close()
+    assert _counter("thread_join_timeouts_total", role="reader") == 1
+    ev.set()
+
+    ev2 = threading.Event()
+    aw = sched.AsyncWriter(enabled=True, join_timeout_s=0.2)
+    aw.submit(ev2.wait)
+    t0 = time.perf_counter()
+    aw.close(raise_pending=False)      # must NOT hang on the stuck job
+    assert time.perf_counter() - t0 < 2.0
+    assert _counter("thread_join_timeouts_total", role="writer") == 1
+    # an abandoned flush is a FAILURE, not a silent success: the
+    # raise_pending path must surface it (a run whose last writes hung
+    # must not report done / delete its resume checkpoint)
+    with pytest.raises(TimeoutError, match="failed to flush"):
+        aw.check()
+    ev2.set()
+
+
+# ---------------------------------------------------------------------------
+# pipeline e2e: transient recovery bit-identity + fail-stop + NaN policy
+# ---------------------------------------------------------------------------
+
+def test_transient_faults_recover_bit_identical(tmp_path):
+    """The acceptance core: transient faults at EVERY wired I/O seam
+    (MS read, beam stage, MS write, solutions write, residual fetch)
+    recover via retry and the outputs are bit-identical to a
+    fault-free run."""
+    msA, skyf, clusf = _make_dataset(tmp_path, "ref.ms", seed=11)
+    msB, _, _ = _make_dataset(tmp_path, "chaos.ms", seed=11)
+    base = _base_config(skyf, clusf)
+    _run(base, msA, str(tmp_path / "ref.sol"))
+    ref = _corrected(msA)
+
+    ometrics.enable()
+    faults.enable([
+        {"point": "ms_read", "at": [1], "times": 2},
+        {"point": "beam_stage", "at": [2], "times": 1},
+        {"point": "ms_write", "at": [1], "times": 1},
+        {"point": "solutions_write", "times": 1},
+        {"point": "residual_fetch", "at": [0], "times": 1},
+    ])
+    _run(base, msB, str(tmp_path / "chaos.sol"))
+    faults.disable()
+
+    for a, b in zip(ref, _corrected(msB)):
+        assert np.array_equal(a, b)
+    assert (tmp_path / "ref.sol").read_text() \
+        == (tmp_path / "chaos.sol").read_text()
+    assert _counter("faults_injected_total", point="ms_read") == 2
+    assert _counter("retries_total", what="read") >= 3
+    assert _counter("retries_total", what="write") >= 3
+    assert _counter("gave_up_total", what="read") == 0
+    assert _counter("gave_up_total", what="write") == 0
+
+
+def test_fatal_read_fails_with_original_traceback(tmp_path):
+    msdir, skyf, clusf = _make_dataset(tmp_path, "fr.ms", seed=11)
+    faults.enable([{"point": "ms_read", "kind": "fatal", "at": [1]}])
+    with pytest.raises(faults.FatalFault,
+                       match="injected fatal fault: ms_read") as ei:
+        _run(_base_config(skyf, clusf), msdir, prefetch=2)
+    import traceback
+    tb = "".join(traceback.format_tb(ei.value.__traceback__))
+    assert "inject" in tb              # original frames preserved
+
+
+def test_reader_thread_failure_propagates_no_hang(tmp_path, monkeypatch):
+    """Satellite 3: an MS-read exception on the Prefetcher background
+    thread (not via the harness — a plain bug) must propagate the
+    original traceback under --prefetch N instead of hanging; only
+    the writer side was regression-tested before."""
+    msdir, skyf, clusf = _make_dataset(tmp_path, "rt.ms", seed=11)
+    cfg = config_from_dict(dict(_base_config(skyf, clusf), ms=msdir,
+                                prefetch=2))
+    real_read = ds.SimMS.read_tile
+
+    def failing_read(self, i):
+        if i == 1:
+            raise ValueError("injected reader failure")
+        return real_read(self, i)
+
+    monkeypatch.setattr(ds.SimMS, "read_tile", failing_read)
+    with pytest.raises(ValueError, match="injected reader failure") as ei:
+        pipeline.run(cfg, log=lambda *a: None)
+    import traceback
+    tb = "".join(traceback.format_tb(ei.value.__traceback__))
+    assert "failing_read" in tb
+
+
+def _drive_stepper(pipe, sol_path, on_diverge):
+    st = pipe.stepper(write_residuals=True, solution_path=sol_path,
+                      log=lambda *a: None, prefetch=0,
+                      on_diverge=on_diverge)
+    recs = []
+    for ti in range(st.n_tiles):
+        tile = pipe.ms.read_tile(ti)
+        recs.append(st.step(ti, tile, st.stage(ti, tile)))
+    st.close()
+    return recs
+
+
+def _open_pipe(msdir, skyf, clusf, **kw):
+    cfg = config_from_dict(dict(_base_config(skyf, clusf, **kw),
+                                ms=msdir))
+    ms = ds.SimMS(msdir)
+    sky = skymodel.read_sky_cluster(skyf, clusf, ms.meta["ra0"],
+                                    ms.meta["dec0"], ms.meta["freq0"])
+    return pipeline.FullBatchPipeline(cfg, ms, sky, log=lambda *a: None)
+
+
+def test_nan_tile_reset_vs_quarantine(tmp_path):
+    """An injected NaN solve drives the divergence policy: the default
+    reset re-arms from the initial solutions (reference semantics);
+    quarantine keeps the LAST-GOOD chain — the poisoned tile's written
+    solutions equal the previous tile's, the tile is flagged in the
+    diag trace, and no poisoned residual lands."""
+    msR, skyf, clusf = _make_dataset(tmp_path, "qr.ms", seed=11)
+    msQ, _, _ = _make_dataset(tmp_path, "qq.ms", seed=11)
+    ometrics.enable()
+
+    faults.enable([{"point": "solve_nan", "at": [1]}])
+    pipeR = _open_pipe(msR, skyf, clusf)
+    recsR = _drive_stepper(pipeR, str(tmp_path / "r.sol"), "reset")
+    faults.disable()
+    assert not np.isfinite(recsR[1]["res_1"])
+    assert "quarantined" not in recsR[1]
+
+    tr = str(tmp_path / "q.diag.jsonl")
+    dtrace.enable(tr, entry="test")
+    faults.enable([{"point": "solve_nan", "at": [1]}])
+    pipeQ = _open_pipe(msQ, skyf, clusf)
+    recsQ = _drive_stepper(pipeQ, str(tmp_path / "q.sol"), "quarantine")
+    faults.disable()
+    dtrace.disable()
+    assert recsQ[1]["quarantined"] is True
+    assert _counter("tiles_quarantined_total") == 1
+    qrecs = [r for r in dtrace.read(tr) if r["ev"] == "quarantine"]
+    assert len(qrecs) == 1 and qrecs[0]["tile"] == 1
+
+    # quarantined tile's written solutions == the last-good interval's
+    sky = pipeQ.sky
+    _, blocksQ = sol.read_solutions(str(tmp_path / "q.sol"), sky.nchunk)
+    assert np.array_equal(blocksQ[0], blocksQ[1])
+    # under reset they differ (tile 1 re-arms from the initial values)
+    _, blocksR = sol.read_solutions(str(tmp_path / "r.sol"), sky.nchunk)
+    assert not np.array_equal(blocksR[0], blocksR[1])
+    # no poisoned residual was written
+    for x in _corrected(msQ):
+        assert np.all(np.isfinite(x))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint/resume: bit-identity vs an uninterrupted run
+# ---------------------------------------------------------------------------
+
+def test_resume_bit_identity_pipeline(tmp_path):
+    """The acceptance gate: kill a run mid-way (injected fatal MS
+    write at tile 1), resubmit with resume=True, and the final
+    residuals AND solutions file are bit-identical to an uninterrupted
+    run; the checkpoint sidecar is removed on completion."""
+    msA, skyf, clusf = _make_dataset(tmp_path, "ua.ms", seed=11)
+    msB, _, _ = _make_dataset(tmp_path, "ub.ms", seed=11)
+    base = _base_config(skyf, clusf)
+    solA = str(tmp_path / "ua.sol")
+    solB = str(tmp_path / "ub.sol")
+    _run(base, msA, solA)                       # uninterrupted reference
+    assert not os.path.exists(sol.checkpoint_path(solA))
+
+    faults.enable([{"point": "ms_write", "kind": "fatal", "at": [1]}])
+    with pytest.raises(faults.FatalFault):
+        _run(base, msB, solB)
+    faults.disable()
+    ck = sol.load_checkpoint(sol.checkpoint_path(solB))
+    assert ck is not None and ck["tile"] == 0   # watermark: tile 0 landed
+
+    _run(dict(base, resume=True), msB, solB)
+    for a, b in zip(_corrected(msA), _corrected(msB)):
+        assert np.array_equal(a, b)
+    with open(solA) as fa, open(solB) as fb:
+        assert fa.read() == fb.read()
+    assert not os.path.exists(sol.checkpoint_path(solB))
+
+    # resume with no checkpoint = a plain fresh run (same outputs)
+    msC, _, _ = _make_dataset(tmp_path, "uc.ms", seed=11)
+    _run(dict(base, resume=True), msC, str(tmp_path / "uc.sol"))
+    for a, b in zip(_corrected(msA), _corrected(msC)):
+        assert np.array_equal(a, b)
+
+
+def test_resume_refuses_mismatched_checkpoint(tmp_path):
+    msA, skyf, clusf = _make_dataset(tmp_path, "ma.ms", seed=11)
+    msB, _, _ = _make_dataset(tmp_path, "mb.ms", n_tiles=2, seed=11)
+    base = _base_config(skyf, clusf)
+    solp = str(tmp_path / "m.sol")
+    faults.enable([{"point": "ms_write", "kind": "fatal", "at": [1]}])
+    with pytest.raises(faults.FatalFault):
+        _run(base, msA, solp)
+    faults.disable()
+    # same solutions path, different dataset shape -> refused
+    with pytest.raises(ValueError, match="different run"):
+        _run(dict(base, resume=True), msB, solp)
+
+
+# ---------------------------------------------------------------------------
+# serve: deadlines, isolation, resume, socket drop, circuit breaker
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def server():
+    srv = Server(port=0, max_inflight=2)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_queue_deadline_expiry_accounting():
+    ometrics.enable()
+    q = jq.JobQueue(max_inflight=2)
+    j1 = q.submit(jq.Job("d1", cfg=None, deadline_s=0.0))
+    j2 = q.submit(jq.Job("d2", cfg=None))
+    with pytest.raises(ValueError, match="on_diverge"):
+        jq.Job("d3", cfg=None, on_diverge="explode")
+    time.sleep(0.01)
+    # admission expires the dead job and hands out the live one
+    assert q.next_admissible(lambda j: 0) is j2
+    assert j1.state == jq.DEADLINE_EXCEEDED
+    assert j1.finished_t is not None and j1.staged_bytes == 0
+    c = q.counts()
+    assert c["deadline_exceeded"] == 1
+    assert _counter("serve_jobs_total", state="deadline_exceeded") == 1
+    q.finish(j2, jq.DONE)
+    assert q.idle()
+
+
+def test_serve_deadline_running_job_stops_at_boundary(tmp_path, server,
+                                                      monkeypatch):
+    msA, skyf, clusf = _make_dataset(tmp_path, "da.ms", seed=11)
+    base = _base_config(skyf, clusf)
+    real_read = ds.SimMS.read_tile
+
+    def slow_read(self, i):
+        time.sleep(0.25)       # keep the job mid-flight deterministically
+        return real_read(self, i)
+
+    monkeypatch.setattr(ds.SimMS, "read_tile", slow_read)
+    with Client(port=server.port) as c:
+        ja = c.submit(dict(base, ms=msA), deadline_s=3600.0)
+        # wait for the first solved tile, then force the deadline into
+        # the past: the scheduler must stop dispatching at the next
+        # tile boundary, not mid-tile and not at job end
+        for _ in range(1500):
+            snap = c.status(ja)
+            if snap["state"] in jq.TERMINAL or snap["tiles_done"] >= 1:
+                break
+            time.sleep(0.02)
+        server.queue.get(ja).deadline_t = time.time() - 1.0
+        snap = c.wait(ja, timeout_s=120)
+        assert snap["state"] == jq.DEADLINE_EXCEEDED
+        assert snap["deadline_s"] == 3600.0
+        assert snap["tiles_done"] < 3
+        # the budget is released and the server keeps serving
+        monkeypatch.setattr(ds.SimMS, "read_tile", real_read)
+        jb = c.submit(dict(base, ms=msA))
+        assert c.wait(jb, timeout_s=300)["state"] == jq.DONE
+
+
+def test_serve_fatal_fault_fails_only_its_job(tmp_path, server):
+    """Isolation under injected faults (extends the PR 7 gate): a
+    fatal read fault targeted at job A's third tile fails ONLY job A
+    with the original injected traceback; neighbour B completes."""
+    msA, skyf, clusf = _make_dataset(tmp_path, "ia.ms", n_tiles=3,
+                                     seed=11)
+    msB, _, _ = _make_dataset(tmp_path, "ib.ms", n_tiles=2, seed=50)
+    base = _base_config(skyf, clusf)
+    # key 2 exists only in job A's 3-tile dataset -> deterministic aim
+    faults.enable([{"point": "ms_read", "kind": "fatal", "at": [2]}])
+    try:
+        with Client(port=server.port) as c:
+            ja = c.submit(dict(base, ms=msA))
+            jb = c.submit(dict(base, ms=msB))
+            snapA = c.wait(ja, timeout_s=300)
+            snapB = c.wait(jb, timeout_s=300)
+    finally:
+        faults.disable()
+    assert snapA["state"] == jq.FAILED
+    assert "injected fatal fault: ms_read" in snapA["error"]
+    assert "inject" in snapA["error_tb"]
+    assert snapB["state"] == jq.DONE
+
+
+def test_serve_resume_after_failure_bit_identical(tmp_path, server):
+    """The serve acceptance leg: a job killed by an injected fatal MS
+    write is resubmitted with resume=true and its final outputs are
+    bit-identical to an uninterrupted solo run."""
+    msA, skyf, clusf = _make_dataset(tmp_path, "ra.ms", seed=11)
+    base = _base_config(skyf, clusf)
+    solp = str(tmp_path / "ra.sol")
+    cfg = dict(base, ms=msA, solutions_file=solp)
+    faults.enable([{"point": "ms_write", "kind": "fatal", "at": [1]}])
+    try:
+        with Client(port=server.port) as c:
+            ja = c.submit(cfg)
+            snap = c.wait(ja, timeout_s=300)
+            assert snap["state"] == jq.FAILED
+            faults.disable()
+            jr = c.submit(dict(cfg, resume=True))
+            snap2 = c.wait(jr, timeout_s=300)
+            assert snap2["state"] == jq.DONE
+            assert snap2["tiles_done"] == 3
+    finally:
+        faults.disable()
+
+    msR, _, _ = _make_dataset(tmp_path, "rr.ms", seed=11)
+    solR = str(tmp_path / "rr.sol")
+    _run(base, msR, solR)
+    for a, b in zip(_corrected(msR), _corrected(msA)):
+        assert np.array_equal(a, b)
+    with open(solR) as fr, open(solp) as fp:
+        assert fr.read() == fp.read()
+
+
+def test_serve_divergence_circuit_breaker_and_quarantine(tmp_path,
+                                                         server):
+    msA, skyf, clusf = _make_dataset(tmp_path, "ca.ms", seed=11)
+    base = _base_config(skyf, clusf)
+    faults.enable([{"point": "solve_nan", "at": [1]}])
+    try:
+        with Client(port=server.port) as c:
+            ja = c.submit(dict(base, ms=msA), on_diverge="fail")
+            snap = c.wait(ja, timeout_s=300)
+    finally:
+        faults.disable()
+    assert snap["state"] == jq.FAILED
+    assert "divergence circuit-breaker" in snap["error"]
+    assert snap["on_diverge"] == "fail"
+
+    # quarantine: the same poison completes, health stays clean
+    msB, _, _ = _make_dataset(tmp_path, "cb.ms", seed=11)
+    faults.enable([{"point": "solve_nan", "at": [1]}])
+    try:
+        with Client(port=server.port) as c:
+            jb = c.submit(dict(base, ms=msB), on_diverge="quarantine")
+            snap = c.wait(jb, timeout_s=300)
+    finally:
+        faults.disable()
+    assert snap["state"] == jq.DONE
+    assert snap["health"] != "diverging"
+    assert _counter("tiles_quarantined_total", job=snap["job_id"]) == 1
+
+
+def test_serve_socket_drop_client_reconnects(tmp_path, server):
+    with Client(port=server.port) as c:
+        assert c.request(op="ping")["pong"]     # connection warm
+        faults.enable([{"point": "socket_drop", "kind": "fatal",
+                        "times": 1}])
+        try:
+            # the drop kills the connection mid-request; the client
+            # reconnects with backoff and the re-sent request succeeds
+            assert c.request(op="ping")["pong"]
+        finally:
+            faults.disable()
+
+    # bounded: with reconnects exhausted the original error surfaces
+    with Client(port=server.port, reconnects=1) as c2:
+        faults.enable([{"point": "socket_drop", "kind": "fatal",
+                        "times": 1}])
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                c2.request(op="ping")
+        finally:
+            faults.disable()
+
+
+def test_client_duplicate_job_id_still_raises_without_resend(tmp_path,
+                                                             server):
+    """A GENUINE duplicate job id (no reconnect/resend happened) must
+    still raise — only a retry-induced duplicate refusal reads as
+    'the first send landed'."""
+    msA, skyf, clusf = _make_dataset(tmp_path, "dup.ms", seed=11)
+    cfg = dict(_base_config(skyf, clusf), ms=msA)
+    with Client(port=server.port) as c:
+        jid = c.submit(cfg, job_id="dup-test")
+        assert jid == "dup-test"
+        with pytest.raises(RuntimeError, match="duplicate job id"):
+            c.submit(cfg, job_id="dup-test")
+
+
+# ---------------------------------------------------------------------------
+# zero-cost contract: inert plan == bit-identical, zero compiles
+# ---------------------------------------------------------------------------
+
+def test_inert_fault_plan_zero_cost(tmp_path):
+    """The diag/obs contract, extended to faults: with a LIVE but
+    inert plan installed (rules that never match), outputs are
+    bit-identical to the faults-off run and the whole run adds ZERO
+    compiles (injection seams are host-side only)."""
+    msA, skyf, clusf = _make_dataset(tmp_path, "za.ms", seed=11)
+    msB, _, _ = _make_dataset(tmp_path, "zb.ms", seed=11)
+    base = _base_config(skyf, clusf)
+    _run(base, msA, str(tmp_path / "za.sol"))   # warm + reference
+
+    faults.enable([{"point": "ms_read", "at": [10 ** 9]},
+                   {"point": "solve_nan", "at": [10 ** 9]}])
+    with guard.CompileGuard() as g:
+        _run(base, msB, str(tmp_path / "zb.sol"))
+    faults.disable()
+    assert g.compiles == 0, (
+        f"inert fault plan added {g.compiles} compiles")
+    for a, b in zip(_corrected(msA), _corrected(msB)):
+        assert np.array_equal(a, b)
+    assert (tmp_path / "za.sol").read_text() \
+        == (tmp_path / "zb.sol").read_text()
+
+
+def test_cli_faults_and_resume_flags(tmp_path):
+    """Both CLI flags parse and reach the config / harness."""
+    args = cli.build_parser().parse_args(
+        ["-d", "x.ms", "-s", "s", "-c", "c", "--resume",
+         "--faults", '[{"point": "ms_read"}]'])
+    cfg = cli.config_from_args(args)
+    assert cfg.resume is True
+    assert args.faults.startswith("[")
+    from sagecal_tpu import cli_mpi
+    margs = cli_mpi.build_parser().parse_args(
+        ["-f", "x", "-s", "s", "-c", "c",
+         "--faults", '[{"point": "ms_read"}]'])
+    assert margs.faults is not None

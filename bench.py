@@ -389,7 +389,7 @@ def refine_trip_flops(M, kmax, n_stations, B, robust, dtype):
 
 
 def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
-                     inner="chol"):
+                     inner="chol", kernel="xla"):
     """FLOPs + bytes accessed of ONE inner solver iteration at the
     per-cluster solve shape.
 
@@ -414,9 +414,17 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
     no Cholesky/assembly, which the LM price would wrongly charge.
     ``nbase``: the rows' baseline period, forwarded to the assembly so
     the priced program IS the solvers' (normal_eq row_period path).
+    ``kernel``: "pallas" prices the fused-sweep bodies the solvers
+    execute under SageConfig.kernel="pallas" (ops/sweep_pallas.py) —
+    assembly via the fused kernel and, under inner="cg", tCG/PCG
+    products on the B-independent per-baseline blocks. A
+    Mosaic-compiled pallas_call is invisible to XLA cost analysis, so
+    roofline.program_cost folds in the kernel's own cost_estimate
+    (roofline.pallas_cost); interpret-mode (CPU) lowerings price
+    through cost_analysis directly.
     """
     key = (int(solver_mode), kmax, n_stations, B, str(dtype), int(nbase),
-           str(inner))
+           str(inner), str(kernel))
     if key in _TRIP_CACHE:
         return _TRIP_CACHE[key]
     import jax
@@ -442,6 +450,11 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
     x8, coh = S((B, 8), f), S((B, 2, 2), c)
     s1, s2, cid = S((B,), i), S((B,), i), S((B,), i)
     wt, p = S((B, 8), f), S((K, P), fa)
+    use_pk = False
+    if kernel == "pallas":
+        from sagecal_tpu.ops import sweep_pallas as swp
+        use_pk = swp.supported(K, int(nbase), B)
+    nb_ = int(nbase)
     try:
         if int(solver_mode) in (int(SolverMode.RTR_OSLM_LBFGS),
                                 int(SolverMode.RTR_OSRLM_RLBFGS)):
@@ -450,7 +463,33 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
             rnu = (2.0 if int(solver_mode)
                    == int(SolverMode.RTR_OSRLM_RLBFGS) else None)
 
-            if inner == "cg":
+            if inner == "cg" and use_pk:
+                # fused-sweep assembly + B-independent blocks products
+                # (the bodies rtr.make_hess executes at kernel="pallas")
+                def outer(p, x8, coh, s1, s2, cid, wt):
+                    J = ne.jones_r2c(p.reshape(K, N, 8))
+                    cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt,
+                                            K, N, robust_nu=rnu)
+                    g = jax.grad(lambda q: jnp.sum(cfn(q)))(p)
+                    g = rtr_mod.project_tangent(p, g, K, N)
+                    fac, _, _ = swp.gn_blocks(x8, J, coh, s1, s2, cid,
+                                              wt, N, K, nb_)
+                    return g, fac, cfn(p)
+
+                def hv(p, pp, qq, pq, D, v, s1, s2):
+                    fac = swp.GNBlocks(pp=pp, qq=qq, pq=pq, D=D)
+                    Hv = 2.0 * swp.gn_matvec_blocks(fac, v, s1, s2, N)
+                    return rtr_mod.project_tangent(p, Hv, K, N)
+
+                trip = _rl().combine(
+                    _lower_cost(outer, p, x8, coh, s1, s2, cid, wt),
+                    _rl().scale(
+                        _lower_cost(hv, p, S((K, nb_, 2, 4, 4), fa),
+                                    S((K, nb_, 2, 4, 4), fa),
+                                    S((K, nb_, 2, 2, 4, 4), fa),
+                                    S((K, N, 2, 4, 4), fa), p, s1, s2),
+                        rtr_mod.RTRConfig().tcg_iters))
+            elif inner == "cg":
                 def outer(p, x8, coh, s1, s2, cid, wt):
                     J = ne.jones_r2c(p.reshape(K, N, 8))
                     cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt,
@@ -477,6 +516,25 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
                                     S((K, N, 2, 4, 4), fa), p,
                                     s1, s2, cid),
                         rtr_mod.RTRConfig().tcg_iters))
+            elif use_pk:
+                def outer(p, x8, coh, s1, s2, cid, wt):
+                    J = ne.jones_r2c(p.reshape(K, N, 8))
+                    cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt,
+                                            K, N, robust_nu=rnu)
+                    g = jax.grad(lambda q: jnp.sum(cfn(q)))(p)
+                    g = rtr_mod.project_tangent(p, g, K, N)
+                    JTJ, _, _ = swp.normal_equations_fused(
+                        x8, J, coh, s1, s2, cid, wt, N, K, nb_)
+                    return g, JTJ, cfn(p)
+
+                def hv(p, JTJ, v):
+                    Hv = 2.0 * jnp.einsum("kij,kj->ki", JTJ, v)
+                    return rtr_mod.project_tangent(p, Hv, K, N)
+
+                trip = _rl().combine(
+                    _lower_cost(outer, p, x8, coh, s1, s2, cid, wt),
+                    _rl().scale(_lower_cost(hv, p, S((K, P, P), fa), p),
+                                rtr_mod.RTRConfig().tcg_iters))
             else:
                 def outer(p, x8, coh, s1, s2, cid, wt):
                     J = ne.jones_r2c(p.reshape(K, N, 8))
@@ -521,9 +579,13 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
             # cg_trip_cost — lm.py counts them in info["cg_iters"].
             def lm_trip(JTe0, mu, p, x8, coh, s1, s2, cid, wt):
                 Jn = ne.jones_r2c(p.reshape(K, N, 8))
-                fac, JTe, cost = ne.gn_factors(x8, Jn, coh, s1, s2, cid,
-                                               wt, N, K,
-                                               row_period=int(nbase))
+                if use_pk:
+                    fac, JTe, cost = swp.gn_blocks(x8, Jn, coh, s1, s2,
+                                                   cid, wt, N, K, nb_)
+                else:
+                    fac, JTe, cost = ne.gn_factors(x8, Jn, coh, s1, s2,
+                                                   cid, wt, N, K,
+                                                   row_period=int(nbase))
                 Lfac = ne.gn_precond_factor(fac.D, mu + 1e-9)
                 z0 = ne.gn_precond_apply(Lfac, JTe, K, N)
                 return fac, JTe, cost, z0
@@ -576,6 +638,9 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
                 Jn = ne.jones_r2c((p + dp).reshape(K, N, 8))
                 # normal equations AND acceptance cost from the body's
                 # single row pass (lm.py); no separate cost evaluation
+                if use_pk:
+                    return swp.normal_equations_fused(
+                        x8, Jn, coh, s1, s2, cid, wt, N, K, nb_)
                 return ne.normal_equations(x8, Jn, coh, s1, s2, cid, wt,
                                            N, K, row_period=int(nbase))
 
@@ -589,7 +654,7 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
         return None
 
 
-def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0):
+def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0, kernel="xla"):
     """FLOPs + bytes of ONE executed PCG inner trip (lm.py
     _solve_damped_cg body under inner="cg"): one matrix-free gn_matvec
     over the Wirtinger factors + one station-block preconditioner apply
@@ -597,8 +662,12 @@ def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0):
     roofline.trip_correct — without this the matrix-free path's actual
     Krylov traffic would vanish from the roofline (the while_loop body
     prices once). The tiny [K,N,2] 4x4 factorization is charged per
-    damping trip (solver_trip_cost), not here."""
-    key = ("cgtrip", kmax, n_stations, B, str(dtype), int(nbase))
+    damping trip (solver_trip_cost), not here. ``kernel="pallas"``
+    prices the B-independent blocks matvec
+    (sweep_pallas.gn_matvec_blocks) instead of the [B]-row factor
+    pass — the melt the fused-sweep kernel buys the cg path."""
+    key = ("cgtrip", kmax, n_stations, B, str(dtype), int(nbase),
+           str(kernel))
     if key in _TRIP_CACHE:
         return _TRIP_CACHE[key]
     import jax
@@ -610,7 +679,31 @@ def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0):
     fa = dtp.acc_dtype(dtype)
     i = jnp.int32
     S = jax.ShapeDtypeStruct
+    use_pk = False
+    if kernel == "pallas":
+        from sagecal_tpu.ops import sweep_pallas as swp
+        use_pk = swp.supported(K, int(nbase), B)
+    nb_ = int(nbase)
     try:
+        if use_pk:
+            def body(pp, qq, pq, Larr, v, r, shift, s1, s2):
+                fac = swp.GNBlocks(pp=pp, qq=qq, pq=pq, D=Larr)
+                Ap = swp.gn_matvec_blocks(fac, v, s1, s2, N,
+                                          shift=shift)
+                alpha = jnp.sum(r * r, axis=-1) \
+                    / jnp.maximum(jnp.sum(v * Ap, axis=-1), 1e-30)
+                rn = r - alpha[:, None] * Ap
+                z = ne.gn_precond_apply((Larr, True), rn, K, N)
+                return rn, z, jnp.sum(rn * z, axis=-1)
+
+            trip = _lower_cost(
+                body, S((K, nb_, 2, 4, 4), fa), S((K, nb_, 2, 4, 4), fa),
+                S((K, nb_, 2, 2, 4, 4), fa), S((K, N, 2, 4, 4), fa),
+                S((K, 8 * N), fa), S((K, 8 * N), fa), S((K,), fa),
+                S((B,), i), S((B,), i))
+            _TRIP_CACHE[key] = trip
+            return trip
+
         def body(MA, MB, w2, Larr, v, r, shift, s1, s2, cid):
             fac = ne.GNFactors(MA=MA, MB=MB, w2=w2, D=Larr)
             Ap = ne.gn_matvec(fac, v, s1, s2, cid, K, N, shift=shift,
@@ -720,7 +813,8 @@ def pallas_ok(device, dtype, sky) -> bool:
 
 def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
               max_emiter=3, max_iter=10, max_lbfgs=10, use_pallas=False,
-              inflight=1, inner="chol", dtype_policy="f32"):
+              inflight=1, inner="chol", dtype_policy="f32",
+              kernel="xla"):
     """Compile + time one batched SAGE solve over ``tiles`` independent
     solve intervals; returns (vis/s, r0, r1, dt, compile_s, cost_step)
     where cost_step is {"flops", "bytes_accessed"} per timed step (or
@@ -765,7 +859,7 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
     cfg = sage.SageConfig(max_emiter=max_emiter, max_iter=max_iter,
                           max_lbfgs=max_lbfgs, solver_mode=int(solver_mode),
                           inflight=inflight, nbase=tile.nbase, inner=inner,
-                          dtype_policy=dtype_policy)
+                          dtype_policy=dtype_policy, kernel=kernel)
     if T > 1:
         # tile-batch trials route through the per-sweep host-tiles
         # driver (VERDICT r5 weak #3): force-fuse each EM sweep into
@@ -859,7 +953,8 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
         refine_trips = float(np.asarray(lk).sum())
         cg_trips = float(np.asarray(ci).sum())
         tf = solver_trip_cost(solver_mode, kmax, n, tile.nrows, sdt,
-                              nbase=tile.nbase, inner=inner)
+                              nbase=tile.nbase, inner=inner,
+                              kernel=kernel)
         rf = refine_trip_cost(sky.n_clusters, kmax, n, tile.nrows,
                               sage._is_robust(int(solver_mode)), sdt)
         # composition detail so config 7 can re-price at EQUAL trip
@@ -884,7 +979,7 @@ def time_sage(device, dtype, sky, dsky, tiles, solver_mode, reps=2,
             # the matrix-free path's Krylov traffic: executed PCG trips
             # (info["cg_iters"]) x one matvec + preconditioner apply
             cf = cg_trip_cost(kmax, n, tile.nrows, sdt,
-                              nbase=tile.nbase)
+                              nbase=tile.nbase, kernel=kernel)
             cost_step = rl.trip_correct(cost_step, cf, cg_trips)
         cost_step.update(detail)
         log(f"# flops: {trips:.0f} solver trips x "
@@ -964,6 +1059,22 @@ def _dtype_policy_for() -> str:
     return v
 
 
+def _kernel_for() -> str:
+    """Row-pass kernel for the SAGE configs (SAGECAL_BENCH_KERNEL
+    override: "xla" | "pallas"). Default xla — the bit-frozen reference
+    the banked rounds price. "pallas" routes the per-cluster assembly
+    and the inner="cg" matvec through the fused-sweep kernel
+    (ops/sweep_pallas.py; interpret-mode on CPU). Non-default runs tag
+    their records with ``kernel`` and are NEVER round-stamped as the
+    standard configs (mirror of the SAGECAL_BENCH_DTYPE exploration
+    rule); tools_dev/northstar.py --b-scaling --kernel both is the
+    banked vehicle for the kernel-on/off deltas (BSCALING_r11.json)."""
+    v = os.environ.get("SAGECAL_BENCH_KERNEL", "xla")
+    if v not in ("xla", "pallas"):
+        raise SystemExit(f"SAGECAL_BENCH_KERNEL={v}: pick xla|pallas")
+    return v
+
+
 def _inner_for() -> str:
     """Inner linear solver for the SAGE configs (SAGECAL_BENCH_INNER
     override: "chol" | "cg"). Default chol — the measured verdict
@@ -1005,6 +1116,7 @@ def config1_fullbatch_lm(device, dtype):
     T = _tiles_for(device)
     G, Ge = _inflight_for(device, 8)
     inr = _inner_for()
+    kern = _kernel_for()
     pol = _dtype_policy_for()
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=8,
                                        tilesz=10, n_tiles=T)
@@ -1012,12 +1124,14 @@ def config1_fullbatch_lm(device, dtype):
     vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
                                           SolverMode.OSLM_OSRLM_RLBFGS,
                                           use_pallas=pal, inflight=G,
-                                          inner=inr, dtype_policy=pol)
-    itag = "" if inr == "chol" else f" inner={inr}"
+                                          inner=inr, dtype_policy=pol,
+                                          kernel=kern)
+    itag = ("" if inr == "chol" else f" inner={inr}") \
+        + ("" if kern == "xla" else f" kernel={kern}")
     ptag = "" if pol == "f32" else f" {pol}"
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, pallas=pal, tiles=T,
-               inflight=G, inflight_eff=Ge, inner=inr,
+               inflight=G, inflight_eff=Ge, inner=inr, kernel=kern,
                shape=f"N=62 M=8 tilesz=10 point -j3 T{T} G{Ge}{itag}{ptag}")
     if pol != "f32":
         out["dtype_policy"] = pol
@@ -1026,7 +1140,8 @@ def config1_fullbatch_lm(device, dtype):
         vps0, _, _, _, _, _ = time_sage(device, dtype, sky, dsky, tiles,
                                         SolverMode.OSLM_OSRLM_RLBFGS,
                                         use_pallas=False, inflight=G,
-                                        inner=inr, dtype_policy=pol)
+                                        inner=inr, dtype_policy=pol,
+                                          kernel=kern)
         out["value_xla"] = vps0
         out["pallas_speedup"] = vps / vps0
     return out
@@ -1205,6 +1320,7 @@ def config3_rtr16(device, dtype):
     T = _tiles_for(device)
     G, Ge = _inflight_for(device, 16)
     inr = _inner_for()
+    kern = _kernel_for()
     pol = _dtype_policy_for()
     sky, dsky, tiles = build_fullbatch(dtype, n_stations=62, n_clusters=16,
                                        tilesz=10, seed=SEED + 10,
@@ -1213,13 +1329,15 @@ def config3_rtr16(device, dtype):
                                           SolverMode.RTR_OSRLM_RLBFGS,
                                           reps=1, max_emiter=emi,
                                           inflight=G, inner=inr,
+                                          kernel=kern,
                                           dtype_policy=pol)
     small = "" if on_tpu else " (cpu-small E1)"
-    itag = "" if inr == "chol" else f" inner={inr}"
+    itag = ("" if inr == "chol" else f" inner={inr}") \
+        + ("" if kern == "xla" else f" kernel={kern}")
     ptag = "" if pol == "f32" else f" {pol}"
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, tiles=T, inflight=G,
-               inflight_eff=Ge, inner=inr,
+               inflight_eff=Ge, inner=inr, kernel=kern,
                shape=f"N=62 M=16 tilesz=10 point -j5 T{T} G{Ge}"
                      f"{small}{itag}{ptag}")
     if pol != "f32":
@@ -1244,18 +1362,21 @@ def config4_extended(device, dtype):
                                        n_tiles=T)
     pal = pallas_ok(device, dtype, sky)
     inr = _inner_for()
+    kern = _kernel_for()
     pol = _dtype_policy_for()
     vps, r0, r1, dt, comp, fl = time_sage(device, dtype, sky, dsky, tiles,
                                           SolverMode.RTR_OSRLM_RLBFGS,
                                           reps=1, max_emiter=emi,
                                           use_pallas=pal, inflight=G,
-                                          inner=inr, dtype_policy=pol)
+                                          inner=inr, dtype_policy=pol,
+                                          kernel=kern)
     small = "" if on_tpu else " (cpu-small E1)"
-    itag = "" if inr == "chol" else f" inner={inr}"
+    itag = ("" if inr == "chol" else f" inner={inr}") \
+        + ("" if kern == "xla" else f" kernel={kern}")
     ptag = "" if pol == "f32" else f" {pol}"
     out = dict(value=vps, unit="vis/s", res_0=r0, res_1=r1,
                step_s=dt, compile_s=comp, pallas=pal, tiles=T,
-               inflight=G, inflight_eff=Ge, inner=inr,
+               inflight=G, inflight_eff=Ge, inner=inr, kernel=kern,
                shape=f"N=64 M=8 shapelet+gauss -F1 -j5 T{T} G{Ge}"
                      f"{small}{itag}{ptag}")
     if pol != "f32":
@@ -1266,7 +1387,8 @@ def config4_extended(device, dtype):
                                         SolverMode.RTR_OSRLM_RLBFGS,
                                         reps=1, max_emiter=emi,
                                         use_pallas=False, inflight=G,
-                                        inner=inr, dtype_policy=pol)
+                                        inner=inr, dtype_policy=pol,
+                                          kernel=kern)
         out["value_xla"] = vps0
         out["pallas_speedup"] = vps / vps0
     return out
@@ -1310,11 +1432,13 @@ def config5_admm32(device, dtype):
     mesh = Mesh(np.array([device]), axis_names=("freq",))
 
     inr = _inner_for()
+    kern = _kernel_for()
     cfg = cadmm.ADMMConfig(
         n_admm=n_admm, npoly=2, rho=2.0, manifold_iters=5,
         sage=sage.SageConfig(max_emiter=1, max_iter=3, max_lbfgs=3,
                              solver_mode=int(SolverMode.LM_LBFGS),
-                             nbase=tile.nbase, inner=inr))
+                             nbase=tile.nbase, inner=inr,
+                             kernel=kern))
     # host_loop: one bounded execution per ADMM iteration — required on
     # the tunneled chip (~60 s per-execution kill with F=32 folded onto
     # one device) and much cheaper to compile
@@ -1353,10 +1477,11 @@ def config5_admm32(device, dtype):
     per_iter = (time.perf_counter() - t0) / reps / n_admm
     res0, res1 = np.asarray(out[3]), np.asarray(out[4])
     small = "" if on_tpu else " (cpu-small)"
-    itag = "" if inr == "chol" else f" inner={inr}"
+    itag = ("" if inr == "chol" else f" inner={inr}") \
+        + ("" if kern == "xla" else f" kernel={kern}")
     rec = dict(value=per_iter, unit="s/ADMM-iter", compile_s=comp,
                res_0=float(res0.mean()), res_1=float(res1.mean()),
-               inner=inr,
+               inner=inr, kernel=kern,
                shape=f"F={F} N={n_stations} M={n_clusters} "
                      f"folded-1-chip x{n_admm}it{small}{itag}")
     # roofline: the ADMM J-update trip count is static here — the LM stop
@@ -1376,7 +1501,8 @@ def config5_admm32(device, dtype):
             "price would undercount the Krylov traffic")
         return rec
     tf = solver_trip_cost(int(SolverMode.LM_LBFGS), kmax, n_stations,
-                          B, dtype, nbase=tile.nbase, inner=inr)
+                          B, dtype, nbase=tile.nbase, inner=inr,
+                          kernel=kern)
     if tf:
         fl = _rl().scale(tf, F * n_clusters * cfg.sage.max_iter)
         _roofline_fields(rec, device, fl, per_iter)
@@ -1971,10 +2097,16 @@ def write_table(results, platform, date=None, stamp=False):
         off_policy = {k for k, v in results.items()
                       if isinstance(v, dict)
                       and v.get("dtype_policy", "f32") != "f32"}
+        # same rule for SAGECAL_BENCH_KERNEL exploration runs: the
+        # banked reference stays the bit-frozen xla path (northstar
+        # --b-scaling --kernel both is the banked kernel comparison)
+        off_policy |= {k for k, v in results.items()
+                       if isinstance(v, dict)
+                       and v.get("kernel", "xla") != "xla"}
         if off_policy:
             log(f"# refusing to round-stamp off-policy records "
                 f"{sorted(off_policy)}; rerun without "
-                f"SAGECAL_BENCH_DTYPE to bank")
+                f"SAGECAL_BENCH_DTYPE/SAGECAL_BENCH_KERNEL to bank")
             payload = {"platform": platform, "date": date,
                        "results": {k: v for k, v in results.items()
                                    if k not in off_policy}}

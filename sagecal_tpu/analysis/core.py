@@ -62,8 +62,13 @@ RULES = {
 # exactly the paths it exists to observe; faults.py joined in ISSUE 10
 # — the injection/retry layer wraps every I/O seam's hot loop, and its
 # ``faults.active()`` gate is blessed alongside ``dtrace.active()`` /
-# ``obs.active()`` by _is_active_gate's ``.active`` suffix match)
-_HOT_SEGMENTS = ("solvers", "consensus", "rime", "serve", "obs")
+# ``obs.active()`` by _is_active_gate's ``.active`` suffix match;
+# ops/ joined in ISSUE 11 — the Pallas kernel bodies (coh_pallas,
+# sweep_pallas) ARE the hottest per-row code in the tree, and a
+# reduced-dtype kernel accumulator is exactly the storage-accum bug
+# class: pl.pallas_call joined _TRACE_WRAPPERS so kernel bodies count
+# as traced)
+_HOT_SEGMENTS = ("solvers", "consensus", "rime", "serve", "obs", "ops")
 _HOT_BASENAMES = ("pipeline.py", "sched.py", "faults.py")
 
 
@@ -202,6 +207,11 @@ _TRACE_WRAPPERS = {
     "jax.lax.cond", "lax.cond",
     "jax.lax.switch", "lax.switch",
     "jax.lax.map", "lax.map",
+    # a Pallas kernel body runs under the Pallas trace — its reductions
+    # and dtype choices are hot-path territory like any jitted kernel
+    # (the per-cell block arrives as a Ref, but the body's jnp ops are
+    # ordinary traced code)
+    "pl.pallas_call", "pallas_call",
 }
 
 

@@ -120,6 +120,12 @@ def build_parser() -> argparse.ArgumentParser:
            "(bit-reference); cg = matrix-free preconditioned CG "
            "(never forms the normal matrix; MIGRATION.md 'Inner "
            "linear solver')")
+    a("--kernel", choices=("xla", "pallas"), default="xla",
+      help="row-pass kernel for the per-cluster solve assembly: xla = "
+           "bit-frozen default; pallas = fused-sweep kernel (one "
+           "streaming [B]-pass per damping/TR iteration + B-"
+           "independent blocks matvec per cg trip; interpret-mode on "
+           "CPU; MIGRATION.md 'Pallas kernels')")
     a("--shard-baselines", action="store_true",
       help="shard the baseline row axis of the (single) subband over "
            "all devices (P1 intra-subband parallelism)")
@@ -211,6 +217,7 @@ def config_from_args(args) -> RunConfig:
         solve_promote=args.solve_promote,
         cluster_inflight=args.inflight,
         solver_inner=args.inner,
+        solver_kernel=args.kernel,
         dtype_policy=args.dtype_policy,
         tile_bucket=args.tile_bucket,
         prefetch=args.prefetch,

@@ -128,6 +128,11 @@ def build_parser() -> argparse.ArgumentParser:
            "dense [K,8N,8N] assembly (bit-reference); cg = matrix-free "
            "preconditioned Krylov — melts the B-independent "
            "factorization floor at north-star N/M (PERF.md round 7)")
+    a("--kernel", choices=("xla", "pallas"), default="xla",
+      help="row-pass kernel for the per-cluster solve assembly: xla = "
+           "bit-frozen default; pallas = fused-sweep kernel "
+           "(ops/sweep_pallas.py; interpret-mode on CPU; PERF.md "
+           "round 11 for the measured cg trip-price melt)")
     a("--host-loop", action="store_true",
       help="one device execution per ADMM iteration instead of a fully "
            "traced n_admm-iteration program")
@@ -363,6 +368,7 @@ def _main_consensus(args, dtrace) -> int:
             nulow=args.nulow, nuhigh=args.nuhigh,
             randomize=bool(args.randomize),
             inflight=args.inflight, inner=args.inner,
+            kernel=args.kernel,
             dtype_policy=getattr(args, "dtype_policy", "f32")))
 
     t0 = mss[0].read_tile(0)

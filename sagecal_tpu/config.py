@@ -153,6 +153,13 @@ class RunConfig:
     # [K, 8N, 8N] assembly (bit-reference), "cg" matrix-free
     # preconditioned Krylov — see MIGRATION.md "Inner linear solver"
     solver_inner: str = "chol"
+    # --kernel : row-pass kernel for the per-cluster solve assembly
+    # (sage.SageConfig.kernel): "xla" (bit-frozen default) | "pallas"
+    # (ops/sweep_pallas.py fused-sweep kernel — one streaming [B]-pass
+    # per damping/TR iteration + a B-independent blocks matvec per
+    # PCG/tCG trip; interpret-mode on CPU, compiled Mosaic on TPU;
+    # tolerance-gated parity — MIGRATION.md "Pallas kernels")
+    solver_kernel: str = "xla"
     # --dtype-policy : storage dtype for the [B]-proportional data
     # (visibilities, weights, staged residual tiles, Wirtinger
     # factors): "f32" (identity, bit-frozen default) | "bf16" | "f16".

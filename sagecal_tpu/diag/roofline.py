@@ -78,12 +78,68 @@ def _from_cost_analysis(ca) -> dict:
             "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
 
 
+def pallas_cost(jfn, args, kwargs=None) -> dict:
+    """{flops, bytes_accessed} summed over the COMPILED pallas_call
+    equations in ``jfn``'s jaxpr.
+
+    XLA's cost analysis cannot see inside a Mosaic-compiled
+    ``pallas_call`` — on TPU the kernel lowers to an opaque custom call
+    priced at ~zero, silently dropping the fused sweep's traffic from
+    every per-trip figure. This walks the (pre-lowering) jaxpr instead:
+    each pallas_call carries its author's ``cost_estimate``
+    (ops/sweep_pallas.py provides one; absent that, bytes fall back to
+    the operand+result aval sizes — the same each-buffer-moves-once
+    convention as XLA's own figure, with flops unknown = 0).
+    INTERPRET-mode calls are skipped: the interpreter lowering is plain
+    HLO, which cost_analysis already prices — adding the estimate there
+    would double-count (so CPU-banked rounds stay consistent)."""
+    import jax
+    out = zero_cost()
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                if eqn.params.get("interpret"):
+                    continue
+                ce = eqn.params.get("cost_estimate")
+                if ce is not None and (getattr(ce, "flops", 0)
+                                       or getattr(ce, "bytes_accessed",
+                                                  0)):
+                    out["flops"] += float(ce.flops)
+                    out["bytes_accessed"] += float(ce.bytes_accessed)
+                else:
+                    out["bytes_accessed"] += float(sum(
+                        v.aval.size * v.aval.dtype.itemsize
+                        for v in list(eqn.invars) + list(eqn.outvars)
+                        if hasattr(v, "aval")))
+            for v in eqn.params.values():
+                # sub-jaxprs hide in several param shapes: a bare
+                # ClosedJaxpr (pjit/scan/while), an object with .eqns,
+                # or a TUPLE of ClosedJaxprs (lax.cond/switch
+                # 'branches') — missing the tuple case would silently
+                # drop any kernel sitting under a solver-mode cond
+                for sub in (v if isinstance(v, (tuple, list)) else (v,)):
+                    if isinstance(sub, jax.core.ClosedJaxpr):
+                        walk(sub.jaxpr)
+                    elif hasattr(sub, "eqns"):
+                        walk(sub)
+
+    try:
+        walk(jax.make_jaxpr(jfn)(*args, **(kwargs or {})).jaxpr)
+    except Exception:           # pricing must never break a bench run
+        pass
+    return out
+
+
 def program_cost(jfn, args, kwargs=None) -> dict:
     """FLOPs + bytes accessed of ONE execution of the compiled program
-    ``jfn(*args, **kwargs)`` via XLA cost analysis. Static figures: loop
-    bodies price once (callers correct with executed trip counts)."""
+    ``jfn(*args, **kwargs)`` via XLA cost analysis, plus the
+    :func:`pallas_cost` correction for Mosaic-compiled kernels the
+    analysis cannot see into. Static figures: loop bodies price once
+    (callers correct with executed trip counts)."""
     comp = jfn.lower(*args, **(kwargs or {})).compile()
-    return _from_cost_analysis(comp.cost_analysis())
+    cost = _from_cost_analysis(comp.cost_analysis())
+    return combine(cost, pallas_cost(jfn, args, kwargs))
 
 
 def lower_cost(fn, *specs) -> dict:
@@ -125,7 +181,14 @@ def trip_correct(cost, per_trip, trips) -> dict:
     preconditioner application; pricing the damping trip alone would
     hide the Krylov traffic the inexact-Newton path actually moves.
     ``per_trip=None`` (pricing unavailable) returns ``cost`` unchanged
-    rather than silently zeroing the base figure."""
+    rather than silently zeroing the base figure.
+
+    Pallas note: per-trip prices that contain a Mosaic-compiled
+    ``pallas_call`` must come from :func:`program_cost`/
+    :func:`lower_cost` (which fold in :func:`pallas_cost`) — raw
+    cost_analysis figures silently drop the kernel's bytes/FLOPs, and
+    multiplying a dropped cost by the trip count here would compound
+    the hole."""
     if cost is None or per_trip is None:
         return cost
     return combine(cost, scale(per_trip, trips))

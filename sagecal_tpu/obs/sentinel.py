@@ -363,6 +363,65 @@ def probe_faults(workdir: str | None = None) -> list:
     return []
 
 
+def probe_kernel() -> list:
+    """The fused-sweep kernel flag's zero-cost contract (ISSUE 11):
+    flipping ``kernel`` between "xla" and "pallas" selects between two
+    independently cached programs — running a pallas solve and then
+    returning to the DEFAULT xla path must add ZERO compiles (the flag
+    is a clean static, it never poisons the bit-frozen default's
+    compile cache). Probed live because no bank records it; a
+    regression here (the flag leaking into a shared cache key by
+    value, or a non-static dispatch) would recompile every default
+    solve the moment anyone tries the kernel."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sagecal_tpu.diag import guard
+    from sagecal_tpu.solvers import lm as lm_mod
+
+    rng = np.random.default_rng(0)
+    N, T = 5, 4
+    p, q = np.triu_indices(N, k=1)
+    nb = len(p)
+    B = nb * T
+    s1 = jnp.asarray(np.tile(p, T).astype(np.int32))
+    s2 = jnp.asarray(np.tile(q, T).astype(np.int32))
+    cid = jnp.zeros((B,), jnp.int32)
+    coh = jnp.asarray(rng.normal(size=(B, 2, 2))
+                      + 1j * rng.normal(size=(B, 2, 2)), jnp.complex64)
+    x8 = jnp.asarray(rng.normal(size=(B, 8)), jnp.float32)
+    wt = jnp.ones((B, 8), jnp.float32)
+    J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex64), (1, N, 1, 1))
+
+    @functools.partial(jax.jit, static_argnames=("kern",))
+    def _solve(x8, coh, s1, s2, cid, wt, J0, kern):
+        cfg = lm_mod.LMConfig(itmax=3, kernel=kern)
+        J, _ = lm_mod.lm_solve(x8, coh, s1, s2, cid, wt, J0, N,
+                               row_period=nb, config=cfg)
+        return J
+
+    def solve(kern):
+        return _solve(x8, coh, s1, s2, cid, wt, J0,
+                      kern=kern).block_until_ready()
+
+    solve("xla")                               # warm the default path
+    solve("pallas")                            # kernel on (may compile)
+    with guard.CompileGuard() as g:
+        solve("xla")                           # back to default: cached
+    if g.compiles:
+        return [{"config": "probe", "metric": "cache",
+                 "field": "compiles", "live": float(g.compiles),
+                 "banked": 0.0, "limit": 0.0, "source": "probe",
+                 "msg": (f"probe/kernel: returning to kernel='xla' "
+                         f"after a pallas solve added {g.compiles} "
+                         "compiles — the kernel flag poisons the "
+                         "default path's compile cache")}]
+    return []
+
+
 # ---------------------------------------------------------------------------
 # full mode: re-run the fast bench configs and compare to the bank
 # ---------------------------------------------------------------------------
@@ -450,6 +509,7 @@ def main(argv=None) -> int:
         viol.extend(probe_overlap())
         viol.extend(probe_cache())
         viol.extend(probe_faults())
+        viol.extend(probe_kernel())
     if args.json:
         print(json.dumps(viol, indent=1))
     for v in viol:

@@ -1,0 +1,574 @@
+"""Pallas fused-sweep kernel: the cluster visit's [B]-pass in ONE grid.
+
+The per-cluster solve floor is data movement over the baseline axis, not
+arithmetic (arXiv:1910.13908, arXiv:1410.8706; this repo's own
+BSCALING_r07.json: a ~34 ms/cluster B-independent floor under ``chol``
+and a 13.6-16.6x loss for ``cg`` because every PCG trip re-pays a full
+[B]-row pass). The XLA assembly (solvers/normal_eq.py) walks the rows
+several times per damping iteration — model eval, residual, Wirtinger
+factors MA/MB, then the Gram/gradient contractions — materializing
+[B]-sized intermediates between fused regions. This module melts that
+structurally, in two pieces (reference GPU analogue: the hand-fused
+mderiv.cu / lmfit_cuda.c kernels):
+
+1. :func:`sweep_blocks` — ONE streaming pass over the [B] rows per
+   cluster visit (per hybrid chunk). Each grid cell loads a
+   [bt, nbase] time-block of the visibility rows, evaluates the model
+   (Jp C Jq^H), the residual, and the Wirtinger factors entirely in
+   registers/VMEM, and accumulates PER-BASELINE Gram blocks (pp/qq/pq),
+   gradients (jtep/jteq) and the acceptance cost with f32 (acc-dtype)
+   accumulators over bf16/f16 storage operands. NOTHING [B]-sized is
+   written back — the outputs are [K, nbase]-sized, B-independent
+   partials.
+2. :func:`gn_matvec_blocks` — the matrix-free PCG/tCG product computed
+   from those per-baseline blocks: y = (JTJ + shift I) v becomes one
+   VMEM-resident pass over [K, nbase] 8x8-structured blocks (gather v
+   per baseline, block products, scatter-add per station). Exact up to
+   summation order: JTJ is the sum of per-baseline outer blocks, so
+   contracting the time axis into the blocks FIRST (once per outer
+   point, in the fused sweep) turns every inner trip from a full
+   [B]-row pass into an O(nbase) pass — the structural reason
+   ``--inner cg`` stops re-paying row traffic per trip.
+
+Wrappers (:func:`normal_equations_fused`, :func:`gn_blocks`) return the
+same (op, JTe, cost) contract as normal_eq.normal_equations /
+gn_factors, so lm.py / rtr.py dispatch on a ``kernel='xla'|'pallas'``
+config flag. Dispatch follows the ops/coh_pallas.py precedent:
+:func:`supported` gating (baseline-major layout, kmax <= MAX_CHUNKS) +
+``interpret=`` for CPU correctness — CPU executions run the SAME kernel
+through the Pallas interpreter (parity-gated in
+tests/test_sweep_pallas.py), while the ``kernel='xla'`` default stays
+bit-frozen. Summation-order freedom: the fused pass contracts (time,
+component) axes in a different order than the XLA einsums, so parity vs
+the dense reference is tolerance-gated (tight at f32/f64; per-policy
+envelopes under bf16/f16 — MIGRATION.md "Pallas kernels").
+
+Hybrid chunks: cluster time chunks are contiguous time blocks
+(rime.predict.chunk_indices), but their boundaries are traced
+per-cluster values, so the kernel cannot slice rows per chunk
+statically. Instead the grid is (K, time-blocks): chunk k's cells
+re-stream the rows with a ``chunk_id == k`` row mask folded into the
+weights and chunk k's per-baseline Jones planes. K <= MAX_CHUNKS keeps
+the re-read factor bounded (K == 1, the single-chunk common case, skips
+the mask entirely).
+
+Layout: rows arrive [tilesz * nbase, 8] baseline-major (the same
+row_period invariant normal_eq builds on) and are VIEWED [T, nbase, ...]
+— no transposes, no copies. Inside the kernel every quantity is a
+[bt, nbase] plane (baselines ride the trailing/lane axis); the 2x2
+complex algebra unrolls over the tiny station-component indices with the
+factor-matrix sign structure folded in at trace time (MA/MB are +/-
+aliases of the A/Bm planes — see normal_eq._ma_factor/_mb_factor).
+Complex inputs are split re/im OUTSIDE the kernel (Pallas has no
+complex dtype); the Jones gathers are [K, nbase]-sized (per-baseline,
+not per-row).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from sagecal_tpu import dtypes as dtp
+
+#: flop estimate per visibility-row visit for one fused sweep pass
+#: (model eval + residual + factor Grams + gradients + cost); feeds the
+#: pl.CostEstimate AND diag/roofline's pallas pricing (bench satellite:
+#: cost_analysis cannot see inside a compiled pallas_call)
+SWEEP_FLOPS_PER_ROW = 1100
+#: flop estimate per (chunk, baseline) block for one blocks matvec
+MATVEC_FLOPS_PER_BASELINE = 300
+#: hybrid-chunk cap: the grid re-streams the rows once per chunk, so
+#: the fused pass stops paying above a few chunks (reference hybrid
+#: clusters use 1-2)
+MAX_CHUNKS = 4
+
+
+def supported(kmax: int, row_period: int, B: int) -> bool:
+    """True when the fused kernels apply: baseline-major
+    [tilesz, nbase] row layout (the normal_eq row_period invariant) and
+    a bounded hybrid-chunk count. Host-side static decision."""
+    return (1 <= kmax <= MAX_CHUNKS and row_period > 0
+            and B % row_period == 0)
+
+
+def interpret_default() -> bool:
+    """Pallas interpreter on every non-TPU backend (the coh_pallas
+    CPU-correctness contract); compiled Mosaic on TPU."""
+    return jax.default_backend() != "tpu"
+
+
+class GNBlocks(NamedTuple):
+    """Per-(chunk, baseline) Gram blocks of the Gauss-Newton operator
+    at the current point — the ``kernel='pallas'`` analogue of
+    normal_eq.GNFactors. All leaves accumulate in the acc dtype.
+
+    pp: [K, nb, 2, 4, 4] station-p diagonal sub-blocks (block-diag over
+        the first complex index — the dense [8, 8] station block is
+        I2 (x) pp);
+    qq: [K, nb, 2, 4, 4] station-q diagonal sub-blocks;
+    pq: [K, nb, 2, 2, 4, 4] station-pair cross blocks (row (a, i), col
+        (o, j) of the dense [8, 8] off-diagonal block);
+    D:  [K, N, 2, 4, 4] station-aggregated diagonal blocks (the exact
+        preconditioner / mu0 seed — identical quantity to GNFactors.D).
+    """
+
+    pp: jax.Array
+    qq: jax.Array
+    pq: jax.Array
+    D: jax.Array
+
+
+def _pick_bt(T: int, nb: int, itemsize: int) -> int:
+    """Largest divisor of T keeping one grid cell's INPUT set under
+    ~4 MB (the VMEM working-set budget; on CPU interpret this usually
+    means bt == T — a single fused region per chunk). Per time-row the
+    cell loads 3 row-blocks (x/w/cw: 8 components each) + 2 coherency
+    blocks (4 components each) = 32 elements/baseline — budgeting only
+    one block would overshoot VMEM ~4x at exactly the large shapes the
+    kernel targets."""
+    budget = 4 << 20
+    bt = max(1, min(T, budget // max(nb * 32 * itemsize, 1)))
+    while T % bt:
+        bt -= 1
+    return bt
+
+
+def _cplx_mats(x, tag):
+    """[..., 2, 2] array -> {(tag, i, j): plane} dict of planes."""
+    return {(tag, i, j): x[..., i, j] for i in range(2)
+            for j in range(2)}
+
+
+# factor-matrix sign structure (normal_eq._ma_factor/_mb_factor), as
+# trace-time tables: MA[o, ri, (d, ci)] over the A = C Jq^H planes and
+# MB[a, ri, (d, ci)] over the Bm = Jp C planes. Each entry is
+# (sign, part, row, col) with part "r"/"i" selecting the re/im plane.
+def _ma_entry(o, ri, d, ci):
+    if ri == 0 and ci == 0:
+        return (1.0, "r", d, o)
+    if ri == 0 and ci == 1:
+        return (-1.0, "i", d, o)
+    if ri == 1 and ci == 0:
+        return (1.0, "i", d, o)
+    return (1.0, "r", d, o)                     # ri == 1, ci == 1
+
+
+def _mb_entry(a, ri, d, ci):
+    if ri == 0 and ci == 0:
+        return (1.0, "r", a, d)
+    if ri == 0 and ci == 1:
+        return (1.0, "i", a, d)
+    if ri == 1 and ci == 0:
+        return (1.0, "i", a, d)
+    return (-1.0, "r", a, d)                    # ri == 1, ci == 1
+
+
+def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
+                  jpr_ref, jpi_ref, jqr_ref, jqi_ref, pp_ref, qq_ref,
+                  pq_ref, jte_ref, cost_ref, *, acc, reduced, st,
+                  kmax):
+    """One (chunk, time-block) grid cell of the fused sweep.
+
+    Refs: x/w/cw [bt, nb, 8] storage; cid [bt, nb] int32 (row chunk
+    ids); chr/chi [bt, nb, 2, 2] acc (coherency re/im); jp*/jq*
+    [1, nb, 2, 2] acc (THIS chunk's per-baseline Jones re/im). Outputs
+    accumulate across time cells per chunk (out index_map pinned to the
+    chunk axis): pp/qq [1, 2, 4, 4, nb], pq [1, 2, 2, 4, 4, nb],
+    jte [1, 2, 2, 4, nb] (side p/q first), cost [1, nb] — acc dtype.
+    """
+    k = pl.program_id(0)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        pp_ref[...] = jnp.zeros_like(pp_ref)
+        qq_ref[...] = jnp.zeros_like(qq_ref)
+        pq_ref[...] = jnp.zeros_like(pq_ref)
+        jte_ref[...] = jnp.zeros_like(jte_ref)
+        cost_ref[...] = jnp.zeros_like(cost_ref)
+
+    x = x_ref[...].astype(acc)                  # [bt, nb, 8]
+    w = w_ref[...].astype(acc)
+    cw = cw_ref[...].astype(acc)
+    if kmax > 1:
+        # hybrid-chunk row mask: this cell contributes chunk k's rows
+        # only (chunk blocks are time-contiguous, so whole planes
+        # usually mask 0/1; the multiply keeps it branch-free)
+        mk = (cid_ref[...] == k).astype(acc)    # [bt, nb]
+        w = w * mk[..., None]
+        cw = cw * mk[..., None]
+    Cr = _cplx_mats(chr_ref[...], "C")          # [bt, nb] planes
+    Ci = _cplx_mats(chi_ref[...], "C")
+    Pr = _cplx_mats(jpr_ref[0], "P")            # [nb] planes
+    Pi = _cplx_mats(jpi_ref[0], "P")
+    Qr = _cplx_mats(jqr_ref[0], "Q")
+    Qi = _cplx_mats(jqi_ref[0], "Q")
+
+    def cpx_mm(Xr, Xi, xn, Yr, Yi, yn, conj_t=False):
+        """2x2 complex matmul on plane dicts: X @ Y (or X @ Y^H)."""
+        Zr, Zi = {}, {}
+        for a in range(2):
+            for o in range(2):
+                zr = None
+                zi = None
+                for d in range(2):
+                    xr, xi = Xr[(xn, a, d)], Xi[(xn, a, d)]
+                    if conj_t:
+                        yr, yi = Yr[(yn, o, d)], -Yi[(yn, o, d)]
+                    else:
+                        yr, yi = Yr[(yn, d, o)], Yi[(yn, d, o)]
+                    tr = xr * yr - xi * yi
+                    ti = xr * yi + xi * yr
+                    zr = tr if zr is None else zr + tr
+                    zi = ti if zi is None else zi + ti
+                Zr[("Z", a, o)] = zr
+                Zi[("Z", a, o)] = zi
+        return Zr, Zi
+
+    # A = C Jq^H, Bm = Jp C, V = Jp A — all [bt, nb] plane sets
+    Ar, Ai = cpx_mm(Cr, Ci, "C", Qr, Qi, "Q", conj_t=True)
+    Br, Bi = cpx_mm(Pr, Pi, "P", Cr, Ci, "C")
+    Vr, Vi = cpx_mm(Pr, Pi, "P", Ar, Ai, "Z")
+
+    def q(p):
+        """Storage-quantization boundary for the reduced policies: the
+        XLA path stores the model emission and the Wirtinger factors in
+        the storage dtype before contracting with f32 accumulators —
+        the kernel rounds the SAME planes at the same boundary
+        (identity at f32/f64)."""
+        return p.astype(st).astype(acc) if reduced else p
+
+    fA = {("r", i, j): q(Ar[("Z", i, j)]) for i in range(2)
+          for j in range(2)}
+    fA.update({("i", i, j): q(Ai[("Z", i, j)]) for i in range(2)
+               for j in range(2)})
+    fB = {("r", i, j): q(Br[("Z", i, j)]) for i in range(2)
+          for j in range(2)}
+    fB.update({("i", i, j): q(Bi[("Z", i, j)]) for i in range(2)
+               for j in range(2)})
+
+    def MA(o, ri, jcol):
+        s, part, i_, j_ = _ma_entry(o, ri, jcol // 2, jcol % 2)
+        return s, fA[(part, i_, j_)]
+
+    def MB(a, ri, jcol):
+        s, part, i_, j_ = _mb_entry(a, ri, jcol // 2, jcol % 2)
+        return s, fB[(part, i_, j_)]
+
+    # residual planes r[a][o][ri] (x is storage-exact in acc; the model
+    # quantizes at q) and the weight planes
+    comp = lambda arr, a, o, ri: arr[..., (a * 2 + o) * 2 + ri]
+    w2, rw2, rc = {}, {}, None
+    for a in range(2):
+        for o in range(2):
+            for ri in range(2):
+                vm = q(Vr[("Z", a, o)] if ri == 0 else Vi[("Z", a, o)])
+                r_ = comp(x, a, o, ri) - vm
+                wv = comp(w, a, o, ri)
+                w2[(a, o, ri)] = wv * wv
+                rw2[(a, o, ri)] = r_ * wv * wv
+                rcp = r_ * comp(cw, a, o, ri)
+                rc = rcp * rcp if rc is None else rc + rcp * rcp
+    cost_ref[0, :] += jnp.sum(rc, axis=0)
+
+    def tsum(p):                                # [bt, nb] -> [nb]
+        return jnp.sum(p, axis=0)
+
+    # per-baseline Gram/gradient partials, signs folded at trace time
+    for a in range(2):
+        for i in range(4):
+            for j in range(4):
+                accu = None
+                for o in range(2):
+                    for ri in range(2):
+                        si, mi = MA(o, ri, i)
+                        sj, mj = MA(o, ri, j)
+                        t = (si * sj) * (w2[(a, o, ri)] * mi * mj)
+                        accu = t if accu is None else accu + t
+                pp_ref[0, a, i, j, :] += tsum(accu)
+    for o in range(2):
+        for i in range(4):
+            for j in range(4):
+                accu = None
+                for a in range(2):
+                    for ri in range(2):
+                        si, mi = MB(a, ri, i)
+                        sj, mj = MB(a, ri, j)
+                        t = (si * sj) * (w2[(a, o, ri)] * mi * mj)
+                        accu = t if accu is None else accu + t
+                qq_ref[0, o, i, j, :] += tsum(accu)
+    for a in range(2):
+        for o in range(2):
+            for i in range(4):
+                for j in range(4):
+                    accu = None
+                    for ri in range(2):
+                        si, mi = MA(o, ri, i)
+                        sj, mj = MB(a, ri, j)
+                        t = (si * sj) * (w2[(a, o, ri)] * mi * mj)
+                        accu = t if accu is None else accu + t
+                    pq_ref[0, a, o, i, j, :] += tsum(accu)
+    for a in range(2):
+        for i in range(4):
+            accu = None
+            for o in range(2):
+                for ri in range(2):
+                    si, mi = MA(o, ri, i)
+                    t = si * (rw2[(a, o, ri)] * mi)
+                    accu = t if accu is None else accu + t
+            jte_ref[0, 0, a, i, :] += tsum(accu)
+    for o in range(2):
+        for i in range(4):
+            accu = None
+            for a in range(2):
+                for ri in range(2):
+                    si, mi = MB(a, ri, i)
+                    t = si * (rw2[(a, o, ri)] * mi)
+                    accu = t if accu is None else accu + t
+            jte_ref[0, 1, o, i, :] += tsum(accu)
+
+
+@functools.partial(jax.jit, static_argnames=("row_period", "kmax",
+                                             "block_t", "interpret"))
+def sweep_blocks(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
+                 row_period: int, kmax: int, block_t: int = 0,
+                 interpret: bool | None = None):
+    """The fused cluster-visit pass: per-(chunk, baseline) Gram blocks,
+    gradient partials and the acceptance cost from one streaming
+    [B]-pass per chunk.
+
+    x8/wt/cost_wt: [B, 8] (storage dtype; ``cost_wt`` may equal
+    ``wt``); J: [K, N, 2, 2] complex; coh: [B, 2, 2] complex;
+    sta1/sta2/chunk_id: [B] (baseline-periodic stations — only the
+    first ``row_period`` entries are used). Returns
+    (pp [K, nb, 2, 4, 4], qq [K, nb, 2, 4, 4], pq [K, nb, 2, 2, 4, 4],
+    jtep [K, nb, 2, 4], jteq [K, nb, 2, 4], cost [K]), all in the acc
+    dtype of the data.
+    """
+    B = x8.shape[0]
+    nb = int(row_period)
+    T = B // nb
+    K = int(kmax)
+    st = x8.dtype
+    acc = dtp.acc_dtype(st)
+    reduced = dtp.is_reduced(st)
+    if interpret is None:
+        interpret = interpret_default()
+    s1b, s2b = sta1[:nb], sta2[:nb]
+    Jp = jnp.take(J, s1b, axis=1)               # [K, nb, 2, 2] complex
+    Jq = jnp.take(J, s2b, axis=1)
+    bt = block_t if block_t else _pick_bt(T, nb, jnp.dtype(acc).itemsize)
+    if T % bt:
+        raise ValueError(
+            f"block_t={bt} does not divide the {T} timeslots — the "
+            f"(K, T//bt) grid would silently drop the tail rows")
+    grid = (K, T // bt)
+    rows = lambda a: a.reshape(T, nb, 8)        # free view, no copy
+    row_spec = pl.BlockSpec((bt, nb, 8), lambda k, t: (t, 0, 0))
+    cid_spec = pl.BlockSpec((bt, nb), lambda k, t: (t, 0))
+    coh_spec = pl.BlockSpec((bt, nb, 2, 2), lambda k, t: (t, 0, 0, 0))
+    jones_spec = pl.BlockSpec((1, nb, 2, 2), lambda k, t: (k, 0, 0, 0))
+    def kernel(*refs):
+        # plain def (not functools.partial) so jaxlint's traced-body
+        # closure follows pallas_call -> kernel -> _sweep_kernel
+        _sweep_kernel(*refs, acc=acc, reduced=reduced, st=st, kmax=K)
+    n_flops = SWEEP_FLOPS_PER_ROW * B * 8 * K
+    n_bytes = int(K * (3 * B * 8 * jnp.dtype(st).itemsize
+                       + 2 * B * 4 * jnp.dtype(acc).itemsize)
+                  + K * (2 * 32 + 64 + 16 + 1) * nb
+                  * jnp.dtype(acc).itemsize)
+    pp, qq, pq, jte, cost = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[row_spec, row_spec, row_spec, cid_spec, coh_spec,
+                  coh_spec, jones_spec, jones_spec, jones_spec,
+                  jones_spec],
+        out_specs=[
+            pl.BlockSpec((1, 2, 4, 4, nb), lambda k, t: (k, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 2, 4, 4, nb), lambda k, t: (k, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 2, 2, 4, 4, nb),
+                         lambda k, t: (k, 0, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 2, 2, 4, nb), lambda k, t: (k, 0, 0, 0, 0)),
+            pl.BlockSpec((1, nb), lambda k, t: (k, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K, 2, 4, 4, nb), acc),
+            jax.ShapeDtypeStruct((K, 2, 4, 4, nb), acc),
+            jax.ShapeDtypeStruct((K, 2, 2, 4, 4, nb), acc),
+            jax.ShapeDtypeStruct((K, 2, 2, 4, nb), acc),
+            jax.ShapeDtypeStruct((K, nb), acc),
+        ],
+        cost_estimate=pl.CostEstimate(flops=n_flops,
+                                      bytes_accessed=n_bytes,
+                                      transcendentals=0),
+        interpret=interpret,
+    )(rows(x8), rows(wt), rows(cost_wt),
+      chunk_id.reshape(T, nb).astype(jnp.int32),
+      coh.real.astype(acc).reshape(T, nb, 2, 2),
+      coh.imag.astype(acc).reshape(T, nb, 2, 2),
+      Jp.real.astype(acc), Jp.imag.astype(acc),
+      Jq.real.astype(acc), Jq.imag.astype(acc))
+    # [K, .., nb] -> [K, nb, ..] caller layouts (all [nbase]-sized)
+    pp = jnp.moveaxis(pp, -1, 1)                # [K, nb, 2, 4, 4]
+    qq = jnp.moveaxis(qq, -1, 1)
+    pq = jnp.moveaxis(pq, -1, 1)                # [K, nb, 2, 2, 4, 4]
+    jtep = jnp.moveaxis(jte[:, 0], -1, 1)       # [K, nb, 2, 4]
+    jteq = jnp.moveaxis(jte[:, 1], -1, 1)
+    return pp, qq, pq, jtep, jteq, jnp.sum(cost, axis=-1)
+
+
+def _station_aggregates(pp, qq, jtep, jteq, s1b, s2b, N: int):
+    """(D [K, N, 2, 4, 4], JTe [K, 8N]) from the per-baseline partials —
+    the [nbase]-sized scatter shared by the dense and matrix-free
+    wrappers (identical structure to normal_eq's station aggregation)."""
+    K = pp.shape[0]
+    acc = pp.dtype
+    D = jnp.zeros((K, N, 2, 4, 4), acc)
+    D = D.at[:, s1b].add(pp).at[:, s2b].add(qq)
+    JTe = jnp.zeros((K, N, 2, 4), acc)
+    JTe = JTe.at[:, s1b].add(jtep).at[:, s2b].add(jteq)
+    return D, JTe.reshape(K, 8 * N)
+
+
+def gn_blocks(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
+              kmax: int, row_period: int, cost_wt=None, block_t: int = 0,
+              interpret: bool | None = None):
+    """Matrix-free operator assembly under ``kernel='pallas'``: the
+    fused sweep's per-baseline Gram blocks become the PCG/tCG operator
+    (:class:`GNBlocks`), plus (JTe [K, 8N], cost [K]) — the same
+    contract as normal_eq.gn_factors, with the [B]-pass fused and the
+    carried operator B-INDEPENDENT ([K, nbase]-sized)."""
+    cw = wt if cost_wt is None else cost_wt
+    pp, qq, pq, jtep, jteq, cost = sweep_blocks(
+        x8, J, coh, sta1, sta2, chunk_id, wt, cw, row_period, kmax,
+        block_t=block_t, interpret=interpret)
+    nb = int(row_period)
+    s1b, s2b = sta1[:nb], sta2[:nb]
+    D, JTe = _station_aggregates(pp, qq, jtep, jteq, s1b, s2b,
+                                 n_stations)
+    return GNBlocks(pp=pp, qq=qq, pq=pq, D=D), JTe, cost
+
+
+def normal_equations_fused(x8, J, coh, sta1, sta2, chunk_id, wt,
+                           n_stations: int, kmax: int, row_period: int,
+                           cost_wt=None, block_t: int = 0,
+                           interpret: bool | None = None):
+    """Dense-path analogue of normal_eq.normal_equations under
+    ``kernel='pallas'``: the fused sweep produces the per-baseline
+    blocks in one [B]-pass per chunk; the dense [K, 8N, 8N] expansion
+    is the same [nbase]/[N]-sized scatter tail as the XLA
+    baseline-major path."""
+    N = n_stations
+    cw = wt if cost_wt is None else cost_wt
+    pp, qq, pq, jtep, jteq, cost = sweep_blocks(
+        x8, J, coh, sta1, sta2, chunk_id, wt, cw, row_period, kmax,
+        block_t=block_t, interpret=interpret)
+    nb = int(row_period)
+    K = int(kmax)
+    s1b, s2b = sta1[:nb], sta2[:nb]
+    acc = pp.dtype
+    D, JTe = _station_aggregates(pp, qq, jtep, jteq, s1b, s2b, N)
+    eye2 = jnp.eye(2, dtype=acc)
+    Dfull = jnp.einsum("knaij,ab->knaibj", D, eye2).reshape(K, N, 8, 8)
+    pq8 = jnp.transpose(pq, (0, 1, 2, 4, 3, 5)).reshape(K, nb, 8, 8)
+    pq8T = jnp.transpose(pq, (0, 1, 3, 5, 2, 4)).reshape(K, nb, 8, 8)
+    idx = jnp.arange(N)
+    JTJ = jnp.zeros((K, N, 8, N, 8), acc)
+    for k in range(K):                          # K <= MAX_CHUNKS, static
+        JTJ = JTJ.at[k, s1b, :, s2b, :].add(pq8[k])
+        JTJ = JTJ.at[k, s2b, :, s1b, :].add(pq8T[k])
+    JTJ = JTJ.at[:, idx, :, idx, :].add(jnp.swapaxes(Dfull, 0, 1))
+    return JTJ.reshape(K, 8 * N, 8 * N), JTe, cost
+
+
+def _matvec_kernel(pp_ref, qq_ref, pq_ref, vp_ref, vq_ref, yp_ref,
+                   yq_ref):
+    """One VMEM-resident blocks matvec (per chunk grid cell): inputs
+    pp/qq [1, 2, 4, 4, nb], pq [1, 2, 2, 4, 4, nb], vp/vq [1, 2, 4, nb];
+    outputs yp/yq [1, 2, 4, nb].
+
+    yp[a, i] = sum_j pp[a, i, j] vp[a, j]
+             + sum_{o, j} pq[a, o, i, j] vq[o, j]
+    yq[o, j] = sum_i qq[o, j, i] vq[o, i]
+             + sum_{a, i} pq[a, o, i, j] vp[a, i]
+    (the exact action of the dense station blocks the same pq/pp/qq
+    scatter into — see normal_equations_fused)."""
+    pp = pp_ref[0]
+    qq = qq_ref[0]
+    pq = pq_ref[0]
+    vp = vp_ref[0]
+    vq = vq_ref[0]
+    for a in range(2):
+        for i in range(4):
+            accu = None
+            for j in range(4):
+                t = pp[a, i, j, :] * vp[a, j, :]
+                accu = t if accu is None else accu + t
+            for o in range(2):
+                for j in range(4):
+                    accu = accu + pq[a, o, i, j, :] * vq[o, j, :]
+            yp_ref[0, a, i, :] = accu
+    for o in range(2):
+        for j in range(4):
+            accu = None
+            for i in range(4):
+                t = qq[o, j, i, :] * vq[o, i, :]
+                accu = t if accu is None else accu + t
+            for a in range(2):
+                for i in range(4):
+                    accu = accu + pq[a, o, i, j, :] * vp[a, i, :]
+            yq_ref[0, o, j, :] = accu
+
+
+@functools.partial(jax.jit, static_argnames=("n_stations", "interpret"))
+def _matvec_blocks_jit(pp, qq, pq, v, s1b, s2b, n_stations: int,
+                       interpret: bool):
+    N = n_stations
+    K, nb = pp.shape[0], pp.shape[1]
+    acc = pp.dtype
+    vr = v.reshape(K, N, 2, 4).astype(acc)
+    vp = jnp.moveaxis(jnp.take(vr, s1b, axis=1), 1, -1)  # [K, 2, 4, nb]
+    vq = jnp.moveaxis(jnp.take(vr, s2b, axis=1), 1, -1)
+    spec_g = pl.BlockSpec((1, 2, 4, 4, nb), lambda k: (k, 0, 0, 0, 0))
+    spec_x = pl.BlockSpec((1, 2, 2, 4, 4, nb),
+                          lambda k: (k, 0, 0, 0, 0, 0))
+    spec_v = pl.BlockSpec((1, 2, 4, nb), lambda k: (k, 0, 0, 0))
+    n_bytes = int(K * (2 * 32 + 64 + 4 * 8) * nb
+                  * jnp.dtype(acc).itemsize)
+    yp, yq = pl.pallas_call(
+        _matvec_kernel,
+        grid=(K,),
+        in_specs=[spec_g, spec_g, spec_x, spec_v, spec_v],
+        out_specs=[spec_v, spec_v],
+        out_shape=[jax.ShapeDtypeStruct((K, 2, 4, nb), acc),
+                   jax.ShapeDtypeStruct((K, 2, 4, nb), acc)],
+        cost_estimate=pl.CostEstimate(
+            flops=MATVEC_FLOPS_PER_BASELINE * nb * K,
+            bytes_accessed=n_bytes, transcendentals=0),
+        interpret=interpret,
+    )(jnp.moveaxis(pp, 1, -1), jnp.moveaxis(qq, 1, -1),
+      jnp.moveaxis(pq, 1, -1), vp, vq)
+    y = jnp.zeros((K, N, 2, 4), acc)
+    y = y.at[:, s1b].add(jnp.moveaxis(yp, -1, 1))
+    y = y.at[:, s2b].add(jnp.moveaxis(yq, -1, 1))
+    return y.reshape(K, 8 * N).astype(v.dtype)
+
+
+def gn_matvec_blocks(fac: GNBlocks, v, sta1, sta2, n_stations: int,
+                     shift=None, interpret: bool | None = None):
+    """(JTJ + shift I) @ v from the per-baseline Gram blocks: one
+    O(nbase), B-independent pass (drop-in for normal_eq.gn_matvec under
+    ``kernel='pallas'``; same [K, 8N] v/y layout and [K]-shaped
+    ``shift`` contract)."""
+    nb = fac.pp.shape[1]
+    if interpret is None:
+        interpret = interpret_default()
+    y = _matvec_blocks_jit(fac.pp, fac.qq, fac.pq, v, sta1[:nb],
+                           sta2[:nb], n_stations, bool(interpret))
+    if shift is not None:
+        y = y + jnp.asarray(shift)[..., None] * v
+    return y

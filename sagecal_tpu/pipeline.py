@@ -224,6 +224,7 @@ class FullBatchPipeline:
             promote=getattr(cfg, "solve_promote", "auto"),
             inflight=max(1, int(getattr(cfg, "cluster_inflight", 1))),
             inner=getattr(cfg, "solver_inner", "chol"),
+            kernel=getattr(cfg, "solver_kernel", "xla"),
             dtype_policy=self.dtype_policy,
             # rows are [tilesz, nbase] (io.dataset layout): lets the
             # solvers' normal-equation assembly take the baseline-major

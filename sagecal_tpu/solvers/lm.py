@@ -77,6 +77,16 @@ class LMConfig(NamedTuple):
     inner: str = "chol"
     cg_tol: float = 0.1        # forcing eta: stop at ||r|| <= eta ||JTe||
     cg_maxiter: int = 25       # static PCG trip cap per damping iteration
+    # row-pass kernel for the normal-equation / matrix-free assembly:
+    # "xla" (bit-frozen default) or "pallas" — the fused-sweep kernel
+    # (ops/sweep_pallas.py): one streaming [B]-pass per damping
+    # iteration emitting per-baseline Gram blocks, and under
+    # inner="cg" a B-INDEPENDENT O(nbase) blocks matvec per PCG trip.
+    # Applies when the problem is single-chunk baseline-major
+    # (sweep_pallas.supported); falls back to the XLA path otherwise.
+    # Parity is tolerance-gated, not bit (MIGRATION.md "Pallas
+    # kernels")
+    kernel: str = "xla"
     # storage dtype policy (sagecal_tpu.dtypes): "f32" is the identity
     # (bit-frozen default); "bf16"/"f16" quantize the [B]-data and
     # Wirtinger-factor storage while every accumulator stays f32 —
@@ -228,7 +238,13 @@ def _solve_damped_cg(fac, JTe, mu, jitter, rho, sta1, sta2, chunk_id,
     dp = 0 exactly, preserving the carried-equation semantics the OS
     body builds on. ``active`` [K] masks chunks out entirely (their rhs
     zeroes, so they start converged) — the LM body passes its live mask
-    so already-stopped chunks never drive extra trips under vmap."""
+    so already-stopped chunks never drive extra trips under vmap.
+
+    ``fac`` is either normal_eq.GNFactors (kernel="xla": each matvec is
+    one [B]-row pass over the Wirtinger factors) or
+    sweep_pallas.GNBlocks (kernel="pallas": each matvec is one
+    B-independent O(nbase) pass over the per-baseline Gram blocks) —
+    the branch is trace-time static."""
     shift = mu + jitter + rho                          # [K], always > 0
     Lfac = ne.gn_precond_factor(fac.D, shift)
     b = JTe if active is None else jnp.where(active[:, None], JTe, 0.0)
@@ -236,10 +252,17 @@ def _solve_damped_cg(fac, JTe, mu, jitter, rho, sta1, sta2, chunk_id,
     tol2 = (eta * eta) * bnorm2
     tiny = jnp.asarray(1e-30, b.dtype)
 
-    def matvec(v):
-        return ne.gn_matvec(fac, v, sta1, sta2, chunk_id, kmax,
-                            n_stations, shift=shift,
-                            row_period=row_period)
+    if type(fac).__name__ == "GNBlocks":
+        from sagecal_tpu.ops import sweep_pallas as swp
+
+        def matvec(v):
+            return swp.gn_matvec_blocks(fac, v, sta1, sta2, n_stations,
+                                        shift=shift)
+    else:
+        def matvec(v):
+            return ne.gn_matvec(fac, v, sta1, sta2, chunk_id, kmax,
+                                n_stations, shift=shift,
+                                row_period=row_period)
 
     x0 = jnp.zeros_like(b)
     z0 = ne.gn_precond_apply(Lfac, b, kmax, n_stations)
@@ -328,6 +351,14 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     if chunk_mask is None:
         chunk_mask = jnp.ones((kmax,), bool)
     inner_cg = config.inner == "cg"
+    # kernel="pallas": the fused-sweep row pass (ops/sweep_pallas) when
+    # the problem shape supports it; anything else falls back to the
+    # XLA assembly silently (same results contract, different traffic)
+    swp = None
+    if config.kernel == "pallas":
+        from sagecal_tpu.ops import sweep_pallas as swp_mod
+        if swp_mod.supported(kmax, row_period, x8.shape[0]):
+            swp = swp_mod
 
     rho_aug = 0.0
     if admm is not None:
@@ -378,11 +409,23 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
                 cost = aug_cost(p, cost)
             return op, JTe, cost
         if inner_cg:
-            op, JTe, cost = ne.gn_factors(x8, J, coh, sta1, sta2,
-                                          chunk_id,
-                                          wt if w is None else w,
-                                          n_stations, kmax, cost_wt=cw,
-                                          row_period=row_period)
+            if swp is not None:
+                op, JTe, cost = swp.gn_blocks(
+                    x8, J, coh, sta1, sta2, chunk_id,
+                    wt if w is None else w, n_stations, kmax,
+                    row_period, cost_wt=cw)
+            else:
+                op, JTe, cost = ne.gn_factors(x8, J, coh, sta1, sta2,
+                                              chunk_id,
+                                              wt if w is None else w,
+                                              n_stations, kmax,
+                                              cost_wt=cw,
+                                              row_period=row_period)
+        elif swp is not None:
+            op, JTe, cost = swp.normal_equations_fused(
+                x8, J, coh, sta1, sta2, chunk_id,
+                wt if w is None else w, n_stations, kmax, row_period,
+                cost_wt=cw)
         else:
             op, JTe, cost = ne.normal_equations(
                 x8, J, coh, sta1, sta2, chunk_id,
@@ -496,7 +539,17 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
             adopt = accept | (~s.live & chunk_mask)
         else:
             adopt = accept
-        if inner_cg:
+        if inner_cg and swp is not None:
+            # the blocks operator is per-(chunk, baseline) and
+            # B-independent: the per-chunk adopt select broadcasts over
+            # each leaf's leading K axis — a rejected chunk keeps its
+            # entering blocks, exactly the dense path's kept JTJ
+            JTJ = jax.tree.map(
+                lambda new, old: jnp.where(
+                    adopt.reshape(adopt.shape + (1,) * (new.ndim - 1)),
+                    new, old),
+                JTJn, s.JTJ)
+        elif inner_cg:
             # the matrix-free operator carries per-ROW factors (MA/MB/w2
             # over [B]) next to the per-chunk D blocks: the per-chunk
             # adopt select maps onto rows through chunk_id — rows of a

@@ -66,6 +66,14 @@ class RTRConfig(NamedTuple):
     # SAME linear operator to fp reordering, so unlike lm.py's
     # inexact-Newton path this changes traffic, not trajectory class.
     inner: str = "chol"
+    # row-pass kernel (lm.LMConfig.kernel): "xla" (bit-frozen default)
+    # or "pallas" — the fused-sweep assembly (ops/sweep_pallas.py).
+    # Under inner="cg" the tCG Hessian products then run on the
+    # B-independent per-baseline Gram blocks (one O(nbase) pass per
+    # product instead of a full [B]-row pass); under inner="chol" the
+    # dense assembly's [B]-pass fuses. Single-chunk baseline-major
+    # problems only (sweep_pallas.supported); XLA fallback otherwise
+    kernel: str = "xla"
     # storage dtype policy (sagecal_tpu.dtypes; see lm.LMConfig): the
     # [B]-data and Wirtinger-factor storage quantize under bf16/f16
     # while the manifold point, tangent vectors and every accumulator
@@ -265,6 +273,13 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
                         n_stations, admm=admm, robust_nu=robust_nu)
     total = lambda p: jnp.sum(cost_fn(p))
     egrad_fn = jax.grad(total)
+    # kernel="pallas": fused-sweep assembly + blocks tCG products when
+    # the shape supports it (see RTRConfig.kernel); XLA otherwise
+    swp = None
+    if config.kernel == "pallas":
+        from sagecal_tpu.ops import sweep_pallas as swp_mod
+        if swp_mod.supported(kmax, row_period, x8.shape[0]):
+            swp = swp_mod
 
     # NOTE: the reference's per-station iw scaling (fns_fcount) is a
     # diagonal preconditioner; applied one-sidedly it would destroy the
@@ -306,6 +321,22 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
             wt_eff = dtp.to_storage(
                 wt * jnp.sqrt(robust_nu) / (robust_nu + e * e), wt.dtype)
         if config.inner == "cg":
+            if swp is not None:
+                # blocks operator: the fused sweep contracts the time
+                # axis into per-baseline Gram blocks ONCE per outer TR
+                # point, so every tCG product is a B-independent
+                # O(nbase) pass (sweep_pallas.gn_matvec_blocks)
+                fac, _, _ = swp.gn_blocks(x8, Jm, coh, sta1, sta2,
+                                          chunk_id, wt_eff, n_stations,
+                                          kmax, row_period)
+
+                def hv(v):
+                    Hv = 2.0 * swp.gn_matvec_blocks(fac, v, sta1, sta2,
+                                                    n_stations)
+                    if admm_rho2 is not None:
+                        Hv = Hv + admm_rho2 * v
+                    return project_tangent(p, Hv, kmax, n_stations)
+                return hv
             # matrix-free operator: JTJ @ v straight from the Wirtinger
             # factors (one [B]-pass per product), never forming the
             # [K, 8N, 8N] matrix; the unused JTe/cost outputs are
@@ -322,9 +353,14 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
                     Hv = Hv + admm_rho2 * v
                 return project_tangent(p, Hv, kmax, n_stations)
             return hv
-        JTJ, _, _ = ne.normal_equations(x8, Jm, coh, sta1, sta2, chunk_id,
-                                        wt_eff, n_stations, kmax,
-                                        row_period=row_period)
+        if swp is not None:
+            JTJ, _, _ = swp.normal_equations_fused(
+                x8, Jm, coh, sta1, sta2, chunk_id, wt_eff, n_stations,
+                kmax, row_period)
+        else:
+            JTJ, _, _ = ne.normal_equations(
+                x8, Jm, coh, sta1, sta2, chunk_id, wt_eff, n_stations,
+                kmax, row_period=row_period)
 
         def hv(v):
             Hv = 2.0 * jnp.einsum("kij,kj->ki", JTJ, v)

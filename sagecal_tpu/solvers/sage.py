@@ -214,6 +214,19 @@ class SageConfig(NamedTuple):
     inner: str = "chol"
     cg_tol: float = 0.1           # inexact-Newton forcing eta (lm.py)
     cg_maxiter: int = 25          # static PCG trip cap per damping iter
+    # row-pass kernel for the per-cluster normal-equation assembly and
+    # the inner="cg" matvec (--kernel; lm.LMConfig.kernel /
+    # rtr.RTRConfig.kernel): "xla" is the bit-frozen default; "pallas"
+    # runs the fused-sweep kernel (ops/sweep_pallas.py) — ONE streaming
+    # [B]-pass per damping/TR iteration emitting per-baseline Gram
+    # blocks, and a B-independent O(nbase) blocks matvec per PCG/tCG
+    # trip. Requires the baseline-major layout with a bounded hybrid-
+    # chunk count (sweep_pallas.supported — nbase set, kmax <=
+    # MAX_CHUNKS); other shapes fall back to the XLA path. Parity is
+    # tolerance-gated
+    # (MIGRATION.md "Pallas kernels"; BSCALING_r11.json for the
+    # measured floor/trip-price deltas)
+    kernel: str = "xla"
     # storage dtype policy (--dtype-policy; sagecal_tpu.dtypes): "f32"
     # is the bit-frozen identity; "bf16"/"f16" store the visibility
     # data, running residual and Wirtinger factors in the reduced dtype
@@ -279,6 +292,7 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
     lm_cfg = lm_mod.LMConfig(itmax=itcap, inner=config.inner,
                              cg_tol=config.cg_tol,
                              cg_maxiter=config.cg_maxiter,
+                             kernel=config.kernel,
                              dtype_policy=config.dtype_policy)
     nbase = int(config.nbase)
     zero_i = jnp.zeros((), jnp.int32)
@@ -303,6 +317,7 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
 
     if mode == int(SolverMode.RTR_OSLM_LBFGS):
         rtr_cfg = rtr_mod.RTRConfig(itmax=itcap, inner=config.inner,
+                                    kernel=config.kernel,
                                     dtype_policy=config.dtype_policy)
         Jn, info = rtr_mod.rtr_solve(
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
@@ -313,6 +328,7 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
 
     if mode == int(SolverMode.RTR_OSRLM_RLBFGS):
         rtr_cfg = rtr_mod.RTRConfig(itmax=itcap, inner=config.inner,
+                                    kernel=config.kernel,
                                     dtype_policy=config.dtype_policy)
         Jn, nu_new, info = rtr_mod.rtr_solve_robust(
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,

@@ -517,6 +517,59 @@ def test_storage_accum_clean_twins(tmp_path):
     assert f == []
 
 
+def test_storage_accum_pallas_kernel_flagged(tmp_path):
+    """A Pallas kernel body is traced code (pl.pallas_call joined
+    _TRACE_WRAPPERS with the ISSUE 11 ops/ scope): a reduced-dtype
+    kernel accumulator — summing planes still in the storage dtype —
+    is exactly the bug class the rule exists for."""
+    f, _ = _lint(tmp_path, """
+    from jax.experimental import pallas as pl
+    from sagecal_tpu import dtypes as dtp
+
+    def _kern(x_ref, o_ref, st):
+        xs = dtp.to_storage(x_ref[...], st)
+        o_ref[...] += jnp.sum(xs * xs, axis=0)
+
+    def sweep(x, st):
+        def kernel(x_ref, o_ref):
+            _kern(x_ref, o_ref, st)
+        return pl.pallas_call(
+            kernel, grid=(4,),
+            out_shape=jax.ShapeDtypeStruct((8,), jnp.float32))(x)
+    """, relpath="ops/kern_pallas.py")
+    assert _rules(f) == ["storage-accum"]
+
+
+def test_storage_accum_pallas_kernel_clean_twin(tmp_path):
+    """The blessed kernel shape: quantize-at-load then upcast — the
+    block read rounds to storage and IMMEDIATELY casts to the acc
+    dtype, so every accumulation below is f32 (ops/sweep_pallas.py's
+    q() boundary)."""
+    f, _ = _lint(tmp_path, """
+    from jax.experimental import pallas as pl
+    from sagecal_tpu import dtypes as dtp
+
+    def _kern(x_ref, o_ref, st, acc):
+        xs = dtp.to_storage(x_ref[...], st).astype(acc)
+        o_ref[...] += jnp.sum(xs * xs, axis=0)
+
+    def sweep(x, st, acc):
+        def kernel(x_ref, o_ref):
+            _kern(x_ref, o_ref, st, acc)
+        return pl.pallas_call(
+            kernel, grid=(4,),
+            out_shape=jax.ShapeDtypeStruct((8,), jnp.float32))(x)
+    """, relpath="ops/kern_pallas.py")
+    assert f == []
+
+
+def test_ops_scope_is_hot():
+    """ISSUE 11 scope widening: ops/ (the Pallas kernels) is hot-path
+    territory for the dtype/storage rules."""
+    assert core.is_hot_path("sagecal_tpu/ops/coh_pallas.py")
+    assert core.is_hot_path("sagecal_tpu/ops/sweep_pallas.py")
+
+
 # ---------------------------------------------------------------------------
 # cond-cost
 # ---------------------------------------------------------------------------

@@ -133,7 +133,17 @@ def b_scaling(args):
     both`` runs the ladder under each and writes the round-7 comparison
     record BSCALING_r07.json (chol vs cg per B rung + the delta on the
     B-independent floor) instead of BSCALING.json — the PR-3 tentpole's
-    banked verdict."""
+    banked verdict.
+
+    ``--kernel xla|pallas|both`` additionally selects the row-pass
+    kernel (SageConfig.kernel; ops/sweep_pallas.py). With more than one
+    (inner, kernel) combination the run writes the round-11 comparison
+    record BSCALING_r11.json — kernel on/off x inner chol/cg per B
+    rung, with EXECUTED trip counts (solver/cg) per cell so the floor
+    melt and the cg trip price are compared at equal work, measured
+    deltas in JSON rather than prose. The SAGECAL_BENCH_KERNEL env var
+    is honored as the default when --kernel is not given (bench.py
+    parity)."""
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -151,7 +161,10 @@ def b_scaling(args):
     Jtrue = ds.random_jones(n_dir, sky.nchunk, n_sta, seed=6, scale=0.15)
     M = n_dir
     inners = (("chol", "cg") if args.inner == "both" else (args.inner,))
-    ladders = {inner: [] for inner in inners}
+    kernels = (("xla", "pallas") if args.kernel == "both"
+               else (args.kernel,))
+    combos = [(i, k) for i in inners for k in kernels]
+    ladders = {c: [] for c in combos}
     for tilesz in (args.tilesz, args.tilesz // 2, args.tilesz // 4):
         if tilesz < 1:
             continue
@@ -185,10 +198,11 @@ def b_scaling(args):
         xres = x8 - sage.full_model8(J0, coh, s1, s2, cidx)
         nuM = jnp.full((M,), 2.0, jnp.float32)
 
-        for inner in inners:
+        for inner, kern in combos:
             cfg = sage.SageConfig(max_iter=3, max_lbfgs=0,
                                   solver_mode=args.solver,
-                                  nbase=tile.nbase, inner=inner)
+                                  nbase=tile.nbase, inner=inner,
+                                  kernel=kern)
 
             def sweep():
                 # fresh state per call: the sweep program donates its
@@ -210,11 +224,17 @@ def b_scaling(args):
                 jax.block_until_ready(out[0])
                 times.append(time.time() - t0)
             med = float(np.median(times))
-            ladders[inner].append(
+            # executed-trip counters (sweep carry tk: [solver iters,
+            # rejected groups, cg trips]) — the "equal trip counts"
+            # evidence next to each timing cell
+            tk = np.asarray(out[4])
+            ladders[(inner, kern)].append(
                 {"tilesz": tilesz, "B": int(B), "sweep_s": round(med, 3),
-                 "ms_per_cluster": round(1e3 * med / M, 2)})
-            print(f"inner={inner} tilesz={tilesz} B={B}: sweep "
-                  f"{med:.3f} s -> {1e3 * med / M:.2f} ms/cluster "
+                 "ms_per_cluster": round(1e3 * med / M, 2),
+                 "solver_trips": int(tk[0]), "cg_trips": int(tk[2])})
+            print(f"inner={inner} kernel={kern} tilesz={tilesz} B={B}: "
+                  f"sweep {med:.3f} s -> {1e3 * med / M:.2f} ms/cluster"
+                  f" trips={int(tk[0])}/{int(tk[2])} "
                   f"(runs {[f'{t:.2f}' for t in times]})", flush=True)
 
     def ladder_fields(rows):
@@ -232,17 +252,20 @@ def b_scaling(args):
 
     import jax as _jax
     shape = f"N={n_sta} M={M} -j{args.solver} -g 3 hybrid-chunks"
-    if len(inners) == 1:
+    platform = _jax.devices()[0].platform
+    if len(combos) == 1:
+        inner, kern = combos[0]
         rec = {"metric": "north-star sweep B-scaling", "shape": shape,
-               "platform": _jax.devices()[0].platform,
-               "inner": inners[0], **ladder_fields(ladders[inners[0]])}
+               "platform": platform,
+               "inner": inner, "kernel": kern,
+               **ladder_fields(ladders[combos[0]])}
         out_path = os.path.join(HERE, "BSCALING.json")
-    else:
-        per = {k: ladder_fields(v) for k, v in ladders.items()}
-        # the tentpole's headline: how much of the B-independent floor
-        # does the matrix-free inner melt, per B rung and at the floor
-        # (the quarter-B rung, where the PR-2 record showed wall-clock
-        # stops following B)
+    elif len(kernels) == 1 and kernels[0] == "xla":
+        per = {i: ladder_fields(ladders[(i, "xla")]) for i in inners}
+        # the PR-3 headline: how much of the B-independent floor does
+        # the matrix-free inner melt, per B rung and at the floor (the
+        # quarter-B rung, where the PR-2 record showed wall-clock stops
+        # following B)
         deltas = [
             {"tilesz": c["tilesz"], "B": c["B"],
              "chol_ms_per_cluster": c["ms_per_cluster"],
@@ -253,11 +276,72 @@ def b_scaling(args):
             for c, g in zip(per["chol"]["rows"], per["cg"]["rows"])]
         rec = {"metric": "north-star sweep B-scaling, chol vs cg inner",
                "shape": shape,
-               "platform": _jax.devices()[0].platform,
+               "platform": platform,
                "chol": per["chol"], "cg": per["cg"],
                "cg_vs_chol": deltas,
                "floor_cg_vs_chol_pct": deltas[-1]["cg_vs_chol_pct"]}
         out_path = os.path.join(HERE, "BSCALING_r07.json")
+    else:
+        # round-11 record: kernel on/off x inner chol/cg — the fused-
+        # sweep melt as measured deltas. Per (inner, kernel) ladders
+        # carry executed trip counters; the kernel deltas compare each
+        # inner's pallas rung against its xla rung (same trajectory
+        # class, trips recorded next to each cell), and the cg-vs-chol
+        # gap is re-stated under each kernel so the "--inner cg pays
+        # for its trips" claim is a number
+        per = {f"{i}-{k}": ladder_fields(ladders[(i, k)])
+               for (i, k) in combos}
+        kernel_deltas = []
+        for i in inners:
+            if "xla" not in kernels or "pallas" not in kernels:
+                break
+            for cx, cp in zip(per[f"{i}-xla"]["rows"],
+                              per[f"{i}-pallas"]["rows"]):
+                kernel_deltas.append(
+                    {"inner": i, "tilesz": cx["tilesz"], "B": cx["B"],
+                     "xla_ms_per_cluster": cx["ms_per_cluster"],
+                     "pallas_ms_per_cluster": cp["ms_per_cluster"],
+                     "pallas_vs_xla_pct": round(
+                         100.0 * (cp["ms_per_cluster"]
+                                  - cx["ms_per_cluster"])
+                         / cx["ms_per_cluster"], 1),
+                     "xla_trips": [cx["solver_trips"], cx["cg_trips"]],
+                     "pallas_trips": [cp["solver_trips"],
+                                      cp["cg_trips"]]})
+        rec = {"metric": "north-star sweep B-scaling, "
+                         "kernel on/off x inner chol/cg",
+               "shape": shape, "platform": platform,
+               "interpret_mode": platform != "tpu",
+               "ladders": per, "pallas_vs_xla": kernel_deltas}
+        # bank hygiene: only the FULL kernel-pair x inner-pair grid may
+        # claim the banked round-11 comparison record — a partial combo
+        # set (e.g. SAGECAL_BENCH_KERNEL=pallas leaking in as the
+        # --kernel default under --inner both, or --kernel both at the
+        # default chol-only inner) lacks ladders the committed record's
+        # headline fields cite and must not clobber it
+        banked_pair = (set(kernels) >= {"xla", "pallas"}
+                       and set(inners) >= {"chol", "cg"})
+        if kernel_deltas:
+            # headline: the per-cluster floor melt at the quarter-B
+            # rung (B-independent regime) per inner, and the cg-vs-chol
+            # gap under each kernel at full B
+            for i in inners:
+                rows = [d for d in kernel_deltas if d["inner"] == i]
+                rec[f"floor_pallas_vs_xla_pct_{i}"] = \
+                    rows[-1]["pallas_vs_xla_pct"]
+            if set(inners) >= {"chol", "cg"}:
+                for k in kernels:
+                    c = per[f"chol-{k}"]["rows"][0]["ms_per_cluster"]
+                    g = per[f"cg-{k}"]["rows"][0]["ms_per_cluster"]
+                    rec[f"cg_vs_chol_pct_{k}"] = round(
+                        100.0 * (g - c) / c, 1)
+        if banked_pair:
+            out_path = os.path.join(HERE, "BSCALING_r11.json")
+        else:
+            out_path = os.path.join(HERE, "BSCALING_EXPLORE.json")
+            print(f"# partial (inner, kernel) combo set {combos}: "
+                  f"writing {os.path.basename(out_path)}, not the "
+                  f"banked BSCALING_r11.json")
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps(rec))
@@ -328,7 +412,8 @@ def multichip(args):
         n_admm=args.admm, npoly=2, rho=5.0, manifold_iters=5,
         sage=sage.SageConfig(max_emiter=1, max_iter=3, max_lbfgs=0,
                              solver_mode=args.solver, nbase=tile.nbase,
-                             inner=args.inner))
+                             inner=args.inner,
+                             kernel=args.kernel))
     runner = cadmm.make_admm_runner(
         dsky, tile.sta1, tile.sta2, cidx, cmask, n_sta, tile.fdelta,
         Bpoly, cfg, mesh, F, host_loop=True, nbase=tile.nbase,
@@ -466,6 +551,14 @@ def main():
                     help="inner linear solver (sage.SageConfig.inner); "
                          "'both' runs the --b-scaling ladder under each "
                          "and banks the comparison")
+    ap.add_argument("--kernel", choices=("xla", "pallas", "both"),
+                    default=os.environ.get("SAGECAL_BENCH_KERNEL",
+                                           "xla"),
+                    help="row-pass kernel (sage.SageConfig.kernel; "
+                         "ops/sweep_pallas.py fused sweep); 'both' "
+                         "runs the --b-scaling ladder kernel-on/off "
+                         "and banks BSCALING_r11.json; defaults to "
+                         "SAGECAL_BENCH_KERNEL when set")
     ap.add_argument("--multichip", action="store_true",
                     help="run the ADMM shape on a virtual multi-device "
                          "CPU mesh and bank a measured per-iteration + "
@@ -481,6 +574,13 @@ def main():
         # from an intentional chol run
         ap.error("--inner both requires --b-scaling "
                  "(--multichip and the full ADMM run take chol|cg)")
+    if args.kernel not in ("xla", "pallas", "both"):
+        # the default may come from SAGECAL_BENCH_KERNEL, which
+        # argparse choices do not validate
+        ap.error(f"--kernel {args.kernel}: pick xla|pallas|both")
+    if args.kernel == "both" and not args.b_scaling:
+        ap.error("--kernel both requires --b-scaling (the full runs "
+                 "take xla|pallas)")
     if args.b_scaling:
         return b_scaling(args)
     if args.multichip:
@@ -505,7 +605,7 @@ def main():
            "-t", str(args.tilesz), "-V",
            "--block-f", str(args.block_f),
            "--inflight", str(args.inflight),
-           "--inner", args.inner]
+           "--inner", args.inner, "--kernel", args.kernel]
     env = dict(os.environ)
     # persistent XLA compilation cache: re-runs (and the second tile's
     # programs) skip the big solve compiles. Keyed per platform (+ CPU

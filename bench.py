@@ -1941,6 +1941,272 @@ def config8_serve(device, dtype):
     return rec
 
 
+def _stamp_fleet(rec: dict, platform: str) -> str:
+    """Round-stamp the fleet record (FLEET_rNN.json, the BSCALING/
+    MULTICHIP precedent: its own record family, judged by the
+    sentinel's fleet tolerances instead of the BENCH table columns).
+    NN = 1 + the newest committed FLEET round (first round is 12 —
+    the ISSUE 12 PR). Never overwrites an existing round."""
+    import glob as _glob
+    import re as _re
+    rounds = [int(m.group(1)) for p in
+              _glob.glob(os.path.join(HERE, "FLEET_r*.json"))
+              if (m := _re.search(r"_r(\d+)\.json$", p))]
+    nn = max(rounds, default=11) + 1
+    path = os.path.join(HERE, f"FLEET_r{nn:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"platform": platform,
+                   "date": time.strftime("%Y-%m-%d %H:%M:%S"),
+                   "results": {"9-fleet-throughput": rec}},
+                  f, indent=1, default=float)
+    return path
+
+
+def config9_fleet(device, dtype):
+    """Round-12 config: fleet-scale serving throughput (ISSUE 12).
+
+    The SAME seeded traffic replay (serve/loadgen.py: 8 jobs, 2 shape
+    buckets, burst arrival, streaming-ingest pacing) drives the
+    daemon twice — one device, then a 2-virtual-device fleet — and
+    banks aggregate throughput scaling, p99 queue wait, per-device
+    cache hit rate, and (from a dedicated leg) the measured cost of a
+    tile-boundary migration. REFUSES to bank unless every replay
+    job's residuals + solutions are bit-identical to a solo run of
+    its template, and unless the migrated job re-ran ZERO tiles.
+
+    Measurement regime, stated honestly: with ingest pacing each
+    tenant's tile stream is rate-limited (the quasi-real-time
+    LOFAR/SKA arrival model, arXiv:1410.2101), so per-device
+    throughput is bounded by per-device ADMISSION (a device-memory
+    budget) times the stream rate, not by solve FLOPs — the regime
+    where a fleet scales linearly and where this host (virtual CPU
+    devices sharing one core) can measure the scheduling/placement
+    machinery without pretending the core count doubled. The
+    per-device busy fractions ride the record so the regime is
+    visible; on real multi-chip hardware the same config measures
+    compute-bound scaling."""
+    import shutil
+    import tempfile
+    import jax
+    from sagecal_tpu import pipeline as pl
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.serve import cache as pcache
+    from sagecal_tpu.serve import loadgen
+    from sagecal_tpu.serve.api import Client, Server, config_from_dict
+
+    if len(jax.devices()) < 2:
+        return {"error": "fleet bench needs >= 2 (virtual) devices"}
+    noop = (lambda *a: None)
+    tmpd = tempfile.mkdtemp(prefix="sagecal_fleet_")
+    PACE = 0.5          # s/tile ingest pacing; per-tile solve is
+    #                     ~0.1 s at these shapes (config 8), so even
+    #                     the 4-concurrent-job fleet leg keeps the
+    #                     single-core host unsaturated — the scaling
+    #                     measured is admission/ingest, not luck
+    N_TILES = 6
+    spec = {
+        "seed": 12, "n_jobs": 8,
+        "arrival": {"process": "burst"},
+        "templates": [
+            {"name": "bucket4", "weight": 1, "n_stations": 16,
+             "tilesz": 4, "n_tiles": N_TILES, "nchan": 24,
+             "config": {"tile_arrival_s": PACE}},
+            {"name": "bucket6", "weight": 1, "n_stations": 16,
+             "tilesz": 6, "n_tiles": N_TILES, "nchan": 24,
+             "config": {"tile_arrival_s": PACE}}]}
+    fixtures = loadgen.build_fixtures(spec, tmpd)
+
+    def leg(n_devices, tag):
+        work = os.path.join(tmpd, f"leg_{tag}")
+        os.makedirs(work, exist_ok=True)
+        srv = Server(port=0, max_inflight=2, devices=n_devices)
+        # work stealing OFF in the throughput legs: placement is the
+        # subject here; migration is priced by its own leg below
+        srv.scheduler.MIGRATE_MIN_REMAINING_TILES = 10 ** 6
+        srv.start()
+        cs0 = pcache.PROGRAMS.stats_by_device()
+        try:
+            with Client(port=srv.port) as c:
+                rec = loadgen.replay(c, spec, fixtures, work, log=noop)
+                m = c.metrics()
+        finally:
+            srv.stop()
+        cs1 = pcache.PROGRAMS.stats_by_device()
+        # per-device cache traffic DELTA across this leg only (the
+        # process cache is shared with the other legs)
+        cache = {}
+        for dev in sorted(cs1):
+            h = cs1[dev]["hits"] - cs0.get(dev, {}).get("hits", 0)
+            mi = cs1[dev]["misses"] - cs0.get(dev, {}).get("misses", 0)
+            if h or mi:
+                cache[dev] = {"hits": h, "misses": mi,
+                              "hit_rate": h / (h + mi) if h + mi
+                              else 1.0}
+        rec["cache_by_device"] = cache
+        rec["device_busy_frac"] = m["device_busy_frac"]
+        rec["devices"] = [
+            {k: d[k] for k in ("device", "busy_frac", "tiles_done",
+                               "jobs_done")}
+            for d in m["devices"]]
+        if rec["states"] != {"done": rec["n_jobs"]}:
+            raise RuntimeError(f"leg {tag}: jobs not all done: "
+                               f"{rec['states']}")
+        return rec
+
+    # solo references (one per template — every replay job is a byte
+    # copy of its template, so one solo run is THE reference for all)
+    solo = {}
+    for name, f in fixtures.items():
+        msdir = os.path.join(tmpd, f"solo_{name}.ms")
+        shutil.copytree(f["ms"], msdir)
+        solp = os.path.join(tmpd, f"solo_{name}.sol")
+        cfg = loadgen.job_config(spec, name, msdir, solp)
+        cfg.update(sky_model=f["sky"], cluster_file=f["cluster"])
+        pl.run(config_from_dict(cfg), log=noop)
+        out = ds.SimMS(msdir, data_column="CORRECTED_DATA")
+        solo[name] = ([out.read_tile(i).x.copy()
+                       for i in range(out.n_tiles)],
+                      open(solp).read())
+
+    def assert_bit_identical(rec, tag):
+        for row in rec["jobs"]:
+            res, sol_text = solo[row["template"]]
+            out = ds.SimMS(row["ms"], data_column="CORRECTED_DATA")
+            for i in range(out.n_tiles):
+                if not np.array_equal(out.read_tile(i).x, res[i]):
+                    return (f"{tag}/{row['job_id']}: residuals NOT "
+                            "bit-identical to the solo run")
+            if open(row["solutions"]).read() != sol_text:
+                return (f"{tag}/{row['job_id']}: solutions NOT "
+                        "bit-identical to the solo run")
+        return None
+
+    # settle both arms: every (bucket, device) program pair compiles
+    # here, never inside a timed rep (the config 6/8 contract)
+    t_w0 = time.perf_counter()
+    leg(1, "settle1")
+    leg(2, "settle2")
+    comp_wall = time.perf_counter() - t_w0
+    # timed: min-of-2 per arm, alternating
+    legs1, legs2 = [], []
+    for rep in range(2):
+        legs1.append(leg(1, f"d1_{rep}"))
+        legs2.append(leg(2, f"d2_{rep}"))
+    for tag, rec in (("1dev0", legs1[0]), ("1dev1", legs1[1]),
+                     ("2dev0", legs2[0]), ("2dev1", legs2[1])):
+        err = assert_bit_identical(rec, tag)
+        if err:
+            return {"error": err}
+    r1 = min(legs1, key=lambda r: r["wall_s"])
+    r2 = min(legs2, key=lambda r: r["wall_s"])
+
+    # migration leg: one paced job on the 2-device fleet, migrated at
+    # a tile boundary via the api op — wall + tiles re-run measured
+    mig_ms = os.path.join(tmpd, "mig.ms")
+    shutil.copytree(fixtures["bucket4"]["ms"], mig_ms)
+    mig_sol = os.path.join(tmpd, "mig.sol")
+    mig_cfg = loadgen.job_config(spec, "bucket4", mig_ms, mig_sol)
+    mig_cfg.update(sky_model=fixtures["bucket4"]["sky"],
+                   cluster_file=fixtures["bucket4"]["cluster"])
+    srv = Server(port=0, max_inflight=2, devices=2)
+    srv.scheduler.MIGRATE_MIN_REMAINING_TILES = 2
+    srv.start()
+    try:
+        with Client(port=srv.port) as c:
+            jid = c.submit(mig_cfg)
+            t_dead = time.monotonic() + 60
+            while True:
+                snap = c.status(jid)
+                if snap["state"] == "running" \
+                        and 1 <= snap["tiles_done"] <= 3:
+                    break
+                if time.monotonic() > t_dead or snap["state"] not in \
+                        ("queued", "running"):
+                    return {"error": f"migration leg: job stuck in "
+                                     f"{snap['state']}"}
+                time.sleep(0.02)
+            c.migrate(jid, 1)
+            snap = c.wait(jid, timeout_s=120)
+            if snap["state"] != "done" or not snap["migrations"]:
+                return {"error": "migration leg: job did not migrate "
+                                 f"and finish ({snap['state']})"}
+            mig = snap["migrations"][0]
+    finally:
+        srv.stop()
+    if mig["tiles_rerun"] != 0:
+        return {"error": f"migration re-ran {mig['tiles_rerun']} "
+                         "tiles; refusing to bank"}
+    out = ds.SimMS(mig_ms, data_column="CORRECTED_DATA")
+    res, sol_text = solo["bucket4"]
+    for i in range(out.n_tiles):
+        if not np.array_equal(out.read_tile(i).x, res[i]):
+            return {"error": "migrated job NOT bit-identical to the "
+                             "solo run; refusing to bank"}
+    if open(mig_sol).read() != sol_text:
+        return {"error": "migrated job solutions NOT bit-identical; "
+                         "refusing to bank"}
+
+    thr1 = r1["throughput_jobs_per_s"]
+    thr2 = r2["throughput_jobs_per_s"]
+    cache2 = r2["cache_by_device"]
+    rec = dict(
+        value=thr2 / thr1, unit="x-thr 1->2dev",
+        step_s=r2["wall_s"] / r2["n_jobs"],
+        compile_s=max(comp_wall - r1["wall_s"] - r2["wall_s"], 0.0),
+        n_jobs=spec["n_jobs"], shape_buckets=2, n_tiles=N_TILES,
+        scaling_1to2=thr2 / thr1,
+        throughput_1dev_jobs_h=thr1 * 3600.0,
+        throughput_2dev_jobs_h=thr2 * 3600.0,
+        throughput_per_device_1dev_jobs_h=thr1 * 3600.0,
+        throughput_per_device_2dev_jobs_h=thr2 * 3600.0 / 2,
+        wall_1dev_s=r1["wall_s"], wall_2dev_s=r2["wall_s"],
+        walls_1dev=[r["wall_s"] for r in legs1],
+        walls_2dev=[r["wall_s"] for r in legs2],
+        p50_queue_wait_1dev_s=r1["queue_wait_p50_s"],
+        p99_queue_wait_1dev_s=r1["queue_wait_p99_s"],
+        p50_queue_wait_2dev_s=r2["queue_wait_p50_s"],
+        p99_queue_wait_2dev_s=r2["queue_wait_p99_s"],
+        e2e_p99_1dev_s=r1["e2e_p99_s"], e2e_p99_2dev_s=r2["e2e_p99_s"],
+        device_busy_frac_1dev=r1["device_busy_frac"],
+        device_busy_frac_2dev=r2["device_busy_frac"],
+        cache_by_device_2dev={str(k): v for k, v in cache2.items()},
+        cache_hit_rate_min_2dev=min(
+            (v["hit_rate"] for v in cache2.values()), default=1.0),
+        migration=dict(wall_s=mig["wall_s"], yield_s=mig["yield_s"],
+                       tile=mig["tile"], tiles_rerun=mig["tiles_rerun"],
+                       src=mig["src"], dst=mig["dst_actual"],
+                       bit_identical=True),
+        ingest=dict(
+            tile_arrival_s=PACE, arrival="burst",
+            # the floor an ideal scheduler cannot beat: waves of
+            # admitted jobs, each paced to n_tiles * PACE (job tile 0
+            # arrives unpaced, so measured walls sit slightly under)
+            floor_1dev_s=-(-spec["n_jobs"] // 2) * N_TILES * PACE,
+            floor_2dev_s=-(-spec["n_jobs"] // 4) * N_TILES * PACE,
+            regime="ingest/admission-limited: per-tenant streaming "
+                   "pacing bounds per-job rate, so throughput = "
+                   "admission slots x stream rate and both legs' "
+                   "walls sit on their ingest floors — the regime "
+                   "where a fleet scales linearly, measured on the "
+                   "scheduling/placement machinery. NOT a CPU "
+                   "compute-scaling claim: the virtual devices share "
+                   "one host core, and the 2dev busy fractions are "
+                   "inflated by cross-thread timeslicing (each "
+                   "step's wall includes preemption by the other "
+                   "owner loop); the compute-bound TPU verdict "
+                   "awaits a healthy chip window"),
+        bit_identical=True,
+        shape=f"8 jobs x {N_TILES}tiles N=16 M=2 F=24 tilesz 4,6 "
+              f"pace{PACE} burst 1dev-vs-2dev e1g4l2")
+    rec["program_cache"] = pcache.PROGRAMS.stats()
+    try:
+        rec["fleet_record"] = _stamp_fleet(
+            rec, jax.devices()[0].platform)
+    except Exception as e:        # the bench result still stands
+        log(f"# fleet record stamping failed: {e}")
+    return rec
+
+
 CONFIGS = [
     ("1-fullbatch-lm", config1_fullbatch_lm),
     ("2-stochastic-lbfgs", config2_stochastic),
@@ -1950,7 +2216,13 @@ CONFIGS = [
     ("6-overlap-e2e", config6_overlap),
     ("7-dtype-melt", config7_dtype),
     ("8-serve-throughput", config8_serve),
+    ("9-fleet-throughput", config9_fleet),
 ]
+
+#: configs that need a virtual multi-device fleet: run_one_config
+#: requests the CPU device count BEFORE the backend initializes
+#: (sagecal_tpu.compat; a real TPU host uses its visible chips)
+MULTI_DEVICE_CONFIGS = {"9-fleet-throughput": 2}
 
 
 
@@ -2141,6 +2413,13 @@ def run_one_config(name: str):
     import jax
     if os.environ.get("SAGECAL_BENCH_CPU"):
         jax.config.update("jax_platforms", "cpu")
+    ndev = MULTI_DEVICE_CONFIGS.get(name)
+    if ndev:
+        # BEFORE the first device use: the virtual-CPU device count
+        # only lands pre-backend-init (a TPU host's real chips are
+        # already visible; the request is a no-op there)
+        from sagecal_tpu import compat
+        compat.set_cpu_device_count(ndev)
     dev = jax.devices()[0]
     # platform assertion: a config expected on TPU must never silently
     # produce a CPU number under a TPU label (round-3 weak item 4)

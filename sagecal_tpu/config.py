@@ -191,6 +191,18 @@ class RunConfig:
     # extra staged tiles). 0: the fully synchronous reference loop —
     # the debugging escape hatch (MIGRATION.md "Overlapped execution")
     prefetch: int = 1
+    # streaming-ingest pacing (sched.Prefetcher pace_s): the k-th
+    # interval this (re)start produces becomes readable no earlier
+    # than (re)start + k * tile_arrival_s seconds, modeling a tenant
+    # whose tiles arrive over the wire at a bounded data rate (the
+    # quasi-real-time LOFAR/SKA regime, arXiv:1410.2101) instead of
+    # sitting on local disk. A resumed/migrated job re-paces from its
+    # resume point (the stream clock is per process run — original
+    # job-start wall time does not survive a restart). Pure wait —
+    # outputs are bit-identical at any pacing; the serve fleet bench
+    # uses it to measure ingest-limited scaling (MIGRATION.md "Fleet
+    # mode"). 0 = off (the default).
+    tile_arrival_s: float = 0.0
 
     # --- observability
     profile_dir: str | None = None     # --profile : jax.profiler trace of

@@ -67,6 +67,12 @@ POINTS = (
     "reader_thread",    # sched: Prefetcher producer death
     "writer_thread",    # sched: AsyncWriter job-loop death
     "socket_drop",      # serve/api: drop the client connection
+    "migrate_abort",    # serve/scheduler: kill a job mid-migration,
+    #                     AFTER its checkpoint flushed on the source
+    #                     device and BEFORE its re-admission on the
+    #                     target — the recovery path must re-queue the
+    #                     job from the durable watermark (zero tiles
+    #                     lost; chaos-gated in tests/test_faults.py)
 )
 
 _KINDS = ("transient", "fatal")

@@ -76,6 +76,23 @@ TABLE_COLUMNS = {"wall": "step", "bytes": "Δbytes",
 #: mode — minutes, not the full bench's half hour.
 RERUN_CONFIGS = ("2-stochastic-lbfgs", "6-overlap-e2e")
 
+#: fleet-record tolerances (FLEET_rNN.json, bench config
+#: 9-fleet-throughput — its own record family, like BSCALING): the
+#: 1->2-device throughput scaling, per-device fleet throughput, p99
+#: queue wait on the fleet leg, and the WORST per-device compile-cache
+#: hit rate (a placement regression shows up as one device going
+#: cold). Judged cross-round exactly like the BENCH banks.
+FLEET_TOLERANCES = {
+    "scaling": dict(field="scaling_1to2", abs=0.15, better="higher"),
+    "fleet_throughput": dict(
+        field="throughput_per_device_2dev_jobs_h", rel=0.30,
+        better="higher"),
+    "queue_wait": dict(field="p99_queue_wait_2dev_s", rel=0.50,
+                       better="lower"),
+    "fleet_cache": dict(field="cache_hit_rate_min_2dev", abs=0.02,
+                        better="higher"),
+}
+
 
 def assert_table_contract(header: str) -> None:
     """Every toleranced metric with a named table column must find it
@@ -96,13 +113,16 @@ def assert_table_contract(header: str) -> None:
 # bank loading
 # ---------------------------------------------------------------------------
 
-def load_banks(platform: str, bank_dir: str = HERE):
+def load_banks(platform: str, bank_dir: str = HERE,
+               pattern: str | None = None):
     """All round-stamped records of ``platform``, oldest first:
     ``[(round, path, results_dict), ...]``. Records whose declared
     platform mismatches their filename are skipped (the bank-hygiene
-    rule bench.py enforces on write)."""
+    rule bench.py enforces on write). ``pattern`` overrides the
+    BENCH filename family (the FLEET loader reuses this body)."""
     out = []
-    pat = os.path.join(bank_dir, f"BENCH_{platform.upper()}_r*.json")
+    pat = os.path.join(bank_dir,
+                       pattern or f"BENCH_{platform.upper()}_r*.json")
     for p in sorted(glob.glob(pat)):
         m = re.search(r"_r(\d+)\.json$", p)
         if not m:
@@ -176,6 +196,39 @@ def compare(live: dict, bank: dict, tolerances: dict | None = None,
                             f"live {lv:.6g} vs {source} {bv:.6g} "
                             f"(limit {lim:.6g})")})
     return out
+
+
+def load_fleet_banks(platform: str, bank_dir: str = HERE):
+    """Round-stamped fleet records (FLEET_rNN.json), oldest first —
+    :func:`load_banks` over the fleet filename family (one series on
+    disk, filtered by the declared platform)."""
+    return load_banks(platform, bank_dir, pattern="FLEET_r*.json")
+
+
+def fleet_cross_round_check(platform: str, bank_dir: str = HERE) -> list:
+    """Newest fleet round vs the most recent earlier one, judged
+    against :data:`FLEET_TOLERANCES` — a PR that banks a fleet round
+    with collapsed scaling, a blown queue-wait tail, or a cold
+    per-device cache fails CI with the metric named (the ISSUE 12
+    satellite: fleet bench metrics join the sentinel like the
+    existing banks)."""
+    occ: dict = {}
+    for rnd, _path, res in load_fleet_banks(platform, bank_dir):
+        for name, rec in res.items():
+            if isinstance(rec, dict) and "error" not in rec:
+                occ.setdefault(name, []).append((rnd, rec))
+    viol = []
+    for name, pairs in occ.items():
+        if len(pairs) < 2:
+            continue
+        (prnd, prev), (rnd, rec) = pairs[-2], pairs[-1]
+        for v in compare({name: rec}, {name: prev},
+                         tolerances=FLEET_TOLERANCES,
+                         source=f"FLEET r{prnd:02d}"):
+            v["round"] = rnd
+            v["msg"] = f"FLEET r{rnd:02d} " + v["msg"]
+            viol.append(v)
+    return viol
 
 
 def cross_round_check(platform: str, bank_dir: str = HERE) -> list:
@@ -499,6 +552,11 @@ def main(argv=None) -> int:
         print(f"sentinel: {plat} bank r{newest[0]:02d} "
               f"({len(banks)} rounds, {os.path.basename(newest[1])})")
         viol.extend(cross_round_check(plat, args.bank_dir))
+        fleet = load_fleet_banks(plat, args.bank_dir)
+        if fleet:
+            print(f"sentinel: {plat} fleet bank r{fleet[-1][0]:02d} "
+                  f"({len(fleet)} rounds)")
+            viol.extend(fleet_cross_round_check(plat, args.bank_dir))
         if not args.fast:
             viol.extend(rerun_check(plat, args.bank_dir))
     if not checked_any:

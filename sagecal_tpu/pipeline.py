@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from sagecal_tpu import coords, dtypes as dtp, faults, sched, skymodel, utils
 from sagecal_tpu.config import RunConfig, SimulationMode, SolverMode
 from sagecal_tpu.serve import cache as pcache
+from sagecal_tpu.serve import fleet as pfleet
 from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.obs import metrics as obs
 from sagecal_tpu.solvers import normal_eq as ne
@@ -294,12 +295,18 @@ class FullBatchPipeline:
 
     def _jit_cached(self, kind: str, build, *extra):
         """A jit wrapper shared through the process-wide program cache:
-        ``build()`` runs once per (kind, content key, extra); every
-        later pipeline with an equal key — another job in the same
-        server, or this pipeline rebuilt — reuses the warm wrapper
-        instead of silently re-tracing (serve/cache.py)."""
-        return pcache.PROGRAMS.get(("prog", kind, self._ckey) + extra,
-                                   build)
+        ``build()`` runs once per (kind, content key, device ordinal,
+        extra); every later pipeline with an equal key — another job in
+        the same server, or this pipeline rebuilt — reuses the warm
+        wrapper instead of silently re-tracing (serve/cache.py). The
+        fleet ordinal (serve/fleet.py; 0 outside any device scope, so
+        solo keys are unchanged in meaning) keys programs PER DEVICE:
+        jax would recompile per device underneath one shared wrapper
+        anyway — separate keys make that cost a visible per-device
+        cache miss the fleet placer can route around."""
+        return pcache.PROGRAMS.get(
+            ("prog", kind, self._ckey, pfleet.current_ordinal()) + extra,
+            build)
 
     def _inflight_downgrade(self, log=print) -> None:
         """Divergence guard for --inflight (VERDICT r5 item 6): a
@@ -646,7 +653,8 @@ class FullBatchPipeline:
             return i, tile, stage_fn(i, tile)
 
         for _j, (ti, tile, stg), wait in sched.Prefetcher(
-                produce, max(0, n - start), depth=depth):
+                produce, max(0, n - start), depth=depth,
+                pace_s=getattr(self.cfg, "tile_arrival_s", 0.0)):
             dtrace.emit("phase", name="io", tile=ti, dur_s=wait)
             yield ti, tile, stg, wait
 

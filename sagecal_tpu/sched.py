@@ -97,12 +97,19 @@ class Prefetcher:
 
     def __init__(self, fn, n: int, depth: int = 1, name: str = "read",
                  context=None, ready_event=None,
-                 join_timeout_s: float = 5.0):
+                 join_timeout_s: float = 5.0, pace_s: float = 0.0):
         self.fn = fn
         self.n = int(n)
         self.depth = int(depth)
         self.name = name
         self.join_timeout_s = float(join_timeout_s)
+        # streaming-ingest model (--tile-arrival): item i becomes
+        # producible no earlier than start + i * pace_s, as if tiles
+        # arrived from a rate-limited tenant stream (the LOFAR/SKA
+        # quasi-real-time regime, arXiv:1410.2101). Pure wait — the
+        # produced bytes, and therefore every output, are unchanged.
+        self.pace_s = max(0.0, float(pace_s))
+        self._t0 = time.monotonic()
         # zero-arg context-manager factory entered for the producer
         # thread's lifetime (serve: routes the thread's diag emits to
         # the owning job's tracer via dtrace.scope)
@@ -136,6 +143,15 @@ class Prefetcher:
         Retrying the whole ``fn(i)`` is safe by the staging contract:
         reads are pure and a producer's only durable side effect
         (``DonatedRing.stage``) is its final statement."""
+        if self.pace_s > 0.0:
+            # ingest pacing: wait out the synthetic arrival time (the
+            # cancel event bounds the wait so close() stays prompt)
+            due = self._t0 + i * self.pace_s
+            while not self._cancel.is_set():
+                delay = due - time.monotonic()
+                if delay <= 0:
+                    break
+                self._cancel.wait(min(delay, 0.2))
         faults.inject("reader_thread", key=i)
         return faults.retry_transient(self.fn, (i,), what="read", key=i)
 

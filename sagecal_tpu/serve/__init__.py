@@ -10,18 +10,24 @@ program away at exit. This package keeps the device busy across *jobs*:
 - :mod:`sagecal_tpu.serve.queue` — job registry + FIFO-with-priorities
   queue with admission control (bounded in-flight jobs and bounded
   staged bytes) and fail-stop per-job isolation;
-- :mod:`sagecal_tpu.serve.scheduler` — the one device-owner loop that
-  interleaves ready tiles from many jobs through per-job
-  ``sched.Prefetcher`` instances and one ordered ``sched.AsyncWriter``
-  per job, preserving each job's sequential warm-start/PRNG chain
-  (per-job outputs are bit-identical to a solo CLI run);
+- :mod:`sagecal_tpu.serve.scheduler` — device-owner loops (one per
+  fleet device) that interleave ready tiles from many jobs through
+  per-job ``sched.Prefetcher`` instances and one ordered
+  ``sched.AsyncWriter`` per job, preserving each job's sequential
+  warm-start/PRNG chain (per-job outputs are bit-identical to a solo
+  CLI run), with tile-boundary migration/work-stealing between
+  devices;
+- :mod:`sagecal_tpu.serve.fleet` — device scopes, shape-bucket
+  affinity tokens and the placement layer (``--devices N``);
+- :mod:`sagecal_tpu.serve.loadgen` — the seedable traffic-replay
+  load generator behind the banked FLEET records;
 - :mod:`sagecal_tpu.serve.api` — a zero-dependency JSON-lines protocol
-  over a local socket (submit/status/cancel/drain/metrics) with
-  graceful drain on SIGTERM.
+  over a local socket (submit/status/cancel/migrate/drain/metrics)
+  with graceful drain on SIGTERM.
 
 Run it: ``python -m sagecal_tpu.serve --socket /tmp/sagecal.sock``.
-See MIGRATION.md "Service mode" for the protocol and the per-job
-bit-identity / bucketing contracts.
+See MIGRATION.md "Service mode" / "Fleet mode" for the protocol and
+the per-job bit-identity / bucketing / migration contracts.
 """
 
 from sagecal_tpu.serve import cache  # noqa: F401

@@ -28,12 +28,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="unix socket path to listen on")
     g.add_argument("--port", type=int,
                    help="TCP port on 127.0.0.1 (0 = ephemeral)")
+    p.add_argument("--devices", type=int, default=1, metavar="N",
+                   help="fleet size: one device-owner loop per device, "
+                        "jobs routed by shape-bucket affinity, "
+                        "tile-boundary migration/work-stealing between "
+                        "devices (0 = every visible device; default 1 "
+                        "= the single-device daemon, bit-identical to "
+                        "pre-fleet behavior)")
     p.add_argument("--max-inflight", type=int, default=2,
-                   help="concurrently RUNNING jobs (admission control; "
-                        "queued jobs wait)")
+                   help="concurrently RUNNING jobs PER DEVICE "
+                        "(admission control; queued jobs wait)")
     p.add_argument("--max-staged-bytes", type=int, default=2 << 30,
-                   help="staged-tile byte budget across running jobs "
-                        "(each job stages ~(prefetch+3) tiles)")
+                   help="staged-tile byte budget across running jobs, "
+                        "PER DEVICE (each job stages ~(prefetch+3) "
+                        "tiles)")
     p.add_argument("--diag", default=None, metavar="PATH",
                    help="server-level JSONL trace (per-job traces come "
                         "from each submit's 'trace' field)")
@@ -45,6 +53,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "'metrics'/'metrics_full' ops always work)")
     p.add_argument("--platform", default=None,
                    help="force the jax platform (e.g. 'cpu')")
+    p.add_argument("--cpu-devices", type=int, default=None,
+                   metavar="N",
+                   help="request N virtual CPU devices (with "
+                        "--platform cpu: the fleet substrate on a "
+                        "chipless host; must land before first device "
+                        "use, same as the solo CLIs)")
     return p
 
 
@@ -53,6 +67,9 @@ def main(argv=None) -> int:
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)
+    if args.cpu_devices:
+        from sagecal_tpu import compat
+        compat.set_cpu_device_count(args.cpu_devices)
     if args.diag:
         from sagecal_tpu.diag import trace as dtrace
         dtrace.enable(args.diag, entry="sagecal-serve",
@@ -62,7 +79,8 @@ def main(argv=None) -> int:
     srv = Server(socket_path=args.socket, port=args.port,
                  max_inflight=args.max_inflight,
                  max_staged_bytes=args.max_staged_bytes,
-                 metrics_port=args.metrics_port)
+                 metrics_port=args.metrics_port,
+                 devices=args.devices)
     # graceful drain on SIGTERM/SIGINT: finish in-flight tiles, flush
     # writers, refuse new submissions, exit when idle
     signal.signal(signal.SIGTERM, lambda *a: srv.drain())
@@ -70,7 +88,8 @@ def main(argv=None) -> int:
     srv.start()
     where = args.socket or f"127.0.0.1:{srv.port}"
     print(f"sagecal-serve: listening on {where} "
-          f"(max_inflight={args.max_inflight})", flush=True)
+          f"(devices={len(srv.scheduler.workers)}, "
+          f"max_inflight={args.max_inflight}/device)", flush=True)
     try:
         srv.serve_forever()
     finally:

@@ -22,9 +22,16 @@ op           request fields / reply
 ``status``   optional ``job_id``; reply one snapshot or all of them
 ``cancel``   ``job_id``; queued cancels now, running at its next tile
              boundary (reply carries the state observed)
+``migrate``  ``job_id`` + ``device``: yield a running fullbatch job at
+             its next tile boundary and resume it on the target device
+             from its checkpoint watermark (zero tiles re-run,
+             bit-identical — MIGRATION.md "Fleet mode"); the fleet
+             controller work-steals with the same machinery
 ``metrics``  queue depths, compile-cache hits/misses/hit_rate,
              device-busy fraction, tiles/jobs done, last-progress
-             watermark, unhealthy jobs
+             watermark, unhealthy jobs, and in fleet mode a
+             ``devices`` list (per-device busy/running/tiles/cache
+             hit rate/watermark) + migration counters
 ``metrics_full``  the ``metrics`` payload PLUS the full obs registry
              dump: every counter/gauge, and per-job SLO histograms
              (queue-wait / run / end-to-end latency) with
@@ -103,7 +110,8 @@ class Server:
     def __init__(self, socket_path: str | None = None,
                  port: int | None = None, max_inflight: int = 2,
                  max_staged_bytes: int = 2 << 30, log=print,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 devices: int | None = None):
         if (socket_path is None) == (port is None):
             raise ValueError("exactly one of socket_path/port")
         self.socket_path = socket_path
@@ -113,9 +121,15 @@ class Server:
         # always live here (solo CLI runs keep the disabled default —
         # MIGRATION.md "Observability")
         self.registry = ometrics.enable()
+        # fleet mode (--devices): one owner loop per device, jobs
+        # routed by shape-bucket affinity, per-device admission
+        # budgets. None/1 = the single-device pre-fleet daemon,
+        # bit- and compile-count-identical (MIGRATION.md "Fleet mode")
         self.queue = jq.JobQueue(max_inflight=max_inflight,
                                  max_staged_bytes=max_staged_bytes)
-        self.scheduler = Scheduler(self.queue, log=log)
+        from sagecal_tpu.serve import fleet
+        self.scheduler = Scheduler(self.queue, log=log,
+                                   devices=fleet.fleet_devices(devices))
         self.metrics_port = metrics_port
         self._obs_http = None
         self._drained = threading.Event()
@@ -193,6 +207,15 @@ class Server:
         if op == "cancel":
             state = self.queue.cancel(req["job_id"])
             return {"ok": True, "state": state}
+        if op == "migrate":
+            # manual tile-boundary migration (the automatic path is
+            # the controller's work stealing): the owning device-owner
+            # loop yields the job at its next boundary, the target
+            # re-admits it as a checkpoint resume — zero tiles re-run,
+            # bit-identical outputs (MIGRATION.md "Fleet mode")
+            state = self.scheduler.request_migration(
+                req["job_id"], int(req["device"]))
+            return {"ok": True, "state": state}
         if op == "metrics":
             return {"ok": True, "metrics": self.scheduler.metrics()}
         if op == "metrics_full":
@@ -217,8 +240,8 @@ class Server:
         gauges (runs per scrape / metrics_full request, so pull-style
         readers always see fresh depths); returns the snapshot."""
         m = self.scheduler.metrics()
-        for state in (jq.QUEUED, jq.RUNNING, jq.DONE, jq.FAILED,
-                      jq.CANCELLED):
+        for state in (jq.QUEUED, jq.RUNNING, jq.MIGRATING, jq.DONE,
+                      jq.FAILED, jq.CANCELLED):
             ometrics.set_gauge("serve_jobs", float(m[state]),
                                state=state)
         ometrics.set_gauge("serve_staged_bytes", m["staged_bytes"])
@@ -230,6 +253,22 @@ class Server:
                            max(0.0, time.time() - m["last_progress_t"]))
         ometrics.set_gauge("serve_unhealthy_jobs",
                            float(len(m["unhealthy_jobs"])))
+        # per-device fleet snapshot (the unlabeled aggregates above
+        # stay — single-device scrape output is a superset of PR 8's)
+        now = time.time()
+        for d in m["devices"]:
+            dev = str(d["device"])
+            ometrics.set_gauge("serve_device_busy_frac",
+                               d["busy_frac"], device=dev)
+            ometrics.set_gauge("serve_device_running_jobs",
+                               float(d["running"]), device=dev)
+            ometrics.set_gauge("serve_device_tiles_done",
+                               float(d["tiles_done"]), device=dev)
+            ometrics.set_gauge(
+                "serve_last_progress_age_seconds",
+                max(0.0, now - d["last_progress_t"]), device=dev)
+            ometrics.set_gauge("serve_program_cache_hit_rate",
+                               d["cache"]["hit_rate"], device=dev)
         return m
 
     def render_metrics(self) -> str:
@@ -250,13 +289,23 @@ class Server:
         unhealthy = m["unhealthy_jobs"]
         degraded = any(j["health"] in ohealth.DEGRADED
                        for j in unhealthy)
+        now = time.time()
         return {
             "status": "degraded" if degraded else "ok",
             "queued": m[jq.QUEUED], "running": m[jq.RUNNING],
+            "migrating": m[jq.MIGRATING],
             "device_busy_frac": m["device_busy_frac"],
             "last_progress_t": m["last_progress_t"],
             "last_progress_age_s":
-                max(0.0, time.time() - m["last_progress_t"]),
+                max(0.0, now - m["last_progress_t"]),
+            # per-device liveness: a wedged device stops moving ITS
+            # watermark while the fleet aggregate keeps advancing
+            "devices": [
+                {"device": d["device"], "busy_frac": d["busy_frac"],
+                 "running": d["running"],
+                 "last_progress_age_s":
+                     max(0.0, now - d["last_progress_t"])}
+                for d in m["devices"]],
             "unhealthy_jobs": unhealthy,
             "draining": self.queue.draining,
         }
@@ -449,6 +498,10 @@ class Client:
 
     def cancel(self, job_id: str) -> str:
         return self.request(op="cancel", job_id=job_id)["state"]
+
+    def migrate(self, job_id: str, device: int) -> str:
+        return self.request(op="migrate", job_id=job_id,
+                            device=int(device))["state"]
 
     def metrics(self) -> dict:
         return self.request(op="metrics")["metrics"]

@@ -116,10 +116,12 @@ class ProgramCache:
     """LRU mapping explicit content keys -> built (jitted) callables.
 
     ``get(key, build)`` returns the cached value or calls ``build()``
-    under the lock (pipelines are constructed on one thread; a slow
-    trace inside ``build`` must not let a racing second builder compile
-    the same program twice). Eviction drops only the cache's reference;
-    live pipelines keep theirs.
+    — OUTSIDE the cache-wide lock, guarded per key: concurrent
+    callers of the same key wait for the one in-flight build (a slow
+    trace must not let a racing second builder compile the same
+    program twice), while callers of other keys — other devices'
+    job starts in fleet mode — proceed unblocked. Eviction drops only
+    the cache's reference; live pipelines keep theirs.
     """
 
     def __init__(self, maxsize: int = 64):
@@ -128,21 +130,65 @@ class ProgramCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # per-device hit/miss accounting (fleet mode): keyed by the
+        # entering thread's fleet ordinal (serve/fleet.py; 0 outside
+        # any device scope). The fleet placer reads these to route
+        # bucket-affine jobs at the devices whose caches are warm.
+        self._by_dev: dict[int, list] = {}
+        # per-key in-flight builds: build() is a multi-second XLA
+        # trace+compile, and holding the cache-wide lock across it
+        # would stall every OTHER device's job start behind one
+        # tenant's cold bucket (fleet mode). A key's first caller
+        # builds outside the lock; concurrent callers of the SAME key
+        # wait on its event (never compiling twice — the original
+        # contract); callers of other keys proceed untouched.
+        self._building: dict = {}
+
+    def _count(self, dev: int, hit: bool) -> None:
+        """Lock held."""
+        st = self._by_dev.setdefault(dev, [0, 0])
+        if hit:
+            self.hits += 1
+            st[0] += 1
+            obs.inc("serve_program_cache_hits_total", device=str(dev))
+        else:
+            self.misses += 1
+            st[1] += 1
+            obs.inc("serve_program_cache_misses_total",
+                    device=str(dev))
 
     def get(self, key, build):
-        with self._lock:
-            if key in self._d:
-                self.hits += 1
-                obs.inc("serve_program_cache_hits_total")
-                self._d.move_to_end(key)
-                return self._d[key]
-            self.misses += 1
-            obs.inc("serve_program_cache_misses_total")
+        from sagecal_tpu.serve import fleet
+        dev = fleet.current_ordinal()
+        while True:
+            with self._lock:
+                if key in self._d:
+                    self._count(dev, hit=True)
+                    self._d.move_to_end(key)
+                    return self._d[key]
+                ev = self._building.get(key)
+                if ev is None:
+                    ev = self._building[key] = threading.Event()
+                    self._count(dev, hit=False)
+                    break               # this caller builds
+            # another thread is building this key: wait, then re-check
+            # (if its build RAISED, the loop finds the key absent and
+            # this caller becomes the builder)
+            ev.wait()
+        try:
             val = build()
+        except BaseException:
+            with self._lock:
+                self._building.pop(key, None)
+            ev.set()
+            raise
+        with self._lock:
             self._d[key] = val
             while len(self._d) > self.maxsize:
                 self._d.popitem(last=False)
-            return val
+            self._building.pop(key, None)
+        ev.set()
+        return val
 
     def stats(self) -> dict:
         with self._lock:
@@ -151,11 +197,22 @@ class ProgramCache:
                     "misses": self.misses,
                     "hit_rate": (self.hits / n) if n else 0.0}
 
+    def stats_by_device(self) -> dict:
+        """Per-fleet-ordinal ``{hits, misses, hit_rate}`` (the
+        placement signal; ordinal 0 covers solo/pre-fleet traffic)."""
+        with self._lock:
+            out = {}
+            for dev, (h, m) in sorted(self._by_dev.items()):
+                out[dev] = {"hits": h, "misses": m,
+                            "hit_rate": (h / (h + m)) if h + m else 0.0}
+            return out
+
     def clear(self) -> None:
         with self._lock:
             self._d.clear()
             self.hits = 0
             self.misses = 0
+            self._by_dev.clear()
 
 
 #: the process singleton every pipeline keys its programs through

@@ -1,0 +1,305 @@
+"""Traffic-replay load generator for the serve fleet.
+
+Synthesizes a *replayable* tenant workload — mixed shape-buckets,
+priorities, deadlines, and a seedable arrival process — and drives a
+live daemon with it through the JSON-lines client, so fleet numbers
+(throughput-per-device, p99 queue wait, per-device cache hit rate)
+are measured against a *defined* traffic mix instead of hand-run
+jobs. Everything is deterministic from the spec: the same ``seed``
+produces the same datasets (content seeds), the same arrival times,
+the same priorities/deadlines — replaying a spec against two fleet
+sizes is an apples-to-apples comparison (bench config
+``9-fleet-throughput``, FLEET_r12.json).
+
+A spec is a JSON object (all fields defaulted — ``{}`` is valid)::
+
+    {
+      "seed": 12,
+      "n_jobs": 8,
+      "arrival": {"process": "poisson", "rate_per_s": 4.0},
+      "templates": [
+        {"name": "bucketA", "weight": 1.0,
+         "n_stations": 16, "tilesz": 4, "n_tiles": 6, "nchan": 24,
+         "noise_sigma": 0.02,
+         "priority": [0], "deadline_s": null,
+         "config": {"solver_mode": 0, "max_em_iter": 1, ...}}
+      ]
+    }
+
+``arrival.process``: ``"poisson"`` (exponential inter-arrival at
+``rate_per_s``), ``"uniform"`` (fixed spacing ``1/rate_per_s``) or
+``"burst"`` (everything at t=0 — the backlog-drain regime whose
+queue-wait tail shows fleet capacity). Template ``config`` fields are
+RunConfig names (serve ``submit`` semantics); ``tile_arrival_s``
+there turns on streaming-ingest pacing (config.py) — the
+ingest-limited regime where per-device throughput is bounded by
+tenant data rate, not device compute.
+
+Each scheduled job gets its OWN copy of its template's dataset (jobs
+write residuals in place), so per-job outputs are independently
+comparable against a solo run of the same template — the
+bit-identity gate the bench refuses to bank without.
+
+Layering: stdlib + numpy + the serve Client; jax only inside
+:func:`build_fixtures` (dataset synthesis).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import shutil
+import time
+
+import numpy as np
+
+#: small two-cluster sky shared by every template (the bench's serve
+#: sky): enough structure for a real solve, cheap enough for a replay
+SKY = """\
+P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6
+P1A 1 20 0 38 0 0 2.5 0 0 0 0 0 0 0 0 150e6
+"""
+CLUSTER = """\
+0 1 P0A
+1 2 P1A
+"""
+
+DEFAULT_TEMPLATE = dict(
+    name="bucketA", weight=1.0, n_stations=16, tilesz=4, n_tiles=6,
+    nchan=24, noise_sigma=0.02, priority=[0], deadline_s=None,
+    config={})
+
+DEFAULT_SPEC = dict(
+    seed=12, n_jobs=8,
+    arrival=dict(process="burst", rate_per_s=4.0),
+    templates=[dict(DEFAULT_TEMPLATE)])
+
+#: solver knobs every template starts from (pinned solve plan — the
+#: zero-compile/bit-identity contract of tests/test_serve.py)
+BASE_CONFIG = dict(solver_mode=0, max_em_iter=1, max_iter=4,
+                   max_lbfgs=2, solve_fuse="on", solve_promote="off",
+                   prefetch=2)
+
+
+def load_spec(spec) -> dict:
+    """Spec from a dict, JSON text, or a path; defaults filled in."""
+    if isinstance(spec, str):
+        if os.path.exists(spec):
+            with open(spec) as f:
+                spec = json.load(f)
+        else:
+            spec = json.loads(spec)
+    out = dict(DEFAULT_SPEC)
+    out.update(spec or {})
+    out["arrival"] = dict(DEFAULT_SPEC["arrival"],
+                          **(out.get("arrival") or {}))
+    tmpls = []
+    for t in out["templates"]:
+        tmpls.append(dict(DEFAULT_TEMPLATE, **t))
+    names = [t["name"] for t in tmpls]
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate template names: {names}")
+    out["templates"] = tmpls
+    return out
+
+
+def schedule(spec) -> list:
+    """The deterministic arrival schedule: ``[{t, template, job_id,
+    priority, deadline_s, seq}, ...]`` sorted by arrival time. Pure
+    function of the spec (``random.Random(seed)`` — no wall clock)."""
+    spec = load_spec(spec)
+    rng = random.Random(int(spec["seed"]))
+    tmpls = spec["templates"]
+    weights = [float(t["weight"]) for t in tmpls]
+    arr = spec["arrival"]
+    t = 0.0
+    out = []
+    for i in range(int(spec["n_jobs"])):
+        tmpl = rng.choices(tmpls, weights=weights)[0]
+        prio = rng.choice(list(tmpl["priority"]))
+        out.append(dict(t=round(t, 6), template=tmpl["name"],
+                        job_id=f"replay-{spec['seed']}-{i:03d}",
+                        priority=int(prio),
+                        deadline_s=tmpl["deadline_s"], seq=i))
+        if arr["process"] == "poisson":
+            t += rng.expovariate(float(arr["rate_per_s"]))
+        elif arr["process"] == "uniform":
+            t += 1.0 / float(arr["rate_per_s"])
+        elif arr["process"] == "burst":
+            pass                        # everything arrives at t=0
+        else:
+            raise ValueError(
+                f"unknown arrival process {arr['process']!r}")
+    return out
+
+
+def build_fixtures(spec, workdir: str) -> dict:
+    """Materialize the sky + one prototype dataset per template
+    (content-seeded: same spec -> same bytes). Returns
+    ``{template_name: {"ms": protodir, "sky": ..., "cluster": ...}}``."""
+    import jax.numpy as jnp
+    from sagecal_tpu import skymodel
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.rime import predict as rp
+    spec = load_spec(spec)
+    os.makedirs(workdir, exist_ok=True)
+    skyf = os.path.join(workdir, "sky.txt")
+    clusf = skyf + ".cluster"
+    with open(skyf, "w") as f:
+        f.write(SKY)
+    with open(clusf, "w") as f:
+        f.write(CLUSTER)
+    ra0 = (41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(skyf, ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(clusf))
+    dsky = rp.sky_to_device(sky, jnp.float32)
+    seed0 = int(spec["seed"])
+    out = {}
+    for tn, tmpl in enumerate(spec["templates"]):
+        Jt = ds.random_jones(sky.n_clusters, sky.nchunk,
+                             tmpl["n_stations"], seed=seed0 + 5 + tn,
+                             scale=0.15)
+        freqs = np.linspace(149e6, 151e6, int(tmpl["nchan"]))
+        tiles = [ds.simulate_dataset(
+            dsky, n_stations=int(tmpl["n_stations"]),
+            tilesz=int(tmpl["tilesz"]), freqs=freqs, ra0=ra0,
+            dec0=dec0, jones=Jt, nchunk=sky.nchunk,
+            noise_sigma=float(tmpl["noise_sigma"]),
+            seed=seed0 + 100 * (tn + 1) + t)
+            for t in range(int(tmpl["n_tiles"]))]
+        proto = os.path.join(workdir, f"proto_{tmpl['name']}.ms")
+        ds.SimMS.create(proto, tiles)
+        out[tmpl["name"]] = {"ms": proto, "sky": skyf,
+                             "cluster": clusf}
+    return out
+
+
+def job_config(spec, tmpl_name: str, msdir: str, solutions: str) -> dict:
+    """The serve ``submit`` config for one replay job of a template
+    (BASE_CONFIG <- template overrides <- this job's paths)."""
+    spec = load_spec(spec)
+    tmpl = {t["name"]: t for t in spec["templates"]}[tmpl_name]
+    cfg = dict(BASE_CONFIG)
+    cfg.update(tmpl["config"])
+    cfg.update(ms=msdir, tile_size=int(tmpl["tilesz"]),
+               solutions_file=solutions)
+    return cfg
+
+
+def replay(client, spec, fixtures, workdir: str, log=print) -> dict:
+    """Drive a live daemon with the spec's schedule. ``client``: a
+    connected ``serve.api.Client``; ``fixtures``: from
+    :func:`build_fixtures` (per-template prototype datasets — each
+    job gets its own copy under ``workdir``). Blocks until every
+    submitted job is terminal (server-side drain wait: no status
+    polling stealing host cycles mid-replay), then returns the replay
+    record: wall, throughput, queue-wait/e2e percentiles, per-job
+    rows, and the output paths for the caller's bit-identity gate."""
+    spec = load_spec(spec)
+    sched_rows = schedule(spec)
+    fix = {n: dict(v) for n, v in fixtures.items()}
+    jobs = []
+    for row in sched_rows:
+        f = fix[row["template"]]
+        msdir = os.path.join(workdir, f"{row['job_id']}.ms")
+        if os.path.exists(msdir):
+            shutil.rmtree(msdir)
+        shutil.copytree(f["ms"], msdir)
+        sol = os.path.join(workdir, f"{row['job_id']}.sol")
+        cfg = job_config(spec, row["template"], msdir, sol)
+        cfg.update(sky_model=f["sky"], cluster_file=f["cluster"])
+        jobs.append(dict(row, ms=msdir, solutions=sol, config=cfg))
+    t0 = time.perf_counter()
+    for job in jobs:
+        # honour the arrival process (monotonic offsets from t0)
+        delay = job["t"] - (time.perf_counter() - t0)
+        if delay > 0:
+            time.sleep(delay)
+        kw = dict(job_id=job["job_id"], priority=job["priority"])
+        if job["deadline_s"] is not None:
+            kw["deadline_s"] = float(job["deadline_s"])
+        client.submit(job["config"], **kw)
+    client.drain(wait=True)
+    wall = time.perf_counter() - t0
+    waits, e2es, states = [], [], {}
+    rows = []
+    for job in jobs:
+        snap = client.status(job["job_id"])
+        states[snap["state"]] = states.get(snap["state"], 0) + 1
+        qw = (snap["started_t"] - snap["submitted_t"]
+              if snap["started_t"] else None)
+        e2e = (snap["finished_t"] - snap["submitted_t"]
+               if snap["finished_t"] else None)
+        if qw is not None:
+            waits.append(qw)
+        if e2e is not None:
+            e2es.append(e2e)
+        rows.append(dict(job_id=job["job_id"],
+                         template=job["template"],
+                         state=snap["state"], device=snap["device"],
+                         queue_wait_s=qw, e2e_s=e2e,
+                         migrations=snap["migrations"],
+                         ms=job["ms"], solutions=job["solutions"]))
+    n_done = states.get("done", 0)
+    rec = dict(
+        n_jobs=len(jobs), states=states, wall_s=round(wall, 3),
+        throughput_jobs_per_s=round(n_done / wall, 4) if wall else 0.0,
+        queue_wait_p50_s=_pct(waits, 50), queue_wait_p99_s=_pct(waits, 99),
+        e2e_p50_s=_pct(e2es, 50), e2e_p99_s=_pct(e2es, 99),
+        jobs=rows)
+    log(f"loadgen: {n_done}/{len(jobs)} done in {wall:.2f}s "
+        f"({rec['throughput_jobs_per_s']:.3f} jobs/s, p99 queue wait "
+        f"{rec['queue_wait_p99_s']}s)")
+    return rec
+
+
+def _pct(vals, p) -> float | None:
+    """Exact (nearest-rank, interpolated) percentile of the measured
+    per-job values — no histogram-bucket clamping."""
+    if not vals:
+        return None
+    v = sorted(vals)
+    k = (len(v) - 1) * p / 100.0
+    lo, hi = int(math.floor(k)), int(math.ceil(k))
+    if lo == hi:
+        return round(v[lo], 6)
+    return round(v[lo] + (v[hi] - v[lo]) * (k - lo), 6)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m sagecal_tpu.serve.loadgen",
+        description="replay a synthetic traffic spec against a live "
+                    "serve daemon and print the replay record")
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("--socket", metavar="PATH")
+    g.add_argument("--port", type=int)
+    p.add_argument("--spec", default="{}",
+                   help="JSON spec (inline or a path); {} = defaults")
+    p.add_argument("--workdir", default=None,
+                   help="dataset scratch dir (default: a tempdir)")
+    p.add_argument("--platform", default=None,
+                   help="force the jax platform for dataset synthesis")
+    args = p.parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    import tempfile
+    workdir = args.workdir or tempfile.mkdtemp(prefix="sagecal_loadgen_")
+    spec = load_spec(args.spec)
+    fixtures = build_fixtures(spec, workdir)
+    from sagecal_tpu.serve.api import Client
+    with Client(socket_path=args.socket, port=args.port) as c:
+        rec = replay(c, spec, fixtures, workdir)
+    print(json.dumps(rec, indent=1, default=float))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

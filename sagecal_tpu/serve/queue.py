@@ -50,6 +50,11 @@ JOB_SLO_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 900.0,
 
 QUEUED = "queued"
 RUNNING = "running"
+#: yielded at a tile boundary for migration to another device: the
+#: checkpoint watermark is on disk, the job waits (ahead of every
+#: QUEUED job) for its target device's owner loop to re-admit it as a
+#: resume. Non-terminal; cancel takes it immediately like QUEUED.
+MIGRATING = "migrating"
 DONE = "done"
 FAILED = "failed"
 CANCELLED = "cancelled"
@@ -112,6 +117,18 @@ class Job:
         self.health: str | None = None
         self.health_detail: dict | None = None
         self._adm_deferred = False        # budget-deferral counted once
+        # fleet placement + migration state (serve/fleet.py,
+        # serve/scheduler.py): the device ordinal the job runs on, a
+        # migration pin (set while MIGRATING: only the pinned device
+        # may re-admit; None = any), the cooperative migrate request
+        # the owner loop honours at the next tile boundary, the cached
+        # shape-bucket affinity token, and the per-migration cost
+        # records (src/dst/tile/yield_s/wall_s/tiles_rerun)
+        self.device: int | None = None
+        self.pinned_device: int | None = None
+        self.migrate_to: int | None = None
+        self.bucket: str | None = None
+        self.migrations: list = []
 
     def snapshot(self) -> dict:
         """JSON-serializable status row (the api `status` reply)."""
@@ -133,6 +150,10 @@ class Job:
             # job is visible from `status` BEFORE it burns its budget
             "health": self.health,
             "health_detail": self.health_detail,
+            # fleet placement: which device owns the job, and every
+            # migration's measured cost (wall + tiles re-run)
+            "device": self.device,
+            "migrations": self.migrations,
         }
 
     def expired(self, now: float | None = None) -> bool:
@@ -192,8 +213,8 @@ class JobQueue:
     def counts(self) -> dict:
         with self._lock:
             out: dict = {s: 0 for s in
-                         (QUEUED, RUNNING, DONE, FAILED, CANCELLED,
-                          DEADLINE_EXCEEDED)}
+                         (QUEUED, RUNNING, MIGRATING, DONE, FAILED,
+                          CANCELLED, DEADLINE_EXCEEDED)}
             for j in self._jobs.values():
                 out[j.state] += 1
             out["staged_bytes"] = sum(
@@ -215,17 +236,17 @@ class JobQueue:
 
     def idle(self) -> bool:
         with self._lock:
-            return not any(j.state in (QUEUED, RUNNING)
+            return not any(j.state in (QUEUED, RUNNING, MIGRATING)
                            for j in self._jobs.values())
 
     def cancel(self, job_id: str) -> str:
-        """Queued jobs cancel immediately; running jobs get the
-        cooperative flag (the scheduler honours it at the next tile
-        boundary — in-flight writes for completed tiles still land).
-        Returns the state observed at the call."""
+        """Queued (or mid-migration) jobs cancel immediately; running
+        jobs get the cooperative flag (the scheduler honours it at the
+        next tile boundary — in-flight writes for completed tiles
+        still land). Returns the state observed at the call."""
         with self._lock:
             job = self._jobs[job_id]
-            if job.state == QUEUED:
+            if job.state in (QUEUED, MIGRATING):
                 # same terminal accounting as the scheduler-side
                 # finish(): the SLO histograms / jobs_total counters
                 # and q.counts() must agree on every path
@@ -236,51 +257,133 @@ class JobQueue:
 
     # -- admission (scheduler side) -----------------------------------------
 
-    def next_admissible(self, est_bytes_fn) -> Job | None:
+    def next_admissible(self, est_bytes_fn, worker_ix: int = 0,
+                        placer=None) -> Job | None:
         """Highest-priority queued job that fits the running budget
-        (FIFO within a priority level), or None. ``est_bytes_fn(job)``
-        prices the job's staged working set once (cached on the job);
-        the estimate is recorded in ``staged_bytes`` so the budget
+        (FIFO within a priority level) AND belongs on device
+        ``worker_ix``, or None. ``est_bytes_fn(job)`` prices the
+        job's staged working set once (cached on the job); the
+        estimate is recorded in ``staged_bytes`` so the budget
         accounting survives until the job finishes. A lone job always
         admits (no starvation by size), and admission is strict
-        head-of-line: a budget-blocked job BLOCKS everything behind it
-        rather than letting a stream of smaller lower-priority jobs
-        backfill past it forever — its reservation is honoured as
-        soon as enough running jobs finish."""
+        head-of-line FLEET-WIDE: a job blocked on every device BLOCKS
+        everything behind it rather than letting a stream of smaller
+        lower-priority jobs backfill past it forever — its
+        reservation is honoured as soon as enough running jobs
+        finish. MIGRATING jobs resume AHEAD of every queued job (they
+        already held a slot).
+
+        ``placer`` None (the single-device daemon) keeps the PR 7
+        admission path bit-for-bit: global budgets, device 0. With a
+        ``fleet.Placer``, capacity is PER DEVICE and the head job is
+        routed by bucket affinity / least load — this worker only
+        receives jobs placed to it. The placer is mutated exclusively
+        under this lock, so its affinity map needs no lock of its
+        own."""
         with self._lock:
-            # expire queued jobs whose deadline already passed — they
-            # must never consume a device slot, and their clients must
-            # observe a terminal state instead of polling forever
+            # expire queued/migrating jobs whose deadline already
+            # passed — they must never consume a device slot, and
+            # their clients must observe a terminal state instead of
+            # polling forever
             now = time.time()
             for j in self._jobs.values():
-                if j.state == QUEUED and j.expired(now):
+                if j.state in (QUEUED, MIGRATING) and j.expired(now):
                     self._finish_locked(j, DEADLINE_EXCEEDED)
-            running = [j for j in self._jobs.values()
-                       if j.state == RUNNING]
-            if len(running) >= self.max_inflight:
-                return None
-            queued = [j for j in self._jobs.values() if j.state == QUEUED]
-            queued.sort(key=lambda j: (-j.priority, self._seq[j.job_id]))
-            used = sum(j.staged_bytes for j in running)
-            for job in queued:
-                if job.est_bytes is None:
-                    job.est_bytes = int(est_bytes_fn(job))
-                if running and used + job.est_bytes > self.max_staged_bytes:
-                    if not job._adm_deferred:
-                        # counted once per job, not once per scheduler
-                        # pass: the SLO question is "how many jobs hit
-                        # the budget wall", not how often we re-polled
-                        job._adm_deferred = True
-                        obs.inc("serve_admission_deferrals_total",
-                                reason="staged_bytes")
-                    return None
-                job.staged_bytes = job.est_bytes
-                job.state = RUNNING
-                job.started_t = time.time()
-                obs.observe("serve_job_queue_wait_seconds",
-                            job.started_t - job.submitted_t)
-                return job
+            if placer is None:
+                return self._next_admissible_solo(est_bytes_fn,
+                                                  worker_ix)
+            return self._next_admissible_fleet(est_bytes_fn, worker_ix,
+                                               placer)
+
+    def _next_admissible_solo(self, est_bytes_fn, worker_ix) -> Job | None:
+        """Lock held. The pre-fleet admission path, verbatim."""
+        running = [j for j in self._jobs.values()
+                   if j.state == RUNNING]
+        if len(running) >= self.max_inflight:
             return None
+        queued = [j for j in self._jobs.values() if j.state == QUEUED]
+        queued.sort(key=lambda j: (-j.priority, self._seq[j.job_id]))
+        used = sum(j.staged_bytes for j in running)
+        for job in queued:
+            if job.est_bytes is None:
+                job.est_bytes = int(est_bytes_fn(job))
+            if running and used + job.est_bytes > self.max_staged_bytes:
+                if not job._adm_deferred:
+                    # counted once per job, not once per scheduler
+                    # pass: the SLO question is "how many jobs hit
+                    # the budget wall", not how often we re-polled
+                    job._adm_deferred = True
+                    obs.inc("serve_admission_deferrals_total",
+                            reason="staged_bytes")
+                return None
+            self._mark_running_locked(job, worker_ix)
+            return job
+        return None
+
+    def _next_admissible_fleet(self, est_bytes_fn, worker_ix,
+                               placer) -> Job | None:
+        """Lock held. Placement-routed admission: migrating jobs
+        first, then priority-FIFO; the head candidate is placed
+        (affinity -> least load, per-device budgets) and only handed
+        to the worker it was placed on. A head that fits NO device
+        blocks the line (the solo path's reservation rule, fleet-
+        wide); one placed to ANOTHER worker blocks this worker's line
+        (that worker's own pass admits it)."""
+        state = [{"running": 0, "staged_bytes": 0}
+                 for _ in range(placer.n)]
+        for j in self._jobs.values():
+            if j.state == RUNNING and j.device is not None \
+                    and 0 <= j.device < placer.n:
+                state[j.device]["running"] += 1
+                state[j.device]["staged_bytes"] += j.staged_bytes
+        migrating = [j for j in self._jobs.values()
+                     if j.state == MIGRATING]
+        migrating.sort(key=lambda j: self._seq[j.job_id])
+        queued = [j for j in self._jobs.values() if j.state == QUEUED]
+        queued.sort(key=lambda j: (-j.priority, self._seq[j.job_id]))
+        for job in migrating + queued:
+            if job.est_bytes is None:
+                job.est_bytes = int(est_bytes_fn(job))
+            target = placer.place(job, state)
+            if target is None:
+                if job.state == QUEUED and not job._adm_deferred:
+                    job._adm_deferred = True
+                    obs.inc("serve_admission_deferrals_total",
+                            reason="staged_bytes")
+                return None
+            if target != worker_ix:
+                return None
+            self._mark_running_locked(job, worker_ix)
+            placer.assign(job, worker_ix)
+            return job
+        return None
+
+    def _mark_running_locked(self, job: Job, worker_ix: int) -> None:
+        resuming = job.state == MIGRATING
+        job.staged_bytes = job.est_bytes
+        job.state = RUNNING
+        job.device = int(worker_ix)
+        job.pinned_device = None
+        if not resuming:
+            # queue-wait is observed ONCE per job: a migration's
+            # re-admission is not a second arrival
+            job.started_t = time.time()
+            obs.observe("serve_job_queue_wait_seconds",
+                        job.started_t - job.submitted_t)
+
+    def requeue_for_migration(self, job: Job,
+                              target: int | None) -> None:
+        """RUNNING -> MIGRATING: the owner loop yielded the job at a
+        tile boundary (checkpoint on disk); it waits for ``target``'s
+        owner loop to re-admit it as a resume (``target`` None — the
+        migrate_abort recovery path — lets ANY device take it)."""
+        with self._lock:
+            assert job.state == RUNNING, job.state
+            job.state = MIGRATING
+            job.staged_bytes = 0
+            job.device = None
+            job.pinned_device = None if target is None else int(target)
+            job.migrate_to = None
 
     # -- terminal transitions (scheduler side) ------------------------------
 

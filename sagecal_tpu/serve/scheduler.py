@@ -1,75 +1,105 @@
-"""The device-owner loop: many jobs' tiles through ONE device.
+"""Device-owner loops: many jobs' tiles through a device FLEET.
 
-Exactly one thread (the one inside :meth:`Scheduler.run`) dispatches
-device programs. Per job it owns a :class:`pipeline.TileStepper`
-(solve state), a per-job ``sched.Prefetcher`` (read + host-stage on
-the job's reader thread) and the stepper's per-job ordered
-``sched.AsyncWriter`` (MS residual tiles + solution rows). The loop
-round-robins over running jobs and steps whichever has a staged tile
+One :class:`_Worker` per fleet device, each driving ITS device from
+exactly one thread (per-device owner loop). Per job the owning worker
+holds a stepper (``pipeline.TileStepper`` for fullbatch jobs, the
+ISSUE 12 ``stochastic.StochasticStepper`` for minibatch jobs — both
+expose the same ``stage``/``step``/``close`` contract), a per-job
+``sched.Prefetcher`` (read + host-stage on the job's reader thread)
+and the stepper's ordered ``sched.AsyncWriter``. Each loop round-
+robins over its running jobs and steps whichever has a staged tile
 READY (``Prefetcher.poll``), so one job's slow IO never parks the
 device while another job has work.
+
+Placement (serve/fleet.py): queued jobs are routed to a device by
+shape-bucket affinity — the device whose compile cache already holds
+the job's program set (per-device hit rates are exported by
+``metrics``) — then by least load; capacity (inflight jobs + staged
+bytes) is budgeted PER DEVICE. With one device the whole layer
+degenerates to the PR 7 single-owner-loop behavior bit- and
+compile-count-identically (no jax device context is even entered).
+
+Migration (tile boundaries only): a running fullbatch job with a
+checkpoint sidecar can move to another device — the owner yields it
+at the next boundary (flush writes, land the PR 9 ``.ckpt.npz``
+watermark, tear down its threads), the job re-queues pinned to the
+target, and the target's owner re-admits it as a RESUME. Zero
+completed tiles re-run (resume starts at watermark + 1) and the final
+outputs are bit-identical to an unmigrated run — both gated, in
+tests/test_serve.py. The ``migrate_abort`` chaos seam
+(sagecal_tpu.faults) kills the handoff between the checkpoint flush
+and the re-admission; recovery drops the pin and re-queues from the
+durable watermark, so an aborted migration loses zero tiles
+(tests/test_faults.py). The fleet controller thread work-steals with
+the same machinery: an idle device pulls a migratable job off the
+busiest one.
 
 Bit-identity argument: a job's tiles are staged and stepped strictly
 in its own tile order; its warm-start Jones chain, divergence resets,
 and the ``fold_in(199, tile_idx)`` PRNG stream live inside its
-stepper and never observe the interleaving. Program *compilations*
-are shared through ``serve.cache`` — sharing a compiled program
-changes which bytes were compiled when, never what a call computes.
-Gated end-to-end by tests/test_serve.py (solutions AND written
-residuals vs solo runs, plus the zero-new-compiles assert).
+stepper and never observe the interleaving, the device it runs on
+(virtual CPU devices share one ALU; on real hardware the solver
+programs are deterministic per backend), or a mid-stream migration
+(resume restores the exact chain state from the full-precision
+checkpoint). Program *compilations* are shared through
+``serve.cache``, keyed per device ordinal.
 
 Failure model (fail-stop, per job): any exception out of a job's
-stage/step/write path — including an async MS-write failure
-re-raised at the job's next tile boundary (PR 5 semantics), after
-the sched layer's bounded transient retries gave up — moves THAT
-job to ``failed`` with the original traceback recorded, tears down
-its threads, and the loop keeps serving its neighbours. No later
-write of a failed job executes (AsyncWriter fail-stop). Per-job
-deadlines and the divergence circuit-breaker (``on_diverge=fail``)
-take effect at the same tile boundaries; a job with a checkpoint
-sidecar can be resubmitted with ``resume=true`` and skips its
-completed tiles bit-identically (MIGRATION.md "Fault tolerance").
+stage/step/write path — after the sched layer's bounded transient
+retries gave up — moves THAT job to ``failed`` with the original
+traceback recorded, tears down its threads, and the loop keeps
+serving its neighbours. Per-job deadlines, cancel, migration and the
+divergence circuit-breaker (``on_diverge=fail``) all take effect at
+tile boundaries.
 
-Stochastic / simulation jobs reuse their existing whole-run drivers
-as one OPAQUE unit: correct and isolated, but not tile-interleaved
-(documented in MIGRATION.md "Service mode").
+Simulation / mpi / tile-batch / consensus-stochastic jobs reuse their
+existing whole-run drivers as one OPAQUE unit on their placed
+worker's thread: correct and isolated, but not tile-interleaved
+(plain minibatch-stochastic jobs ARE tile-interleaved since ISSUE 12;
+documented in MIGRATION.md "Fleet mode").
 """
 
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import threading
 import time
 
 import numpy as np
 
-from sagecal_tpu import sched
+from sagecal_tpu import faults, sched
 from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.obs import health as ohealth
 from sagecal_tpu.obs import metrics as obs
 from sagecal_tpu.serve import cache as pcache
+from sagecal_tpu.serve import fleet
 from sagecal_tpu.serve import queue as jq
 
 
-def job_telemetry_ctx(tracer, job_id):
-    """Zero-arg factory for ONE job's telemetry context: routes the
-    entering thread's diag emits to the job tracer (``dtrace.scope``)
-    and labels its obs metric emissions with the owning job
-    (``obs.scope_labels``). The SAME factory serves the device-owner
-    thread around a step, the job's reader thread (Prefetcher
-    ``context=``), and its writer thread (TileStepper ``trace_ctx=``)
-    — one definition, so per-job attribution cannot drift between the
-    three thread roles (the satellite-1 regression class: a refactor
-    that scopes one role and not the others)."""
+def job_telemetry_ctx(tracer, job_id, ordinal: int = 0, device=None):
+    """Zero-arg factory for ONE job's telemetry + device context:
+    routes the entering thread's diag emits to the job tracer
+    (``dtrace.scope``), labels its obs metric emissions with the
+    owning job (``obs.scope_labels``), and binds it to the owning
+    worker's device (``fleet.device_scope`` — a no-op for the
+    single-device daemon, where ``device`` is None). The SAME factory
+    serves the device-owner thread around a step, the job's reader
+    thread (Prefetcher ``context=``), and its writer thread
+    (TileStepper ``trace_ctx=``) — one definition, so per-job
+    attribution AND device placement cannot drift between the three
+    thread roles (a reader staging onto the wrong device would force
+    a silent cross-device copy per tile)."""
     @contextlib.contextmanager
     def ctx():
-        with dtrace.scope(tracer), obs.scope_labels(job=job_id):
+        with fleet.device_scope(ordinal, device), \
+                dtrace.scope(tracer), obs.scope_labels(job=job_id):
             yield
     return ctx
 
 
 class _RunningJob:
-    """Scheduler-side live state of one running fullbatch job."""
+    """Worker-side live state of one running tile-interleaved job."""
 
     def __init__(self, job, pipe, stepper, prefetcher, tracer, ctx):
         self.job = job
@@ -116,48 +146,120 @@ def estimate_staged_bytes(job) -> int:
         return 0
 
 
+class _Worker:
+    """One device's owner-loop state (stepped by its own thread in
+    fleet mode; inline on the scheduler thread for a single device)."""
+
+    def __init__(self, ix: int, device):
+        self.ix = int(ix)
+        self.device = device            # jax Device, or None (default)
+        self.running: list[_RunningJob] = []
+        # set by every owned job's reader thread after staging a tile:
+        # the idle path waits on it (then re-polls) instead of
+        # sleeping a fixed quantum
+        self.ready = threading.Event()
+        self.busy_s = 0.0
+        self.tiles_done = 0
+        self.jobs_done = 0
+        self.last_progress_t = time.time()
+
+    def snapshot(self, wall: float) -> dict:
+        return {"device": self.ix,
+                "name": "default" if self.device is None
+                else str(self.device),
+                "busy_s": self.busy_s,
+                "busy_frac": (self.busy_s / wall) if wall else 0.0,
+                "running": len(self.running),
+                "tiles_done": self.tiles_done,
+                "jobs_done": self.jobs_done,
+                "last_progress_t": self.last_progress_t}
+
+
 class Scheduler:
-    """Owns the device; drives :class:`serve.queue.JobQueue` jobs."""
+    """Owns the device fleet; drives :class:`serve.queue.JobQueue`
+    jobs. ``devices``: the ``fleet.fleet_devices`` list — ``[None]``
+    (default) is the single-device pre-fleet identity path."""
+
+    #: a stolen/migrated job must have at least this many tiles left —
+    #: yielding a nearly-done job costs a teardown + resume for no win
+    MIGRATE_MIN_REMAINING_TILES = 2
 
     def __init__(self, queue: jq.JobQueue, log=print,
-                 idle_sleep_s: float = 0.002):
+                 idle_sleep_s: float = 0.002, devices=None):
         self.q = queue
         self.log = log
         self.idle_sleep_s = float(idle_sleep_s)
         self._stop = threading.Event()
-        self._running: list[_RunningJob] = []
-        # set by every job's reader thread after staging a tile: the
-        # idle path waits on it (then re-polls) instead of sleeping a
-        # fixed quantum — a ready tile wakes the device immediately
-        self._ready = threading.Event()
-        # server-level accounting (the metrics op): device-driving
-        # seconds vs loop wall — the service's busy fraction
+        devices = devices if devices is not None else [None]
+        self.workers = [_Worker(i, d) for i, d in enumerate(devices)]
+        # the placement layer only exists for a real fleet: a single
+        # device keeps the PR 7 admission path bit-for-bit
+        self.placer = None
+        if len(self.workers) > 1:
+            self.placer = fleet.Placer(
+                len(self.workers), queue.max_inflight,
+                queue.max_staged_bytes)
+        # server-level accounting (the metrics op). Counters written
+        # from worker threads live on the workers (each is touched by
+        # exactly one thread) and aggregate via properties; the
+        # migration counters are only written by the yielding/
+        # resuming owner under no contention worth a lock
         self.t0 = time.time()
-        self.busy_s = 0.0
-        self.tiles_done = 0
-        self.jobs_done = 0
-        # last-progress watermark: wall time of the most recent
-        # completed tile / opaque job (the /healthz liveness signal —
-        # a wedged device stops moving it while the loop stays alive)
-        self.last_progress_t = self.t0
+        self.migrations_done = 0
+        self.migrations_aborted = 0
 
     # -- lifecycle ----------------------------------------------------------
 
     def stop(self) -> None:
-        """Hard stop: the loop exits at the next boundary. Running jobs
-        are torn down as CANCELLED (graceful drain is the queue's
-        ``start_drain`` + letting the loop run dry instead)."""
+        """Hard stop: every loop exits at its next boundary. Running
+        jobs are torn down as CANCELLED (graceful drain is the queue's
+        ``start_drain`` + letting the loops run dry instead)."""
         self._stop.set()
+
+    # -- metrics ------------------------------------------------------------
+
+    @property
+    def busy_s(self) -> float:
+        return sum(w.busy_s for w in self.workers)
+
+    @property
+    def tiles_done(self) -> int:
+        return sum(w.tiles_done for w in self.workers)
+
+    @property
+    def jobs_done(self) -> int:
+        return sum(w.jobs_done for w in self.workers)
+
+    @property
+    def last_progress_t(self) -> float:
+        return max(w.last_progress_t for w in self.workers)
 
     def metrics(self) -> dict:
         wall = time.time() - self.t0
         out = dict(self.q.counts())
         out.update(pcache.PROGRAMS.stats())
-        out.update(wall_s=wall, busy_s=self.busy_s,
-                   device_busy_frac=(self.busy_s / wall) if wall else 0.0,
+        busy = self.busy_s
+        n_dev = len(self.workers)
+        by_dev = pcache.PROGRAMS.stats_by_device()
+        devices = []
+        for w in self.workers:
+            snap = w.snapshot(wall)
+            snap["cache"] = by_dev.get(
+                w.ix, {"hits": 0, "misses": 0, "hit_rate": 0.0})
+            devices.append(snap)
+        out.update(wall_s=wall, busy_s=busy,
+                   # the fleet's busy fraction is per-device-averaged:
+                   # with one device this is exactly the pre-fleet
+                   # busy/wall, and a 2-device fleet at 0.5 means each
+                   # device idles half the time
+                   device_busy_frac=(busy / (wall * n_dev))
+                   if wall else 0.0,
                    tiles_done=self.tiles_done, jobs_done=self.jobs_done,
-                   running=len(self._running),
+                   running=sum(len(w.running) for w in self.workers),
                    last_progress_t=self.last_progress_t,
+                   n_devices=n_dev, devices=devices,
+                   migrations=self.migrations_done,
+                   migrations_aborted=self.migrations_aborted,
                    unhealthy_jobs=self.unhealthy_jobs())
         return out
 
@@ -173,11 +275,14 @@ class Scheduler:
     def _job_log(self, job):
         return lambda *a: self.log(f"[{job.job_id}]", *a)
 
-    def _start_job(self, job) -> _RunningJob | None:
-        """Open the dataset, build (or cache-hit) the pipeline, wire
-        the per-job reader thread. Raises propagate to the caller's
-        fail-stop handler."""
-        from sagecal_tpu import pipeline, skymodel
+    def _is_consensus_stochastic(self, cfg) -> bool:
+        return cfg.n_admm > 1 and cfg.channel_avg_per_band > 1
+
+    def _start_job(self, w: _Worker, job) -> _RunningJob | None:
+        """Open the dataset, build (or cache-hit) the job's stepper on
+        THIS worker's device, wire the per-job reader thread. Raises
+        propagate to the caller's fail-stop handler."""
+        from sagecal_tpu import pipeline, skymodel, stochastic
         from sagecal_tpu.io import dataset as ds
         cfg = job.cfg
         tracer = None
@@ -186,77 +291,101 @@ class Scheduler:
                                    job=job.job_id)
         # ONE per-job context factory for every thread role (device-
         # owner, reader, writer) — entered here so the pipeline build
-        # and opaque run bodies attribute to the job too
-        ctx = job_telemetry_ctx(tracer, job.job_id)
-        # opaque kinds — plus fullbatch with tile_batch > 1: the
-        # batched driver's warm start is BATCH-granular, so
-        # running such a job through the sequential stepper would
-        # silently produce different (non-CLI-identical) output;
-        # pipeline.run dispatches to the same driver the CLI uses.
+        # and opaque run bodies attribute to the job AND land on the
+        # owning worker's device
+        ctx = job_telemetry_ctx(tracer, job.job_id, ordinal=w.ix,
+                                device=w.device)
+        # opaque kinds — sim/mpi, fullbatch with tile_batch > 1 (the
+        # batched driver's warm start is BATCH-granular), and
+        # consensus-stochastic (its ADMM epoch chain has no tile
+        # boundary the scheduler owns). Plain minibatch-stochastic
+        # jobs are tile-interleaved like fullbatch since ISSUE 12.
         # Dispatched OUTSIDE ctx: the queue's terminal transitions
         # (finish -> SLO histograms) must aggregate un-labeled
-        if (job.kind in ("stochastic", "sim", "mpi")
-                or int(getattr(cfg, "tile_batch", 1) or 1) > 1):
-            self._run_opaque(job, tracer, ctx)
+        opaque = (job.kind in ("sim", "mpi")
+                  or (job.kind == "fullbatch"
+                      and int(getattr(cfg, "tile_batch", 1) or 1) > 1)
+                  or (job.kind == "stochastic"
+                      and self._is_consensus_stochastic(cfg)))
+        if opaque:
+            self._run_opaque(w, job, tracer, ctx)
             return None
         with ctx():
-            ms = ds.open_dataset(cfg.ms, cfg.ms_list,
-                                 tilesz=cfg.tile_size,
-                                 data_column=cfg.input_column,
-                                 out_column=cfg.output_column)
-            meta = ms.meta
-            sky = skymodel.read_sky_cluster(
-                cfg.sky_model, cfg.cluster_file, meta["ra0"],
-                meta["dec0"], meta["freq0"], cfg.format_3)
-            pipe = pipeline.FullBatchPipeline(cfg, ms, sky,
-                                              log=self._job_log(job))
-            st = pipe.stepper(
-                write_residuals=True, solution_path=cfg.solutions_file,
-                max_tiles=cfg.max_timeslots or None,
-                log=self._job_log(job), trace_ctx=ctx,
-                # divergence quarantine is the stepper's policy; the
-                # job-level "fail" circuit-breaker lives in _step_ready
-                on_diverge=("quarantine"
-                            if job.on_diverge == "quarantine"
-                            else "reset"))
+            if job.kind == "stochastic":
+                st = stochastic.stepper(cfg, log=self._job_log(job),
+                                        trace_ctx=ctx)
+                ms = st.ms
+            else:
+                ms = ds.open_dataset(cfg.ms, cfg.ms_list,
+                                     tilesz=cfg.tile_size,
+                                     data_column=cfg.input_column,
+                                     out_column=cfg.output_column)
+                meta = ms.meta
+                sky = skymodel.read_sky_cluster(
+                    cfg.sky_model, cfg.cluster_file, meta["ra0"],
+                    meta["dec0"], meta["freq0"], cfg.format_3)
+                pipe = pipeline.FullBatchPipeline(cfg, ms, sky,
+                                                  log=self._job_log(job))
+                st = pipe.stepper(
+                    write_residuals=True,
+                    solution_path=cfg.solutions_file,
+                    max_tiles=cfg.max_timeslots or None,
+                    log=self._job_log(job), trace_ctx=ctx,
+                    # divergence quarantine is the stepper's policy;
+                    # the job-level "fail" circuit-breaker lives in
+                    # _step_ready
+                    on_diverge=("quarantine"
+                                if job.on_diverge == "quarantine"
+                                else "reset"))
             job.n_tiles = st.n_tiles
-            # checkpoint resume (resume=true): completed tiles are
-            # already on disk — report them done and only produce the
-            # remainder
+            # checkpoint resume (resume=true, incl. a migration's
+            # re-admission): completed tiles are already on disk —
+            # report them done and only produce the remainder
             job.tiles_done = st.start_tile
+            if job.migrations and "resumed_t" not in job.migrations[-1]:
+                # close the books on the migration that re-queued this
+                # job: wall cost and — the zero-rerun gate's number —
+                # how many already-completed tiles the resume re-runs
+                mrec = job.migrations[-1]
+                mrec["resumed_t"] = time.time()
+                mrec["wall_s"] = round(
+                    mrec["resumed_t"] - mrec["t_yield"], 6)
+                mrec["resume_tile"] = st.start_tile
+                mrec["tiles_rerun"] = (mrec["tile"] + 1) - st.start_tile
+                mrec["dst_actual"] = w.ix
+                self.migrations_done += 1
+                obs.inc("serve_migrations_total")
 
             def produce(j, _ms=ms, _st=st):
                 i = _st.start_tile + j
                 tile = _ms.read_tile(i)
                 return i, tile, _st.stage(i, tile)
 
-            pf = sched.Prefetcher(produce,
-                                  st.n_tiles - st.start_tile,
-                                  depth=st.depth,
-                                  name=f"job-{job.job_id}", context=ctx,
-                                  ready_event=self._ready)
-        return _RunningJob(job, pipe, st, pf, tracer, ctx)
+            pf = sched.Prefetcher(
+                produce, st.n_tiles - st.start_tile, depth=st.depth,
+                name=f"job-{job.job_id}", context=ctx,
+                ready_event=w.ready,
+                pace_s=float(getattr(cfg, "tile_arrival_s", 0.0) or 0.0))
+        return _RunningJob(job, getattr(st, "p", None), st, pf, tracer,
+                           ctx)
 
-    def _run_opaque(self, job, tracer, ctx) -> None:
-        """Stochastic / simulation / mpi / tile-batch jobs: the
-        existing whole-run drivers as one opaque, isolated unit on the
-        device-owner thread. An opaque job has no tile boundary the
-        scheduler owns, so a cancel arriving AFTER this point cannot
-        take effect until the run completes (documented limitation,
-        MIGRATION.md "Service mode"); one arriving before it is
-        honoured here. Only the run BODY enters the per-job telemetry
-        context; the queue's terminal transitions stay outside it so
-        the SLO histograms aggregate un-labeled, same as the
-        tile-interleaved path."""
+    def _run_opaque(self, w: _Worker, job, tracer, ctx) -> None:
+        """Simulation / mpi / tile-batch / consensus-stochastic jobs:
+        the existing whole-run drivers as one opaque, isolated unit on
+        the PLACED worker's thread. An opaque job has no tile boundary
+        the scheduler owns, so a cancel/deadline/migration arriving
+        AFTER this point cannot take effect until the run completes
+        (documented limitation, MIGRATION.md "Fleet mode"); one
+        arriving before it is honoured here. Only the run BODY enters
+        the per-job telemetry context; the queue's terminal
+        transitions stay outside it so the SLO histograms aggregate
+        un-labeled, same as the tile-interleaved path."""
         t0 = time.perf_counter()
         try:
             if job.cancel_requested:
                 self.q.finish(job, jq.CANCELLED)
                 return
             if job.expired():
-                # a deadline arriving AFTER this point cannot take
-                # effect until the opaque run completes — the same
-                # documented limitation as cancel
                 self.q.finish(job, jq.DEADLINE_EXCEEDED)
                 return
             cfg = job.cfg
@@ -264,59 +393,60 @@ class Scheduler:
                 if job.kind == "mpi":
                     # the consensus interval loop, reused verbatim as
                     # a job (cli_mpi.main owns its own diag/--platform
-                    # flags)
+                    # flags). NOTE: an mpi job builds its own mesh
+                    # over the process's visible devices — placement
+                    # gives it an owner THREAD; its device usage is
+                    # fleet-wide by construction (MIGRATION.md)
                     from sagecal_tpu import cli_mpi
                     rc = cli_mpi.main(job.argv)
                     if rc:
                         raise RuntimeError(f"cli_mpi exited rc={rc}")
                 elif job.kind == "stochastic":
                     from sagecal_tpu import stochastic
-                    if cfg.n_admm > 1 and cfg.channel_avg_per_band > 1:
-                        job.history = \
-                            stochastic.run_minibatch_consensus(
-                                cfg, log=self._job_log(job)) or []
-                    else:
-                        job.history = stochastic.run_minibatch(
-                            cfg, log=self._job_log(job)) or []
+                    job.history = stochastic.run_minibatch_consensus(
+                        cfg, log=self._job_log(job)) or []
                 else:
                     from sagecal_tpu import pipeline
                     pipeline.run(cfg, log=self._job_log(job))
             self.q.finish(job, jq.DONE)
-            self.jobs_done += 1
+            w.jobs_done += 1
         except BaseException as e:
             self.q.finish(job, jq.FAILED, exc=e)
             self.log(f"[{job.job_id}] FAILED: {job.error}")
         finally:
             dt = time.perf_counter() - t0
-            self.busy_s += dt
-            self.last_progress_t = time.time()
-            obs.inc("serve_device_busy_seconds_total", dt)
+            w.busy_s += dt
+            w.last_progress_t = time.time()
+            obs.inc("serve_device_busy_seconds_total", dt,
+                    device=str(w.ix))
             if tracer is not None:
                 tracer.close()
 
-    # -- the loop -----------------------------------------------------------
+    # -- the per-worker loop ------------------------------------------------
 
-    def _admit(self) -> bool:
+    def _admit(self, w: _Worker) -> bool:
         admitted = False
         while True:
-            job = self.q.next_admissible(estimate_staged_bytes)
+            job = self.q.next_admissible(estimate_staged_bytes,
+                                         worker_ix=w.ix,
+                                         placer=self.placer)
             if job is None:
                 return admitted
             try:
-                rj = self._start_job(job)
+                rj = self._start_job(w, job)
             except BaseException as e:
                 self.q.finish(job, jq.FAILED, exc=e)
                 self.log(f"[{job.job_id}] FAILED at start: {job.error}")
                 continue
             if rj is not None:          # opaque jobs already finished
-                self._running.append(rj)
-                self.log(f"[{job.job_id}] running "
+                w.running.append(rj)
+                self.log(f"[{job.job_id}] running on device {w.ix} "
                          f"({job.n_tiles} tiles, "
                          f"~{job.staged_bytes / 1e6:.0f} MB staged)")
             admitted = True
 
-    def _finish(self, rj, state, exc=None) -> None:
-        self._running.remove(rj)
+    def _finish(self, w: _Worker, rj, state, exc=None) -> None:
+        w.running.remove(rj)
         if state == jq.DONE:
             try:
                 # close raises a still-pending async-write failure:
@@ -335,15 +465,67 @@ class Scheduler:
                 self.log(f"[{rj.job.job_id}] teardown error ignored: "
                          f"{type(e).__name__}: {e}")
         job = rj.job
-        job.history = rj.stepper.history
+        # accumulate (don't assign): a migrated job's earlier legs
+        # already contributed their tiles at yield time
+        job.history.extend(rj.stepper.history)
         self.q.finish(job, state, exc=exc)
         if state == jq.DONE:
-            self.jobs_done += 1
+            w.jobs_done += 1
         self.log(f"[{job.job_id}] {state}"
                  + (f": {job.error}" if exc is not None else ""))
 
-    def _step_ready(self) -> bool:
-        """One pass over running jobs; True if any made progress.
+    def _yield_for_migration(self, w: _Worker, rj) -> None:
+        """Tile-boundary half of a migration: flush this job's writes
+        (the checkpoint sidecar lands LAST on the ordered writer
+        queue, so the watermark names only durably-written tiles),
+        tear down its threads on this device, and re-queue it pinned
+        to the target as a RESUME. The ``migrate_abort`` chaos seam
+        fires between the durable flush and the re-queue; recovery is
+        the same re-queue with the pin dropped — the checkpoint is
+        already on disk, so an aborted handoff loses zero tiles."""
+        job = rj.job
+        target = job.migrate_to
+        job.migrate_to = None
+        t0 = time.perf_counter()
+        w.running.remove(rj)
+        job.history.extend(rj.stepper.history)
+        try:
+            rj.teardown(raise_pending=True)
+        except BaseException as e:
+            # the flush itself failed: fail-stop, like any write
+            # failure at a boundary — a job whose outputs may not have
+            # landed must not resume as if they had
+            self.q.finish(job, jq.FAILED, exc=e)
+            self.log(f"[{job.job_id}] FAILED during migration flush: "
+                     f"{job.error}")
+            return
+        job.cfg = dataclasses.replace(job.cfg, resume=True)
+        job.migrations.append(dict(
+            src=w.ix, dst=target, tile=rj.stepper._last_tile,
+            yield_s=round(time.perf_counter() - t0, 6),
+            t_yield=time.time()))
+        self.log(f"[{job.job_id}] yielded at tile "
+                 f"{rj.stepper._last_tile} for migration "
+                 f"{w.ix} -> {target}")
+        try:
+            faults.inject("migrate_abort", key=job.job_id)
+            self.q.requeue_for_migration(job, target)
+            if self.placer is not None:
+                self.placer.rehome(fleet.job_bucket(job), target)
+        except BaseException as e:
+            # mid-migration death: the handoff is gone but the
+            # watermark is durable — recover by re-queueing UNPINNED
+            # (any device may resume it from the checkpoint)
+            self.migrations_aborted += 1
+            obs.inc("serve_migrations_aborted_total")
+            self.log(f"[{job.job_id}] migration aborted "
+                     f"({type(e).__name__}: {e}); re-queueing from "
+                     "the checkpoint watermark")
+            self.q.requeue_for_migration(job, None)
+
+    def _step_ready(self, w: _Worker) -> bool:
+        """One pass over this worker's running jobs; True if any made
+        progress.
 
         STICKY within the pass, BOUNDED: a job steps up to
         ``depth + 1`` consecutive tiles while they are already staged,
@@ -353,16 +535,16 @@ class Scheduler:
         (measured +5% on the serve bench) — but UNbounded stickiness
         would let a job whose reader keeps pace with the device run to
         completion, starving its neighbours' staged tiles and
-        deferring cancel/stop/drain for its whole runtime. The bound
-        keeps the alternation win while guaranteeing every running
-        job (and every control signal) is visited at least once per
-        ``depth + 1`` tiles."""
+        deferring cancel/stop/drain/migration for its whole runtime.
+        The bound keeps the alternation win while guaranteeing every
+        running job (and every control signal) is visited at least
+        once per ``depth + 1`` tiles."""
         progressed = False
-        for rj in list(self._running):
+        for rj in list(w.running):
             job = rj.job
             for _ in range(rj.stepper.depth + 1):
                 if job.cancel_requested:
-                    self._finish(rj, jq.CANCELLED)
+                    self._finish(w, rj, jq.CANCELLED)
                     progressed = True
                     break
                 if job.expired():
@@ -370,9 +552,16 @@ class Scheduler:
                     # dispatching this job's tiles, release its
                     # admission budget, record deadline_exceeded
                     # through the same _finish accounting as cancel
-                    self._finish(rj, jq.DEADLINE_EXCEEDED)
+                    self._finish(w, rj, jq.DEADLINE_EXCEEDED)
                     progressed = True
                     break
+                if job.migrate_to is not None:
+                    if job.migrate_to == w.ix:
+                        job.migrate_to = None      # already home
+                    else:
+                        self._yield_for_migration(w, rj)
+                        progressed = True
+                        break
                 try:
                     with rj.ctx():
                         r = rj.pf.poll()
@@ -383,12 +572,12 @@ class Scheduler:
                             t0 = time.perf_counter()
                             rec = rj.stepper.step(ti, tile, stg, wait)
                             dt = time.perf_counter() - t0
-                            self.busy_s += dt
+                            w.busy_s += dt
                     if r is sched.Prefetcher.DONE:
                         # outside the job label scope: the queue's SLO
                         # histograms (run / e2e latency) aggregate
                         # across jobs un-labeled
-                        self._finish(rj, jq.DONE)
+                        self._finish(w, rj, jq.DONE)
                         progressed = True
                         break
                     # live convergence health: fold this tile's final
@@ -401,11 +590,12 @@ class Scheduler:
                     if not rec.get("quarantined"):
                         job.health = rj.health.update(rec["res_1"])
                         job.health_detail = rj.health.snapshot()
-                    self.last_progress_t = time.time()
-                    obs.inc("serve_device_busy_seconds_total", dt)
+                    w.last_progress_t = time.time()
+                    obs.inc("serve_device_busy_seconds_total", dt,
+                            device=str(w.ix))
                     obs.inc("serve_tiles_done_total", job=job.job_id)
                     job.tiles_done += 1
-                    self.tiles_done += 1
+                    w.tiles_done += 1
                     progressed = True
                     if job.health == ohealth.DIVERGING \
                             and job.on_diverge == "fail":
@@ -413,7 +603,7 @@ class Scheduler:
                         # health signal wired into action — this job
                         # stops at the boundary instead of burning its
                         # remaining tile budget on a diverged chain
-                        self._finish(rj, jq.FAILED, exc=RuntimeError(
+                        self._finish(w, rj, jq.FAILED, exc=RuntimeError(
                             "divergence circuit-breaker: residual "
                             f"{rec['res_1']:.6g} against best "
                             f"{rj.health.best}"))
@@ -421,37 +611,122 @@ class Scheduler:
                 except BaseException as e:
                     # fail-stop isolation: THIS job only; neighbours
                     # keep solving and the loop keeps serving
-                    self._finish(rj, jq.FAILED, exc=e)
+                    self._finish(w, rj, jq.FAILED, exc=e)
                     progressed = True
                     break
         return progressed
 
-    def run(self) -> None:
-        """Drive jobs until stopped, or — when the queue is draining —
-        until everything accepted has finished."""
+    def _worker_loop(self, w: _Worker) -> None:
+        """Drive one device until stopped, or — when the queue is
+        draining — until everything accepted has finished."""
         while True:
             if self._stop.is_set():
-                for rj in list(self._running):
-                    self._finish(rj, jq.CANCELLED)
-                # queued jobs will never run either: leave none
-                # stranded in a non-terminal state a client would
-                # poll forever
-                for job in self.q.jobs():
-                    if job.state == jq.QUEUED:
-                        self.q.finish(job, jq.CANCELLED)
+                for rj in list(w.running):
+                    self._finish(w, rj, jq.CANCELLED)
                 return
-            self._admit()
-            progressed = self._step_ready()
-            if not self._running:
+            self._admit(w)
+            progressed = self._step_ready(w)
+            if not w.running:
                 if self.q.draining and self.q.idle():
                     return
                 if not progressed:
                     time.sleep(self.idle_sleep_s * 5)
             elif not progressed:
                 # every running job is waiting on its reader thread:
-                # genuine pipeline bubble at server level. Wait for a
+                # genuine pipeline bubble at device level. Wait for a
                 # producer's ready signal (with a timeout backstop),
                 # then clear and re-poll — a tile staged during the
                 # poll pass leaves the event set, so nothing is lost
-                self._ready.wait(timeout=0.05)
-                self._ready.clear()
+                w.ready.wait(timeout=0.05)
+                w.ready.clear()
+
+    # -- work stealing (the fleet controller's rebalance pass) --------------
+
+    def _migratable(self, rj) -> bool:
+        st = rj.stepper
+        return (rj.job.kind == "fullbatch"
+                and getattr(st, "ckpt_path", None) is not None
+                and (st.n_tiles - 1 - st._last_tile)
+                >= self.MIGRATE_MIN_REMAINING_TILES)
+
+    def request_migration(self, job_id: str, target: int) -> str:
+        """Manual migration (the api ``migrate`` op, and the bench's
+        deterministic lever): ask the owner loop to yield the job to
+        ``target`` at its next tile boundary. Validates the job is a
+        RUNNING migratable fullbatch job and the target exists."""
+        if not 0 <= int(target) < len(self.workers):
+            raise ValueError(f"no device {target} in a fleet of "
+                             f"{len(self.workers)}")
+        job = self.q.get(job_id)
+        if job.state != jq.RUNNING:
+            raise ValueError(f"job {job_id} is {job.state}, not running")
+        for w in self.workers:
+            for rj in list(w.running):
+                if rj.job is job:
+                    if not self._migratable(rj):
+                        raise ValueError(
+                            f"job {job_id} is not migratable (needs a "
+                            "solutions-file checkpoint, a sequential "
+                            "fullbatch stepper, and >= "
+                            f"{self.MIGRATE_MIN_REMAINING_TILES} "
+                            "remaining tiles)")
+                    job.migrate_to = int(target)
+                    return jq.RUNNING
+        raise ValueError(f"job {job_id} is running opaquely and cannot "
+                         "be migrated mid-run")
+
+    def _rebalance(self) -> None:
+        """Work stealing at tile boundaries: when a device sits idle
+        with an empty queue while another runs >= 2 interleaved jobs,
+        migrate one (the one with the most remaining tiles) to the
+        idle device. At most one migration is in flight fleet-wide —
+        rebalancing is a trickle, not a thundering herd."""
+        jobs = self.q.jobs()
+        if any(j.state == jq.MIGRATING or j.migrate_to is not None
+               for j in jobs):
+            return
+        if any(j.state == jq.QUEUED for j in jobs):
+            return          # placement will feed the idle device
+        idle = [w for w in self.workers if not w.running]
+        donors = [w for w in self.workers if len(w.running) >= 2]
+        if not idle or not donors:
+            return
+        donor = max(donors, key=lambda w: len(w.running))
+        cands = [rj for rj in list(donor.running) if self._migratable(rj)]
+        if not cands:
+            return
+        pick = max(cands, key=lambda rj:
+                   rj.stepper.n_tiles - 1 - rj.stepper._last_tile)
+        pick.job.migrate_to = idle[0].ix
+        self.log(f"[{pick.job.job_id}] work-steal: device {donor.ix} "
+                 f"-> idle device {idle[0].ix}")
+
+    # -- the fleet ----------------------------------------------------------
+
+    def run(self) -> None:
+        """Single device: the owner loop runs on THIS thread (the
+        pre-fleet identity path — no extra threads, no jax device
+        contexts). Fleet: one owner thread per device plus this
+        thread as the controller (work stealing + liveness)."""
+        if len(self.workers) == 1:
+            self._worker_loop(self.workers[0])
+        else:
+            threads = [threading.Thread(
+                target=self._worker_loop, args=(w,),
+                name=f"device-owner-{w.ix}", daemon=True)
+                for w in self.workers]
+            for t in threads:
+                t.start()
+            while any(t.is_alive() for t in threads):
+                if not self._stop.is_set():
+                    self._rebalance()
+                time.sleep(self.idle_sleep_s * 10)
+            for t in threads:
+                t.join()
+        # queued (or mid-migration) jobs will never run after a hard
+        # stop: leave none stranded in a non-terminal state a client
+        # would poll forever
+        if self._stop.is_set():
+            for job in self.q.jobs():
+                if job.state in (jq.QUEUED, jq.MIGRATING):
+                    self.q.finish(job, jq.CANCELLED)

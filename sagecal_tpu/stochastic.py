@@ -360,20 +360,30 @@ class _StochasticRunner:
 
     def prepare_tile(self, tile: ds.VisTile):
         """Pad + upload every (minibatch, band) slice once per tile."""
-        self._tile_inputs = {}
+        self._tile_inputs, self.tile_beam = self.build_tile_inputs(tile)
+
+    def build_tile_inputs(self, tile: ds.VisTile):
+        """The staging body of :meth:`prepare_tile`, returning
+        ``(inputs, tile_beam)`` WITHOUT touching runner state — safe
+        to run on a background reader thread (the serve scheduler's
+        tile-interleaved stochastic path stages tile t+1 while tile t
+        solves; the solve state the step half mutates lives on the
+        StochasticStepper, never here)."""
+        tile_inputs = {}
+        tile_beam = None
         rdt = self.rdt
         # -x/-y uv window (Data::loadData applies it at load in the
         # reference, so minibatch mode respects it too): solve-scoped
         # flag-2 rows on a COPY — tile.flags is written back verbatim
-        self._rowflags = rp.apply_uvcut(tile.flags, tile,
-                                        self.cfg.uvmin, self.cfg.uvmax)
+        rowflags = rp.apply_uvcut(tile.flags, tile,
+                                  self.cfg.uvmin, self.cfg.uvmax)
         if self.dobeam:
             if tile.time_mjd is None and not self._warned_no_times:
                 self.log("WARNING: dataset tiles carry no timestamps; beam "
                          "az/el will be evaluated at the J2000 placeholder "
                          "epoch")
                 self._warned_no_times = True
-            self.tile_beam = bm.beam_to_device(
+            tile_beam = bm.beam_to_device(
                 self.beam_info, self.meta["freq0"], rdt,
                 time_jd=tile.time_jd)
         for nmb in range(self.minibatches):
@@ -387,7 +397,7 @@ class _StochasticRunner:
             sta1 = np.zeros(self.bmb, np.int32)
             sta2 = np.ones(self.bmb, np.int32)
             sta1[:nrow] = tile.sta1[sel]; sta2[:nrow] = tile.sta2[sel]
-            flags = self._rowflags[sel]
+            flags = rowflags[sel]
             good = (flags == 0)[:, None]
             uj, vj, wj = (jnp.asarray(u, rdt), jnp.asarray(v, rdt),
                           jnp.asarray(w, rdt))
@@ -414,10 +424,11 @@ class _StochasticRunner:
                 wtF[:nrow, :nc] = np.where(ok[..., None], 1.0, 0.0)
                 freqsF = np.full(self.fpad, self.freqs[c0], np.float64)
                 freqsF[:nc] = self.freqs[c0:c0 + nc]
-                self._tile_inputs[(nmb, b)] = (
+                tile_inputs[(nmb, b)] = (
                     jnp.asarray(x8F, self.sdt), uj, vj, wj, s1j, s2j,
                     jnp.asarray(wtF, self.sdt), jnp.asarray(freqsF, rdt),
                     tsj)
+        return tile_inputs, tile_beam
 
     def band_inputs(self, nmb: int, band: int):
         return self._tile_inputs[(nmb, band)]
@@ -621,64 +632,131 @@ def _tile_source(ms, cfg):
     return src(), depth
 
 
+class StochasticStepper:
+    """The minibatch runner as a resumable per-tile unit — the same
+    ``stage``/``step``/``close`` contract as ``pipeline.TileStepper``,
+    so the serve scheduler's device-owner loops interleave stochastic
+    jobs' tiles with everyone else's instead of running them as one
+    opaque blocking unit (ISSUE 12; MIGRATION.md "Fleet mode").
+
+    All mutable solve state (per-band parameter/LBFGS-memory chains,
+    reset bookkeeping, the per-job ordered writer) lives HERE;
+    :meth:`stage` only builds device inputs (pure w.r.t. this state,
+    safe on a reader thread). Outputs are bit-identical to the
+    pre-stepper ``run_minibatch`` loop — the epoch/minibatch chain is
+    byte-for-byte the same code, stepped one tile at a time. No
+    checkpoint sidecar (the minibatch epoch chain has no tile-boundary
+    watermark), so stochastic jobs are interleavable and
+    cancel/deadline-interruptible at tile boundaries but NOT
+    migratable (``ckpt_path`` None)."""
+
+    def __init__(self, cfg: RunConfig, log=print, trace_ctx=None):
+        self.cfg = cfg
+        self.log = log
+        ms, sky = _open(cfg, log)
+        self.ms = ms
+        self.rn = rn = _StochasticRunner(cfg, ms, sky, log=log)
+        self.solver = make_band_solver_batched(
+            rn.dsky, rn.n, rn.cidx, rn.cmask, rn.fdelta_chan,
+            nu=cfg.robust_nulow, max_lbfgs=cfg.max_lbfgs,
+            consensus=False, dobeam=rn.dobeam, loss=cfg.stochastic_loss)
+        pinit, pfreq = rn.initial_p()
+        self.mems = [lbfgs_mod.lbfgs_memory_init(rn.nparam, cfg.lbfgs_m,
+                                                 rn.rdt)
+                     for _ in range(rn.nsolbw)]
+        self.pfreq = pfreq
+        self.writer = rn.solution_writer()
+        self.state = {"pfreq": pfreq, "mems": self.mems, "pinit": pinit,
+                      "res_prev": None}
+        self.n_tiles = ms.n_tiles
+        if cfg.max_timeslots:
+            self.n_tiles = min(self.n_tiles, cfg.max_timeslots)
+        self.start_tile = 0         # no checkpoint: always from 0
+        self.ckpt_path = None       # not migratable (see class doc)
+        self.depth = max(0, int(getattr(cfg, "prefetch", 1)))
+        self.history: list = []
+        self.aw = sched.AsyncWriter(enabled=self.depth > 0,
+                                    context=trace_ctx)
+
+    # -- reader-thread half --------------------------------------------------
+
+    def stage(self, ti, tile):
+        t_stage = time.perf_counter()
+        inputs, beam = self.rn.build_tile_inputs(tile)
+        dtrace.emit("phase", name="stage", tile=ti,
+                    dur_s=time.perf_counter() - t_stage,
+                    bg=self.depth > 0)
+        return {"inputs": inputs, "beam": beam}
+
+    # -- device-owner half ---------------------------------------------------
+
+    def step(self, ti, tile, stg, io_wait=0.0):
+        cfg, rn, log = self.cfg, self.rn, self.log
+        self.aw.check()  # async write failure -> fail at this boundary
+        t0 = time.time()
+        rn._tile_inputs = stg["inputs"]
+        rn.tile_beam = stg["beam"]
+        pfreq, mems = self.pfreq, self.mems
+        resband = np.zeros(rn.nsolbw)
+        res_0 = res_1 = 0.0
+        # all bands ride one device program (P7); host state restacks
+        # only at tile boundaries where the reset logic lives
+        pstack, memstack = rn.stack_state(pfreq, mems)
+        for nepch in range(cfg.n_epochs):
+            for nmb in range(rn.minibatches):
+                args = rn.band_inputs_all(nmb)
+                out = self.solver(*args, pstack, memstack, None, None,
+                                  None, rn.tile_beam)
+                pstack, memstack = out.p, out.mem
+                r0s = np.asarray(out.res_0)
+                r1s = np.asarray(out.res_1)
+                resband[:] = r1s
+                if cfg.verbose:
+                    for b in range(rn.nsolbw):
+                        log(f"epoch={nepch} minibatch={nmb} band={b} "
+                            f"{r0s[b]:.6f} {r1s[b]:.6f}")
+                res_0, res_1 = float(np.mean(r0s)), float(np.mean(r1s))
+                if dtrace.active():
+                    dtrace.emit("minibatch", tile=ti, epoch=nepch,
+                                minibatch=nmb, res_0=res_0,
+                                res_1=res_1,
+                                iters=int(np.asarray(out.iters).sum()))
+        rn.unstack_state(pstack, memstack, pfreq, mems)
+
+        rn.end_of_tile(tile, ti, self.state, resband, res_0, res_1, t0,
+                       self.writer, self.history, aw=self.aw,
+                       bubble_s=io_wait, overlap=self.depth)
+        return self.history[-1]
+
+    def close(self, raise_pending: bool = True):
+        try:
+            self.aw.close(raise_pending=raise_pending)
+        finally:
+            if self.writer:
+                self.writer.close()
+
+
+def stepper(cfg: RunConfig, log=print, trace_ctx=None) -> StochasticStepper:
+    """Factory mirroring ``FullBatchPipeline.stepper`` (the serve
+    scheduler's entry point for tile-interleaved stochastic jobs)."""
+    return StochasticStepper(cfg, log=log, trace_ctx=trace_ctx)
+
+
 def run_minibatch(cfg: RunConfig, log=print):
-    """Stochastic minibatch calibration (minibatch_mode.cpp:47)."""
-    ms, sky = _open(cfg, log)
-    rn = _StochasticRunner(cfg, ms, sky, log=log)
+    """Stochastic minibatch calibration (minibatch_mode.cpp:47).
 
-    solver = make_band_solver_batched(
-        rn.dsky, rn.n, rn.cidx, rn.cmask, rn.fdelta_chan,
-        nu=cfg.robust_nulow, max_lbfgs=cfg.max_lbfgs, consensus=False,
-        dobeam=rn.dobeam, loss=cfg.stochastic_loss)
-
-    pinit, pfreq = rn.initial_p()
-    mems = [lbfgs_mod.lbfgs_memory_init(rn.nparam, cfg.lbfgs_m, rn.rdt)
-            for _ in range(rn.nsolbw)]
-    writer = rn.solution_writer()
-    state = {"pfreq": pfreq, "mems": mems, "pinit": pinit, "res_prev": None}
-
-    history = []
-    source, depth = _tile_source(ms, cfg)
-    aw = sched.AsyncWriter(enabled=depth > 0)
+    Drives :class:`StochasticStepper` tile by tile — the same unit
+    the serve fleet interleaves — with --prefetch read-ahead; outputs
+    are bit-identical to the pre-stepper monolithic loop (the solve
+    chain is the same code, one tile per step)."""
+    st = StochasticStepper(cfg, log=log)
+    source, _depth = _tile_source(st.ms, cfg)
     try:
         for ti, tile, io_wait in source:
-            aw.check()  # async write failure -> fail at this boundary
-            t0 = time.time()
-            rn.prepare_tile(tile)
-            resband = np.zeros(rn.nsolbw)
-            res_0 = res_1 = 0.0
-            # all bands ride one device program (P7); host state restacks
-            # only at tile boundaries where the reset logic lives
-            pstack, memstack = rn.stack_state(pfreq, mems)
-            for nepch in range(cfg.n_epochs):
-                for nmb in range(rn.minibatches):
-                    args = rn.band_inputs_all(nmb)
-                    out = solver(*args, pstack, memstack, None, None, None,
-                                 rn.tile_beam)
-                    pstack, memstack = out.p, out.mem
-                    r0s = np.asarray(out.res_0)
-                    r1s = np.asarray(out.res_1)
-                    resband[:] = r1s
-                    if cfg.verbose:
-                        for b in range(rn.nsolbw):
-                            log(f"epoch={nepch} minibatch={nmb} band={b} "
-                                f"{r0s[b]:.6f} {r1s[b]:.6f}")
-                    res_0, res_1 = float(np.mean(r0s)), float(np.mean(r1s))
-                    if dtrace.active():
-                        dtrace.emit("minibatch", tile=ti, epoch=nepch,
-                                    minibatch=nmb, res_0=res_0,
-                                    res_1=res_1,
-                                    iters=int(np.asarray(out.iters).sum()))
-            rn.unstack_state(pstack, memstack, pfreq, mems)
-
-            rn.end_of_tile(tile, ti, state, resband, res_0, res_1, t0,
-                           writer, history, aw=aw, bubble_s=io_wait,
-                           overlap=depth)
+            st.step(ti, tile, st.stage(ti, tile), io_wait)
     finally:
-        aw.close()
-    if writer:
-        writer.close()
-    return history
+        st.close()
+    return st.history
 
 
 def run_minibatch_consensus(cfg: RunConfig, log=print):

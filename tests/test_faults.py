@@ -699,3 +699,67 @@ def test_cli_faults_and_resume_flags(tmp_path):
         ["-f", "x", "-s", "s", "-c", "c",
          "--faults", '[{"point": "ms_read"}]'])
     assert margs.faults is not None
+
+
+# ---------------------------------------------------------------------------
+# migrate_abort: a job killed mid-migration resumes from the watermark
+# ---------------------------------------------------------------------------
+
+def test_migrate_abort_resumes_from_watermark_zero_tiles_lost(tmp_path):
+    """The ISSUE 12 chaos seam: ``migrate_abort`` kills the migration
+    handoff AFTER the source device flushed the checkpoint and BEFORE
+    the target re-admitted the job. Recovery must re-queue the job
+    from the durable watermark (pin dropped — any device may take it):
+    the job completes with zero tiles lost AND zero tiles re-run (the
+    per-job step counter equals n_tiles), and its outputs stay
+    bit-identical to a solo run."""
+    import jax
+    assert len(jax.devices()) >= 2
+    msA, skyf, clusf = _make_dataset(tmp_path, "mab.ms", n_tiles=6,
+                                     seed=11)
+    base = _base_config(skyf, clusf, tile_arrival_s=0.35)
+    faults.enable([{"point": "migrate_abort", "kind": "fatal",
+                    "times": 1}])
+    from sagecal_tpu.serve.api import Server as _Server
+    srv = _Server(port=0, max_inflight=2, devices=2)
+    try:
+        srv.start()
+        with Client(port=srv.port) as c:
+            ja = c.submit(dict(base, ms=msA,
+                               solutions_file=str(tmp_path / "mab.sol")))
+            deadline = time.time() + 120
+            while True:
+                snap = c.status(ja)
+                if snap["state"] == jq.RUNNING \
+                        and 1 <= snap["tiles_done"] <= 3:
+                    break
+                assert snap["state"] in (jq.QUEUED, jq.RUNNING)
+                assert time.time() < deadline
+                time.sleep(0.02)
+            assert c.migrate(ja, 1) == jq.RUNNING
+            snap = c.wait(ja, timeout_s=300)
+            assert snap["state"] == jq.DONE
+            assert snap["tiles_done"] == 6
+            mig = snap["migrations"][0]
+            # the abort fired (counted), the pin was dropped, and the
+            # resume started exactly at watermark + 1: nothing lost,
+            # nothing repeated
+            assert mig["tiles_rerun"] == 0
+            assert mig["resume_tile"] == mig["tile"] + 1
+            m = c.metrics()
+            assert m["migrations_aborted"] == 1
+            assert _counter("faults_injected_total",
+                            point="migrate_abort") == 1
+            reg = c.request(op="metrics_full")["registry"]
+            assert reg["serve_tiles_done_total"]["series"][
+                f"job={ja}"] == 6
+    finally:
+        srv.stop()
+        faults.disable()
+
+    ms2, _, _ = _make_dataset(tmp_path, "mab2.ms", n_tiles=6, seed=11)
+    _run(_base_config(skyf, clusf), ms2, str(tmp_path / "mab_solo.sol"))
+    for a, b in zip(_corrected(msA), _corrected(ms2)):
+        assert np.array_equal(a, b)
+    assert (tmp_path / "mab.sol").read_text() \
+        == (tmp_path / "mab_solo.sol").read_text()

@@ -484,3 +484,52 @@ def test_sentinel_cache_probe_green():
     """The live cache probe (also exercised by the CI sentinel lane):
     a second bucket-compatible pipeline adds zero compiles."""
     assert sentinel.probe_cache() == []
+
+
+def _write_fleet_bank(dirpath, rnd, rec, platform="cpu"):
+    with open(os.path.join(dirpath, f"FLEET_r{rnd:02d}.json"), "w") as f:
+        json.dump({"platform": platform, "date": "2026-08-04",
+                   "results": {"9-fleet-throughput": rec}}, f)
+
+
+def _fleet_rec(**kw):
+    rec = dict(scaling_1to2=1.85,
+               throughput_per_device_2dev_jobs_h=2470.0,
+               p99_queue_wait_2dev_s=2.9, cache_hit_rate_min_2dev=1.0,
+               shape="fleet test")
+    rec.update(kw)
+    return rec
+
+
+def test_sentinel_fleet_cross_round(tmp_path):
+    """ISSUE 12 satellite: the fleet bank (FLEET_rNN.json) is judged
+    like the BENCH banks — newest pair, named metric, improvements
+    never fail; a collapsed 1->2-device scaling or a cold per-device
+    compile cache fails with the metric named."""
+    d = str(tmp_path)
+    _write_fleet_bank(d, 12, _fleet_rec())
+    assert sentinel.fleet_cross_round_check("cpu", d) == []
+    _write_fleet_bank(d, 13, _fleet_rec(scaling_1to2=1.95))
+    assert sentinel.fleet_cross_round_check("cpu", d) == []
+    _write_fleet_bank(d, 14, _fleet_rec(scaling_1to2=1.2))
+    v = sentinel.fleet_cross_round_check("cpu", d)
+    assert len(v) == 1 and v[0]["metric"] == "scaling"
+    assert "FLEET r14" in v[0]["msg"]
+    _write_fleet_bank(d, 15, _fleet_rec(scaling_1to2=1.95,
+                                        cache_hit_rate_min_2dev=0.5))
+    v = sentinel.fleet_cross_round_check("cpu", d)
+    assert {x["metric"] for x in v} == {"fleet_cache"}
+    assert sentinel.load_fleet_banks("tpu", d) == []
+
+
+def test_sentinel_fleet_committed_bank_loads():
+    """The committed FLEET round parses, declares its platform, and
+    carries every toleranced field (a renamed bench field can never
+    silently orphan a fleet tolerance)."""
+    banks = sentinel.load_fleet_banks("cpu", REPO)
+    assert banks, "no committed FLEET_rNN.json"
+    rec = banks[-1][2]["9-fleet-throughput"]
+    for spec in sentinel.FLEET_TOLERANCES.values():
+        assert spec["field"] in rec, spec["field"]
+    assert rec["bit_identical"] is True
+    assert rec["migration"]["tiles_rerun"] == 0

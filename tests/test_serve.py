@@ -519,6 +519,124 @@ def test_serve_stochastic_job_opaque(tmp_path, server):
 
 
 # ---------------------------------------------------------------------------
+# fleet migration: tile-boundary bit-identity, zero tiles re-run (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_cross_device_resume_bit_identical(tmp_path):
+    """Pipeline-level migration gate: a run whose first tiles solved
+    on device A and whose remainder resumed (from the PR 9 checkpoint
+    sidecar) on device B writes residuals AND solutions byte-identical
+    to an uninterrupted run — the primitive the serve migration path
+    is wired from."""
+    import dataclasses
+    import jax
+    from sagecal_tpu.serve import fleet
+    devs = jax.devices()
+    assert len(devs) >= 2
+    noop = lambda *a: None  # noqa: E731
+    msA, skyf, clusf = _make_dataset(tmp_path, "xa.ms", n_tiles=6,
+                                     seed=11)
+    msR, _, _ = _make_dataset(tmp_path, "xr.ms", n_tiles=6, seed=11)
+    base = _base_config(skyf, clusf)
+
+    # reference: uninterrupted on the default device
+    cfgR = config_from_dict(dict(base, ms=msR,
+                                 solutions_file=str(tmp_path / "xr.sol")))
+    pipeline.run(cfgR, log=noop)
+
+    # leg A: 3 tiles on device 0, closed mid-run (checkpoint stays)
+    cfgA = config_from_dict(dict(base, ms=msA,
+                                 solutions_file=str(tmp_path / "xa.sol")))
+    with fleet.device_scope(0, devs[0]):
+        ms = ds.SimMS(msA)
+        sky = skymodel.read_sky_cluster(skyf, clusf, ms.meta["ra0"],
+                                        ms.meta["dec0"], ms.meta["freq0"])
+        pipe = pipeline.FullBatchPipeline(cfgA, ms, sky, log=noop)
+        st = pipe.stepper(write_residuals=True,
+                          solution_path=str(tmp_path / "xa.sol"),
+                          log=noop)
+        for ti in range(3):
+            tile = ms.read_tile(ti)
+            st.step(ti, tile, st.stage(ti, tile))
+        st.close()
+    # leg B: resume on device 1 — zero tiles re-run (the checkpoint
+    # watermark is tile 2, so the resume produces tiles 3..5 only)
+    with fleet.device_scope(1, devs[1]):
+        cfgB = dataclasses.replace(cfgA, resume=True)
+        history = pipeline.run(cfgB, log=noop)
+    assert [h["tile"] for h in history] == [3, 4, 5]
+
+    for a, b in zip(_corrected(msA), _corrected(msR)):
+        assert np.array_equal(a, b)
+    assert (tmp_path / "xa.sol").read_text() \
+        == (tmp_path / "xr.sol").read_text()
+
+
+def test_serve_migration_bit_identical_zero_rerun(tmp_path):
+    """Serve-level migration gate: a running job migrated from device
+    0 to device 1 at a tile boundary (the api ``migrate`` op) finishes
+    on the target, re-runs ZERO completed tiles (the per-job step
+    counter equals n_tiles, and the migration record prices the move),
+    and its residuals + solutions are bit-identical to a solo run."""
+    import jax
+    assert len(jax.devices()) >= 2
+    msA, skyf, clusf = _make_dataset(tmp_path, "mg.ms", n_tiles=6,
+                                     seed=11)
+    # ingest pacing keeps the job mid-flight long enough to land the
+    # migrate op at a deterministic-ish point (outputs are unchanged
+    # by pacing — config.py tile_arrival_s)
+    base = _base_config(skyf, clusf, tile_arrival_s=0.35)
+    srv = Server(port=0, max_inflight=2, devices=2)
+    try:
+        srv.start()
+        with Client(port=srv.port) as c:
+            ja = c.submit(dict(base, ms=msA,
+                               solutions_file=str(tmp_path / "mg.sol")))
+            # wait for some progress, then migrate with tiles to spare
+            deadline = time.monotonic() + 120
+            while True:
+                snap = c.status(ja)
+                if snap["state"] == jq.RUNNING \
+                        and 1 <= snap["tiles_done"] <= 3:
+                    break
+                assert snap["state"] in (jq.QUEUED, jq.RUNNING)
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+            assert c.migrate(ja, 1) == jq.RUNNING
+            snap = c.wait(ja, timeout_s=300)
+            assert snap["state"] == jq.DONE
+            assert snap["device"] == 1
+            assert snap["tiles_done"] == 6
+            mig = snap["migrations"][0]
+            assert mig["src"] == 0 and mig["dst_actual"] == 1
+            assert mig["tiles_rerun"] == 0
+            assert mig["resume_tile"] == mig["tile"] + 1
+            assert mig["wall_s"] > 0
+            # zero tiles re-stepped: the job-attributed step counter
+            # says every tile executed exactly once across both devices
+            reg = c.metrics_full()["registry"]
+            assert reg["serve_tiles_done_total"]["series"][
+                f"job={ja}"] == 6
+            m = c.metrics()
+            assert m["migrations"] == 1
+            per_dev = {d["device"]: d for d in m["devices"]}
+            assert per_dev[0]["tiles_done"] >= 1
+            assert per_dev[1]["tiles_done"] >= 1
+            assert per_dev[0]["tiles_done"] \
+                + per_dev[1]["tiles_done"] == 6
+    finally:
+        srv.stop()
+
+    ms2, _, _ = _make_dataset(tmp_path, "mg2.ms", n_tiles=6, seed=11)
+    res_solo = _solo_run(_base_config(skyf, clusf), ms2,
+                         str(tmp_path / "mg_solo.sol"))
+    for a, b in zip(_corrected(msA), res_solo):
+        assert np.array_equal(a, b)
+    assert (tmp_path / "mg.sol").read_text() \
+        == (tmp_path / "mg_solo.sol").read_text()
+
+
+# ---------------------------------------------------------------------------
 # satellite 1 regression: two-jobs-one-process program reuse
 # ---------------------------------------------------------------------------
 

@@ -1941,25 +1941,36 @@ def config8_serve(device, dtype):
     return rec
 
 
-def _stamp_fleet(rec: dict, platform: str) -> str:
-    """Round-stamp the fleet record (FLEET_rNN.json, the BSCALING/
-    MULTICHIP precedent: its own record family, judged by the
-    sentinel's fleet tolerances instead of the BENCH table columns).
-    NN = 1 + the newest committed FLEET round (first round is 12 —
-    the ISSUE 12 PR). Never overwrites an existing round."""
+def stamp_family(rec: dict, platform: str, family: str,
+                 config_name: str, first_round: int) -> str:
+    """Round-stamp one record of a standalone record family
+    (``<FAMILY>_rNN.json`` — the BSCALING/MULTICHIP precedent: its own
+    filename series, judged by the sentinel's family tolerances
+    instead of the BENCH table columns). NN = 1 + the newest committed
+    round of the family, starting at ``first_round`` (the PR round
+    that introduced it). Never overwrites an existing round; the
+    sentinel's loaders read the ``{"platform", "results": {name:
+    rec}}`` envelope written here."""
     import glob as _glob
     import re as _re
     rounds = [int(m.group(1)) for p in
-              _glob.glob(os.path.join(HERE, "FLEET_r*.json"))
+              _glob.glob(os.path.join(HERE, f"{family}_r*.json"))
               if (m := _re.search(r"_r(\d+)\.json$", p))]
-    nn = max(rounds, default=11) + 1
-    path = os.path.join(HERE, f"FLEET_r{nn:02d}.json")
+    nn = max(rounds, default=first_round - 1) + 1
+    path = os.path.join(HERE, f"{family}_r{nn:02d}.json")
     with open(path, "w") as f:
         json.dump({"platform": platform,
                    "date": time.strftime("%Y-%m-%d %H:%M:%S"),
-                   "results": {"9-fleet-throughput": rec}},
+                   "results": {config_name: rec}},
                   f, indent=1, default=float)
     return path
+
+
+def _stamp_fleet(rec: dict, platform: str) -> str:
+    """Round-stamp the fleet record (FLEET_rNN.json; first round is
+    12 — the ISSUE 12 PR)."""
+    return stamp_family(rec, platform, "FLEET", "9-fleet-throughput",
+                        first_round=12)
 
 
 def config9_fleet(device, dtype):

@@ -110,11 +110,33 @@ def build_parser() -> argparse.ArgumentParser:
       help="force the jax platform, e.g. 'cpu' for a virtual host mesh")
     a("--cpu-devices", type=int, default=0,
       help="virtual CPU device count (with --platform cpu)")
+    a("--mesh-devices", type=int, default=0,
+      help="cap the consensus mesh to N of the visible devices "
+           "(0 = all, up to F). Lets a run leave devices to other "
+           "tenants — and works around the jaxlib 0.4.x XLA SPMD "
+           "partitioner abort on the multi-device -X program "
+           "(array.h:511 Check failed: new_num_elements == "
+           "num_elements(); single-device compiles fine)")
     a("--block-f", type=int, default=0,
       help="single-device blocked J-update: subbands per device "
            "execution (keeps each program under the tunneled chip's "
            "per-execution wall-clock kill on north-star shapes); 0 = "
            "one mesh program")
+    a("--time-shard", type=int, default=0, metavar="T",
+      help="2-D ('freq', 'time') mesh: shard the solution intervals "
+           "over T time-mesh devices IN ADDITION to the subband freq "
+           "axis, solving the whole selected observation as one SPMD "
+           "program (admm.make_admm_runner_2d; MIGRATION.md '2-D "
+           "mesh'). Reads every interval up front; the warm-start J "
+           "chain runs per time shard with a cold seam at each shard "
+           "boundary. 0 = off (the per-interval loop)")
+    a("--staleness", type=int, default=0, metavar="S",
+      help="bounded-staleness consensus (single device, opt-in): a "
+           "straggling subband — injected via the admm_subband_slow "
+           "fault point — may skip its J-update while peers consume "
+           "its duals up to S iterations stale "
+           "(admm.make_admm_runner_stale). 0 = synchronous (default; "
+           "bit-identical chain)")
     a("--inflight", type=int, default=1,
       help="clusters solved concurrently per SAGE sweep step (block-"
            "Jacobi groups; the reference GPU pipeline's 2-in-flight "
@@ -318,9 +340,23 @@ def _main_consensus(args, dtrace) -> int:
     # devices or the SPMD programs desynchronize.
     multihost = args.num_processes > 1
     ndev_avail = len(jax.devices())
+    if args.mesh_devices and not multihost:
+        # --mesh-devices: never slice below a process boundary, so the
+        # cap is single-process only (multi-host meshes must span all
+        # processes' devices or the SPMD programs desynchronize)
+        ndev_avail = min(ndev_avail, max(1, args.mesh_devices))
     ndev = ndev_avail if multihost else min(ndev_avail, nf)
+    if args.staleness > 0 and not multihost:
+        # bounded-staleness consensus is the single-device host-driven
+        # plan (per-subband executions it can actually skip) — fold all
+        # subbands onto one device regardless of what is visible
+        ndev = 1
     fpad = -(-max(nf, ndev) // ndev) * ndev
     mesh = Mesh(np.array(jax.devices()[:ndev]), ("freq",))
+    # running as a serve job: surface the mesh's device span to the
+    # fleet view (no-op outside a job scope — solo CLI runs)
+    from sagecal_tpu.serve import fleet as _fleet
+    _fleet.note_mesh(mesh)
     is_writer = args.process_id == 0   # mpirun-analogue output ownership
     if is_writer:
         print(f"Platform: {jax.devices()[0].platform} "
@@ -372,13 +408,53 @@ def _main_consensus(args, dtrace) -> int:
             dtype_policy=getattr(args, "dtype_policy", "f32")))
 
     t0 = mss[0].read_tile(0)
+    plans = [nm for nm, on in (("--block-f", args.block_f),
+                               ("--host-loop", args.host_loop),
+                               ("--time-shard", args.time_shard > 1),
+                               ("--staleness", args.staleness > 0))
+             if on]
+    if len(plans) > 1:
+        raise ValueError(f"{' and '.join(plans)} are different "
+                         "execution plans; pick one")
     blk_timer = [] if args.block_f else None
-    if args.block_f:
+    if args.time_shard == 1:
+        raise ValueError("--time-shard 1 is ambiguous: use 0 (off, "
+                         "the per-interval loop) or >= 2 time-mesh "
+                         "devices")
+    if args.time_shard > 1:
+        # 2-D ('freq', 'time') mesh: handled by its own driver below —
+        # the whole selected observation is one SPMD program, so the
+        # per-interval prefetch loop never runs
+        if multihost:
+            raise ValueError("--time-shard stages the whole "
+                             "observation from one host; it cannot "
+                             "run multi-host yet (the mesh would span "
+                             "non-addressable devices)")
+        if dobeam:
+            raise ValueError("--time-shard does not support -B beam "
+                             "tables yet; use the per-interval loop")
+        if args.spatialreg:
+            raise ValueError("--time-shard does not support -X spatial "
+                             "regularization; use the mesh runner")
+        if args.mdl:
+            raise ValueError("--time-shard does not support --mdl")
+        runner = None
+    elif args.staleness > 0:
+        if multihost:
+            raise ValueError("--staleness is a single-device host-"
+                             "driven plan; it cannot run multi-host "
+                             "(every process would redundantly drive "
+                             "the same chain)")
+        if dobeam:
+            raise ValueError("--staleness does not support -B beam "
+                             "tables")
+        runner = cadmm.make_admm_runner_stale(
+            dsky, t0.sta1, t0.sta2, cidx, cmask, n, meta0["fdelta"],
+            Bpoly_pad, cfg, nf, staleness=args.staleness,
+            nbase=meta0["nbase"])
+    elif args.block_f:
         if args.block_f < 1:
             raise ValueError(f"--block-f {args.block_f}: must be >= 1")
-        if args.host_loop:
-            raise ValueError("--block-f and --host-loop are different "
-                             "execution plans; pick one")
         if ndev != 1:
             raise ValueError("--block-f is the single-device execution "
                              "plan; it needs a 1-device mesh")
@@ -563,6 +639,47 @@ def _main_consensus(args, dtrace) -> int:
                 interval_min, n, sky.n_clusters, sky.n_eff_clusters)
             for m in mss]
 
+    def _prep_tiles(tiles):
+        """One interval's solve inputs from its subband tiles: the
+        shared staging decision (VisTile.solve_input — per-channel
+        packing when cflags exist, plain mean else), solve-scoped
+        uv-cut flags (predict.c:876 rule; originals restored before
+        write-back), optional -W whitening, and the per-subband
+        unflagged fraction that scales rho (master :646-650)."""
+        x8_l, wt_l, fr_l = [], [], []
+        uvcut_on = args.uvmin > 0.0 or args.uvmax < 1e9
+        orig_flags = [t.flags for t in tiles]
+        for t in tiles:
+            if uvcut_on:
+                t.flags = rp.apply_uvcut(t.flags, t,
+                                         args.uvmin, args.uvmax)
+            x8_t, flags_t, good = t.solve_input()
+            fr_l.append(good)
+            if args.whiten:
+                from sagecal_tpu.solvers import robust as rb
+                x8_t = np.asarray(rb.whiten_data(
+                    jnp.asarray(x8_t, rdt), jnp.asarray(t.u, rdt),
+                    jnp.asarray(t.v, rdt), t.freq0))
+            x8_l.append(x8_t)
+            wt_l.append(np.asarray(lm_mod.make_weights(
+                jnp.asarray(flags_t, jnp.int32), rdt)))
+        if uvcut_on:
+            for t, fl in zip(tiles, orig_flags):
+                t.flags = fl
+        return (np.stack(x8_l), np.stack([t.u for t in tiles]),
+                np.stack([t.v for t in tiles]),
+                np.stack([t.w for t in tiles]), np.stack(wt_l),
+                np.array(fr_l))
+
+    if args.time_shard > 1:
+        return _consensus_time_sharded(
+            args, dtrace, mss=mss, meta0=meta0, freqs=freqs, sky=sky,
+            dsky=dsky, cfg=cfg, Bpoly=Bpoly, rdt=rdt, sdt=sdt,
+            cidx=cidx, cmask=cmask, n=n, t0=t0, start=start, stop=stop,
+            Jinit=Jinit, res_jit=res_jit, writer=writer,
+            worker_writers=worker_writers, is_writer=is_writer,
+            prep_tiles=_prep_tiles)
+
     # overlapped execution (sagecal_tpu.sched): read all subbands of
     # interval t+N on a background thread while interval t solves, and
     # drain residual/solution writes on an ordered writer thread;
@@ -582,42 +699,7 @@ def _main_consensus(args, dtrace) -> int:
             ti = start + _i
             aw.check()      # async write failure -> fail at this boundary
             dtrace.emit("phase", name="io", tile=ti, dur_s=io_wait)
-            # shared staging decision (VisTile.solve_input): per-channel
-            # packing when cflags exist, plain mean else; uv-cut rows (flag 2)
-            # stay excluded from the solve; the downweight ratio is the GOOD
-            # fraction (sagecal_slave.cpp:513)
-            x8_l, wt_l, fr_l = [], [], []
-            uvcut_on = args.uvmin > 0.0 or args.uvmax < 1e9
-            orig_flags = [t.flags for t in tiles]
-            for t in tiles:
-                if uvcut_on:
-                    # uv-window rows -> flag 2: subtracted, excluded from
-                    # the solve (predict.c:876 rule, as in the single-node
-                    # pipeline). Solve-scoped only: the original flags are
-                    # restored before write-back so the cut is never baked
-                    # into the stored dataset.
-                    t.flags = rp.apply_uvcut(t.flags, t,
-                                             args.uvmin, args.uvmax)
-                x8_t, flags_t, good = t.solve_input()
-                fr_l.append(good)
-                if args.whiten:
-                    from sagecal_tpu.solvers import robust as rb
-                    x8_t = np.asarray(rb.whiten_data(
-                        jnp.asarray(x8_t, rdt), jnp.asarray(t.u, rdt),
-                        jnp.asarray(t.v, rdt), t.freq0))
-                x8_l.append(x8_t)
-                wt_l.append(np.asarray(lm_mod.make_weights(
-                    jnp.asarray(flags_t, jnp.int32), rdt)))
-            if uvcut_on:
-                for t, fl in zip(tiles, orig_flags):
-                    t.flags = fl
-            x8F = np.stack(x8_l)
-            uF = np.stack([t.u for t in tiles])
-            vF = np.stack([t.v for t in tiles])
-            wF = np.stack([t.w for t in tiles])
-            wtF = np.stack(wt_l)
-            # rho scaled by unflagged fraction (master :646-650)
-            fratioF = np.array(fr_l)
+            x8F, uF, vF, wF, wtF, fratioF = _prep_tiles(tiles)
 
             padded, _, _ = cadmm.pad_subbands(
                 (x8F, uF, vF, wF, freqs, wtF, fratioF, J0), Bpoly, nf, ndev)
@@ -691,11 +773,12 @@ def _main_consensus(args, dtrace) -> int:
 
             if dtrace.active() or obs.active():
                 # per-ADMM-iteration convergence records from the fetched
-                # telemetry. The host-loop and blocked runners already emit
-                # live per-iteration records (admm.py feeds BOTH the trace
-                # and the obs gauges there), so only the fully traced mesh
-                # program needs the post-hoc emission.
-                if not args.host_loop and not args.block_f:
+                # telemetry. The host-loop, blocked and stale runners
+                # already emit live per-iteration records (admm.py feeds
+                # BOTH the trace and the obs gauges there), so only the
+                # fully traced mesh program needs the post-hoc emission.
+                if (not args.host_loop and not args.block_f
+                        and not args.staleness):
                     for k in range(np.asarray(r1s).shape[0]):
                         r1m = float(np.asarray(r1s)[k].mean())
                         du = float(duals[k]) if len(duals) else 0.0
@@ -794,6 +877,161 @@ def _main_consensus(args, dtrace) -> int:
         writer.close()
     if spatial_file is not None:
         spatial_file.close()
+    for ww in worker_writers:
+        ww.close()
+    return 0
+
+
+def _consensus_time_sharded(args, dtrace, *, mss, meta0, freqs, sky,
+                            dsky, cfg, Bpoly, rdt, sdt, cidx, cmask, n,
+                            t0, start, stop, Jinit, res_jit, writer,
+                            worker_writers, is_writer, prep_tiles) -> int:
+    """``--time-shard T``: the 2-D ('freq', 'time') mesh driver. Every
+    selected interval is read and prepped up front, the whole
+    observation solves as ONE SPMD program over a ``ndev_f x T`` device
+    mesh (admm.make_admm_runner_2d: per-interval J-updates shard-local,
+    consensus a freq-axis collective per interval, the warm-start J
+    chain a per-time-shard scan with the divergence reset in-program),
+    then outputs write back per interval through the same writers as
+    the sequential loop. Memory note: this is the pod batch mode —
+    host staging holds all T intervals at once (MIGRATION.md '2-D
+    mesh'). Writes are synchronous (no prefetch/AsyncWriter: there is
+    no solve left to overlap them with)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from sagecal_tpu import utils
+    from sagecal_tpu.consensus import admm as cadmm
+
+    nf = len(mss)
+    T = int(args.time_shard)
+    ndev_avail = len(jax.devices())
+    if args.mesh_devices:
+        # honor the --mesh-devices cap here too (leave devices to
+        # co-tenants; the jaxlib 0.4.x -X workaround)
+        ndev_avail = min(ndev_avail, max(1, args.mesh_devices))
+    if ndev_avail < T:
+        raise ValueError(f"--time-shard {T} needs at least T devices; "
+                         f"{ndev_avail} visible")
+    ndev_f = min(nf, max(1, ndev_avail // T))
+    mesh = Mesh(np.array(jax.devices()[:ndev_f * T]).reshape(ndev_f, T),
+                ("freq", "time"))
+    from sagecal_tpu.serve import fleet as _fleet
+    _fleet.note_mesh(mesh)     # fleet-view span when run as a serve job
+    nt_sel = stop - start
+    if nt_sel < 1:
+        raise ValueError("no intervals selected (-T/-K window is empty)")
+    if is_writer:
+        print(f"2-D mesh: {ndev_f} freq x {T} time devices, "
+              f"{nf} subbands x {nt_sel} intervals")
+
+    # read + prep every interval up front (pod batch mode)
+    all_tiles = [[m.read_tile(start + i) for m in mss]
+                 for i in range(nt_sel)]
+    preps = [prep_tiles(tiles) for tiles in all_tiles]
+    x8FT, uFT, vFT, wFT, wtFT = [
+        np.stack([p[k] for p in preps], axis=1) for k in range(5)]
+    frFT = np.stack([p[5] for p in preps], axis=1)       # [F, T]
+
+    # subband padding (freq axis), then time padding — the two mesh
+    # padding contracts in admm.py
+    (x8FT, uFT, vFT, wFT, wtFT, frFT, freqsP, J0P), BpolyP, fpad = \
+        cadmm.pad_subbands((x8FT, uFT, vFT, wFT, wtFT, frFT, freqs,
+                            np.asarray(Jinit)), Bpoly, nf, ndev_f)
+    (x8FT, uFT, vFT, wFT, wtFT, frFT), tpad = cadmm.pad_time(
+        (x8FT, uFT, vFT, wFT, wtFT, frFT), nt_sel, T)
+
+    timer: list = []
+    runner = cadmm.make_admm_runner_2d(
+        dsky, t0.sta1, t0.sta2, cidx, cmask, n, meta0["fdelta"],
+        BpolyP, cfg, mesh, nf, nt_sel, nbase=meta0["nbase"],
+        host_loop=True, timer=timer)
+
+    # dtype policy: [B]-traffic stages in the storage dtype, geometry
+    # and Jones keep the pipeline dtype — no f32 fallback on this path
+    from sagecal_tpu import dtypes as dtp
+    sd = dtp.storage_np(getattr(args, "dtype_policy", "f32"), rdt)
+    rd = np.dtype(rdt)
+    out = runner(x8FT.astype(sd), uFT.astype(rd), vFT.astype(rd),
+                 wFT.astype(rd), freqsP.astype(rd), wtFT.astype(sd),
+                 frFT.astype(rd), J0P.astype(rd))
+    JT, ZT, rhoT, res0T, res1T, r1sT, dualsT, Y0T = [
+        np.asarray(o) for o in out]
+    if is_writer and timer:
+        waves = [s for _, s in timer]
+        print("2-D mesh wavefront wall-clock: "
+              + " ".join(f"{s:.2f}s" for s in waves)
+              + f" ({T} time devices/wavefront, "
+              f"{max(cfg.n_admm, 1)} ADMM iters each; first includes "
+              "compile)")
+
+    kmax = int(np.asarray(cmask).shape[1])
+    for i in range(nt_sel):
+        ti = start + i
+        JF_r8_5 = JT[i][:nf].reshape(nf, sky.n_clusters, kmax, n, 8)
+        Z = ZT[i]
+        res0 = res0T[i][:nf]
+        r1s = r1sT[i][:, :nf]
+        res1 = r1s[-1] if cfg.n_admm > 1 else res1T[i][:nf]
+        duals = dualsT[i]
+        if worker_writers:
+            J_all = utils.jones_r2c_np(JF_r8_5)
+            for f, ww in enumerate(worker_writers):
+                ww.write_interval(J_all[f], sky.nchunk)
+        if dtrace.active() or obs.active():
+            for k in range(r1s.shape[0]):
+                r1m = float(r1s[k].mean())
+                du = float(duals[k]) if len(duals) else 0.0
+                dtrace.emit("admm_iter", interval=ti, iter=k + 1,
+                            r1_mean=r1m, dual=du)
+                if obs.active():
+                    obs.inc("admm_iterations_total")
+                    obs.set_gauge("admm_primal_residual", r1m)
+                    obs.set_gauge("admm_dual_residual", du)
+            BZf = np.einsum("fp,mpknr->fmknr", Bpoly, Z)
+            primal = float(np.linalg.norm(
+                JF_r8_5 - BZf.reshape(JF_r8_5.shape))
+                / np.sqrt(BZf.size))
+            dtrace.emit("tile", tile=ti, res_0=float(res0.mean()),
+                        res_1=float(res1.mean()), primal=primal,
+                        rho_mean=float(rhoT[i][:nf].mean()))
+            if obs.active():
+                obs.inc("tiles_solved_total")
+                obs.set_gauge("consensus_primal_residual", primal)
+        if is_writer:
+            print(f"Timeslot:{ti} ADMM:{cfg.n_admm} residual "
+                  f"initial={res0.mean():.6g} final={res1.mean():.6g} "
+                  f"dual={duals[-1] if len(duals) else 0:.3g}")
+            if args.verbose:
+                for f in range(nf):
+                    print(f"  subband {f}: {res0[f]:.6g} -> "
+                          f"{res1[f]:.6g}")
+            if args.use_global_solution:
+                BZ = np.einsum("fp,mpknr->fmknr", Bpoly, Z)
+                J_res = BZ.reshape(nf, sky.n_clusters, kmax, n, 8)
+            else:
+                J_res = JF_r8_5
+            tiles = all_tiles[i]
+            xF_r = np.stack([utils.c2r(t.x) for t in tiles])
+            uF, vF, wF = preps[i][1], preps[i][2], preps[i][3]
+            res_r = res_jit(jnp.asarray(J_res, rdt),
+                            jnp.asarray(xF_r, sdt),
+                            jnp.asarray(uF, rdt), jnp.asarray(vF, rdt),
+                            jnp.asarray(wF, rdt),
+                            jnp.asarray(freqs, rdt))
+            res_np = utils.r2c(np.asarray(res_r, np.float64))
+            for f, (msx, t) in enumerate(zip(mss, tiles)):
+                t.x = res_np[f].astype(np.complex128)
+                msx.write_tile(ti, t)
+        if writer:
+            Zr = np.asarray(Z)
+            Zj = utils.jones_r2c_np(
+                Zr.transpose(0, 2, 1, 3, 4).reshape(
+                    sky.n_clusters, kmax * args.npoly, n, 8))
+            writer.write_interval(Zj, sky.nchunk * args.npoly)
+
+    if writer:
+        writer.close()
     for ww in worker_writers:
         ww.close()
     return 0

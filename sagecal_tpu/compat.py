@@ -27,11 +27,30 @@ def set_cpu_device_count(n: int) -> None:
 def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
     """``jax.shard_map`` with the modern keyword set; falls back to
     ``jax.experimental.shard_map.shard_map`` (jax < 0.6), where the
-    replication-check keyword is spelled ``check_rep``."""
+    replication-check keyword is spelled ``check_rep``.
+
+    Multi-axis meshes (the 2-D ``('freq', 'time')`` consensus mesh,
+    admm.make_admm_runner_2d) work on BOTH spellings — the
+    experimental entry point has carried multi-axis support since jax
+    0.4.3, verified on 0.4.37 by tests/test_mesh2d.py. A jax too old
+    to have either entry point gets a clear capability error naming
+    the version floor instead of an import failure (or, worse, a
+    shape error deep inside tracing) at first mesh use."""
     try:
         from jax import shard_map as sm
     except ImportError:
-        from jax.experimental.shard_map import shard_map as sm
+        try:
+            from jax.experimental.shard_map import shard_map as sm
+        except ImportError as e:
+            import jax
+            axes = tuple(getattr(mesh, "axis_names", ()) or ())
+            what = (f"a {len(axes)}-D mesh {axes}" if len(axes) > 1
+                    else f"mesh {axes}")
+            raise RuntimeError(
+                f"shard_map over {what} requires jax >= 0.4.3 "
+                f"(jax.experimental.shard_map) or jax >= 0.5 "
+                f"(jax.shard_map); this is jax {jax.__version__} with "
+                f"neither entry point") from e
         return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                   check_rep=check_vma)
     return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,

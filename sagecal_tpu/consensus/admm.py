@@ -478,7 +478,8 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         return dict(local_solve_plain=local_solve_plain,
                     local_solve_admm=local_solve_admm,
                     iter0_post=iter0_post, body_post=body_post,
-                    _brow=_brow, _per_subband=_per_subband)
+                    _brow=_brow, _per_subband=_per_subband,
+                    Bfull=Bfull)
 
     def admm_program(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F,
                      *beam_rest):
@@ -612,6 +613,536 @@ def make_admm_runner(dsky, sta1, sta2, cidx, cmask, n_stations: int,
         return JF, Z, rhoF, res0, res1, r1s_a, duals_a, Y0F
 
     run.consensus_program = prog_cons
+    return run
+
+
+def pad_time(arrays, nt: int, ndev_t: int, axis: int = 1):
+    """THE padding contract for the time axis of the 2-D mesh, mirror
+    of :func:`pad_subbands`: pad ``axis`` (the solution-interval axis)
+    of every host array up to ``tpad = ceil(nt/ndev_t)*ndev_t`` by
+    replicating the LAST interval — padded intervals solve numerically
+    tame copies whose outputs the caller drops ([:nt] on the time
+    axis). Unlike padded subbands they need no collective mask: the
+    time axis carries no collective, every interval's consensus is its
+    own freq-psum."""
+    ndev_t = max(int(ndev_t), 1)
+    tpad = -(-max(nt, ndev_t) // ndev_t) * ndev_t
+    if tpad == nt:
+        return list(arrays), tpad
+    out = []
+    for a in arrays:
+        a = np.asarray(a)
+        last = np.take(a, [-1], axis=axis)
+        reps = np.concatenate([last] * (tpad - nt), axis=axis)
+        out.append(np.concatenate([a, reps], axis=axis))
+    return out, tpad
+
+
+def divergence_reset(JF, J0F, res0, res_fin, ratio: float = 5.0):
+    """The per-subband warm-start divergence rule (slave :680-683, the
+    cli_mpi host-loop rule) as a traced op: a subband whose final ADMM
+    residual is non-finite, exactly zero (all-flagged) or blew past
+    ``ratio`` x its initial residual restarts the next interval from
+    ``J0F`` instead of carrying its diverged Jones forward."""
+    bad = (~jnp.isfinite(res_fin)) | (res_fin == 0.0) \
+        | (res_fin > ratio * res0)
+    return jnp.where(bad[:, None, None, None, None], J0F, JF)
+
+
+def make_admm_runner_2d(dsky, sta1, sta2, cidx, cmask, n_stations: int,
+                        fdelta: float, B_poly: np.ndarray,
+                        cfg: ADMMConfig, mesh: Mesh, nf_total: int,
+                        nt_total: int, with_shapelets: bool = False,
+                        nbase: int | None = None,
+                        host_loop: bool = False,
+                        timer: list | None = None):
+    """Consensus ADMM over a 2-D ``('freq', 'time')`` mesh: subbands
+    shard on the freq axis exactly as :func:`make_admm_runner`, and
+    the solution intervals shard on the time axis with the PR 2
+    ``[tilesz, nbase]`` ``row_period`` tile as the shard unit — an
+    F-subband x T-interval pod slice solves the whole observation as
+    ONE SPMD program.
+
+    Structure (MIGRATION.md "2-D mesh"):
+
+    - per-interval SAGE/LM J-updates are independent along time: every
+      (subband, interval) cell solves shard-local;
+    - the polynomial-in-frequency consensus update (z-sum psum + Bii
+      solve + duals) is a **freq-axis collective**: each interval owns
+      its own Z, so time shards run the identical iteration schedule
+      with no cross-time communication at all;
+    - the warm-start J chain becomes a **time-axis scan seam**: each
+      time shard scans its local contiguous block of intervals in
+      order (interval t+1 warm-starts from t's Jones, with the
+      divergence-reset rule in-program), and the FIRST interval of
+      each block cold-starts from ``J0F`` — the one deliberate
+      numerical deviation from the sequential chain, gated by the
+      residual-parity envelope at bank time (MESH2D record).
+
+    Dtype policy: identical contract to the 1-D mesh runner — ``x8``
+    and ``wt`` may arrive in the reduced storage dtype and
+    ``cfg.sage.dtype_policy`` rides into every sagefit; the consensus
+    state never quantizes. There is no f32 fallback on this path.
+
+    ``mesh`` must carry exactly the axes ``("freq", "time")``. Interval
+    mapping: time-device d owns the contiguous block
+    ``[d*Tl, (d+1)*Tl)`` where ``Tl = Tpad // ndev_time``.
+
+    ``run(x8FT, uFT, vFT, wFT, freqF, wtFT, fratioFT, J0F)`` takes
+    HOST arrays (it owns its staging, unlike the 1-D runner):
+    ``[Fpad, Tpad, ...]`` per-cell data, ``freqF [Fpad]``, ``J0F
+    [Fpad, M, K, N, 8]``; subband padding via :func:`pad_subbands`,
+    time padding via :func:`pad_time`. Returns
+    ``(JT, ZT, rhoT, res0T, res1T, r1sT, dualsT, Y0T)`` with a leading
+    GLOBAL time axis: ``JT [Tpad, Fpad, M, K, N, 8]``, ``ZT [Tpad, M,
+    P, K, N, 8]``, ``res* [Tpad, Fpad]``, ``r1sT [Tpad, n_admm-1,
+    Fpad]``, ``dualsT [Tpad, n_admm-1]``.
+
+    ``host_loop=True`` executes one bounded mesh program per time
+    WAVEFRONT (wavefront w = interval ``d*Tl + w`` on every time
+    device d, the warm-start carry rebound on the host between
+    executions) — identical math to the fully traced scan, per-
+    execution ``timer`` telemetry like the 1-D host loop. The runner
+    exposes ``run.consensus_program`` (the per-iteration consensus
+    half on the 2-D mesh) for the collective-overhead probe either
+    way.
+
+    Not offered here (use the 1-D runner): ``-X`` spatial
+    regularization and ``-B`` beam tables (per-interval beam staging
+    across the time mesh is future work; cli_mpi refuses the combo).
+    """
+    if cfg.spatialreg is not None:
+        raise ValueError("2-D mesh runner does not support -X spatial "
+                         "regularization; use make_admm_runner")
+    if tuple(mesh.axis_names) != ("freq", "time"):
+        raise ValueError(f"make_admm_runner_2d needs a ('freq', 'time') "
+                         f"mesh, got axes {mesh.axis_names}")
+    ndev_f, ndev_t = mesh.devices.shape
+    parts = make_admm_runner(
+        dsky, sta1, sta2, cidx, cmask, n_stations, fdelta, B_poly, cfg,
+        mesh, nf_total, with_shapelets=with_shapelets, nbase=nbase,
+        _return_parts=True)
+    lsp = parts["local_solve_plain"]
+    lsa = parts["local_solve_admm"]
+    iter0_post = parts["iter0_post"]
+    body_post = parts["body_post"]
+    _brow = parts["_brow"]
+    _per_subband = parts["_per_subband"]
+
+    def one_interval(Jc, x8t, ut, vt, wt_, wtt, frt, freqF, J0F):
+        """One solution interval's FULL ADMM chain on the local freq
+        shard ([Fl, ...] arrays): iteration 0 + n_admm-1 body
+        iterations, every consensus step a freq-axis collective.
+        Returns (Jnext, outputs) — Jnext is the warm-start carry for
+        the next interval in this time shard's block."""
+        JF, res0, res1 = _per_subband(lsp)(x8t, ut, vt, wt_, wtt, Jc,
+                                           freqF)
+        carry, res0, res1, Y0F = iter0_post(JF, res0, res1, frt)
+        Fl = x8t.shape[0]
+
+        def body(carry, it):
+            Brow = _brow(Fl)
+            BZ = jnp.einsum("fp,mpknr->fmknr", Brow, carry[2])
+            Jr, r0, r1 = _per_subband(lsa)(
+                x8t, ut, vt, wt_, wtt, carry[0], freqF, carry[1], BZ,
+                carry[3])
+            return body_post(Jr, r0, r1, carry, it)
+
+        carry, (r0s, r1s, duals) = jax.lax.scan(
+            body, carry, jnp.arange(1, max(cfg.n_admm, 1),
+                                    dtype=jnp.int32))
+        JF, Z, rhoF = carry[0], carry[2], carry[3]
+        res_fin = r1s[-1] if cfg.n_admm > 1 else res1
+        Jnext = divergence_reset(JF, J0F, res0, res_fin)
+        return Jnext, (JF, Z, rhoF, res0, res1, r1s, duals, Y0F)
+
+    def scan_program(x8, u, v, w, freqF, wtf, fratio, J0F):
+        # local shard: [Fl, Tl, ...]; scan the time block in order so
+        # the warm-start chain is sequential WITHIN the shard
+        xs = tuple(jnp.moveaxis(a, 1, 0)
+                   for a in (x8, u, v, w, wtf, fratio))
+
+        def step(Jc, per_t):
+            x8t, ut, vt, wt_, wtt, frt = per_t
+            return one_interval(Jc, x8t, ut, vt, wt_, wtt, frt, freqF,
+                                J0F)
+
+        _, outs = jax.lax.scan(step, J0F, xs)
+        return outs
+
+    def wave_program(x8, u, v, w, freqF, wtf, fratio, J0F, Jc):
+        # local shard: [Fl, 1, ...] (one interval per time device per
+        # wavefront); squeeze the unit time axis, run the interval,
+        # re-emit with it so the out specs shard back over "time"
+        sq = [a[:, 0] for a in (x8, u, v, w, wtf)]
+        Jnext, outs = one_interval(Jc[:, 0], sq[0], sq[1], sq[2], sq[3],
+                                   sq[4], fratio[:, 0], freqF, J0F)
+        outs = tuple(o[None] for o in outs)     # leading local-time 1
+        return (Jnext[:, None],) + outs
+
+    from sagecal_tpu.compat import shard_map
+    Pft = P("freq", "time")
+    Pf = P("freq")
+    # outputs stack a leading local-time axis: [Tl, ...]
+    out_specs = (P("time", "freq"),            # JF
+                 P("time"),                    # Z
+                 P("time", "freq"),            # rhoF
+                 P("time", "freq"),            # res0
+                 P("time", "freq"),            # res1
+                 P("time", None, "freq"),      # r1s
+                 P("time"),                    # duals
+                 P("time", "freq"))            # Y0F
+    in_specs = (Pft, Pft, Pft, Pft, Pf, Pft, Pft, Pf)
+
+    prog_scan = jax.jit(shard_map(
+        scan_program, mesh=mesh, in_specs=in_specs,
+        out_specs=out_specs, check_vma=False))
+    prog_wave = jax.jit(shard_map(
+        wave_program, mesh=mesh, in_specs=in_specs + (Pft,),
+        out_specs=(Pft,) + out_specs, check_vma=False))
+
+    # the consensus half of one body iteration as its OWN 2-D mesh
+    # program (the measured collective-overhead probe, multichip
+    # precedent): every time shard runs its interval's freq-psum
+    # consensus concurrently — exactly the per-iteration communication
+    # pattern of the 2-D program. Carries are [Fpad, ...] arrays
+    # replicated along "time".
+    carry_specs = (Pf, Pf, P(), Pf, Pf, Pf, P(), P(), Pf)
+
+    def cons_flat(Jr, r0, r1, JF, YF, Z, rhoF, Yhat, Jprev, Zbar, Xd,
+                  rho_upper, it):
+        carry = (JF, YF, Z, rhoF, Yhat, Jprev, Zbar, Xd, rho_upper)
+        carry, (r0o, r1o, dual) = body_post(Jr, r0, r1, carry, it)
+        return carry + (r0o, r1o, dual)
+
+    prog_cons = jax.jit(shard_map(
+        cons_flat, mesh=mesh,
+        in_specs=(Pf, Pf, Pf) + carry_specs + (P(),),
+        out_specs=carry_specs + (Pf, Pf, P()),
+        check_vma=False))
+
+    sh_ft = NamedSharding(mesh, Pft)
+    sh_f = NamedSharding(mesh, Pf)
+
+    import time as _time
+
+    def _t(label, t0, out):
+        if timer is not None:
+            jax.block_until_ready(out)
+            timer.append((label, _time.perf_counter() - t0))
+        return out
+
+    def run(x8FT, uFT, vFT, wFT, freqF, wtFT, fratioFT, J0F):
+        x8FT, uFT, vFT, wFT, wtFT, fratioFT = [
+            np.asarray(a) for a in (x8FT, uFT, vFT, wFT, wtFT,
+                                    fratioFT)]
+        Fpad, Tpad = x8FT.shape[:2]
+        if Fpad % ndev_f or Tpad % ndev_t:
+            raise ValueError(
+                f"staged axes [F={Fpad}, T={Tpad}] must divide the "
+                f"mesh {ndev_f}x{ndev_t} (pad_subbands / pad_time)")
+        if Tpad < -(-nt_total // ndev_t) * ndev_t:
+            raise ValueError(
+                f"staged time axis {Tpad} cannot hold the declared "
+                f"{nt_total} intervals over {ndev_t} time devices "
+                f"(pad_time)")
+        freq_d = jax.device_put(np.asarray(freqF), sh_f)
+        J0_d = jax.device_put(np.asarray(J0F), sh_f)
+        if not host_loop:
+            t0 = _time.perf_counter()
+            args_d = [jax.device_put(a, sh_ft)
+                      for a in (x8FT, uFT, vFT, wFT)]
+            wt_d = jax.device_put(wtFT, sh_ft)
+            fr_d = jax.device_put(fratioFT, sh_ft)
+            out = prog_scan(args_d[0], args_d[1], args_d[2], args_d[3],
+                            freq_d, wt_d, fr_d, J0_d)
+            _t("scan", t0, out[0])
+            return out
+
+        # wavefront host loop: one bounded execution per local
+        # interval index w; time-device d solves interval d*Tl + w
+        Tl = Tpad // ndev_t
+        outs_host = [None] * 8
+
+        def _place(buf, w, a, t_lead):
+            # a: wavefront output with time axis leading (t_lead) or
+            # second; scatter device d's cell to interval d*Tl + w
+            a = np.asarray(a)
+            at = a if t_lead else np.moveaxis(a, 1, 0)
+            if buf is None:
+                buf = np.zeros((Tpad,) + at.shape[1:], at.dtype)
+            buf[w::Tl] = at
+            return buf
+
+        Jc = np.broadcast_to(
+            np.asarray(J0F)[:, None],
+            (Fpad, ndev_t) + np.asarray(J0F).shape[1:])
+        Jc_d = jax.device_put(np.ascontiguousarray(Jc), sh_ft)
+        for w in range(Tl):
+            t0 = _time.perf_counter()
+            sl = [jax.device_put(np.ascontiguousarray(a[:, w::Tl]),
+                                 sh_ft)
+                  for a in (x8FT, uFT, vFT, wFT, wtFT, fratioFT)]
+            out = prog_wave(sl[0], sl[1], sl[2], sl[3], freq_d, sl[4],
+                            sl[5], J0_d, Jc_d)
+            _t(f"wave[{w}]", t0, out[0])
+            Jc_d = out[0]
+            # wavefront outputs: JF/rho/res/r1s/Y0 lead with the local
+            # time axis (size 1 per device -> global [ndev_t, ...])
+            for i, o in enumerate(out[1:]):
+                outs_host[i] = _place(outs_host[i], w, o, t_lead=True)
+        return tuple(jnp.asarray(b) for b in outs_host)
+
+    run.consensus_program = prog_cons
+    run.mesh_shape = (ndev_f, ndev_t)
+    return run
+
+
+def make_admm_runner_stale(dsky, sta1, sta2, cidx, cmask,
+                           n_stations: int, fdelta: float,
+                           B_poly: np.ndarray, cfg: ADMMConfig,
+                           nf_total: int, staleness: int = 0,
+                           with_shapelets: bool = False,
+                           nbase: int | None = None, device=None,
+                           timer: list | None = None):
+    """Bounded-staleness consensus ADMM (opt-in): a straggling subband
+    may SKIP its J-update for a round while every other subband keeps
+    iterating against its last-sent dual contribution — consumed up to
+    ``staleness`` iterations stale — instead of the whole pod pacing
+    on the slowest subband (arXiv:1605.09219's stale-tolerant rho
+    schedules; arXiv:1410.2101's ADI analysis of reordered updates).
+
+    Composition with the PR 9 fault harness makes the straggler a
+    MEASURED experiment rather than a hang: per round, each subband
+    asks ``faults.fires("admm_subband_slow", key=f)`` whether it is
+    slow — but only when skipping would keep its staleness within the
+    bound (``staleness=0`` never even asks: the synchronous chain).
+    A subband whose bound is exhausted is forced to update — the
+    simulation analogue of the synchronous runner blocking on it, so
+    the chain NEVER deadlocks on a slow subband, and a ``kind:
+    "fatal"`` rule marks the subband DEAD: it is masked out of every
+    later consensus like a padded mesh slot (zero rho, zero sent
+    dual) and its last residual is carried forward.
+
+    Semantics per round (vs the synchronous body_post):
+
+    - updated subbands: ``Ysent_f = Y_f + rho_f J_f(new)`` then the
+      dual step against the fresh Z, exactly the synchronous math;
+    - sleeping subbands: ``Ysent_f`` (their last-sent contribution)
+      enters the z-sum unchanged — the "stale dual" — and their
+      ``Y_f``/``J_f``/residual are untouched;
+    - the Z solve itself stays exact over the mixed-freshness table.
+
+    With ``staleness=0`` — or any bound but no fault plan — every
+    subband updates every round and the chain is BIT-IDENTICAL to
+    ``make_admm_runner_blocked(block_f=1)`` (gated,
+    tests/test_mesh2d.py). ``adaptive_rho`` is refused: BB steps over
+    mixed-staleness increments have no convergence story.
+
+    Single-device host-driven execution (block_f=1 per-subband
+    executions — the granularity that lets a real deployment actually
+    skip a straggler's solve). Same run signature/outputs as
+    :func:`make_admm_runner_blocked`; additionally ``run.schedule``
+    holds, per interval, the list of per-round update masks and
+    ``run.dead`` the dead-subband set — the harness's telemetry.
+    """
+    import time as _time
+
+    from sagecal_tpu import faults
+
+    if cfg.spatialreg is not None:
+        raise ValueError("bounded-staleness runner does not support -X "
+                         "spatial regularization")
+    if cfg.adaptive_rho:
+        raise ValueError("bounded-staleness consensus requires "
+                         "adaptive_rho=False (BB rho over stale "
+                         "increments is undefined)")
+    S = int(staleness)
+    if S < 0:
+        raise ValueError(f"staleness {S}: must be >= 0")
+
+    devs = [device] if device is not None else jax.devices()[:1]
+    mesh = Mesh(np.array(devs), ("freq",))
+    parts = make_admm_runner(
+        dsky, sta1, sta2, cidx, cmask, n_stations, fdelta, B_poly, cfg,
+        mesh, nf_total, with_shapelets=with_shapelets, nbase=nbase,
+        _return_parts=True)
+    local_solve_plain = parts["local_solve_plain"]
+    local_solve_admm = parts["local_solve_admm"]
+    iter0_post = parts["iter0_post"]
+    body_post = parts["body_post"]
+    _brow = parts["_brow"]
+    _per_subband = parts["_per_subband"]
+
+    M = int(np.asarray(cmask).shape[0])
+    K = int(np.asarray(cmask).shape[1])
+    N = n_stations
+
+    solve0 = jax.jit(_per_subband(local_solve_plain))
+    solveb = jax.jit(_per_subband(local_solve_admm))
+    cons0 = jax.jit(lambda JF, res0, res1, fratioF: iter0_post(
+        JF, res0, res1, fratioF, ax=None), donate_argnums=(0,))
+    bz_prog = jax.jit(
+        lambda Z, Brow: jnp.einsum("fp,mpknr->fmknr", Brow, Z))
+
+    def stale_post(Jr, r1_new, upd, alive, JF, YF, Z, rhoF, Ysent,
+                   r1_prev, it):
+        """The consensus half of one stale round. ``upd``/``alive``:
+        [F] {0,1} masks. With upd == alive == 1 everywhere this
+        computes bit-for-bit the synchronous ``body_post`` values
+        (the where() wrappers select the identical branch
+        expressions), which is the S=0 parity gate's contract."""
+        F = Jr.shape[0]
+        dtype = Jr.dtype
+        Brow = _brow(F, None)
+        J5 = Jr.reshape(F, M, K, N, 8)
+        upd5 = upd[:, None, None, None, None]
+        alive5 = alive[:, None, None, None, None]
+        rho_eff = jnp.where(alive[:, None] > 0, rhoF, 0.0)
+        Ysent = jnp.where(upd5 > 0,
+                          YF + rho_eff[..., None, None, None] * J5,
+                          Ysent)
+        Ysent = jnp.where(alive5 > 0, Ysent, 0.0)
+        Zold = Z
+        zsum = jnp.einsum("fp,fmknr->mpknr", Brow, Ysent)
+        Bii = cpoly.find_prod_inverse(
+            parts["Bfull"], rho_eff.T.astype(Ysent.dtype))
+        Z = cpoly.z_from_contributions(zsum, Bii)
+        BZn = jnp.einsum("fp,mpknr->fmknr", Brow, Z)
+        YF = jnp.where(upd5 > 0,
+                       Ysent - rho_eff[..., None, None, None] * BZn, YF)
+        JF = jnp.where(upd5 > 0, J5.reshape(JF.shape), JF)
+        r1 = jnp.where(upd > 0, r1_new, r1_prev)
+        dual = jnp.linalg.norm(Z - Zold) / np.sqrt(Z.size)
+        return JF, YF, Z, rho_eff, Ysent, r1, dual
+
+    stale_cons = jax.jit(stale_post)
+
+    def _t(label, t0, out):
+        if timer is not None:
+            jax.block_until_ready(out)
+            timer.append((label, _time.perf_counter() - t0))
+        return out
+
+    n_runs = [0]
+    schedule: list = []
+    dead_log: list = []
+
+    def run(x8F, uF, vF, wF, freqF, wtF, fratioF, J0F, *beam_rest):
+        if beam_rest:
+            raise ValueError("bounded-staleness runner does not "
+                             "support -B beam tables")
+        interval = n_runs[0]
+        n_runs[0] += 1
+        F = x8F.shape[0]
+        Brow_full = _brow(F, None)
+
+        def take(a, f):
+            return jax.tree.map(lambda x: x[f:f + 1], a)
+
+        def sub_solve0(f):
+            t0 = _time.perf_counter()
+            Jb, r0b, r1b = solve0(take(x8F, f), take(uF, f), take(vF, f),
+                                  take(wF, f), take(wtF, f),
+                                  take(J0F, f), take(freqF, f))
+            _t(f"solve0[{f}]", t0, Jb)
+            return Jb, r0b, r1b
+
+        def sub_solveb(f, JF, YF, BZ, rhoF):
+            t0 = _time.perf_counter()
+            Jb, r0b, r1b = solveb(take(x8F, f), take(uF, f), take(vF, f),
+                                  take(wF, f), take(wtF, f),
+                                  take(JF, f), take(freqF, f),
+                                  take(YF, f), take(BZ, f),
+                                  take(rhoF, f))
+            _t(f"solve[{f}]", t0, Jb)
+            return Jb, r0b, r1b
+
+        # --- iteration 0: synchronous for every subband (the dual
+        # seed + manifold averaging need the full subband set)
+        Js, r0s, r1s_l = zip(*[sub_solve0(f) for f in range(F)])
+        JF = jnp.concatenate(Js)
+        res0 = jnp.concatenate(r0s)
+        res1 = jnp.concatenate(r1s_l)
+        t0 = _time.perf_counter()
+        carry, res0, res1, Y0F = cons0(JF, res0, res1, fratioF)
+        _t("cons0", t0, carry[2])
+        JF, YF, Z, rhoF = carry[0], carry[1], carry[2], carry[3]
+        # last-sent table: iteration 0's sent contribution is the
+        # manifold-projected rho*J — exactly Y0F
+        Ysent = Y0F
+        r1_cur = res1
+
+        alive_np = np.ones(F, np.float64)
+        alive_np[nf_total:] = 0.0          # padded mesh slots
+        upd_base = alive_np.copy()
+        last_update = np.zeros(F, np.int64)
+        dead: set = set()
+        sched_rounds: list = []
+        r1h, dualh, pend = [], [], []
+        for it in range(1, max(cfg.n_admm, 1)):
+            upd_np = upd_base.copy()
+            for f in range(min(nf_total, F)):
+                if f in dead:
+                    upd_np[f] = 0.0
+                    continue
+                # may f be lazy this round? only asked when the bound
+                # permits the resulting staleness
+                if S > 0 and (it - last_update[f]) <= S:
+                    kind = faults.draw("admm_subband_slow", key=f)
+                    if kind == "fatal":
+                        dead.add(f)
+                        alive_np[f] = 0.0
+                        upd_base[f] = 0.0
+                        upd_np[f] = 0.0
+                        dead_log.append((interval, it, f))
+                        continue
+                    if kind is not None:
+                        upd_np[f] = 0.0
+                        continue
+                last_update[f] = it
+            sched_rounds.append(upd_np.copy())
+
+            BZ = bz_prog(Z, Brow_full)
+            Jr = JF
+            r1_new = r1_cur
+            for f in range(F):
+                if upd_np[f] == 0.0:
+                    continue
+                Jb, _r0b, r1b = sub_solveb(f, JF, YF, BZ, rhoF)
+                # in-place-style scatter: one dispatch per subband,
+                # no full-[F] copies (the values land verbatim, so
+                # the S=0 bit-identity gate is untouched)
+                Jr = Jr.at[f:f + 1].set(Jb)
+                r1_new = r1_new.at[f:f + 1].set(r1b)
+            upd_d = jnp.asarray(upd_np, JF.dtype)
+            alive_d = jnp.asarray(alive_np, JF.dtype)
+            t0 = _time.perf_counter()
+            JF, YF, Z, rhoF, Ysent, r1_cur, dual = stale_cons(
+                Jr, r1_new, upd_d, alive_d, JF, YF, Z, rhoF, Ysent,
+                r1_cur, jnp.asarray(it, jnp.int32))
+            _t(f"cons[{it}]", t0, Z)
+            r1h.append(r1_cur)
+            dualh.append(dual)
+            if dtrace.active() or obs.active():
+                pend.append((it, jnp.mean(r1_cur), dual,
+                             jnp.mean(rhoF)))
+                skipped = [f for f in range(nf_total)
+                           if upd_np[f] == 0.0]
+                if skipped:
+                    dtrace.emit("admm_stale", interval=interval,
+                                iter=it, skipped=skipped,
+                                dead=sorted(dead))
+        _emit_deferred(pend, interval)
+        schedule.append(sched_rounds)
+        r1s_a = (jnp.stack(r1h) if r1h
+                 else jnp.zeros((0, F), x8F.dtype))
+        duals_a = (jnp.stack(dualh) if dualh
+                   else jnp.zeros((0,), x8F.dtype))
+        return JF, Z, rhoF, res0, res1, r1s_a, duals_a, Y0F
+
+    run.schedule = schedule
+    run.dead = dead_log
     return run
 
 

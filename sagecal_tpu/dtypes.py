@@ -34,6 +34,8 @@ tests/test_dtype_policy.py.
 
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 
 # user-facing policy names (--dtype-policy on both CLIs)
@@ -86,6 +88,21 @@ def to_storage(x, dtype):
     if not is_reduced(dtype):
         return x
     return x.astype(dtype)
+
+
+def storage_np(policy: str, default=None):
+    """Numpy dtype for HOST-side staging under ``policy`` — the
+    casting boundary where [B]-data leaves numpy for the device
+    (cli_mpi interval staging, the sharded-path ``pad_rows`` buffers,
+    the 2-D mesh batch staging). Reduced dtypes resolve through
+    ml_dtypes' numpy registration, so ``np.asarray(a,
+    storage_np("bf16"))`` quantizes on the host and the transfer
+    itself ships half the bytes. ``default`` (a jnp or np dtype)
+    is returned for "f32", mirroring :func:`storage_dtype`."""
+    validate(policy)
+    if policy in _REDUCED:
+        return np.dtype(_REDUCED[policy])
+    return np.dtype(jnp.float32 if default is None else default)
 
 
 def pet(dtype):

@@ -73,6 +73,12 @@ POINTS = (
     #                     target — the recovery path must re-queue the
     #                     job from the durable watermark (zero tiles
     #                     lost; chaos-gated in tests/test_faults.py)
+    "admm_subband_slow",  # consensus/admm: a subband straggles for one
+    #                     ADMM round (kind "transient": skipped under
+    #                     bounded staleness, forced when the bound is
+    #                     exhausted; kind "fatal": the subband is DEAD
+    #                     — masked out of every later consensus).
+    #                     Queried via draw(); key = subband index
 )
 
 _KINDS = ("transient", "fatal")
@@ -221,6 +227,22 @@ def fires(point: str, key=None) -> bool:
         return False
     obs.inc("faults_injected_total", point=point)
     return True
+
+
+def draw(point: str, key=None) -> str | None:
+    """Kind-preserving query sites (``admm_subband_slow``): the rule's
+    ``kind`` ("transient"/"fatal") when one fires, else None — for
+    callers whose response differs by kind (a slow subband is skipped
+    for a round, a dead one is masked out for good) without raising
+    through a device-dispatch loop. None when disabled."""
+    p = _PLAN
+    if p is None:
+        return None
+    r = p.match(point, key)
+    if r is None:
+        return None
+    obs.inc("faults_injected_total", point=point)
+    return r.kind
 
 
 def inject(point: str, key=None) -> None:

@@ -93,6 +93,22 @@ FLEET_TOLERANCES = {
                         better="higher"),
 }
 
+#: 2-D mesh record tolerances (MESH2D_rNN.json, tools_dev/northstar.py
+#: --mesh2d — the freq x time pod-slice record family, ISSUE 14):
+#: per-ADMM-iteration wall on the mesh leg, the measured collective-
+#: overhead fraction (consensus program wall / body-iteration wall —
+#: the "consensus is free" claim as a number), and the residual-parity
+#: flag vs the sequential warm-start chain (gated at bank time; a
+#: record banking parity_ok=0 — or a later round losing it — fails CI
+#: with the metric named). Judged cross-round like FLEET_TOLERANCES.
+MESH_TOLERANCES = {
+    "mesh_wall": dict(field="wall_per_admm_iter_s", rel=0.30,
+                      better="lower"),
+    "mesh_collective": dict(field="collective_overhead_frac", abs=0.02,
+                            better="lower"),
+    "mesh_parity": dict(field="parity_ok", abs=0.0, better="higher"),
+}
+
 
 def assert_table_contract(header: str) -> None:
     """Every toleranced metric with a named table column must find it
@@ -205,15 +221,20 @@ def load_fleet_banks(platform: str, bank_dir: str = HERE):
     return load_banks(platform, bank_dir, pattern="FLEET_r*.json")
 
 
-def fleet_cross_round_check(platform: str, bank_dir: str = HERE) -> list:
-    """Newest fleet round vs the most recent earlier one, judged
-    against :data:`FLEET_TOLERANCES` — a PR that banks a fleet round
-    with collapsed scaling, a blown queue-wait tail, or a cold
-    per-device cache fails CI with the metric named (the ISSUE 12
-    satellite: fleet bench metrics join the sentinel like the
-    existing banks)."""
+def load_mesh_banks(platform: str, bank_dir: str = HERE):
+    """Round-stamped 2-D mesh records (MESH2D_rNN.json), oldest first
+    — :func:`load_banks` over the mesh filename family."""
+    return load_banks(platform, bank_dir, pattern="MESH2D_r*.json")
+
+
+def _family_cross_round_check(banks, tolerances: dict,
+                              tag: str) -> list:
+    """Newest round of a record family vs the most recent earlier one,
+    judged against ``tolerances`` — the shared body of the FLEET and
+    MESH2D cross-round checks (same final-pair-only discipline as
+    :func:`cross_round_check`)."""
     occ: dict = {}
-    for rnd, _path, res in load_fleet_banks(platform, bank_dir):
+    for rnd, _path, res in banks:
         for name, rec in res.items():
             if isinstance(rec, dict) and "error" not in rec:
                 occ.setdefault(name, []).append((rnd, rec))
@@ -223,12 +244,34 @@ def fleet_cross_round_check(platform: str, bank_dir: str = HERE) -> list:
             continue
         (prnd, prev), (rnd, rec) = pairs[-2], pairs[-1]
         for v in compare({name: rec}, {name: prev},
-                         tolerances=FLEET_TOLERANCES,
-                         source=f"FLEET r{prnd:02d}"):
+                         tolerances=tolerances,
+                         source=f"{tag} r{prnd:02d}"):
             v["round"] = rnd
-            v["msg"] = f"FLEET r{rnd:02d} " + v["msg"]
+            v["msg"] = f"{tag} r{rnd:02d} " + v["msg"]
             viol.append(v)
     return viol
+
+
+def fleet_cross_round_check(platform: str, bank_dir: str = HERE) -> list:
+    """Newest fleet round vs the most recent earlier one, judged
+    against :data:`FLEET_TOLERANCES` — a PR that banks a fleet round
+    with collapsed scaling, a blown queue-wait tail, or a cold
+    per-device cache fails CI with the metric named (the ISSUE 12
+    satellite: fleet bench metrics join the sentinel like the
+    existing banks)."""
+    return _family_cross_round_check(
+        load_fleet_banks(platform, bank_dir), FLEET_TOLERANCES, "FLEET")
+
+
+def mesh_cross_round_check(platform: str, bank_dir: str = HERE) -> list:
+    """Newest 2-D mesh round vs the most recent earlier one, judged
+    against :data:`MESH_TOLERANCES` — a later round regressing the
+    mesh wall/iter, fattening the collective-overhead fraction, or
+    losing residual parity vs the sequential chain fails CI with the
+    metric named (the ISSUE 14 satellite, mirroring the fleet
+    family)."""
+    return _family_cross_round_check(
+        load_mesh_banks(platform, bank_dir), MESH_TOLERANCES, "MESH2D")
 
 
 def cross_round_check(platform: str, bank_dir: str = HERE) -> list:
@@ -557,6 +600,11 @@ def main(argv=None) -> int:
             print(f"sentinel: {plat} fleet bank r{fleet[-1][0]:02d} "
                   f"({len(fleet)} rounds)")
             viol.extend(fleet_cross_round_check(plat, args.bank_dir))
+        mesh = load_mesh_banks(plat, args.bank_dir)
+        if mesh:
+            print(f"sentinel: {plat} mesh bank r{mesh[-1][0]:02d} "
+                  f"({len(mesh)} rounds)")
+            viol.extend(mesh_cross_round_check(plat, args.bank_dir))
         if not args.fast:
             viol.extend(rerun_check(plat, args.bank_dir))
     if not checked_any:

@@ -75,6 +75,15 @@ def sharded_sagefit(mesh: Mesh, dsky, fdelta: float, chunk_mask,
     ``with_shapelets=None`` auto-detects from the sky model like the
     unsharded predict. The caller stages inputs with :func:`shard_rows`;
     outputs (J, res_0, res_1, mean_nu) come back replicated.
+
+    Dtype policy (MIGRATION.md "Dtype policy"): ``x8``/``wt`` may be
+    staged in the reduced storage dtype — ``config.dtype_policy``
+    rides into sagefit, which owns the storage/accumulate split, so
+    the row-sharded program moves storage-dtype [B]-rows and GSPMD's
+    all-reduces contract f32 accumulators exactly like the unsharded
+    path. Geometry (u, v, w) must keep the pipeline real dtype. No
+    f32 fallback remains on this path (the PR 6 exemption melted in
+    ISSUE 14; tolerance-gated in tests/test_dtype_policy.py).
     """
     from sagecal_tpu.rime import predict as rp
     from sagecal_tpu.solvers import normal_eq as ne

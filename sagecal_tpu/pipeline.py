@@ -115,14 +115,12 @@ class FullBatchPipeline:
         self.rdt = real_dtype
         # --dtype-policy storage dtype for the staged [B]-data (x8, wt,
         # residual ring slots); "f32" keeps sdt == rdt (bit-frozen).
-        # The sharded (GSPMD) path stages through parallel.pad_rows in
-        # rdt and is policy-exempt for now — reduced policies fall back
-        # to f32 there with a log line rather than silently diverging.
+        # The sharded (GSPMD) path stages its [B]-rows in the storage
+        # dtype too (the row-sharded solve reuses the same
+        # storage/accumulate split inside sagefit) — the PR 6
+        # policy-exemption melted in ISSUE 14, tolerance-gated by
+        # tests/test_dtype_policy.py::test_sharded_path_applies_policy.
         policy = getattr(cfg, "dtype_policy", "f32")
-        if policy != "f32" and getattr(cfg, "shard_baselines", False):
-            log("dtype-policy: sharded path is policy-exempt; "
-                "staging stays f32")
-            policy = "f32"
         if policy != "f32" and real_dtype == jnp.float64:
             # a reduced storage policy pairs with the f32/c64 pipeline
             # (the accumulator contract is f32); keeping the f64/c128
@@ -484,13 +482,19 @@ class FullBatchPipeline:
                  np.zeros(bpad - B, np.asarray(os_ids_np).dtype)])
             tsp = np.concatenate(
                 [tslot_np, np.zeros(bpad - B, tslot_np.dtype)])
+            # dtype policy: the [B]-proportional rows (x8, wt) stage in
+            # the storage dtype; geometry (u, v, w) keeps the pipeline
+            # dtype (the RIME phase needs every f32 bit). Identity when
+            # the policy is "f32".
+            x8p, geom = arrs[0], arrs[1:]
             args = parallel.shard_rows(
-                mesh, *[np.asarray(a, np.dtype(self.rdt)
-                                   if np.asarray(a).dtype.kind == "f"
-                                   else None) for a in arrs])
+                mesh, np.asarray(x8p, np.dtype(self.sdt)),
+                *[np.asarray(a, np.dtype(self.rdt)
+                             if np.asarray(a).dtype.kind == "f"
+                             else None) for a in geom])
             (cidx_d,) = parallel.shard_rows(mesh, cidxp, row_axis=1)
             (wt_d,) = parallel.shard_rows(
-                mesh, np.asarray(wtp, np.dtype(self.rdt)))
+                mesh, np.asarray(wtp, np.dtype(self.sdt)))
             (os_d,) = parallel.shard_rows(mesh, osp)
             (ts_d,) = parallel.shard_rows(mesh, tsp)
             key = jax.random.fold_in(jax.random.PRNGKey(199), tile_idx)

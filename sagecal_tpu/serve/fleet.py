@@ -50,6 +50,8 @@ from __future__ import annotations
 import contextlib
 import threading
 
+import numpy as np
+
 from sagecal_tpu.serve import cache as pcache
 
 _tls = threading.local()
@@ -82,6 +84,72 @@ def device_scope(ordinal: int, device=None):
             del _tls.ordinal
         else:
             _tls.ordinal = prev
+
+
+@contextlib.contextmanager
+def job_scope(job_id: str):
+    """Bind this thread to a serve job id (strictly thread-local,
+    like :func:`device_scope`). Entered by ``job_telemetry_ctx``
+    alongside the device scope so code deep inside a job's run body —
+    cli_mpi building its consensus mesh — can attribute process-wide
+    facts (the mesh span) to the owning job without threading the id
+    through every layer."""
+    prev = getattr(_tls, "job_id", None)
+    _tls.job_id = str(job_id)
+    try:
+        yield
+    finally:
+        if prev is None:
+            del _tls.job_id
+        else:
+            _tls.job_id = prev
+
+
+def current_job() -> str | None:
+    """The entering job's id, or None outside any job scope (solo CLI
+    runs; the scheduler's own threads between jobs)."""
+    return getattr(_tls, "job_id", None)
+
+
+# -- mesh spans: which devices a mesh/mpi job's SPMD programs cover ---------
+
+_spans_lock = threading.Lock()
+_MESH_SPANS: dict = {}     # job_id -> {"devices": [...], "axes": ...}
+
+
+def note_mesh(mesh) -> None:
+    """Record the device span of a consensus mesh built INSIDE a serve
+    job (cli_mpi calls this right after constructing its Mesh; a
+    no-op outside any job scope, so solo CLI runs never touch the
+    registry). An mpi job runs opaquely on ONE owner thread, but its
+    SPMD programs span every mesh device — before this record, that
+    fleet-wide device use was invisible to the fleet view
+    (``metrics_full`` per-device snapshots now list the job under
+    every device its mesh covers)."""
+    job = current_job()
+    if job is None:
+        return
+    try:
+        devs = [str(d) for d in np.asarray(mesh.devices).flat]
+        span = {"devices": devs,
+                "axes": list(getattr(mesh, "axis_names", ())),
+                "shape": list(np.asarray(mesh.devices).shape)}
+    except Exception:
+        return
+    with _spans_lock:
+        _MESH_SPANS[job] = span
+
+
+def clear_mesh_span(job_id: str) -> None:
+    """Drop a finished job's span (the scheduler's opaque-run finally)."""
+    with _spans_lock:
+        _MESH_SPANS.pop(str(job_id), None)
+
+
+def mesh_spans() -> dict:
+    """Snapshot of the live {job_id: span} registry."""
+    with _spans_lock:
+        return {k: dict(v) for k, v in _MESH_SPANS.items()}
 
 
 def fleet_devices(n: int | None):

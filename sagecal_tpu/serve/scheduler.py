@@ -93,6 +93,7 @@ def job_telemetry_ctx(tracer, job_id, ordinal: int = 0, device=None):
     @contextlib.contextmanager
     def ctx():
         with fleet.device_scope(ordinal, device), \
+                fleet.job_scope(job_id), \
                 dtrace.scope(tracer), obs.scope_labels(job=job_id):
             yield
     return ctx
@@ -241,11 +242,30 @@ class Scheduler:
         busy = self.busy_s
         n_dev = len(self.workers)
         by_dev = pcache.PROGRAMS.stats_by_device()
+        # mesh/mpi jobs run opaquely on ONE owner thread but their
+        # SPMD programs span a device mesh (fleet.note_mesh, fed from
+        # cli_mpi under the job scope): list each such job under every
+        # device its mesh covers, so the fleet view stops reading a
+        # multi-device consensus job as single-device use
+        spans = fleet.mesh_spans()
+        default_name = None
+        if spans:
+            try:
+                import jax
+                default_name = str(jax.devices()[0])
+            except Exception:
+                pass
         devices = []
         for w in self.workers:
             snap = w.snapshot(wall)
             snap["cache"] = by_dev.get(
                 w.ix, {"hits": 0, "misses": 0, "hit_rate": 0.0})
+            if spans:
+                wname = (default_name if w.device is None
+                         else str(w.device))
+                snap["mesh_jobs"] = sorted(
+                    j for j, sp in spans.items()
+                    if wname in sp.get("devices", ()))
             devices.append(snap)
         out.update(wall_s=wall, busy_s=busy,
                    # the fleet's busy fraction is per-device-averaged:
@@ -261,6 +281,8 @@ class Scheduler:
                    migrations=self.migrations_done,
                    migrations_aborted=self.migrations_aborted,
                    unhealthy_jobs=self.unhealthy_jobs())
+        if spans:
+            out["mesh_spans"] = spans
         return out
 
     def unhealthy_jobs(self) -> list:
@@ -417,6 +439,7 @@ class Scheduler:
             dt = time.perf_counter() - t0
             w.busy_s += dt
             w.last_progress_t = time.time()
+            fleet.clear_mesh_span(job.job_id)
             obs.inc("serve_device_busy_seconds_total", dt,
                     device=str(w.ix))
             if tracer is not None:

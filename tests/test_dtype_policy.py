@@ -367,6 +367,138 @@ def test_pipeline_overlap_bit_identical_bf16(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# the sharded (GSPMD) path: the PR 6 policy-exemption is melted
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_sharded_path_applies_policy():
+    """ISSUE 14: the row-sharded solve (parallel.sharded_sagefit — the
+    path that fell back to f32 with a log line since PR 6) runs with
+    bf16 [B]-row staging ACTIVE: the staged arrays really carry the
+    storage dtype across the mesh, the solve converges, and the final
+    residual sits inside the bf16 envelope of the f32 sharded chain."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from sagecal_tpu import parallel, skymodel, utils
+    from sagecal_tpu.config import SolverMode
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.rime import predict as rp
+
+    rng = np.random.default_rng(21)
+    srcs, clusters = {}, []
+    for m in range(2):
+        nm = f"P{m}"
+        ll, mm = rng.normal(0, 0.04, 2)
+        srcs[nm] = skymodel.Source(
+            name=nm, ra=0, dec=0, ll=ll, mm=mm,
+            nn=np.sqrt(max(1 - ll * ll - mm * mm, 0.0)) - 1, sI=1.5,
+            sQ=0.0, sU=0.0, sV=0.0, sI0=1.0, sQ0=0, sU0=0, sV0=0,
+            spec_idx=0, spec_idx1=0, spec_idx2=0, f0=150e6)
+        clusters.append((m, 1, [nm]))
+    sky = skymodel.build_cluster_sky(srcs, clusters)
+    dsky = rp.sky_to_device(sky, jnp.float32)
+    n_sta, tilesz = 8, 3
+    Jtrue = ds.random_jones(sky.n_clusters, sky.nchunk, n_sta, seed=51,
+                            scale=0.15)
+    tile = ds.simulate_dataset(dsky, n_stations=n_sta, tilesz=tilesz,
+                               freqs=[150e6], ra0=0.1, dec0=0.9,
+                               jones=Jtrue, nchunk=sky.nchunk,
+                               noise_sigma=0.01, seed=52)
+    kmax = int(sky.nchunk.max())
+    cidx = np.asarray(rp.chunk_indices(tilesz, tile.nbase, sky.nchunk))
+    cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
+    xa = tile.averaged()
+    x8 = np.stack([xa.reshape(-1, 4).real, xa.reshape(-1, 4).imag],
+                  -1).reshape(-1, 8)
+    wt = np.asarray(lm_mod.make_weights(
+        jnp.asarray(tile.flags, jnp.int32), jnp.float32))
+    J0 = utils.jones_c2r_np(np.tile(
+        np.eye(2, dtype=complex), (sky.n_clusters, kmax, n_sta, 1, 1)))
+    B = tile.nrows
+    (x8p, up, vp, wp, s1p, s2p), wtp, bpad = parallel.pad_rows(
+        (x8, tile.u, tile.v, tile.w, tile.sta1, tile.sta2), wt, B, 4)
+    cidxp = np.concatenate(
+        [cidx, np.zeros((sky.n_clusters, bpad - B), cidx.dtype)],
+        axis=1)
+    ts = np.asarray(ds.row_tslot(B, tile.nbase))
+    ts_p = np.concatenate([ts, np.zeros(bpad - B, ts.dtype)])
+    freq = np.array([tile.freq0])
+    mesh = parallel.base_mesh(4)
+    repl = NamedSharding(mesh, P())
+
+    res = {}
+    for policy in ("f32", "bf16"):
+        cfg = sage.SageConfig(max_emiter=1, max_iter=4, max_lbfgs=2,
+                              solver_mode=int(SolverMode.LM_LBFGS),
+                              dtype_policy=policy)
+        solve = parallel.sharded_sagefit(mesh, dsky, tile.fdelta,
+                                         cmask, n_sta, config=cfg)
+        sd = dtp.storage_np(policy, np.float32)
+        args = parallel.shard_rows(
+            mesh, np.asarray(x8p, sd),
+            *[np.asarray(a, np.float32) for a in (up, vp, wp)],
+            s1p, s2p)
+        if policy == "bf16":
+            assert args[0].dtype == jnp.bfloat16     # melt ACTIVE
+        (cidx_d,) = parallel.shard_rows(mesh, cidxp, row_axis=1)
+        (wt_d,) = parallel.shard_rows(mesh, np.asarray(wtp, sd))
+        (os_d,) = parallel.shard_rows(mesh, np.zeros(bpad, np.int32))
+        (ts_d,) = parallel.shard_rows(mesh, ts_p)
+        J, r0, r1, mnu = solve(
+            *args, cidx_d, wt_d,
+            jax.device_put(jnp.asarray(J0, jnp.float32), repl),
+            jax.device_put(jnp.asarray(freq, jnp.float32), repl),
+            os_d, jax.device_put(jax.random.PRNGKey(7), repl),
+            ts_d, None)
+        r0, r1 = float(r0), float(r1)
+        assert np.isfinite(r1) and r1 < r0
+        res[policy] = r1
+    drift = abs(res["bf16"] - res["f32"]) / res["f32"]
+    assert drift < ENVELOPE["bf16"], drift
+
+
+def test_pipeline_sharded_no_f32_fallback(tmp_path):
+    """FullBatchPipeline(shard_baselines=True, dtype_policy="bf16")
+    keeps the policy: no "policy-exempt" fallback log line, sdt is the
+    storage dtype (the acceptance criterion's "no f32-fallback log
+    line")."""
+    import math
+    from sagecal_tpu import pipeline, skymodel
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.rime import predict as rp
+    from sagecal_tpu.serve.api import config_from_dict
+
+    sky_path = tmp_path / "sky.txt"
+    sky_path.write_text(
+        "P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6\n")
+    (tmp_path / "sky.txt.cluster").write_text("0 1 P0A\n")
+    ra0 = (41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(sky_path), ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(
+            str(tmp_path / "sky.txt.cluster")))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jt = ds.random_jones(1, sky.nchunk, 5, seed=5, scale=0.1)
+    tiles = [ds.simulate_dataset(
+        dsky, n_stations=5, tilesz=2, freqs=np.array([150e6]), ra0=ra0,
+        dec0=dec0, jones=Jt, nchunk=sky.nchunk, noise_sigma=0.01,
+        seed=11)]
+    msdir = tmp_path / "a.ms"
+    ds.SimMS.create(str(msdir), tiles)
+    cfg = config_from_dict(dict(
+        ms=str(msdir), sky_model=str(sky_path),
+        cluster_file=str(tmp_path / "sky.txt.cluster"),
+        solver_mode=0, max_em_iter=1, max_iter=2, max_lbfgs=0,
+        tile_size=2, shard_baselines=True, dtype_policy="bf16"))
+    logs = []
+    pipe = pipeline.FullBatchPipeline(cfg, ds.SimMS(str(msdir)), sky,
+                                      log=logs.append)
+    assert not any("policy-exempt" in str(line) for line in logs)
+    assert pipe.dtype_policy == "bf16"
+    assert pipe.sdt == jnp.dtype(jnp.bfloat16)
+
+
+# ---------------------------------------------------------------------------
 # traffic: the priced config-1 trip melts >= 30% under bf16
 # ---------------------------------------------------------------------------
 

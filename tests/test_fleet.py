@@ -418,3 +418,47 @@ def test_fleet_loadgen_replay_end_to_end(tmp_path):
         for x, y in zip(_corrected(row["ms"]), res):
             assert np.array_equal(x, y)
         assert open(row["solutions"]).read() == sol_text
+
+
+def test_mesh_span_surfaces_in_fleet_view():
+    """ISSUE 14 satellite: an mpi/mesh job stays opaque, but the
+    device span of its consensus mesh is no longer invisible — the
+    span registry is fed under the job scope (cli_mpi.note_mesh path),
+    and the scheduler's metrics list the job under EVERY device its
+    mesh covers, plus a metrics-level mesh_spans map. Cleared when the
+    job finishes."""
+    from jax.sharding import Mesh
+    from sagecal_tpu.serve import scheduler as sched_mod
+
+    # outside any job scope: a no-op (solo CLI runs never register)
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("freq",))
+    fleet.note_mesh(mesh2)
+    assert "j-mesh" not in fleet.mesh_spans()
+
+    with fleet.job_scope("j-mesh"):
+        assert fleet.current_job() == "j-mesh"
+        fleet.note_mesh(mesh2)
+    assert fleet.current_job() is None
+    spans = fleet.mesh_spans()
+    assert spans["j-mesh"]["devices"] == [str(d) for d in
+                                          jax.devices()[:2]]
+    assert spans["j-mesh"]["axes"] == ["freq"]
+
+    try:
+        q = jq.JobQueue(max_inflight=2, max_staged_bytes=1 << 30)
+        sch = sched_mod.Scheduler(
+            q, log=lambda *a: None,
+            devices=fleet.fleet_devices(2))
+        m = sch.metrics()
+        assert m["mesh_spans"]["j-mesh"]["shape"] == [2]
+        by_dev = {d["device"]: d for d in m["devices"]}
+        assert by_dev[0]["mesh_jobs"] == ["j-mesh"]
+        assert by_dev[1]["mesh_jobs"] == ["j-mesh"]
+    finally:
+        fleet.clear_mesh_span("j-mesh")
+    assert "j-mesh" not in fleet.mesh_spans()
+    # registry empty again: snapshots stop carrying the key (the PR 8
+    # metrics surface is unchanged when no mesh job is live)
+    m = sch.metrics()
+    assert "mesh_spans" not in m
+    assert all("mesh_jobs" not in d for d in m["devices"])

@@ -522,6 +522,63 @@ def test_sentinel_fleet_cross_round(tmp_path):
     assert sentinel.load_fleet_banks("tpu", d) == []
 
 
+def _write_mesh_bank(dirpath, rnd, rec, platform="cpu"):
+    with open(os.path.join(dirpath, f"MESH2D_r{rnd:02d}.json"),
+              "w") as f:
+        json.dump({"platform": platform, "date": "2026-08-04",
+                   "results": {"10-mesh2d-northstar": rec}}, f)
+
+
+def _mesh_rec(**kw):
+    rec = dict(wall_per_admm_iter_s=12.0,
+               collective_overhead_frac=0.001, parity_ok=1,
+               shape="mesh test")
+    rec.update(kw)
+    return rec
+
+
+def test_sentinel_mesh_cross_round(tmp_path):
+    """ISSUE 14 satellite: the 2-D mesh bank (MESH2D_rNN.json) is
+    judged like the FLEET bank — newest pair, named metric,
+    improvements never fail; a regressed wall/iter, a fattened
+    collective-overhead fraction, or a LOST residual-parity flag
+    fails with the metric named."""
+    d = str(tmp_path)
+    _write_mesh_bank(d, 13, _mesh_rec())
+    assert sentinel.mesh_cross_round_check("cpu", d) == []
+    _write_mesh_bank(d, 14, _mesh_rec(wall_per_admm_iter_s=10.0))
+    assert sentinel.mesh_cross_round_check("cpu", d) == []
+    _write_mesh_bank(d, 15, _mesh_rec(wall_per_admm_iter_s=20.0))
+    v = sentinel.mesh_cross_round_check("cpu", d)
+    assert len(v) == 1 and v[0]["metric"] == "mesh_wall"
+    assert "MESH2D r15" in v[0]["msg"]
+    _write_mesh_bank(d, 16, _mesh_rec(wall_per_admm_iter_s=10.0,
+                                      parity_ok=0,
+                                      collective_overhead_frac=0.2))
+    v = sentinel.mesh_cross_round_check("cpu", d)
+    assert {x["metric"] for x in v} == {"mesh_parity",
+                                        "mesh_collective"}
+    assert sentinel.load_mesh_banks("tpu", d) == []
+
+
+def test_sentinel_mesh_committed_bank_loads():
+    """The committed MESH2D round parses, declares its platform,
+    carries every toleranced field, banked with parity OK, a bf16
+    (non-fallback) dtype policy, and the staleness experiment's
+    convergence delta as numbers."""
+    banks = sentinel.load_mesh_banks("cpu", REPO)
+    assert banks, "no committed MESH2D_rNN.json"
+    rec = banks[-1][2]["10-mesh2d-northstar"]
+    for spec in sentinel.MESH_TOLERANCES.values():
+        assert spec["field"] in rec, spec["field"]
+    assert rec["parity_ok"] == 1
+    assert rec["dtype_policy"] != "f32" and not rec["f32_fallback"]
+    st = rec["staleness"]
+    assert st["skipped_solves"] > 0
+    assert "convergence_delta_rel_mean" in st
+    assert st["stale_still_falling"] is True
+
+
 def test_sentinel_fleet_committed_bank_loads():
     """The committed FLEET round parses, declares its platform, and
     carries every toleranced field (a renamed bench field can never

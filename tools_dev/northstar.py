@@ -525,6 +525,430 @@ def multichip(args):
     return 0
 
 
+def mesh2d(args):
+    """ISSUE 14 tentpole evidence: the north-star ADMM shape on a
+    VIRTUAL 2-D ``(freq, time)`` CPU mesh — subbands shard on the freq
+    axis, solution intervals on the time axis, the whole observation
+    ONE SPMD program (admm.make_admm_runner_2d). Banks a round-stamped
+    ``MESH2D_rNN.json`` (bench.stamp_family; judged by the sentinel's
+    MESH_TOLERANCES) holding, all measured:
+
+    - per-ADMM-iteration wall on the warm mesh leg + the consensus
+      half timed as its OWN 2-D mesh program (the collective-overhead
+      fraction — MULTICHIP precedent, now with a time axis);
+    - residual PARITY vs the sequential warm-start chain at the same
+      shape/policy, gated AT BANK TIME: the time-shard-0 prefix must
+      match tightly (same solve programs, no seam), the cold-seam
+      intervals must stay within a stated ratio and keep falling — a
+      failed gate refuses to write the record and exits non-zero;
+    - the dtype policy ACTIVE on the sharded path (default bf16 —
+      storage-dtype [B]-traffic through the mesh programs, no
+      f32-fallback anywhere), with the bf16-vs-f32 residual drift of
+      a matched mesh pair inside bench.DTYPE_DRIFT_ENVELOPE;
+    - a bounded-staleness leg (admm.make_admm_runner_stale composed
+      with the faults harness): one injected slow subband under
+      ``--staleness`` S, banked NEXT TO its synchronous baseline with
+      the per-subband convergence delta as numbers in the record.
+
+    CPU wall-clock honesty: virtual devices share one host core, so
+    the walls measure program structure + collective overhead, not
+    compute scaling — the compute verdict awaits a TPU window (the
+    full 64x100x32 defaults are wired for it; the CPU-banked shape is
+    stated in the record, MULTICHIP r06 precedent)."""
+    import os as _os
+    ndev = args.devices_f * args.devices_t
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = _os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        _os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{ndev}").strip()
+    import bench as _bench
+    _os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                           _bench.compile_cache_dir("cpu"))
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", ndev)
+    except Exception:
+        pass
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from sagecal_tpu import faults, utils
+    from sagecal_tpu.consensus import admm as cadmm
+    from sagecal_tpu.consensus import poly as cpoly
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.rime import predict as rp
+    from sagecal_tpu.solvers import lm as lm_mod, sage
+
+    assert len(jax.devices()) >= ndev, jax.devices()
+    n_sta, n_dir = args.stations, args.dirs
+    F, T = args.subbands, args.intervals
+    ndev_f, ndev_t = args.devices_f, args.devices_t
+    if F % ndev_f or T % ndev_t:
+        raise SystemExit(f"F={F} and T={T} must divide the "
+                         f"{ndev_f}x{ndev_t} mesh")
+    policy = args.dtype_policy
+    sky = _northstar_sky(n_sta, n_dir)
+    dsky = rp.sky_to_device(sky, jnp.float32)
+    kmax = int(sky.nchunk.max())
+    cmask = np.arange(kmax)[None, :] < sky.nchunk[:, None]
+    Jbase = ds.random_jones(n_dir, sky.nchunk, n_sta, seed=6, scale=0.15)
+    slope = (ds.random_jones(n_dir, sky.nchunk, n_sta, seed=7,
+                             scale=0.04) - np.eye(2))
+    freqs = 120e6 * (1 + 0.004 * np.arange(F))
+    print(f"mesh2d: generating {F} subbands x {T} intervals "
+          f"(N={n_sta} M={n_dir} tilesz={args.tilesz})", flush=True)
+    tiles = []
+    for f_i in range(F):
+        Jf = Jbase + slope * (freqs[f_i] - 120e6) / 120e6
+        tiles.append([ds.simulate_dataset(
+            dsky, n_stations=n_sta, tilesz=args.tilesz,
+            freqs=[freqs[f_i]], ra0=1.2, dec0=0.7, jones=Jf,
+            nchunk=sky.nchunk, noise_sigma=0.02, seed=20 + f_i + 97 * t)
+            for t in range(T)])
+        if (f_i + 1) % 8 == 0:
+            print(f"  subband {f_i + 1}/{F} generated", flush=True)
+    tile = tiles[0][0]
+    B = tile.nrows
+    cidx = rp.chunk_indices(args.tilesz, tile.nbase, sky.nchunk)
+    Bpoly_full = cpoly.setup_polynomials(freqs, float(freqs.mean()), 2, 2)
+
+    def x8_of(t):
+        xa = np.asarray(t.averaged())
+        return np.stack([xa.reshape(-1, 4).real,
+                         xa.reshape(-1, 4).imag], -1).reshape(-1, 8)
+
+    def sd_np(pol):
+        from sagecal_tpu import dtypes as dtp
+        return dtp.storage_np(pol, np.float32)
+
+    def inputs_ft(F_use, pol):
+        """[F_use, T, ...] host inputs with the [B]-traffic staged in
+        the policy storage dtype (the active-under-sharding melt)."""
+        sd = sd_np(pol)
+        x8 = np.stack([np.stack([x8_of(tiles[f][t]) for t in range(T)])
+                       for f in range(F_use)]).astype(sd)
+        u = np.stack([np.stack([tiles[f][t].u for t in range(T)])
+                      for f in range(F_use)]).astype(np.float32)
+        v = np.stack([np.stack([tiles[f][t].v for t in range(T)])
+                      for f in range(F_use)]).astype(np.float32)
+        w = np.stack([np.stack([tiles[f][t].w for t in range(T)])
+                      for f in range(F_use)]).astype(np.float32)
+        wt = np.stack([np.stack([np.asarray(lm_mod.make_weights(
+            jnp.asarray(tiles[f][t].flags, jnp.int32), jnp.float32))
+            for t in range(T)]) for f in range(F_use)]).astype(sd)
+        fr = np.ones((F_use, T), np.float32)
+        J0 = np.zeros((F_use, n_dir, kmax, n_sta, 8), np.float32)
+        J0[..., 0] = 1.0
+        J0[..., 6] = 1.0
+        return x8, u, v, w, wt, fr, J0
+
+    def cfg_for(pol, n_admm):
+        return cadmm.ADMMConfig(
+            n_admm=n_admm, npoly=2, rho=5.0, manifold_iters=5,
+            sage=sage.SageConfig(
+                max_emiter=1, max_iter=args.maxit, max_lbfgs=0,
+                solver_mode=args.solver, nbase=tile.nbase,
+                inner="chol" if args.inner == "both" else args.inner,
+                kernel="xla" if args.kernel == "both" else args.kernel,
+                dtype_policy=pol))
+
+    partial = {}
+
+    def checkpoint(tag, data):
+        partial[tag] = data
+        with open("/tmp/mesh2d_partial.json", "w") as f:
+            json.dump(partial, f, indent=1, default=float)
+        print(f"mesh2d: leg {tag} done", flush=True)
+
+    def res_fin_of(out, n_admm):
+        r1sT = np.asarray(out[5])               # [T, n_admm-1, F]
+        return (r1sT[:, -1, :] if n_admm > 1
+                else np.asarray(out[4]))        # [T, F]
+
+    def mesh_leg(F_use, nf_f, pol, tag, warm: bool):
+        mesh = Mesh(np.array(jax.devices()[:nf_f * ndev_t]).reshape(
+            nf_f, ndev_t), ("freq", "time"))
+        timer = []
+        Bp = cpoly.setup_polynomials(freqs[:F_use],
+                                     float(freqs[:F_use].mean()), 2, 2)
+        runner = cadmm.make_admm_runner_2d(
+            dsky, tile.sta1, tile.sta2, cidx, cmask, n_sta, tile.fdelta,
+            Bp, cfg_for(pol, args.admm), mesh, F_use, T,
+            nbase=tile.nbase, host_loop=True, timer=timer)
+        ins = inputs_ft(F_use, pol)
+        x8, u, v, w, wt, fr, J0 = ins
+        t0 = time.time()
+        out = runner(x8, u, v, w, freqs[:F_use], wt, fr, J0)
+        cold_s = time.time() - t0
+        cold_waves = [s for _, s in timer]
+        print(f"mesh2d: leg {tag} cold run {cold_s:.1f}s "
+              f"(waves {[round(s, 1) for s in cold_waves]})",
+              flush=True)
+        warm_waves = None
+        if warm:
+            timer.clear()
+            t0 = time.time()
+            out = runner(x8, u, v, w, freqs[:F_use], wt, fr, J0)
+            warm_waves = [s for _, s in timer]
+            print(f"mesh2d: leg {tag} warm run {time.time() - t0:.1f}s",
+                  flush=True)
+        rfin = res_fin_of(out, args.admm)
+        res0 = np.asarray(out[3])
+        falling = bool(np.all(np.isfinite(rfin))
+                       and np.all(rfin < res0))
+        leg = {"mesh": [nf_f, ndev_t], "policy": pol,
+               "cold_total_s": round(cold_s, 1),
+               "cold_wave_s": [round(s, 2) for s in cold_waves],
+               "warm_wave_s": ([round(s, 2) for s in warm_waves]
+                               if warm_waves else None),
+               "res0": res0.round(6).tolist(),
+               "res_fin": rfin.round(6).tolist(),
+               "residuals_falling": falling}
+        checkpoint(tag, leg)
+        return runner, out, leg
+
+    # ---- leg A: the headline 2-D mesh run, warm-timed, policy active
+    runner_a, out_a, leg_a = mesh_leg(F, ndev_f, policy, "mesh", True)
+    n_it = max(args.admm, 1)
+    warm_wave = float(np.median(leg_a["warm_wave_s"]))
+    wall_per_iter = warm_wave / n_it
+
+    # ---- consensus-overhead probe: body_post as its own 2-D mesh
+    # program on dummy carries (multichip precedent)
+    Ppoly = Bpoly_full.shape[1]
+    f32 = jnp.float32
+    mesh_a = Mesh(np.array(jax.devices()[:ndev_f * ndev_t]).reshape(
+        ndev_f, ndev_t), ("freq", "time"))
+    sh_f = NamedSharding(mesh_a, P("freq"))
+    sh_r = NamedSharding(mesh_a, P())
+    mk = (F, n_dir, kmax, n_sta, 8)
+    zshape = (n_dir, Ppoly, kmax, n_sta, 8)
+    carry_shapes = [(mk, sh_f), (mk, sh_f), (zshape, sh_r),
+                    ((F, n_dir), sh_f), (mk, sh_f), (mk, sh_f),
+                    (zshape, sh_r), (zshape, sh_r), ((F, n_dir), sh_f)]
+    carry0 = [jax.device_put(jnp.full(shp, 0.01, f32), s)
+              for shp, s in carry_shapes]
+    carry0[3] = jax.device_put(jnp.full((F, n_dir), 5.0, f32), sh_f)
+    carry0[8] = carry0[3]
+    Jr = jax.device_put(jnp.full(mk, 0.01, f32), sh_f)
+    r0d = jax.device_put(jnp.zeros((F,), f32), sh_f)
+    cons = runner_a.consensus_program
+    it1 = jnp.asarray(1, jnp.int32)
+    o = cons(Jr, r0d, r0d, *carry0, it1)
+    jax.block_until_ready(o[0])
+    cons_times = []
+    for _ in range(max(args.reps, 2)):
+        t0 = time.time()
+        o = cons(Jr, r0d, r0d, *carry0, it1)
+        jax.block_until_ready(o[0])
+        cons_times.append(time.time() - t0)
+    cons_s = float(np.median(cons_times))
+    checkpoint("consensus", {"consensus_only_s": cons_s})
+
+    # ---- leg B: the sequential warm-start chain at the SAME shape,
+    # policy and per-device subband width (the parity reference)
+    mesh_seq = Mesh(np.array(jax.devices()[:ndev_f]), ("freq",))
+    runner_s = cadmm.make_admm_runner(
+        dsky, tile.sta1, tile.sta2, cidx, cmask, n_sta, tile.fdelta,
+        Bpoly_full, cfg_for(policy, args.admm), mesh_seq, F,
+        host_loop=True, nbase=tile.nbase)
+    x8, u, v, w, wt, fr, J0 = inputs_ft(F, policy)
+    sh_seq = NamedSharding(mesh_seq, P("freq"))
+    Jc = J0.copy()
+    seq_fin = np.zeros((T, F))
+    for t in range(T):
+        argsd = [jax.device_put(jnp.asarray(a), sh_seq) for a in
+                 (x8[:, t], u[:, t], v[:, t], w[:, t],
+                  freqs.astype(np.float32), wt[:, t], fr[:, t], Jc)]
+        o = runner_s(*argsd)
+        Jf, r0, r1 = (np.asarray(o[0]), np.asarray(o[3]),
+                      np.asarray(o[4]))
+        r1s = np.asarray(o[5])
+        rfin = r1s[-1] if args.admm > 1 else r1
+        seq_fin[t] = rfin
+        bad = (~np.isfinite(rfin)) | (rfin == 0) | (rfin > 5 * r0)
+        Jc = np.where(bad[:, None, None, None, None], J0, Jf).astype(
+            np.float32)
+    checkpoint("seq", {"res_fin": seq_fin.round(6).tolist()})
+
+    # ---- parity gate (AT BANK TIME). Two claims, separately gated:
+    # (a) PREFIX parity — time-shard 0's interval block has no seam
+    #     (identical warm chain), so the 2-D program must reproduce
+    #     the sequential chain tightly there: same math, different
+    #     execution plan;
+    # (b) SEAM parity — the first interval of every later time shard
+    #     is a COLD start by construction, so its converged residual
+    #     is compared to the chain's own cold interval (interval 0),
+    #     which is its like-for-like reference: a seam interval
+    #     landing well off the cold level means the seam broke the
+    #     solve, not just forwent the warm start. The warm-start
+    #     advantage the seam gives up is REPORTED as its own number
+    #     (seam_vs_warm_ratio), not gated — it is the measured price
+    #     of time-parallelism at this iteration budget.
+    mesh_fin = np.asarray(leg_a["res_fin"])     # [T, F]
+    Tl = T // ndev_t
+    prefix = slice(0, Tl)                       # time-shard 0 == chain
+    prefix_rel = float(np.max(
+        np.abs(mesh_fin[prefix] - seq_fin[prefix])
+        / np.maximum(seq_fin[prefix], 1e-12)))
+    # the cold seam is the FIRST interval of each later time shard
+    # (intervals Tl, 2*Tl, ...); later intervals of those shards are
+    # warm again within their block and are not gated
+    seam = slice(Tl, None, Tl)
+    seam_vs_warm = float(np.mean(
+        mesh_fin[seam] / np.maximum(seq_fin[seam], 1e-12)))
+    cold_ref = np.mean(seq_fin[0])              # the chain's own cold
+    seam_vs_cold = float(np.mean(mesh_fin[seam]) / max(cold_ref,
+                                                       1e-12))
+    band = args.parity_seam_ratio
+    parity_ok = (prefix_rel < args.parity_prefix_rel
+                 and 1.0 / band <= seam_vs_cold <= band
+                 and leg_a["residuals_falling"])
+    checkpoint("parity", {"prefix_max_rel": prefix_rel,
+                          "seam_vs_cold_ratio": seam_vs_cold,
+                          "seam_vs_warm_ratio": seam_vs_warm,
+                          "parity_ok": parity_ok})
+
+    # ---- dtype drift: a matched mesh pair (bf16 vs f32) at a reduced
+    # subband count — same program structure, only the storage dtype
+    # differs; must sit inside the banked envelope
+    drift = None
+    if policy != "f32":
+        Fd = min(F, args.drift_subbands)
+        nf_d = max(1, min(ndev_f, Fd))
+        while Fd % nf_d:
+            nf_d -= 1
+        _, out_f32, leg_f32 = mesh_leg(Fd, nf_d, "f32", "drift-f32",
+                                       False)
+        _, out_red, leg_red = mesh_leg(Fd, nf_d, policy,
+                                       f"drift-{policy}", False)
+        rf = np.asarray(leg_f32["res_fin"])
+        rr_ = np.asarray(leg_red["res_fin"])
+        envelope = _bench.DTYPE_DRIFT_ENVELOPE.get(policy, 0.25)
+        drift = {"subbands": Fd, "policy": policy,
+                 "rel_mean": float(np.mean(np.abs(rr_ - rf)
+                                           / np.maximum(rf, 1e-12))),
+                 "rel_max": float(np.max(np.abs(rr_ - rf)
+                                         / np.maximum(rf, 1e-12))),
+                 "envelope": envelope}
+        drift["inside_envelope"] = bool(
+            drift["rel_mean"] <= envelope)
+        checkpoint("drift", drift)
+
+    # ---- bounded-staleness experiment: sync baseline vs one injected
+    # slow subband, SAME runner/programs, convergence delta in numbers
+    Fs = min(F, args.stale_subbands)
+    Bst = cpoly.setup_polynomials(freqs[:Fs],
+                                  float(freqs[:Fs].mean()), 2, 2)
+    cfg_st = cfg_for(policy, args.stale_admm)
+    x8a, ua, va, wa, wta, fra, J0a = inputs_ft(Fs, policy)
+    st_args = tuple(jnp.asarray(a) for a in
+                    (x8a[:, 0], ua[:, 0], va[:, 0], wa[:, 0],
+                     freqs[:Fs].astype(np.float32), wta[:, 0],
+                     fra[:, 0], J0a))
+
+    def stale_leg(plan):
+        if plan:
+            faults.enable(plan)
+        try:
+            run = cadmm.make_admm_runner_stale(
+                dsky, tile.sta1, tile.sta2, cidx, cmask, n_sta,
+                tile.fdelta, Bst, cfg_st, Fs,
+                staleness=args.staleness, nbase=tile.nbase)
+            t0 = time.time()
+            out = run(*st_args)
+            wall = time.time() - t0
+            rfin = (np.asarray(out[5])[-1] if args.stale_admm > 1
+                    else np.asarray(out[4]))
+            return (rfin, np.asarray(out[3]), wall,
+                    [m.tolist() for m in run.schedule[0]])
+        finally:
+            if plan:
+                faults.disable()
+
+    sync_fin, sync_r0, sync_wall, _ = stale_leg(None)
+    slow_plan = [{"point": "admm_subband_slow",
+                  "at": [args.slow_subband],
+                  "times": args.slow_rounds}]
+    stale_fin, stale_r0, stale_wall, sched = stale_leg(slow_plan)
+    skipped = int(sum(1 - np.asarray(m)[args.slow_subband]
+                      for m in sched))
+    st_delta = np.abs(stale_fin - sync_fin) / np.maximum(sync_fin,
+                                                         1e-12)
+    stale_rec = {
+        "shape": f"N={n_sta} M={n_dir} F={Fs} tilesz={args.tilesz} "
+                 f"x{args.stale_admm}it interval0 {policy}",
+        "staleness_S": args.staleness,
+        "slow_subband": args.slow_subband,
+        "slow_rounds_injected": args.slow_rounds,
+        "skipped_solves": skipped,
+        "schedule": sched,
+        "sync_final_res": sync_fin.round(6).tolist(),
+        "stale_final_res": stale_fin.round(6).tolist(),
+        "convergence_delta_rel": st_delta.round(4).tolist(),
+        "convergence_delta_rel_mean": float(st_delta.mean()),
+        "convergence_delta_rel_slow_subband":
+            float(st_delta[args.slow_subband]),
+        "stale_still_falling": bool(
+            np.all(np.isfinite(stale_fin))
+            and np.all(stale_fin < stale_r0)),
+        "sync_wall_s": round(sync_wall, 1),
+        "stale_wall_s": round(stale_wall, 1),
+    }
+    checkpoint("staleness", stale_rec)
+
+    rec = {
+        "metric": "north-star ADMM on virtual 2-D (freq x time) mesh",
+        "measured": True,
+        "shape": f"N={n_sta} M={n_dir} F={F} T={T} B={B} "
+                 f"tilesz={args.tilesz} mesh={ndev_f}x{ndev_t} "
+                 f"-j{args.solver} -g {args.maxit} x{args.admm}it "
+                 f"{policy} wavefront",
+        "platform_detail": "cpu-virtual-mesh (one host core: walls "
+                           "measure program structure + collective "
+                           "overhead, not compute scaling; TPU "
+                           "verdict awaits a chip window)",
+        "n_devices": ndev_f * ndev_t,
+        "mesh_devices": [ndev_f, ndev_t],
+        "dtype_policy": policy,
+        "f32_fallback": False,
+        "compile_plus_cold_total_s": leg_a["cold_total_s"],
+        "cold_wave_s": leg_a["cold_wave_s"],
+        "warm_wave_s": leg_a["warm_wave_s"],
+        "wall_per_admm_iter_s": round(wall_per_iter, 3),
+        "consensus_only_s": round(cons_s, 4),
+        "collective_overhead_frac": round(cons_s / wall_per_iter, 6),
+        "res0": leg_a["res0"],
+        "res_fin": leg_a["res_fin"],
+        "residuals_falling_all_subbands": leg_a["residuals_falling"],
+        "seq_res_fin": seq_fin.round(6).tolist(),
+        "parity": {"vs": "sequential warm-start chain, same "
+                         "shape/policy/subband-width",
+                   "prefix_intervals": Tl,
+                   "prefix_max_rel": round(prefix_rel, 6),
+                   "prefix_gate": args.parity_prefix_rel,
+                   "seam_vs_cold_ratio": round(seam_vs_cold, 4),
+                   "seam_gate_band": args.parity_seam_ratio,
+                   "seam_vs_warm_ratio": round(seam_vs_warm, 4)},
+        "parity_ok": 1 if parity_ok else 0,
+        "dtype_drift": drift,
+        "staleness": stale_rec,
+    }
+    if not parity_ok:
+        print("mesh2d: PARITY GATE FAILED — record NOT banked:\n"
+              + json.dumps(rec["parity"], indent=1), file=sys.stderr)
+        with open("/tmp/mesh2d_FAILED.json", "w") as f:
+            json.dump(rec, f, indent=1, default=float)
+        return 1
+    path = _bench.stamp_family(rec, "cpu", "MESH2D",
+                               "10-mesh2d-northstar", first_round=13)
+    print(f"mesh2d: banked {os.path.basename(path)}")
+    print(json.dumps(rec))
+    return 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")
@@ -565,6 +989,50 @@ def main():
                          "collective-overhead record (MULTICHIP_rNN)")
     ap.add_argument("--devices", type=int, default=8,
                     help="virtual device count for --multichip")
+    ap.add_argument("--mesh2d", action="store_true",
+                    help="run the ADMM shape on a virtual 2-D "
+                         "(freq x time) mesh: warm-timed wavefronts, "
+                         "consensus-overhead probe, sequential-chain "
+                         "parity gate, dtype-drift pair and the "
+                         "bounded-staleness experiment; banks "
+                         "MESH2D_rNN.json (ISSUE 14)")
+    ap.add_argument("--devices-f", type=int, default=8,
+                    help="freq-axis device count for --mesh2d")
+    ap.add_argument("--devices-t", type=int, default=2,
+                    help="time-axis device count for --mesh2d")
+    ap.add_argument("--intervals", type=int, default=2,
+                    help="solution intervals (time-axis extent) for "
+                         "--mesh2d")
+    ap.add_argument("--maxit", type=int, default=2,
+                    help="solver max_iter (-g) for --mesh2d")
+    ap.add_argument("--dtype-policy", choices=("f32", "bf16", "f16"),
+                    default="bf16",
+                    help="--mesh2d storage dtype policy (bf16 default: "
+                         "the melt must be ACTIVE under sharding)")
+    ap.add_argument("--drift-subbands", type=int, default=8,
+                    help="subband count of the --mesh2d bf16-vs-f32 "
+                         "drift pair")
+    ap.add_argument("--parity-prefix-rel", type=float, default=2e-2,
+                    help="--mesh2d bank gate: max rel final-residual "
+                         "diff vs the sequential chain on the "
+                         "time-shard-0 prefix (no seam there)")
+    ap.add_argument("--parity-seam-ratio", type=float, default=1.5,
+                    help="--mesh2d bank gate: band (ratio and its "
+                         "inverse) the cold-seam intervals' mean "
+                         "residual must sit in vs the chain's own "
+                         "COLD interval level (like-for-like); the "
+                         "forgone warm-start advantage is reported, "
+                         "not gated")
+    ap.add_argument("--staleness", type=int, default=2,
+                    help="--mesh2d bounded-staleness S")
+    ap.add_argument("--stale-subbands", type=int, default=8,
+                    help="subband count of the --mesh2d staleness legs")
+    ap.add_argument("--stale-admm", type=int, default=4,
+                    help="ADMM iterations of the staleness legs")
+    ap.add_argument("--slow-subband", type=int, default=1,
+                    help="subband the admm_subband_slow fault targets")
+    ap.add_argument("--slow-rounds", type=int, default=2,
+                    help="rounds the injected slow subband straggles")
     ap.add_argument("--reps", type=int, default=3,
                     help="warm sweep timings per shape (--b-scaling)")
     args = ap.parse_args()
@@ -585,6 +1053,8 @@ def main():
         return b_scaling(args)
     if args.multichip:
         return multichip(args)
+    if args.mesh2d:
+        return mesh2d(args)
 
     workdir = args.keep or tempfile.mkdtemp(prefix="northstar_")
     os.makedirs(workdir, exist_ok=True)

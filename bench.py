@@ -1942,7 +1942,8 @@ def config8_serve(device, dtype):
 
 
 def stamp_family(rec: dict, platform: str, family: str,
-                 config_name: str, first_round: int) -> str:
+                 config_name: str, first_round: int,
+                 bank_dir: str | None = None) -> str:
     """Round-stamp one record of a standalone record family
     (``<FAMILY>_rNN.json`` — the BSCALING/MULTICHIP precedent: its own
     filename series, judged by the sentinel's family tolerances
@@ -1950,14 +1951,43 @@ def stamp_family(rec: dict, platform: str, family: str,
     round of the family, starting at ``first_round`` (the PR round
     that introduced it). Never overwrites an existing round; the
     sentinel's loaders read the ``{"platform", "results": {name:
-    rec}}`` envelope written here."""
+    rec}}`` envelope written here.
+
+    Family names are EXACT-MATCH: ``[A-Z][A-Z0-9]*`` only (an
+    underscore would make ``<FAMILY>_rNN`` unparseable), and a name
+    that is a prefix of — or prefixed by — a family already banked in
+    ``bank_dir`` is REFUSED: the PR 14 round landed a stray
+    ``MESH_r13.json`` next to ``MESH2D_r13.json``, and two families
+    whose names nest are one typo away from cross-reading each
+    other's rounds (regression-gated in tests/test_router.py)."""
     import glob as _glob
     import re as _re
-    rounds = [int(m.group(1)) for p in
-              _glob.glob(os.path.join(HERE, f"{family}_r*.json"))
-              if (m := _re.search(r"_r(\d+)\.json$", p))]
+    bank_dir = bank_dir or HERE
+    if not _re.fullmatch(r"[A-Z][A-Z0-9]*", family):
+        raise ValueError(
+            f"stamp_family: family {family!r} must match "
+            "[A-Z][A-Z0-9]* (no underscores — '_rNN' is the round "
+            "separator)")
+    on_disk = set()
+    for p in _glob.glob(os.path.join(bank_dir, "*_r[0-9]*.json")):
+        m = _re.fullmatch(r"([A-Z][A-Z0-9]*)_r(\d+)\.json",
+                          os.path.basename(p))
+        if m:
+            on_disk.add(m.group(1))
+    for other in sorted(on_disk):
+        if other != family and (other.startswith(family)
+                                or family.startswith(other)):
+            raise ValueError(
+                f"stamp_family: family {family!r} prefix-collides "
+                f"with banked family {other!r}; pick a name neither "
+                "prefixes")
+    rounds = [int(m.group(2)) for p in
+              _glob.glob(os.path.join(bank_dir, f"{family}_r*.json"))
+              if (m := _re.fullmatch(
+                  r"([A-Z][A-Z0-9]*)_r(\d+)\.json",
+                  os.path.basename(p))) and m.group(1) == family]
     nn = max(rounds, default=first_round - 1) + 1
-    path = os.path.join(HERE, f"{family}_r{nn:02d}.json")
+    path = os.path.join(bank_dir, f"{family}_r{nn:02d}.json")
     with open(path, "w") as f:
         json.dump({"platform": platform,
                    "date": time.strftime("%Y-%m-%d %H:%M:%S"),
@@ -2218,6 +2248,399 @@ def config9_fleet(device, dtype):
     return rec
 
 
+def _stamp_scaleout(rec: dict, platform: str) -> str:
+    """Round-stamp the cross-process scale-out record
+    (SCALEOUT_rNN.json; first round is 15 — the ISSUE 15 PR)."""
+    return stamp_family(rec, platform, "SCALEOUT", "10-scaleout",
+                        first_round=15)
+
+
+def config10_scaleout(device, dtype):
+    """Round-15 config: cross-process fleet scale-out (ISSUE 15).
+
+    The SAME seeded traffic replay as config 9 drives a ROUTER
+    (serve/router.py) fronting W = 1, 2, 4 real WORKER PROCESSES
+    (``python -m sagecal_tpu.serve --worker --router ...``), plus two
+    dedicated legs: a cross-process tile-boundary migration (the api
+    ``migrate`` op, cancel-at-boundary + shared-filesystem checkpoint
+    resume) and a worker-LOSS recovery (the ``worker_crash`` fault
+    point kills a worker mid-job; the router's lease eviction
+    re-queues its job onto the survivor as a resume). REFUSES to bank
+    unless every replay job's residuals + solutions are bit-identical
+    to a solo run of its template, and unless BOTH the migrated and
+    the recovered job re-ran ZERO completed tiles.
+
+    Measurement regime, stated honestly (the config 9 discipline one
+    level up): with per-tenant ingest pacing, throughput is bounded by
+    fleet-wide admission slots x stream rate, not solve FLOPs — the
+    regime where worker processes scale linearly and which a host with
+    few cores can measure without pretending its core count grew. The
+    host's real core count rides the record; on a genuinely multi-core
+    host the same config (pacing off) measures compute-bound process
+    scaling, and per-worker busy walls are recorded either way."""
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+    import jax
+    from sagecal_tpu import pipeline as pl
+    from sagecal_tpu.io import dataset as ds
+    from sagecal_tpu.serve import loadgen
+    from sagecal_tpu.serve.api import Client, config_from_dict
+    from sagecal_tpu.serve.router import Router
+
+    noop = (lambda *a: None)
+    tmpd = tempfile.mkdtemp(prefix="sagecal_scaleout_")
+    PACE = 0.5
+    N_TILES = 6
+    LEASE_S = 2.0
+    spec = {
+        "seed": 12, "n_jobs": 8,
+        "arrival": {"process": "burst"},
+        "templates": [
+            {"name": "bucket4", "weight": 1, "n_stations": 16,
+             "tilesz": 4, "n_tiles": N_TILES, "nchan": 24,
+             "config": {"tile_arrival_s": PACE, "prefetch": 0}},
+            {"name": "bucket6", "weight": 1, "n_stations": 16,
+             "tilesz": 6, "n_tiles": N_TILES, "nchan": 24,
+             "config": {"tile_arrival_s": PACE, "prefetch": 0}}]}
+    fixtures = loadgen.build_fixtures(spec, tmpd)
+    worker_env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def spawn_worker(rport, name, faults=None):
+        args = [_sys.executable, "-m", "sagecal_tpu.serve",
+                "--worker", "--router", f"127.0.0.1:{rport}",
+                "--port", "0", "--max-inflight", "2",
+                "--worker-id", name]
+        if faults:
+            args += ["--faults", faults]
+        logf = open(os.path.join(tmpd, f"{name}.log"), "w")
+        return subprocess.Popen(args, stdout=logf,
+                                stderr=subprocess.STDOUT,
+                                env=worker_env, cwd=HERE)
+
+    def wait_alive(r, n, timeout=240):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if r.metrics()["n_alive"] >= n:
+                return
+            time.sleep(0.1)
+        raise RuntimeError(f"fleet never reached {n} alive workers")
+
+    def stop_all(r, procs):
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        r.stop()
+
+    def run_topology(W):
+        """Router + W fresh worker processes; one settle replay (every
+        worker's programs compile OUTSIDE the timed legs), two timed
+        replays (min wall wins), per-worker cache-hit DELTAS across
+        the timed legs only. Returns (best, legs, cache, pipelining)."""
+        r = Router(port=0, lease_s=LEASE_S, heartbeat_s=0.4, log=noop)
+        r.start()
+        procs = [spawn_worker(r.port, f"w{W}_{i}") for i in range(W)]
+        legs = []
+        try:
+            wait_alive(r, W)
+            with Client(port=r.port) as c:
+                work = os.path.join(tmpd, f"settle_w{W}")
+                loadgen.replay(c, spec, fixtures, work, log=noop,
+                               drain=False, tag=f"s{W}")
+                m0 = c.metrics()
+                for rep in range(2):
+                    work = os.path.join(tmpd, f"leg_w{W}_{rep}")
+                    rec = loadgen.replay(c, spec, fixtures, work,
+                                         log=noop, drain=False,
+                                         tag=f"t{W}{rep}")
+                    if rec["states"] != {"done": rec["n_jobs"]}:
+                        raise RuntimeError(
+                            f"W={W} rep{rep}: jobs not all done: "
+                            f"{rec['states']}")
+                    legs.append(rec)
+                m1 = c.metrics()
+                pipelining = None
+                if W == 2:
+                    # the Client-pipelining satellite, measured where
+                    # it matters: status polls against the router
+                    # (which proxies each to the owning worker)
+                    jid = legs[-1]["jobs"][0]["job_id"]
+                    NOPS = 100
+                    t0 = time.perf_counter()
+                    for _ in range(NOPS):
+                        c.status(jid)
+                    seq_s = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    c.status_many([jid] * NOPS)
+                    pipe_s = time.perf_counter() - t0
+                    pipelining = dict(
+                        n_ops=NOPS, sequential_s=round(seq_s, 4),
+                        pipelined_s=round(pipe_s, 4),
+                        sequential_per_op_ms=round(seq_s / NOPS * 1e3,
+                                                   4),
+                        pipelined_per_op_ms=round(pipe_s / NOPS * 1e3,
+                                                  4),
+                        saving_pct=round(
+                            100.0 * (1 - pipe_s / seq_s), 1))
+        finally:
+            stop_all(r, procs)
+        cache = {}
+        w0 = {w["worker_id"]: w["cache"] for w in m0["workers"]}
+        for w in m1["workers"]:
+            c0 = w0.get(w["worker_id"], {})
+            h = w["cache"].get("hits", 0) - c0.get("hits", 0)
+            mi = w["cache"].get("misses", 0) - c0.get("misses", 0)
+            cache[w["worker_id"]] = {
+                "hits": h, "misses": mi,
+                "hit_rate": (h / (h + mi)) if h + mi else 1.0}
+        best = min(legs, key=lambda rec: rec["wall_s"])
+        return best, legs, cache, pipelining
+
+    # solo references (one per template — every replay job is a byte
+    # copy of its template; the bench process and the workers share
+    # the same default-config CPU backend, so in-process solo runs are
+    # THE bit-identity reference, the config 9 discipline)
+    solo = {}
+    for name, f in fixtures.items():
+        msdir = os.path.join(tmpd, f"solo_{name}.ms")
+        shutil.copytree(f["ms"], msdir)
+        solp = os.path.join(tmpd, f"solo_{name}.sol")
+        cfg = loadgen.job_config(spec, name, msdir, solp)
+        cfg.update(sky_model=f["sky"], cluster_file=f["cluster"])
+        pl.run(config_from_dict(cfg), log=noop)
+        out = ds.SimMS(msdir, data_column="CORRECTED_DATA")
+        solo[name] = ([out.read_tile(i).x.copy()
+                       for i in range(out.n_tiles)],
+                      open(solp).read())
+
+    def assert_bit_identical(rec, tag):
+        for row in rec["jobs"]:
+            res, sol_text = solo[row["template"]]
+            out = ds.SimMS(row["ms"], data_column="CORRECTED_DATA")
+            for i in range(out.n_tiles):
+                if not np.array_equal(out.read_tile(i).x, res[i]):
+                    return (f"{tag}/{row['job_id']}: residuals NOT "
+                            "bit-identical to the solo run")
+            if open(row["solutions"]).read() != sol_text:
+                return (f"{tag}/{row['job_id']}: solutions NOT "
+                        "bit-identical to the solo run")
+        return None
+
+    t_w0 = time.perf_counter()
+    topo = {}
+    for W in (1, 2, 4):
+        topo[W] = run_topology(W)
+    comp_wall = time.perf_counter() - t_w0
+    for W, (best, legs, _c, _p) in topo.items():
+        for i, rec in enumerate(legs):
+            err = assert_bit_identical(rec, f"w{W}_rep{i}")
+            if err:
+                return {"error": err}
+
+    # -- cross-process migration leg ----------------------------------------
+    def paced_job_cfg(name, msdir, solp):
+        cfg = loadgen.job_config(spec, name, msdir, solp)
+        cfg.update(sky_model=fixtures[name]["sky"],
+                   cluster_file=fixtures[name]["cluster"])
+        return cfg
+
+    r = Router(port=0, lease_s=LEASE_S, heartbeat_s=0.2, log=noop)
+    r.start()
+    procs = [spawn_worker(r.port, "mig_a"), spawn_worker(r.port, "mig_b")]
+    try:
+        wait_alive(r, 2)
+        mig_ms = os.path.join(tmpd, "mig.ms")
+        shutil.copytree(fixtures["bucket4"]["ms"], mig_ms)
+        mig_sol = os.path.join(tmpd, "mig.sol")
+        with Client(port=r.port) as c:
+            jid = c.submit(paced_job_cfg("bucket4", mig_ms, mig_sol))
+            t_dead = time.monotonic() + 180
+            while True:
+                snap = c.status(jid)
+                if snap["state"] == "running" \
+                        and 1 <= snap["tiles_done"] <= 3:
+                    break
+                if time.monotonic() > t_dead or snap["state"] not in \
+                        ("queued", "dispatched", "running"):
+                    return {"error": "migration leg: job stuck in "
+                                     f"{snap['state']}"}
+                time.sleep(0.02)
+            src = snap["worker"]
+            dst = "mig_b" if src == "mig_a" else "mig_a"
+            c.request(op="migrate", job_id=jid, worker=dst)
+            snap = c.wait(jid, timeout_s=300)
+            if snap["state"] != "done" or not snap["hops"]:
+                return {"error": "migration leg: job did not migrate "
+                                 f"and finish ({snap['state']})"}
+            mig = snap["hops"][0]
+    finally:
+        stop_all(r, procs)
+    if mig.get("tiles_rerun") != 0:
+        return {"error": f"cross-process migration re-ran "
+                         f"{mig.get('tiles_rerun')} tiles; refusing "
+                         "to bank"}
+    out = ds.SimMS(mig_ms, data_column="CORRECTED_DATA")
+    res, sol_text = solo["bucket4"]
+    for i in range(out.n_tiles):
+        if not np.array_equal(out.read_tile(i).x, res[i]):
+            return {"error": "migrated job NOT bit-identical to the "
+                             "solo run; refusing to bank"}
+    if open(mig_sol).read() != sol_text:
+        return {"error": "migrated job solutions NOT bit-identical; "
+                         "refusing to bank"}
+
+    # -- worker-loss recovery leg -------------------------------------------
+    CRASH_TILE = 3
+    import json as _json
+    plan = _json.dumps({"rules": [{"point": "worker_crash",
+                                   "at": [f"crash-r15:{CRASH_TILE}"]}]})
+    r = Router(port=0, lease_s=LEASE_S, heartbeat_s=0.2, log=noop)
+    r.start()
+    procs = [spawn_worker(r.port, "crash_w1", faults=plan)]
+    try:
+        wait_alive(r, 1)
+        with Client(port=r.port) as c:
+            # warm crash_w1's bucket4 programs so the crash job's tile
+            # cadence is the PACE (heartbeats must observe every
+            # boundary before the crash)
+            wm_ms = os.path.join(tmpd, "warm.ms")
+            shutil.copytree(fixtures["bucket4"]["ms"], wm_ms)
+            wcfg = paced_job_cfg("bucket4", wm_ms,
+                                 os.path.join(tmpd, "warm.sol"))
+            wcfg["tile_arrival_s"] = 0.0
+            wid = c.submit(wcfg)
+            if c.wait(wid, timeout_s=300)["state"] != "done":
+                return {"error": "recovery leg: warm-up job failed"}
+            crash_ms = os.path.join(tmpd, "crash.ms")
+            shutil.copytree(fixtures["bucket4"]["ms"], crash_ms)
+            crash_sol = os.path.join(tmpd, "crash.sol")
+            jid = c.submit(paced_job_cfg("bucket4", crash_ms,
+                                         crash_sol),
+                           job_id="crash-r15")
+            # the survivor registers while the doomed worker solves
+            procs.append(spawn_worker(r.port, "crash_w2"))
+            wait_alive(r, 2)
+            snap = c.wait(jid, timeout_s=300)
+            if snap["state"] != "done" or not snap["hops"]:
+                return {"error": "recovery leg: job did not recover "
+                                 f"({snap['state']}: {snap.get('error')})"}
+            rec_hop = snap["hops"][0]
+            m_rec = c.metrics()
+    finally:
+        stop_all(r, procs)
+    if rec_hop.get("reason") != "worker_lost" \
+            or rec_hop.get("tiles_rerun") != 0 \
+            or rec_hop.get("resume_tile") != CRASH_TILE:
+        return {"error": f"recovery hop not clean: {rec_hop}; "
+                         "refusing to bank"}
+    out = ds.SimMS(crash_ms, data_column="CORRECTED_DATA")
+    res, sol_text = solo["bucket4"]
+    for i in range(out.n_tiles):
+        if not np.array_equal(out.read_tile(i).x, res[i]):
+            return {"error": "recovered job NOT bit-identical to the "
+                             "solo run; refusing to bank"}
+    if open(crash_sol).read() != sol_text:
+        return {"error": "recovered job solutions NOT bit-identical; "
+                         "refusing to bank"}
+
+    r1, legs1, cache1, _ = topo[1]
+    r2, legs2, cache2, pipelining = topo[2]
+    r4, legs4, cache4, _ = topo[4]
+    thr1 = r1["throughput_jobs_per_s"]
+    thr2 = r2["throughput_jobs_per_s"]
+    thr4 = r4["throughput_jobs_per_s"]
+    recovery_wall = round((rec_hop.get("detect_s") or 0.0)
+                          + rec_hop["wall_s"], 3)
+    floors = {W: -(-spec["n_jobs"] // (2 * W)) * N_TILES * PACE
+              for W in (1, 2, 4)}
+    # a leg well above its ingest floor left the paced regime: its
+    # concurrent solves saturated the host cores (recorded so the
+    # scaling numbers cannot be read past the host's core count)
+    over_floor = [f"{W}w" for W, (best, _l, _c, _p) in topo.items()
+                  if best["wall_s"] > 1.5 * floors[W]]
+    rec = dict(
+        value=thr2 / thr1, unit="x-thr 1->2proc",
+        step_s=r2["wall_s"] / r2["n_jobs"],
+        compile_s=max(comp_wall - r1["wall_s"] - r2["wall_s"]
+                      - r4["wall_s"], 0.0),
+        n_jobs=spec["n_jobs"], shape_buckets=2, n_tiles=N_TILES,
+        host_cores=os.cpu_count(),
+        scaling_1to2=thr2 / thr1,
+        scaling_1to4=thr4 / thr1,
+        throughput_1w_jobs_h=thr1 * 3600.0,
+        throughput_2w_jobs_h=thr2 * 3600.0,
+        throughput_4w_jobs_h=thr4 * 3600.0,
+        wall_1w_s=r1["wall_s"], wall_2w_s=r2["wall_s"],
+        wall_4w_s=r4["wall_s"],
+        walls_1w=[x["wall_s"] for x in legs1],
+        walls_2w=[x["wall_s"] for x in legs2],
+        walls_4w=[x["wall_s"] for x in legs4],
+        p50_queue_wait_1w_s=r1["queue_wait_p50_s"],
+        p99_queue_wait_1w_s=r1["queue_wait_p99_s"],
+        p50_queue_wait_2w_s=r2["queue_wait_p50_s"],
+        p99_queue_wait_2w_s=r2["queue_wait_p99_s"],
+        p99_queue_wait_4w_s=r4["queue_wait_p99_s"],
+        e2e_p99_1w_s=r1["e2e_p99_s"], e2e_p99_2w_s=r2["e2e_p99_s"],
+        cache_by_worker_2w=cache2,
+        cache_hit_rate_min_2w=min(
+            (v["hit_rate"] for v in cache2.values()), default=1.0),
+        migration=dict(wall_s=mig["wall_s"],
+                       tiles_at_yield=mig["tiles_at_yield"],
+                       resume_tile=mig["resume_tile"],
+                       tiles_rerun=mig["tiles_rerun"],
+                       src=mig["src"], dst=mig["dst"],
+                       bit_identical=True),
+        recovery=dict(detect_s=rec_hop.get("detect_s"),
+                      resume_wall_s=rec_hop["wall_s"],
+                      total_wall_s=recovery_wall,
+                      crash_tile=CRASH_TILE,
+                      tiles_at_yield=rec_hop["tiles_at_yield"],
+                      resume_tile=rec_hop["resume_tile"],
+                      tiles_rerun=rec_hop["tiles_rerun"],
+                      lease_s=LEASE_S,
+                      lease_evictions=m_rec["lease_evictions"],
+                      bit_identical=True),
+        recovery_wall_s=recovery_wall,
+        recovery_tiles_rerun=rec_hop["tiles_rerun"],
+        client_pipelining=pipelining,
+        ingest=dict(
+            tile_arrival_s=PACE, arrival="burst",
+            floor_1w_s=floors[1], floor_2w_s=floors[2],
+            floor_4w_s=floors[4],
+            legs_over_floor=over_floor,
+            regime="ingest/admission-limited across PROCESSES: "
+                   "per-tenant streaming pacing bounds per-job rate, "
+                   "so aggregate throughput = fleet-wide admission "
+                   "slots x stream rate while a leg's wall sits on "
+                   "its ingest floor — the regime where worker "
+                   "processes scale linearly and which this host "
+                   f"({os.cpu_count()} core(s)) can measure honestly. "
+                   "Legs listed in legs_over_floor EXCEEDED their "
+                   "floor: their concurrent solves saturated the "
+                   "host cores, so their scaling numbers document "
+                   "the HOST ceiling, not the fleet's. NOT a "
+                   "compute-scaling claim: the workers timeshare the "
+                   "host cores, so the in-regime scaling measured is "
+                   "the router/registry/placement/recovery machinery "
+                   "end to end; the compute-bound multi-core/"
+                   "TPU-host verdict takes the same config with "
+                   "pacing off on real parallel hardware"),
+        bit_identical=True,
+        shape=f"8 jobs x {N_TILES}tiles N=16 M=2 F=24 tilesz 4,6 "
+              f"pace{PACE} burst router 1w-vs-2w-vs-4w procs e1g4l2")
+    try:
+        rec["scaleout_record"] = _stamp_scaleout(
+            rec, jax.devices()[0].platform)
+    except Exception as e:        # the bench result still stands
+        log(f"# scaleout record stamping failed: {e}")
+    return rec
+
+
 CONFIGS = [
     ("1-fullbatch-lm", config1_fullbatch_lm),
     ("2-stochastic-lbfgs", config2_stochastic),
@@ -2228,6 +2651,7 @@ CONFIGS = [
     ("7-dtype-melt", config7_dtype),
     ("8-serve-throughput", config8_serve),
     ("9-fleet-throughput", config9_fleet),
+    ("10-scaleout", config10_scaleout),
 ]
 
 #: configs that need a virtual multi-device fleet: run_one_config

@@ -73,6 +73,17 @@ POINTS = (
     #                     target — the recovery path must re-queue the
     #                     job from the durable watermark (zero tiles
     #                     lost; chaos-gated in tests/test_faults.py)
+    "worker_crash",     # serve/scheduler: kill the WHOLE WORKER
+    #                     PROCESS (os._exit) at the tile boundary
+    #                     entering tile ti, key "<job_id>:<ti>" — the
+    #                     cross-process chaos lever: the router's
+    #                     lease eviction must recover the dead
+    #                     worker's jobs onto survivors from their
+    #                     durable checkpoint watermarks (serve/
+    #                     router.py; gated in tests/test_router.py).
+    #                     Queried via fires(); only a process started
+    #                     with a --faults plan can fire it, so it can
+    #                     never kill a multi-tenant test process
     "admm_subband_slow",  # consensus/admm: a subband straggles for one
     #                     ADMM round (kind "transient": skipped under
     #                     bounded staleness, forced when the bound is

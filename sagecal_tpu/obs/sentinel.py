@@ -109,6 +109,28 @@ MESH_TOLERANCES = {
     "mesh_parity": dict(field="parity_ok", abs=0.0, better="higher"),
 }
 
+#: cross-process scale-out tolerances (SCALEOUT_rNN.json, bench config
+#: 10-scaleout — the router + W worker PROCESSES record family, ISSUE
+#: 15): the 1->2-WORKER aggregate throughput scaling, the 2-worker
+#: p99 queue wait, the WORST per-worker compile-cache hit rate on the
+#: timed legs (a routing regression shows up as one worker's cache
+#: going cold), the worker-loss recovery wall, and the recovery's
+#: tiles-re-run count — banked 0; a later round re-running ANY
+#: completed tile after a crash fails CI with the metric named.
+#: Judged cross-round like FLEET/MESH_TOLERANCES.
+SCALEOUT_TOLERANCES = {
+    "scaleout_scaling": dict(field="scaling_1to2", abs=0.15,
+                             better="higher"),
+    "scaleout_queue_wait": dict(field="p99_queue_wait_2w_s", rel=0.50,
+                                better="lower"),
+    "scaleout_cache": dict(field="cache_hit_rate_min_2w", abs=0.02,
+                           better="higher"),
+    "scaleout_recovery_wall": dict(field="recovery_wall_s", rel=0.50,
+                                   better="lower"),
+    "scaleout_recovery_rerun": dict(field="recovery_tiles_rerun",
+                                    abs=0.0, better="lower"),
+}
+
 
 def assert_table_contract(header: str) -> None:
     """Every toleranced metric with a named table column must find it
@@ -227,6 +249,12 @@ def load_mesh_banks(platform: str, bank_dir: str = HERE):
     return load_banks(platform, bank_dir, pattern="MESH2D_r*.json")
 
 
+def load_scaleout_banks(platform: str, bank_dir: str = HERE):
+    """Round-stamped cross-process scale-out records
+    (SCALEOUT_rNN.json), oldest first."""
+    return load_banks(platform, bank_dir, pattern="SCALEOUT_r*.json")
+
+
 def _family_cross_round_check(banks, tolerances: dict,
                               tag: str) -> list:
     """Newest round of a record family vs the most recent earlier one,
@@ -272,6 +300,20 @@ def mesh_cross_round_check(platform: str, bank_dir: str = HERE) -> list:
     family)."""
     return _family_cross_round_check(
         load_mesh_banks(platform, bank_dir), MESH_TOLERANCES, "MESH2D")
+
+
+def scaleout_cross_round_check(platform: str,
+                               bank_dir: str = HERE) -> list:
+    """Newest scale-out round vs the most recent earlier one, judged
+    against :data:`SCALEOUT_TOLERANCES` — a later round collapsing the
+    cross-process throughput scaling, blowing the fleet queue-wait
+    tail, going cache-cold on a worker, slowing worker-loss recovery,
+    or RE-RUNNING completed tiles after a crash fails CI with the
+    metric named (the ISSUE 15 satellite, mirroring the FLEET and
+    MESH2D families)."""
+    return _family_cross_round_check(
+        load_scaleout_banks(platform, bank_dir), SCALEOUT_TOLERANCES,
+        "SCALEOUT")
 
 
 def cross_round_check(platform: str, bank_dir: str = HERE) -> list:
@@ -605,6 +647,11 @@ def main(argv=None) -> int:
             print(f"sentinel: {plat} mesh bank r{mesh[-1][0]:02d} "
                   f"({len(mesh)} rounds)")
             viol.extend(mesh_cross_round_check(plat, args.bank_dir))
+        so = load_scaleout_banks(plat, args.bank_dir)
+        if so:
+            print(f"sentinel: {plat} scaleout bank r{so[-1][0]:02d} "
+                  f"({len(so)} rounds)")
+            viol.extend(scaleout_cross_round_check(plat, args.bank_dir))
         if not args.fast:
             viol.extend(rerun_check(plat, args.bank_dir))
     if not checked_any:

@@ -23,11 +23,19 @@ program away at exit. This package keeps the device busy across *jobs*:
   load generator behind the banked FLEET records;
 - :mod:`sagecal_tpu.serve.api` — a zero-dependency JSON-lines protocol
   over a local socket (submit/status/cancel/migrate/drain/metrics)
-  with graceful drain on SIGTERM.
+  with graceful drain on SIGTERM, and a client with persistent
+  connections + request pipelining;
+- :mod:`sagecal_tpu.serve.router` — the CROSS-PROCESS fleet: a router
+  front-end speaking the same API over worker daemons (``--worker
+  --router ADDR``) with a leased worker registry, bucket-affinity
+  routing over reported compile-cache inventories, and
+  checkpoint-based cross-process migration / worker-loss recovery
+  (zero completed tiles re-run, bit-identical outputs).
 
 Run it: ``python -m sagecal_tpu.serve --socket /tmp/sagecal.sock``.
-See MIGRATION.md "Service mode" / "Fleet mode" for the protocol and
-the per-job bit-identity / bucketing / migration contracts.
+See MIGRATION.md "Service mode" / "Fleet mode" / "Multi-process
+fleet" for the protocol and the per-job bit-identity / bucketing /
+migration contracts.
 """
 
 from sagecal_tpu.serve import cache  # noqa: F401

@@ -51,6 +51,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "HTTP on 127.0.0.1:PORT (0 = ephemeral; "
                         "default: no HTTP endpoint — the JSON-lines "
                         "'metrics'/'metrics_full' ops always work)")
+    p.add_argument("--worker", action="store_true",
+                   help="run as a FLEET WORKER: serve jobs as usual "
+                        "AND register with the --router front-end "
+                        "over one persistent control connection "
+                        "(leased heartbeats carrying job snapshots + "
+                        "compile-cache bucket inventory; MIGRATION.md "
+                        "'Multi-process fleet')")
+    p.add_argument("--router", default=None, metavar="ADDR",
+                   help="router control address: HOST:PORT or a unix "
+                        "socket path (requires --worker)")
+    p.add_argument("--worker-id", default=None, metavar="ID",
+                   help="stable worker identity (default "
+                        "w-<hostname>-<pid>)")
+    p.add_argument("--faults", default=None, metavar="SPEC",
+                   help="deterministic fault-injection plan "
+                        "(sagecal_tpu.faults.enable_spec — process-"
+                        "global, so meant for dedicated worker "
+                        "processes: the worker_crash chaos point "
+                        "lives behind it)")
     p.add_argument("--platform", default=None,
                    help="force the jax platform (e.g. 'cpu')")
     p.add_argument("--cpu-devices", type=int, default=None,
@@ -64,6 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if bool(args.worker) != (args.router is not None):
+        raise SystemExit("--worker and --router ADDR go together")
+    if args.faults:
+        from sagecal_tpu import faults
+        faults.enable_spec(args.faults)
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)
@@ -90,9 +114,21 @@ def main(argv=None) -> int:
     print(f"sagecal-serve: listening on {where} "
           f"(devices={len(srv.scheduler.workers)}, "
           f"max_inflight={args.max_inflight}/device)", flush=True)
+    agent = None
+    if args.worker:
+        # the job API is live (srv.port resolved), so register now;
+        # the agent heartbeats at the router-granted cadence until
+        # drain
+        from sagecal_tpu.serve.router import WorkerAgent
+        agent = WorkerAgent(srv, args.router, worker_id=args.worker_id)
+        agent.start()
+        print(f"sagecal-serve: worker {agent.worker_id} -> router "
+              f"{args.router}", flush=True)
     try:
         srv.serve_forever()
     finally:
+        if agent is not None:
+            agent.stop()
         if args.diag:
             dtrace.disable()
     return 0

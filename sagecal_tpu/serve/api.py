@@ -324,6 +324,14 @@ class Server:
         server = self
 
         class Handler(socketserver.StreamRequestHandler):
+            # reply batches must not sit out Nagle/delayed-ACK stalls
+            # (the Client pipelining contract; a handler-class
+            # attribute — setting it on the server class does
+            # nothing). TCP ONLY: setup() would raise OSError 95
+            # setsockopt'ing an AF_UNIX socket, killing every
+            # unix-socket connection before handle() ran
+            disable_nagle_algorithm = server.socket_path is None
+
             def handle(self):
                 for line in self.rfile:
                     line = line.strip()
@@ -430,6 +438,11 @@ class Client:
             s.connect(socket_path)
         else:
             s = socket.create_connection(("127.0.0.1", port))
+            # without NODELAY a pipelined batch loses to Nagle +
+            # delayed-ACK (~40 ms stalls that dwarf the round-trips
+            # pipelining removes); the protocol is line-delimited
+            # JSON, so there is nothing for Nagle to usefully coalesce
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         s.settimeout(self._timeout)
         self._sock = s
         self._f = s.makefile("rwb")
@@ -490,6 +503,52 @@ class Client:
                     and "duplicate job id" in str(e):
                 return kw["job_id"]
             raise
+
+    def pipeline(self, reqs: list) -> list:
+        """Request PIPELINING on the persistent connection: write all
+        ``reqs`` before reading any reply, collapsing N network
+        round-trips into one (the server answers a connection's lines
+        strictly in order, daemon and router alike). Returns the raw
+        response dicts IN ORDER — per-request errors come back as
+        ``{"ok": false, ...}`` rows, not raises (a batch reader must
+        see which row failed). Only for ops that are idempotent under
+        resend (status/metrics/ping): a transient socket failure
+        reconnects and re-sends the WHOLE batch, up to the same
+        ``reconnects`` budget as :meth:`request`."""
+        payload = b"".join((json.dumps(r) + "\n").encode()
+                           for r in reqs)
+        if not reqs:
+            return []
+        for attempt in range(self._reconnects):
+            try:
+                if self._f is None:
+                    self._connect()
+                self._f.write(payload)
+                self._f.flush()
+                lines = []
+                for _ in reqs:
+                    line = self._f.readline()
+                    if not line:
+                        raise ConnectionError(
+                            "server closed the connection mid-batch")
+                    lines.append(line)
+                return [json.loads(ln) for ln in lines]
+            except (ConnectionError, OSError):
+                self._drop()
+                if attempt == self._reconnects - 1:
+                    raise
+                time.sleep(self._reconnect_base_s * (2 ** attempt))
+
+    def status_many(self, job_ids) -> list:
+        """Snapshots of many jobs in ONE pipelined round-trip (the
+        loadgen's post-replay sweep, the router's per-worker poll)."""
+        out = []
+        for r in self.pipeline([{"op": "status", "job_id": j}
+                                for j in job_ids]):
+            if not r.get("ok"):
+                raise RuntimeError(r.get("error", "status failed"))
+            out.append(r["job"])
+        return out
 
     def status(self, job_id: str | None = None):
         r = self.request(op="status",

@@ -191,17 +191,29 @@ def job_config(spec, tmpl_name: str, msdir: str, solutions: str) -> dict:
     return cfg
 
 
-def replay(client, spec, fixtures, workdir: str, log=print) -> dict:
-    """Drive a live daemon with the spec's schedule. ``client``: a
-    connected ``serve.api.Client``; ``fixtures``: from
-    :func:`build_fixtures` (per-template prototype datasets — each
-    job gets its own copy under ``workdir``). Blocks until every
-    submitted job is terminal (server-side drain wait: no status
-    polling stealing host cycles mid-replay), then returns the replay
-    record: wall, throughput, queue-wait/e2e percentiles, per-job
-    rows, and the output paths for the caller's bit-identity gate."""
+def replay(client, spec, fixtures, workdir: str, log=print,
+           drain: bool = True, timeout_s: float = 3600.0,
+           tag: str | None = None) -> dict:
+    """Drive a live daemon (or fleet router — the same API) with the
+    spec's schedule. ``client``: a connected ``serve.api.Client``;
+    ``fixtures``: from :func:`build_fixtures` (per-template prototype
+    datasets — each job gets its own copy under ``workdir``). Blocks
+    until every submitted job is terminal — by default via a
+    server-side drain wait (no status polling stealing host cycles
+    mid-replay); ``drain=False`` instead polls with ONE pipelined
+    status batch per interval, leaving the server accepting, so a
+    bench can run several replays against one warm fleet (the
+    10-scaleout legs). Returns the replay record: wall, throughput,
+    queue-wait/e2e percentiles, per-job rows, and the output paths
+    for the caller's bit-identity gate."""
     spec = load_spec(spec)
     sched_rows = schedule(spec)
+    if tag:
+        # several replays of ONE spec against one long-lived server
+        # (the scaleout bench's warm legs) need distinct job ids —
+        # registries, daemon and router alike, refuse duplicates
+        sched_rows = [dict(row, job_id=f"{row['job_id']}-{tag}")
+                      for row in sched_rows]
     fix = {n: dict(v) for n, v in fixtures.items()}
     jobs = []
     for row in sched_rows:
@@ -224,12 +236,29 @@ def replay(client, spec, fixtures, workdir: str, log=print) -> dict:
         if job["deadline_s"] is not None:
             kw["deadline_s"] = float(job["deadline_s"])
         client.submit(job["config"], **kw)
-    client.drain(wait=True)
+    if drain:
+        client.drain(wait=True)
+    else:
+        terminal = ("done", "failed", "cancelled", "deadline_exceeded")
+        deadline = time.monotonic() + timeout_s
+        ids = [job["job_id"] for job in jobs]
+        while True:
+            if all(s["state"] in terminal
+                   for s in client.status_many(ids)):
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"replay: jobs not terminal after {timeout_s}s")
+            time.sleep(0.1)
     wall = time.perf_counter() - t0
     waits, e2es, states = [], [], {}
     rows = []
-    for job in jobs:
-        snap = client.status(job["job_id"])
+    # ONE pipelined round-trip for the whole post-replay sweep (the
+    # api.Client persistent-connection pipelining): N per-op network
+    # round-trips collapse to one — against a router front-end every
+    # status also fans out a proxy hop, so the saving doubles
+    snaps = client.status_many([job["job_id"] for job in jobs])
+    for job, snap in zip(jobs, snaps):
         states[snap["state"]] = states.get(snap["state"], 0) + 1
         qw = (snap["started_t"] - snap["submitted_t"]
               if snap["started_t"] else None)
@@ -239,12 +268,17 @@ def replay(client, spec, fixtures, workdir: str, log=print) -> dict:
             waits.append(qw)
         if e2e is not None:
             e2es.append(e2e)
-        rows.append(dict(job_id=job["job_id"],
-                         template=job["template"],
-                         state=snap["state"], device=snap["device"],
-                         queue_wait_s=qw, e2e_s=e2e,
-                         migrations=snap["migrations"],
-                         ms=job["ms"], solutions=job["solutions"]))
+        row = dict(job_id=job["job_id"], template=job["template"],
+                   state=snap["state"], device=snap["device"],
+                   queue_wait_s=qw, e2e_s=e2e,
+                   migrations=snap["migrations"],
+                   ms=job["ms"], solutions=job["solutions"])
+        if "worker" in snap:
+            # router replay: which worker PROCESS ran the job (the
+            # per-worker routing view; "device" is worker-local)
+            row["worker"] = snap["worker"]
+            row["hops"] = snap.get("hops", [])
+        rows.append(row)
     n_done = states.get("done", 0)
     rec = dict(
         n_jobs=len(jobs), states=states, wall_s=round(wall, 3),
@@ -279,6 +313,12 @@ def main(argv=None) -> int:
     g = p.add_mutually_exclusive_group(required=True)
     g.add_argument("--socket", metavar="PATH")
     g.add_argument("--port", type=int)
+    g.add_argument("--router", metavar="ADDR",
+                   help="drive a fleet ROUTER instead of a daemon "
+                        "(HOST:PORT or unix socket path — the same "
+                        "JSON-lines API, serve/router.py); replay "
+                        "records then measure the whole multi-process "
+                        "fleet behind it")
     p.add_argument("--spec", default="{}",
                    help="JSON spec (inline or a path); {} = defaults")
     p.add_argument("--workdir", default=None,
@@ -294,7 +334,12 @@ def main(argv=None) -> int:
     spec = load_spec(args.spec)
     fixtures = build_fixtures(spec, workdir)
     from sagecal_tpu.serve.api import Client
-    with Client(socket_path=args.socket, port=args.port) as c:
+    sock, port = args.socket, args.port
+    if args.router:
+        from sagecal_tpu.serve.router import parse_router_addr
+        addr = parse_router_addr(args.router)
+        sock, port = addr.get("socket"), addr.get("port")
+    with Client(socket_path=sock, port=port) as c:
         rec = replay(c, spec, fixtures, workdir)
     print(json.dumps(rec, indent=1, default=float))
     return 0

@@ -129,6 +129,11 @@ class Job:
         self.migrate_to: int | None = None
         self.bucket: str | None = None
         self.migrations: list = []
+        # the tile a (possibly resumed) run actually started at — 0
+        # for a fresh run, the checkpoint watermark + 1 for a resume.
+        # Surfaced in the snapshot so a CROSS-PROCESS router can price
+        # recovery/migration hops (serve/router.py) exactly.
+        self.resume_start_tile: int | None = None
 
     def snapshot(self) -> dict:
         """JSON-serializable status row (the api `status` reply)."""
@@ -154,6 +159,7 @@ class Job:
             # migration's measured cost (wall + tiles re-run)
             "device": self.device,
             "migrations": self.migrations,
+            "resume_start_tile": self.resume_start_tile,
         }
 
     def expired(self, now: float | None = None) -> bool:

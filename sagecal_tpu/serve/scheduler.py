@@ -208,6 +208,14 @@ class Scheduler:
         self.t0 = time.time()
         self.migrations_done = 0
         self.migrations_aborted = 0
+        # compile-cache bucket INVENTORY: which job affinity tokens
+        # have warm programs, and on which device ordinals — written
+        # at every job start, exported to the cross-process router
+        # (serve/router.py) via the worker heartbeat so fleet-level
+        # placement can follow warm caches across PROCESS boundaries
+        # the way the in-process Placer follows them across devices
+        self._bucket_lock = threading.Lock()
+        self._buckets: dict = {}        # token -> set of ordinals
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -285,6 +293,21 @@ class Scheduler:
             out["mesh_spans"] = spans
         return out
 
+    def bucket_inventory(self) -> dict:
+        """``{bucket_token: [device ordinals]}`` of every affinity
+        token this process has compiled programs for (the worker
+        heartbeat's routing signal; sticky like the Placer's map —
+        eviction from the LRU program cache is rare enough that a
+        stale claim costs one cold compile, never correctness)."""
+        with self._bucket_lock:
+            return {b: sorted(s) for b, s in self._buckets.items()}
+
+    def _note_bucket(self, job, ordinal: int) -> None:
+        b = fleet.job_bucket(job)
+        if b is not None:
+            with self._bucket_lock:
+                self._buckets.setdefault(b, set()).add(int(ordinal))
+
     def unhealthy_jobs(self) -> list:
         """RUNNING jobs whose convergence health is stalled/diverging
         (the /healthz degradation signal)."""
@@ -317,6 +340,7 @@ class Scheduler:
         # owning worker's device
         ctx = job_telemetry_ctx(tracer, job.job_id, ordinal=w.ix,
                                 device=w.device)
+        self._note_bucket(job, w.ix)
         # opaque kinds — sim/mpi, fullbatch with tile_batch > 1 (the
         # batched driver's warm start is BATCH-granular), and
         # consensus-stochastic (its ADMM epoch chain has no tile
@@ -362,8 +386,12 @@ class Scheduler:
             job.n_tiles = st.n_tiles
             # checkpoint resume (resume=true, incl. a migration's
             # re-admission): completed tiles are already on disk —
-            # report them done and only produce the remainder
+            # report them done and only produce the remainder. The
+            # start tile is surfaced in the snapshot so a CROSS-PROCESS
+            # router can price a recovery hop (tiles_rerun =
+            # tiles-at-yield - resume_start_tile) without guessing
             job.tiles_done = st.start_tile
+            job.resume_start_tile = st.start_tile
             if job.migrations and "resumed_t" not in job.migrations[-1]:
                 # close the books on the migration that re-queued this
                 # job: wall cost and — the zero-rerun gate's number —
@@ -592,6 +620,23 @@ class Scheduler:
                             break
                         if r is not sched.Prefetcher.DONE:
                             _j, (ti, tile, stg), wait = r
+                            # worker_crash: the cross-process chaos
+                            # seam — kill THIS WHOLE PROCESS at the
+                            # boundary entering tile ti (tiles < ti
+                            # completed; with prefetch=0 their
+                            # checkpoint is durably on disk). The
+                            # router's lease eviction must recover the
+                            # job onto a surviving worker as a resume
+                            # with zero completed tiles re-run
+                            # (tests/test_router.py). Keyed
+                            # "<job_id>:<tile>" so a plan pins the
+                            # exact boundary deterministically. Only a
+                            # process started with --faults can ever
+                            # fire it (single-tenant worker processes).
+                            if faults.fires("worker_crash",
+                                            key=f"{job.job_id}:{ti}"):
+                                import os as _os
+                                _os._exit(17)
                             t0 = time.perf_counter()
                             rec = rj.stepper.step(ti, tile, stg, wait)
                             dt = time.perf_counter() - t0

@@ -1,0 +1,767 @@
+"""Cross-process fleet gates (serve/router.py + worker mode, ISSUE 15).
+
+The contracts under test (MIGRATION.md "Multi-process fleet"):
+
+- the worker registry (live, fake workers): register grants a lease +
+  heartbeat cadence, heartbeats renew it, a silent worker is EVICTED
+  at lease expiry and its dispatched jobs re-queue as resumes;
+- routing (pure): bucket-inventory affinity > sticky map > least
+  load; capacity budgeted per worker; a pinned (migrating) job only
+  admits on its pin; strict head-of-line fleet-wide;
+- the api.Client persistent-connection request pipelining (N status
+  round-trips collapse to one write+read batch, same replies);
+- `bench.stamp_family` exact-match families (the PR 14 stray
+  MESH_r13.json regression): underscores refused, prefix-colliding
+  family names refused, round numbering never cross-reads;
+- the sentinel SCALEOUT family: a doctored bank regressing scaling /
+  recovery re-runs fails the cross-round check with the metric named;
+- jaxlint hot-path scope covers serve/router.py;
+- LIVE (worker subprocesses, spawn-safe, hard timeouts; slow-marked
+  to hold the tier-1 wall — CI's full-suite step runs them, and the
+  same crash/migration recovery legs gate the banked SCALEOUT record
+  at bench time): a worker killed mid-job by the `worker_crash`
+  fault point is lease-evicted, its job recovers onto the survivor
+  from the durable checkpoint watermark with ZERO completed tiles
+  re-run, and the outputs are byte-for-byte identical to an
+  uninterrupted solo run; the same machinery moves a healthy job
+  cross-process via the `migrate` op.
+
+Worker subprocesses inherit this suite's env plus JAX_ENABLE_X64=true
+so their jax config matches the in-process solo references
+(conftest.py enables x64 for the test process).
+"""
+
+import json
+import math
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from sagecal_tpu import pipeline, skymodel  # noqa: E402
+from sagecal_tpu.io import dataset as ds  # noqa: E402
+from sagecal_tpu.rime import predict as rp  # noqa: E402
+from sagecal_tpu.serve import queue as jq  # noqa: E402
+from sagecal_tpu.serve import router as rt  # noqa: E402
+from sagecal_tpu.serve.api import Client, Server, config_from_dict  # noqa: E402
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+SKY = "P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6\n"
+CLUSTER = "0 1 P0A\n"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs_registry():
+    from sagecal_tpu.obs import metrics as ometrics
+    ometrics.disable()
+    yield
+    ometrics.disable()
+
+
+def _deadline_loop(cond, timeout_s, what, poll_s=0.1):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        v = cond()
+        if v:
+            return v
+        time.sleep(poll_s)
+    raise AssertionError(f"timeout after {timeout_s}s waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# registry / lease / recovery units (fake workers — no jax, no spawn)
+# ---------------------------------------------------------------------------
+
+class _FakeWorker:
+    """A canned-response daemon speaking just enough of the job API
+    for the router's data plane (submit/status/cancel), plus a control
+    client that registers + heartbeats like the real WorkerAgent."""
+
+    def __init__(self, router_port, worker_id, capacity=2):
+        import socketserver
+        self.worker_id = worker_id
+        self.capacity = capacity
+        self.submitted = []             # (worker_job_id, request) pairs
+        self.cancelled = []
+        self.snapshots = {}             # worker_job_id -> snapshot dict
+        fw = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    req = json.loads(line)
+                    op = req.get("op")
+                    if op == "submit":
+                        fw.submitted.append((req.get("job_id"), req))
+                        # worker-side "queued" until the test scripts a
+                        # state: the router must not close hops off a
+                        # snapshot that predates the (fake) job start
+                        fw.snapshots.setdefault(
+                            req["job_id"],
+                            fw.snap(req["job_id"], "queued",
+                                    resume_start_tile=None))
+                        resp = {"ok": True, "job_id": req["job_id"]}
+                    elif op == "status":
+                        s = fw.snapshots.get(req.get("job_id"))
+                        resp = ({"ok": True, "job": s} if s else
+                                {"ok": False, "error": "KeyError"})
+                    elif op == "cancel":
+                        fw.cancelled.append(req["job_id"])
+                        resp = {"ok": True, "state": "running"}
+                    else:
+                        resp = {"ok": True, "pong": True}
+                    self.wfile.write((json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+
+        class Srv(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+        self._srv = Srv(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        import threading
+        threading.Thread(target=self._srv.serve_forever,
+                         kwargs={"poll_interval": 0.05},
+                         daemon=True).start()
+        # control connection (persistent, like the WorkerAgent)
+        self._ctl = socket.create_connection(("127.0.0.1", router_port))
+        self._ctl.settimeout(10.0)
+        self._f = self._ctl.makefile("rwb")
+        r = self.control({"op": "worker_register",
+                          "worker_id": worker_id,
+                          "addr": {"port": self.port},
+                          "capacity": capacity, "devices": 1})
+        assert r["ok"] and r["lease_s"] > 0 and r["heartbeat_s"] > 0
+        self.lease_s = r["lease_s"]
+
+    @staticmethod
+    def snap(job_id, state, tiles_done=0, resume_start_tile=0, **kw):
+        return dict(job_id=job_id, state=state, kind="fullbatch",
+                    priority=0, tiles_done=tiles_done, n_tiles=4,
+                    submitted_t=time.time(), started_t=time.time(),
+                    finished_t=None, device=0, migrations=[],
+                    resume_start_tile=resume_start_tile, error=None,
+                    **kw)
+
+    def control(self, obj) -> dict:
+        self._f.write((json.dumps(obj) + "\n").encode())
+        self._f.flush()
+        return json.loads(self._f.readline())
+
+    def heartbeat(self, buckets=None, jobs=None) -> dict:
+        return self.control({
+            "op": "worker_heartbeat", "worker_id": self.worker_id,
+            "buckets": buckets or {},
+            "jobs": jobs if jobs is not None
+            else list(self.snapshots.values()),
+            "cache": {"entries": 0, "hits": 3, "misses": 1,
+                      "hit_rate": 0.75},
+            "counts": {}, "tiles_done": 0})
+
+    def close(self):
+        try:
+            self._f.close()
+            self._ctl.close()
+        except OSError:
+            pass
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_registry_lease_eviction_recovers_dispatched_jobs(tmp_path):
+    """Register + heartbeat keeps the lease; silence evicts the worker
+    and its dispatched job re-queues as an UNPINNED resume hop, which
+    a later-registered worker picks up (resume=true forwarded)."""
+    r = rt.Router(port=0, lease_s=0.6, poll_s=0.02,
+                  log=lambda *a: None)
+    r.start()
+    w1 = None
+    w2 = None
+    try:
+        w1 = _FakeWorker(r.port, "fw1")
+        assert abs(w1.lease_s - 0.6) < 1e-9
+        with Client(port=r.port) as c:
+            m = c.metrics()
+            assert m["n_alive"] == 1 and m["n_workers"] == 1
+            jid = c.submit({"ms": str(tmp_path / "none.ms"),
+                            "sky_model": "s", "cluster_file": "cl",
+                            "solutions_file": str(tmp_path / "s.sol")})
+            _deadline_loop(lambda: w1.submitted, 10, "dispatch")
+            assert w1.submitted[0][0] == jid
+            # heartbeats renew the lease well past its duration
+            for _ in range(6):
+                assert w1.heartbeat()["ok"]
+                time.sleep(0.15)
+            m = c.metrics()
+            assert m["n_alive"] == 1 and m["lease_evictions"] == 0
+            assert m["workers"][0]["cache"]["hit_rate"] == 0.75
+            # silence -> eviction -> the job re-queues + recovers
+            w2 = _FakeWorker(r.port, "fw2")
+            _deadline_loop(lambda: c.metrics()["lease_evictions"] == 1,
+                           10, "lease eviction")
+            _deadline_loop(lambda: w2.submitted, 10, "re-dispatch")
+            wjid, req = w2.submitted[0]
+            assert wjid == f"{jid}~h1"          # hop-suffixed id
+            assert req["config"]["resume"] is True
+            snap = c.status(jid)
+            assert snap["hops"][0]["reason"] == "worker_lost"
+            assert snap["hops"][0]["src"] == "fw1"
+            # an evicted incarnation's heartbeat is refused
+            assert not w1.heartbeat().get("ok")
+            # terminal state propagates from the worker snapshot
+            w2.snapshots[wjid] = w2.snap(wjid, "done", tiles_done=4)
+            snap = _deadline_loop(
+                lambda: (c.status(jid)
+                         if c.status(jid)["state"] == "done" else None),
+                10, "terminal fold")
+            assert snap["worker"] == "fw2"
+    finally:
+        for w in (w1, w2):
+            if w is not None:
+                w.close()
+        r.stop()
+
+
+def test_router_migrate_op_cancels_then_resumes_pinned(tmp_path):
+    """The cross-process migrate op: cancel lands on the source
+    worker; when the source reports CANCELLED the job re-queues
+    PINNED to the target and re-submits there as a resume."""
+    r = rt.Router(port=0, lease_s=5.0, poll_s=0.02,
+                  log=lambda *a: None)
+    r.start()
+    ws = []
+    try:
+        ws = [_FakeWorker(r.port, "fwa"), _FakeWorker(r.port, "fwb")]
+        with Client(port=r.port) as c:
+            jid = c.submit({"ms": "x.ms", "sky_model": "s",
+                            "cluster_file": "cl",
+                            "solutions_file": str(tmp_path / "m.sol")})
+            _deadline_loop(lambda: ws[0].submitted, 10, "dispatch")
+            # no solutions_file -> refused (no checkpoint contract)
+            with pytest.raises(RuntimeError, match="solutions_file"):
+                c.request(op="migrate",
+                          job_id=c.submit({"ms": "y.ms",
+                                           "sky_model": "s",
+                                           "cluster_file": "cl"}),
+                          worker="fwb")
+            assert c.request(op="migrate", job_id=jid,
+                             worker="fwb")["state"] == jq.MIGRATING
+            _deadline_loop(lambda: jid in ws[0].cancelled, 10,
+                           "cancel forwarded")
+            # source reports the boundary cancel; router re-dispatches
+            ws[0].snapshots[jid] = ws[0].snap(jid, "cancelled",
+                                              tiles_done=2)
+            # the decoy no-solutions job may also land on fwb; find
+            # the hop-suffixed RESUME dispatch specifically
+            wjid, req = _deadline_loop(
+                lambda: next(((w, q) for w, q in ws[1].submitted
+                              if w == f"{jid}~h1"), None),
+                10, "pinned re-dispatch")
+            assert req["config"]["resume"] is True
+            ws[1].snapshots[wjid] = ws[1].snap(wjid, "running",
+                                               tiles_done=2,
+                                               resume_start_tile=2)
+            snap = _deadline_loop(
+                lambda: (c.status(jid) if c.status(jid)["hops"]
+                         and "resumed_t" in c.status(jid)["hops"][-1]
+                         else None), 10, "hop close")
+            hop = snap["hops"][0]
+            assert hop["reason"] == "migrate" and hop["dst"] == "fwb"
+            assert hop["tiles_at_yield"] == 2
+            assert hop["resume_tile"] == 2 and hop["tiles_rerun"] == 0
+    finally:
+        for w in ws:
+            w.close()
+        r.stop()
+
+
+# ---------------------------------------------------------------------------
+# placement units (pure — fabricated registry state, no sockets)
+# ---------------------------------------------------------------------------
+
+def _mk_router():
+    return rt.Router(port=0, log=lambda *a: None)    # never started
+
+
+def _add_worker(r, wid, capacity=2, buckets=(), t=None):
+    w = rt.WorkerInfo(wid, {"port": 1}, capacity)
+    w.lease_t = time.time() + 60
+    w.registered_t = t if t is not None else time.time()
+    w.buckets = {b: [0] for b in buckets}
+    r.workers[wid] = w
+    return w
+
+
+def _add_job(r, jid, worker=None, state=jq.RUNNING):
+    rj = rt.RJob(jid, {"config": {}}, next(r._seq))
+    rj._bucket_done = True
+    rj.state = state
+    rj.worker_id = worker
+    r.jobs[jid] = rj
+    return rj
+
+
+def test_place_bucket_affinity_capacity_and_pins():
+    r = _mk_router()
+    _add_worker(r, "wa", capacity=2, t=1.0)
+    _add_worker(r, "wb", capacity=2, buckets=("B",), t=2.0)
+
+    job = rt.RJob("j1", {"config": {}}, 0)
+    job._bucket_done = True
+    # least-load + registration-order tie-break
+    assert r._place(job) == "wa"
+    # live INVENTORY beats least load: wb reports bucket B warm
+    job.bucket = "B"
+    assert r._place(job) == "wb"
+    # sticky map used when no inventory claims the bucket
+    job.bucket = "C"
+    r._affinity["C"] = "wb"
+    assert r._place(job) == "wb"
+    # per-worker capacity: fill wb -> spills by least load
+    _add_job(r, "r1", worker="wb")
+    _add_job(r, "r2", worker="wb")
+    assert r._place(job) == "wa"
+    # all full -> head-of-line block
+    _add_job(r, "r3", worker="wa")
+    _add_job(r, "r4", worker="wa")
+    assert r._place(job) is None
+    # a migration pin only admits on its pin
+    r.jobs.clear()
+    pinned = rt.RJob("jp", {"config": {}}, 99)
+    pinned._bucket_done = True
+    pinned.pinned_worker = "wa"
+    assert r._place(pinned) == "wa"
+    for i in range(2):
+        _add_job(r, f"f{i}", worker="wa")
+    assert r._place(pinned) is None      # pin full: wb may NOT take it
+    # dead lease excluded
+    r.jobs.clear()
+    r.workers["wb"].lease_t = 0.1
+    job.bucket = "B"
+    assert r._place(job) == "wa"
+
+
+def test_dispatch_pass_is_strict_head_of_line_and_resume_first():
+    r = _mk_router()
+    _add_worker(r, "wa", capacity=1)
+    j1 = _add_job(r, "j1", state=jq.QUEUED)
+    j2 = _add_job(r, "j2", state=jq.QUEUED)
+    j2.priority = 5                     # higher priority: the head
+    j3 = _add_job(r, "j3", state=jq.QUEUED)
+    j3.resume = True                    # recovering: ahead of everyone
+    order = []
+    r._forward_submit = lambda rj, w: order.append(rj.job_id)  # stub
+    r._dispatch_pass()
+    assert order == ["j3"]              # capacity 1: only the head
+    assert j3.state == rt.DISPATCHED and j3.worker_id == "wa"
+    assert j1.state == jq.QUEUED and j2.state == jq.QUEUED
+    j3.state = jq.DONE                  # slot frees
+    r._dispatch_pass()
+    assert order == ["j3", "j2"]        # then priority, then FIFO
+    # deadline expiry at the dispatch pass, before any slot is burnt
+    j2.state = jq.DONE
+    j1.deadline_t = time.time() - 1
+    r._dispatch_pass()
+    assert j1.state == jq.DEADLINE_EXCEEDED
+
+
+# ---------------------------------------------------------------------------
+# api.Client request pipelining
+# ---------------------------------------------------------------------------
+
+def test_unix_socket_serving_still_works(tmp_path):
+    """The TCP_NODELAY handler attribute must never reach an AF_UNIX
+    connection (setsockopt raises OSError 95 there and kills every
+    connection before handle() runs — the documented default
+    `--socket` mode): ping + a pipelined batch over a unix socket."""
+    sock = str(tmp_path / "s.sock")
+    srv = Server(socket_path=sock, max_inflight=1)
+    try:
+        srv.start()
+        with Client(socket_path=sock) as c:
+            assert c.request(op="ping")["pong"]
+            assert [r["ok"] for r in
+                    c.pipeline([{"op": "ping"}] * 3)] == [True] * 3
+    finally:
+        srv.stop()
+    r = rt.Router(socket_path=str(tmp_path / "r.sock"),
+                  log=lambda *a: None)
+    try:
+        r.start()
+        with Client(socket_path=str(tmp_path / "r.sock")) as c:
+            assert c.request(op="ping")["router"]
+    finally:
+        r.stop()
+
+
+def test_client_pipelining_matches_sequential_and_orders():
+    srv = Server(port=0, max_inflight=1)
+    try:
+        srv.start()
+        with Client(port=srv.port) as c:
+            # mixed batch: replies come back in request order, errors
+            # as rows (not raises)
+            resps = c.pipeline([{"op": "ping"},
+                                {"op": "status"},
+                                {"op": "nope"},
+                                {"op": "metrics"}])
+            assert [r["ok"] for r in resps] == [True, True, False, True]
+            assert resps[0]["pong"] and "jobs" in resps[1]
+            assert "unknown op" in resps[2]["error"]
+            assert c.pipeline([]) == []
+            with pytest.raises(RuntimeError, match="KeyError"):
+                c.status_many(["missing-job"])
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# bench.stamp_family exact-match (the PR 14 stray-bank regression)
+# ---------------------------------------------------------------------------
+
+def test_stamp_family_exact_match_and_prefix_refusal(tmp_path):
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    bank = str(tmp_path)
+    rec = {"value": 1.0, "shape": "x"}
+    p = bench.stamp_family(rec, "cpu", "MESH2D", "cfg", 13,
+                           bank_dir=bank)
+    assert os.path.basename(p) == "MESH2D_r13.json"
+    # numbering is exact-match per family, never cross-read
+    p = bench.stamp_family(rec, "cpu", "MESH2D", "cfg", 13,
+                           bank_dir=bank)
+    assert os.path.basename(p) == "MESH2D_r14.json"
+    # the regression: a family that PREFIXES a banked one is refused
+    with pytest.raises(ValueError, match="prefix-collides"):
+        bench.stamp_family(rec, "cpu", "MESH", "cfg", 13,
+                           bank_dir=bank)
+    # ... and one a banked family prefixes, equally
+    with pytest.raises(ValueError, match="prefix-collides"):
+        bench.stamp_family(rec, "cpu", "MESH2D2", "cfg", 13,
+                           bank_dir=bank)
+    # underscores cannot parse out of <FAMILY>_rNN.json
+    with pytest.raises(ValueError, match="A-Z"):
+        bench.stamp_family(rec, "cpu", "MESH_2D", "cfg", 13,
+                           bank_dir=bank)
+    # non-colliding families coexist
+    p = bench.stamp_family(rec, "cpu", "SCALEOUT", "cfg", 15,
+                           bank_dir=bank)
+    assert os.path.basename(p) == "SCALEOUT_r15.json"
+    # the repo bank itself holds no prefix-colliding families (the
+    # stray MESH_r13.json was folded into MESH2D_r13.json)
+    assert not os.path.exists(os.path.join(REPO, "MESH_r13.json"))
+    import re
+    fams = set()
+    for f in os.listdir(REPO):
+        m = re.fullmatch(r"([A-Z][A-Z0-9]*)_r(\d+)\.json", f)
+        if m:
+            fams.add(m.group(1))
+    for a in fams:
+        for b in fams:
+            assert a == b or not a.startswith(b), (a, b)
+
+
+# ---------------------------------------------------------------------------
+# sentinel SCALEOUT family (doctored-bank negative test)
+# ---------------------------------------------------------------------------
+
+def _scaleout_rec(**kw):
+    rec = dict(shape="8 jobs router", scaling_1to2=1.8,
+               p99_queue_wait_2w_s=2.0, cache_hit_rate_min_2w=1.0,
+               recovery_wall_s=2.5, recovery_tiles_rerun=0)
+    rec.update(kw)
+    return rec
+
+
+def _write_bank(d, fname, cfg, rec):
+    with open(os.path.join(d, fname), "w") as f:
+        json.dump({"platform": "cpu", "results": {cfg: rec}}, f)
+
+
+def test_sentinel_scaleout_cross_round_check(tmp_path):
+    from sagecal_tpu.obs import sentinel
+    bank = str(tmp_path)
+    _write_bank(bank, "SCALEOUT_r15.json", "10-scaleout",
+                _scaleout_rec())
+    # a clean later round: no violations
+    _write_bank(bank, "SCALEOUT_r16.json", "10-scaleout",
+                _scaleout_rec(scaling_1to2=1.75))
+    assert sentinel.scaleout_cross_round_check("cpu", bank) == []
+    # doctored: collapsed scaling + a recovery that re-ran tiles
+    _write_bank(bank, "SCALEOUT_r16.json", "10-scaleout",
+                _scaleout_rec(scaling_1to2=1.0,
+                              recovery_tiles_rerun=3))
+    viol = sentinel.scaleout_cross_round_check("cpu", bank)
+    metrics = {v["metric"] for v in viol}
+    assert "scaleout_scaling" in metrics
+    assert "scaleout_recovery_rerun" in metrics
+    # ... and the CLI lane fails with the metric named (needs any
+    # BENCH bank present so main() has a platform to check)
+    _write_bank(bank, "BENCH_CPU_r01.json", "cfg",
+                {"shape": "x", "step_s": 1.0})
+    rc = sentinel.main(["--fast", "--no-probes", "--platform", "cpu",
+                        "--bank-dir", bank])
+    assert rc == 1
+    # the committed repo bank must be clean for the new family
+    assert sentinel.scaleout_cross_round_check("cpu") == []
+
+
+def test_sentinel_scaleout_committed_bank_loads():
+    """The committed SCALEOUT round parses, declares its platform,
+    carries every toleranced field, and banked the acceptance gates:
+    1->2-worker scaling >= 1.6, a recovery leg with ZERO tiles re-run
+    and a measured cost, per-job bit-identity, and the regime stated
+    (host core count + which legs left the ingest floor)."""
+    from sagecal_tpu.obs import sentinel
+    banks = sentinel.load_scaleout_banks("cpu", REPO)
+    assert banks, "no committed SCALEOUT_rNN.json"
+    rec = banks[-1][2]["10-scaleout"]
+    for spec in sentinel.SCALEOUT_TOLERANCES.values():
+        assert spec["field"] in rec, spec["field"]
+    assert rec["scaling_1to2"] >= 1.6
+    assert rec["recovery_tiles_rerun"] == 0
+    assert rec["recovery_wall_s"] > 0
+    assert rec["migration"]["tiles_rerun"] == 0
+    assert rec["bit_identical"] is True
+    assert rec["recovery"]["bit_identical"] is True
+    assert isinstance(rec["host_cores"], int)
+    assert "legs_over_floor" in rec["ingest"]
+    assert rec["client_pipelining"]["n_ops"] > 0
+
+
+def test_jaxlint_hot_path_covers_router():
+    from sagecal_tpu.analysis import core
+    assert core.is_hot_path("sagecal_tpu/serve/router.py")
+    assert core.is_hot_path("sagecal_tpu/serve/scheduler.py")
+
+
+# ---------------------------------------------------------------------------
+# LIVE: worker subprocesses (spawn-safe, hard timeouts everywhere)
+# ---------------------------------------------------------------------------
+
+def _make_dataset(tmp_path, name, n_tiles=5, seed=11):
+    sky_path = tmp_path / "sky.txt"
+    if not sky_path.exists():
+        sky_path.write_text(SKY)
+        (tmp_path / "sky.txt.cluster").write_text(CLUSTER)
+    ra0 = (41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(sky_path), ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(
+            str(tmp_path / "sky.txt.cluster")))
+    dsky = rp.sky_to_device(sky, jnp.float32)
+    Jt = ds.random_jones(sky.n_clusters, sky.nchunk, 5, seed=5,
+                         scale=0.1)
+    tiles = [ds.simulate_dataset(
+        dsky, n_stations=5, tilesz=2, freqs=np.array([150e6]),
+        ra0=ra0, dec0=dec0, jones=Jt, nchunk=sky.nchunk,
+        noise_sigma=0.01, seed=seed + t) for t in range(n_tiles)]
+    msdir = tmp_path / name
+    ds.SimMS.create(str(msdir), tiles)
+    return (str(msdir), str(sky_path),
+            str(tmp_path / "sky.txt.cluster"))
+
+
+def _base_config(skyf, clusf, **kw):
+    cfg = dict(sky_model=skyf, cluster_file=clusf, solver_mode=0,
+               max_em_iter=1, max_iter=2, max_lbfgs=0, tile_size=2,
+               solve_fuse="on", solve_promote="off", prefetch=0)
+    cfg.update(kw)
+    return cfg
+
+
+def _spawn_worker(tmp_path, rport, name, faults=None):
+    args = [sys.executable, "-m", "sagecal_tpu.serve", "--worker",
+            "--router", f"127.0.0.1:{rport}", "--port", "0",
+            "--worker-id", name]
+    if faults:
+        args += ["--faults", faults]
+    log = open(str(tmp_path / f"{name}.log"), "w")
+    # JAX_ENABLE_X64 matches conftest's in-process x64 config so the
+    # solo reference and the worker solve the same programs
+    return subprocess.Popen(
+        args, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 JAX_ENABLE_X64="true"))
+
+
+def _reap(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=10)
+
+
+def _assert_solo_identical(tmp_path, msdir, solf, skyf, clusf,
+                           n_tiles, seed):
+    ms2, _, _ = _make_dataset(tmp_path, f"solo_{os.path.basename(msdir)}",
+                              n_tiles=n_tiles, seed=seed)
+    cfg = config_from_dict(_base_config(
+        skyf, clusf, ms=ms2,
+        solutions_file=str(tmp_path / f"solo_{solf}")))
+    pipeline.run(cfg, log=lambda *a: None)
+    outA = ds.SimMS(msdir, data_column="CORRECTED_DATA")
+    outS = ds.SimMS(ms2, data_column="CORRECTED_DATA")
+    for i in range(outA.n_tiles):
+        assert np.array_equal(outA.read_tile(i).x,
+                              outS.read_tile(i).x), f"tile {i}"
+    assert (tmp_path / solf).read_text() \
+        == (tmp_path / f"solo_{solf}").read_text()
+
+
+@pytest.mark.slow
+def test_live_worker_crash_recovery_zero_rerun_bit_identity(tmp_path):
+    """THE cross-process resume gate: the worker_crash fault point
+    kills worker w1 (os._exit, no flush beyond what already landed)
+    at the boundary entering tile 2; the router lease-evicts it and
+    recovers the job onto w2 as a resume from the durable checkpoint
+    watermark. Gates: resume starts EXACTLY at the crash boundary
+    (zero completed tiles re-run) and residuals + solutions are
+    byte-for-byte identical to an uninterrupted solo run."""
+    msA, skyf, clusf = _make_dataset(tmp_path, "a.ms", seed=11)
+    CRASH_TILE = 2
+    plan = json.dumps({"rules": [{"point": "worker_crash",
+                                  "at": [f"crashjob:{CRASH_TILE}"]}]})
+    r = rt.Router(port=0, lease_s=1.0, heartbeat_s=0.2,
+                  log=lambda *a: None)
+    r.start()
+    procs = []
+    try:
+        procs.append(_spawn_worker(tmp_path, r.port, "w1",
+                                   faults=plan))
+        _deadline_loop(lambda: r.metrics()["n_alive"] >= 1, 120,
+                       "w1 registration")
+        with Client(port=r.port) as c:
+            # warm w1's programs with a same-bucket job so the crash
+            # job's tiles run at PACE and every boundary is
+            # heartbeat-observed before the crash
+            msW, _, _ = _make_dataset(tmp_path, "warm.ms", seed=90)
+            wid = c.submit(_base_config(
+                skyf, clusf, ms=msW,
+                solutions_file=str(tmp_path / "w.sol")))
+            assert c.wait(wid, timeout_s=240,
+                          poll_s=0.1)["state"] == jq.DONE
+            jid = c.submit(_base_config(
+                skyf, clusf, ms=msA, tile_arrival_s=0.6,
+                solutions_file=str(tmp_path / "a.sol")),
+                job_id="crashjob")
+            # the survivor registers while the doomed worker solves
+            procs.append(_spawn_worker(tmp_path, r.port, "w2"))
+            _deadline_loop(lambda: r.metrics()["n_alive"] >= 2, 120,
+                           "w2 registration")
+            snap = c.wait(jid, timeout_s=300, poll_s=0.1)
+            assert snap["state"] == jq.DONE, snap
+            assert snap["worker"] == "w2"
+            assert snap["tiles_done"] == 5
+            assert len(snap["hops"]) == 1
+            hop = snap["hops"][0]
+            assert hop["reason"] == "worker_lost"
+            assert hop["src"] == "w1" and hop["dst"] == "w2"
+            # the crash really was the fault point, not a crash of
+            # convenience: os._exit(17)
+            assert procs[0].wait(timeout=20) == 17
+            # zero completed tiles re-run: the resume starts exactly
+            # at the crash boundary (checkpoint durable at tile 1)
+            assert hop["resume_tile"] == CRASH_TILE, hop
+            assert hop["tiles_rerun"] == 0, hop
+            assert hop["wall_s"] > 0 and hop["detect_s"] is not None
+            m = c.metrics()
+            assert m["recoveries"] == 1 and m["lease_evictions"] == 1
+    finally:
+        _reap(procs)
+        r.stop()
+    _assert_solo_identical(tmp_path, msA, "a.sol", skyf, clusf,
+                           n_tiles=5, seed=11)
+
+
+@pytest.mark.slow
+def test_live_cross_process_migration_and_bucket_routing(tmp_path):
+    """A healthy job moves cross-process via the `migrate` op
+    (cancel-at-boundary + shared-filesystem checkpoint resume): zero
+    tiles re-run, outputs bit-identical; and a second job of the same
+    bucket routes to the worker whose heartbeat inventory claims the
+    bucket, not the emptier one."""
+    msA, skyf, clusf = _make_dataset(tmp_path, "a.ms", seed=11)
+    msB, _, _ = _make_dataset(tmp_path, "b.ms", seed=40)
+    r = rt.Router(port=0, lease_s=3.0, heartbeat_s=0.2,
+                  log=lambda *a: None)
+    r.start()
+    procs = []
+    try:
+        procs.append(_spawn_worker(tmp_path, r.port, "w1"))
+        _deadline_loop(lambda: r.metrics()["n_alive"] >= 1, 120,
+                       "w1 registration")
+        with Client(port=r.port) as c:
+            # warm w1's programs first (same bucket): the paced job's
+            # mid-run window must span real wall-clock, not vanish
+            # into one post-compile burst of overdue tiles
+            msW, _, _ = _make_dataset(tmp_path, "warm.ms", seed=90)
+            wid = c.submit(_base_config(
+                skyf, clusf, ms=msW,
+                solutions_file=str(tmp_path / "w.sol")))
+            assert c.wait(wid, timeout_s=240,
+                          poll_s=0.1)["state"] == jq.DONE
+            procs.append(_spawn_worker(tmp_path, r.port, "w2"))
+            _deadline_loop(lambda: r.metrics()["n_alive"] >= 2, 120,
+                           "w2 registration")
+            ja = c.submit(_base_config(
+                skyf, clusf, ms=msA, tile_arrival_s=0.4,
+                solutions_file=str(tmp_path / "a.sol")))
+            snap = _deadline_loop(
+                lambda: (c.status(ja)
+                         if c.status(ja)["state"] == jq.RUNNING
+                         and 1 <= c.status(ja)["tiles_done"] <= 3
+                         else None), 240, "mid-run window", poll_s=0.05)
+            src = snap["worker"]
+            dst = "w2" if src == "w1" else "w1"
+            assert c.request(op="migrate", job_id=ja,
+                             worker=dst)["state"] == jq.MIGRATING
+            snap = c.wait(ja, timeout_s=300, poll_s=0.1)
+            assert snap["state"] == jq.DONE and snap["worker"] == dst
+            hop = snap["hops"][0]
+            assert hop["reason"] == "migrate"
+            assert hop["tiles_rerun"] == 0, hop
+            # bucket routing: the same bucket now has warm programs on
+            # BOTH workers; the sticky affinity + inventory must keep
+            # the next job off the cold path (route to a claimer)
+            _deadline_loop(
+                lambda: any("w" in w["worker_id"] and w["buckets"] > 0
+                            for w in c.metrics()["workers"]),
+                60, "bucket inventory heartbeat")
+            jb = c.submit(_base_config(
+                skyf, clusf, ms=msB,
+                solutions_file=str(tmp_path / "b.sol")))
+            snapb = c.wait(jb, timeout_s=300, poll_s=0.1)
+            assert snapb["state"] == jq.DONE
+            claimers = {w["worker_id"]
+                        for w in c.metrics()["workers"]
+                        if w["buckets"] > 0}
+            assert snapb["worker"] in claimers
+            m = c.metrics()
+            assert m["migrations"] == 1
+    finally:
+        _reap(procs)
+        r.stop()
+    _assert_solo_identical(tmp_path, msA, "a.sol", skyf, clusf,
+                           n_tiles=5, seed=11)
+    _assert_solo_identical(tmp_path, msB, "b.sol", skyf, clusf,
+                           n_tiles=5, seed=40)

@@ -204,6 +204,31 @@ class RunConfig:
     # mode"). 0 = off (the default).
     tile_arrival_s: float = 0.0
 
+    # --- streaming ingest (sagecal_tpu.stream; MIGRATION.md
+    # "Streaming mode"): tiles arrive from a live source instead of a
+    # complete on-disk MS, and the SLO is per-tile arrival->write
+    # latency rather than job makespan.
+    # stream_source : transport spec — "gen[:interval_s]" (seeded
+    # in-process generator over the MS at --ms, released on an arrival
+    # clock; the tests/bench transport), "tail[:path]" (follow a
+    # spool directory a feeder writes tiles into; default path = the
+    # MS itself), "socket:host:port" (length-prefixed npz tile frames
+    # over TCP; tiles spool into the MS directory as they land).
+    # None/"" = batch mode (everything before this PR).
+    stream_source: str | None = None
+    # per-tile deadline, seconds from tile ARRIVAL to its residual
+    # durably written. 0 = no per-tile deadline (lateness still
+    # counted against nothing). A late tile never stalls the stream:
+    # it is counted (stream_tiles_late_total) and handled per
+    # late_policy.
+    tile_deadline_s: float = 0.0
+    # what to do with a late tile: "degrade" (skip its solve, write
+    # the residual from the last-good Jones via the quarantine
+    # writeback path — bounded staleness, bounded latency) or "count"
+    # (solve anyway; lateness is observability only, outputs stay
+    # bit-identical to batch).
+    late_policy: str = "degrade"
+
     # --- observability
     profile_dir: str | None = None     # --profile : jax.profiler trace of
     #                                    the first solve interval

@@ -20,12 +20,17 @@ event            meaning / required extra fields
 ===============  ============================================================
 ``run_start``    first record; run metadata (argv, entry point)
 ``phase``        a timed host phase: ``name`` (io/stage/solve/residual/
-                 write/read/consensus), ``dur_s``; optional ``tile``,
-                 ``bg`` (True when the phase ran on a background
-                 prefetch/writeback thread — under overlapped
-                 execution the "io" phase records the host's WAIT for
-                 the next tile, the bubble, while the thread's own
-                 read/stage time carries ``bg``)
+                 write/read/consensus/arrival_wait), ``dur_s``;
+                 optional ``tile``, ``bg`` (True when the phase ran on
+                 a background prefetch/writeback thread — under
+                 overlapped execution the "io" phase records the
+                 host's WAIT for the next tile, the bubble, while the
+                 thread's own read/stage time carries ``bg``).
+                 ``arrival_wait`` is time spent waiting for a tile to
+                 ARRIVE (ingest pacing or a live stream transport,
+                 sched.Prefetcher) — the tenant's data rate, NEVER
+                 counted as io/bubble; producer-side waits carry
+                 ``bg``, the consumer's overlapping block does not
 ``em_sweep``     one SAGE EM sweep (solvers/sage.py host driver):
                  ``sweep``, ``wall_s``, ``fused``, ``err_reduction``,
                  ``solver_iters`` (cumulative executed inner trips)
@@ -243,9 +248,15 @@ def overlap_stats(recs: list) -> dict:
     residual phase sums. Background (``bg``) phase records are the
     prefetch/writeback threads' own time and never count as bubble.
 
-    Returns ``{"tiles", "wall_s", "busy_s", "bubble_s", "busy_frac",
-    "bubble_frac", "overlap"}`` — fractions are of ``wall_s`` (run_end
-    when present, else the record time span).
+    Arrival waits (the ``arrival_wait`` phase — ingest pacing / live
+    stream transports) are the TENANT'S data rate, not a pipeline
+    bubble: they are summed separately into ``arrival_wait_s`` and
+    excluded from both busy and bubble.
+
+    Returns ``{"tiles", "wall_s", "busy_s", "bubble_s",
+    "arrival_wait_s", "busy_frac", "bubble_frac", "overlap"}`` —
+    fractions are of ``wall_s`` (run_end when present, else the
+    record time span).
     """
     tiles = [r for r in recs if r.get("ev") == "tile"]
     phases = [r for r in recs if r.get("ev") == "phase"
@@ -266,10 +277,13 @@ def overlap_stats(recs: list) -> dict:
         # disk) are the host's data-movement stalls
         bubble = sum(r.get("dur_s", 0.0) for r in phases
                      if r.get("name") in ("io", "write"))
+    arrival = sum(r.get("dur_s", 0.0) for r in phases
+                  if r.get("name") == "arrival_wait")
     wall = wall or 0.0
     return {
         "tiles": len(tiles), "wall_s": wall, "busy_s": busy,
-        "bubble_s": bubble, "overlap": overlap,
+        "bubble_s": bubble, "arrival_wait_s": arrival,
+        "overlap": overlap,
         "busy_frac": (busy / wall) if wall else 0.0,
         "bubble_frac": (bubble / wall) if wall else 0.0,
     }

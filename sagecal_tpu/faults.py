@@ -90,6 +90,22 @@ POINTS = (
     #                     exhausted; kind "fatal": the subband is DEAD
     #                     — masked out of every later consensus).
     #                     Queried via draw(); key = subband index
+    "tile_late",        # serve/scheduler + pipeline: force a streaming
+    #                     tile past its per-tile arrival->write
+    #                     deadline, key "<job_id>:<ti>" (serve) or the
+    #                     tile index (direct runs). Queried via
+    #                     fires(); the stream layer then applies its
+    #                     own lateness policy — count, or degrade to
+    #                     the last-good-Jones writeback — so the chaos
+    #                     lever exercises the REAL late path, not a
+    #                     synthetic clock skew
+    "tile_dropped",     # stream transports: make the transport drop
+    #                     tile i on the floor (never delivered), key =
+    #                     tile index. The consumer observes the index
+    #                     gap, counts stream_tiles_dropped_total and
+    #                     continues — a live stream must survive loss
+    #                     without stalling (gated in tests/
+    #                     test_stream.py)
 )
 
 _KINDS = ("transient", "fatal")

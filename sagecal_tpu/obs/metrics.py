@@ -48,6 +48,18 @@ DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
                    120.0, 300.0, 600.0)
 
+#: per-TILE latency ladder (seconds), 1 ms .. 60 s. The job-scale
+#: ladder (serve.queue.JOB_SLO_BUCKETS) starts at 100 ms, which clamps
+#: p50/p99 for tile-scale arrival-to-write latencies — a 5 ms tile and
+#: a 95 ms tile land in the same bucket. Streaming SLO histograms
+#: (stream_tile_latency_seconds) declare with THIS ladder: dense below
+#: 100 ms where live-tile latency budgets actually live, capped at
+#: 60 s because a tile a minute late is simply "late" (counted in
+#: stream_tiles_late_total), not worth extra buckets.
+TILE_LAT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.02, 0.035, 0.05,
+                    0.075, 0.1, 0.15, 0.25, 0.4, 0.6, 1.0, 1.5, 2.5,
+                    4.0, 6.0, 10.0, 20.0, 40.0, 60.0)
+
 _REGISTRY = None            # module-level singleton; None = disabled
 
 # thread-scoped default labels (serve: per-job attribution). A stack,

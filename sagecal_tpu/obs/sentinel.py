@@ -131,6 +131,24 @@ SCALEOUT_TOLERANCES = {
                                     abs=0.0, better="lower"),
 }
 
+#: streaming-calibration tolerances (STREAM_rNN.json, bench config
+#: 11-stream-latency — live tile ingest with arrival-to-write latency
+#: as the SLO, ISSUE 16): the p99 arrival->durable-residual latency
+#: while a batch job shares the device, the late-tile fraction
+#: (banked 0 — a later round missing ANY deadline regresses the SLO
+#: itself, not just the tail), and the batch tiles re-run across
+#: stream preemptions — banked 0; preemption must resume from
+#: checkpoint, never replay. Judged cross-round like the FLEET/
+#: MESH2D/SCALEOUT families.
+STREAM_TOLERANCES = {
+    "stream_p99_latency": dict(field="p99_latency_s", rel=0.50,
+                               better="lower"),
+    "stream_late_frac": dict(field="late_frac", abs=0.0,
+                             better="lower"),
+    "stream_batch_rerun": dict(field="batch_tiles_rerun", abs=0.0,
+                               better="lower"),
+}
+
 
 def assert_table_contract(header: str) -> None:
     """Every toleranced metric with a named table column must find it
@@ -255,6 +273,12 @@ def load_scaleout_banks(platform: str, bank_dir: str = HERE):
     return load_banks(platform, bank_dir, pattern="SCALEOUT_r*.json")
 
 
+def load_stream_banks(platform: str, bank_dir: str = HERE):
+    """Round-stamped streaming-calibration records (STREAM_rNN.json),
+    oldest first."""
+    return load_banks(platform, bank_dir, pattern="STREAM_r*.json")
+
+
 def _family_cross_round_check(banks, tolerances: dict,
                               tag: str) -> list:
     """Newest round of a record family vs the most recent earlier one,
@@ -314,6 +338,19 @@ def scaleout_cross_round_check(platform: str,
     return _family_cross_round_check(
         load_scaleout_banks(platform, bank_dir), SCALEOUT_TOLERANCES,
         "SCALEOUT")
+
+
+def stream_cross_round_check(platform: str,
+                             bank_dir: str = HERE) -> list:
+    """Newest streaming round vs the most recent earlier one, judged
+    against :data:`STREAM_TOLERANCES` — a later round fattening the
+    p99 arrival->write tail, missing ANY per-tile deadline, or
+    re-running batch tiles across stream preemptions fails CI with
+    the metric named (the ISSUE 16 satellite, mirroring the FLEET,
+    MESH2D and SCALEOUT families)."""
+    return _family_cross_round_check(
+        load_stream_banks(platform, bank_dir), STREAM_TOLERANCES,
+        "STREAM")
 
 
 def cross_round_check(platform: str, bank_dir: str = HERE) -> list:
@@ -652,6 +689,11 @@ def main(argv=None) -> int:
             print(f"sentinel: {plat} scaleout bank r{so[-1][0]:02d} "
                   f"({len(so)} rounds)")
             viol.extend(scaleout_cross_round_check(plat, args.bank_dir))
+        strm = load_stream_banks(plat, args.bank_dir)
+        if strm:
+            print(f"sentinel: {plat} stream bank r{strm[-1][0]:02d} "
+                  f"({len(strm)} rounds)")
+            viol.extend(stream_cross_round_check(plat, args.bank_dir))
         if not args.fast:
             viol.extend(rerun_check(plat, args.bank_dir))
     if not checked_any:

@@ -639,26 +639,44 @@ class FullBatchPipeline:
             prefetch = getattr(self.cfg, "prefetch", 1)
         return max(0, int(prefetch))
 
-    def _tile_source(self, stage_fn, max_tiles, depth, start=0):
+    def _tile_source(self, stage_fn, max_tiles, depth, start=0,
+                     stream=None):
         """Yield ``(ti, tile, staged, io_wait_s)`` with read + host
         staging running ``depth`` tiles ahead on a background thread
         (depth 0: inline — the synchronous reference path). The io
         wait is the consumer's bubble; the thread's own read+stage
-        time is emitted as a ``bg``-tagged "read" phase. ``start``:
-        first tile to produce (checkpoint resume skips completed
-        tiles); the produced payload carries the ABSOLUTE tile id."""
-        n = self.ms.n_tiles
-        if max_tiles is not None:
-            n = min(n, max_tiles)
+        time is emitted as a ``bg``-tagged "read" phase and its
+        wait-for-arrival (pacing or a live transport) as
+        ``arrival_wait`` — never folded into io. ``start``: first tile
+        to produce (checkpoint resume skips completed tiles); the
+        produced payload carries the ABSOLUTE tile id. ``stream``: a
+        :class:`sagecal_tpu.stream.TileStream` — production then runs
+        OPEN-ENDED (tile count unknown; the transport's EndOfStream is
+        the end) and each staged payload carries the tile's arrival
+        stamp for the arrival-to-write latency SLO."""
+        if stream is not None:
+            def produce(_j, _strm=stream):
+                i, tile, t_arr = _strm.take()
+                stg = stage_fn(i, tile)
+                stg["_t_arrival"] = t_arr
+                return i, tile, stg
 
-        def produce(j):
-            i = start + j
-            tile = self.ms.read_tile(i)
-            return i, tile, stage_fn(i, tile)
+            pf = sched.Prefetcher(produce, None, depth=depth,
+                                  arrive=stream.wait_next)
+        else:
+            n = self.ms.n_tiles
+            if max_tiles is not None:
+                n = min(n, max_tiles)
 
-        for _j, (ti, tile, stg), wait in sched.Prefetcher(
+            def produce(j):
+                i = start + j
+                tile = self.ms.read_tile(i)
+                return i, tile, stage_fn(i, tile)
+
+            pf = sched.Prefetcher(
                 produce, max(0, n - start), depth=depth,
-                pace_s=getattr(self.cfg, "tile_arrival_s", 0.0)):
+                pace_s=getattr(self.cfg, "tile_arrival_s", 0.0))
+        for _j, (ti, tile, stg), wait in pf:
             dtrace.emit("phase", name="io", tile=ti, dur_s=wait)
             yield ti, tile, stg, wait
 
@@ -873,7 +891,8 @@ class FullBatchPipeline:
 
     def stepper(self, write_residuals: bool = True, solution_path=None,
                 max_tiles=None, log=print, prefetch=None,
-                trace_ctx=None, on_diverge: str = "reset") -> "TileStepper":
+                trace_ctx=None, on_diverge: str = "reset",
+                open_ended: bool = False) -> "TileStepper":
         """The sequential driver as a resumable per-tile unit: the
         serve scheduler owns ``stage``/``step``/``close`` and may
         interleave many jobs' tiles through one device while each
@@ -886,14 +905,22 @@ class FullBatchPipeline:
                            solution_path=solution_path,
                            max_tiles=max_tiles, log=log,
                            depth=self._prefetch_depth(prefetch),
-                           trace_ctx=trace_ctx, on_diverge=on_diverge)
+                           trace_ctx=trace_ctx, on_diverge=on_diverge,
+                           open_ended=open_ended)
 
     def run(self, write_residuals: bool = True, solution_path=None,
-            max_tiles=None, log=print, prefetch=None):
+            max_tiles=None, log=print, prefetch=None, stream=None):
         """``prefetch``: overlap depth override (None = cfg.prefetch;
         0 = the synchronous reference loop). Outputs are bit-identical
         across depths — only data movement overlaps; the warm-start
-        solve chain stays sequential (tests/test_overlap.py)."""
+        solve chain stays sequential (tests/test_overlap.py).
+        ``stream``: a live :class:`sagecal_tpu.stream.TileStream` —
+        tiles come from the transport (open-ended, arrival-stamped)
+        and each one is checked against the per-tile deadline at step
+        entry (MIGRATION.md "Streaming mode")."""
+        if stream is not None:
+            return self._run_stream(stream, write_residuals,
+                                    solution_path, log, prefetch)
         if getattr(self, "batch_ok", False):
             if getattr(self.cfg, "resume", False):
                 # the batched driver's warm start is batch-granular;
@@ -932,6 +959,30 @@ class FullBatchPipeline:
                 if prof_live:   # abnormal exit or 0-tile run:
                     import jax.profiler
                     jax.profiler.stop_trace()  # close the trace
+        return st.history
+
+    def _run_stream(self, stream, write_residuals=True,
+                    solution_path=None, log=print, prefetch=None):
+        """Direct (non-serve) streaming driver: open-ended stepping
+        over a live :class:`TileStream`, with the per-tile deadline /
+        lateness policy applied at each step entry. The serve
+        scheduler runs the same seam through poll(); this path is the
+        single-job reference (and the bit-identity audit target: with
+        no late degradations the outputs match a batch run of the same
+        tiles exactly)."""
+        depth = self._prefetch_depth(prefetch)
+        st = self.stepper(write_residuals, solution_path, None, log,
+                          prefetch=depth, open_ended=True)
+        try:
+            for ti, tile, stg, io_wait in self._tile_source(
+                    st.stage, None, depth, stream=stream):
+                _late, degrade = stream_tile_late(self.cfg, ti, stg)
+                st.step(ti, tile, stg, io_wait, degrade=degrade)
+        finally:
+            try:
+                st.close()
+            finally:
+                stream.close()
         return st.history
 
     def run_simulation(self, log=print):
@@ -987,6 +1038,29 @@ class FullBatchPipeline:
             log(f"Timeslot: {ti} simulated (mode={int(cfg.simulation)})")
 
 
+def stream_tile_late(cfg, ti, stg, key=None):
+    """Per-tile deadline check at STEP ENTRY (streaming jobs): a tile
+    whose arrival-to-now age already exceeds ``tile_deadline_s`` — or
+    that the ``tile_late`` chaos point forces late — is counted
+    (``stream_tiles_late_total``) and, under ``late_policy="degrade"``,
+    degraded to the last-good-Jones writeback instead of solved. A
+    late tile NEVER stalls the stream. Returns ``(late, degrade)``.
+    Degradation is unsupported under per-channel BFGS (its residual
+    path re-solves; there is no staged last-good writeback), so that
+    combination counts only."""
+    t_arr = stg.get("_t_arrival")
+    ddl = float(getattr(cfg, "tile_deadline_s", 0.0) or 0.0)
+    late = faults.fires("tile_late", key=ti if key is None else key)
+    if not late and ddl > 0.0 and t_arr is not None:
+        late = (time.monotonic() - t_arr) > ddl
+    if not late:
+        return False, False
+    obs.inc("stream_tiles_late_total")
+    degrade = (getattr(cfg, "late_policy", "degrade") == "degrade"
+               and not cfg.per_channel_bfgs)
+    return True, degrade
+
+
 class TileStepper:
     """One job's resumable per-tile execution unit (sequential driver).
 
@@ -1006,7 +1080,7 @@ class TileStepper:
     def __init__(self, pipe: "FullBatchPipeline", write_residuals=True,
                  solution_path=None, max_tiles=None, log=print,
                  depth: int = 0, trace_ctx=None,
-                 on_diverge: str = "reset"):
+                 on_diverge: str = "reset", open_ended: bool = False):
         if on_diverge not in ("reset", "quarantine"):
             raise ValueError(f"on_diverge {on_diverge!r}: "
                              "expected 'reset' or 'quarantine'")
@@ -1020,18 +1094,31 @@ class TileStepper:
         self.n_tiles = ms.n_tiles
         if max_tiles:
             self.n_tiles = min(self.n_tiles, int(max_tiles))
+        # open-ended (streaming) mode: the tile count is NOT known at
+        # start — the transport's EndOfStream is the end, progress is
+        # "tiles so far", and checkpoint/resume is disabled: a live
+        # stream cannot deterministically re-read its past, so the
+        # recovery story is the lateness policy, never a rewind
+        # (MIGRATION.md "Streaming mode")
+        self.open_ended = bool(open_ended)
+        if self.open_ended:
+            self.n_tiles = None
         # tile-boundary checkpoint/resume (MIGRATION.md "Fault
         # tolerance"): the sidecar lives next to the solutions file —
         # no solutions file, no checkpoint. The identity meta refuses
         # resuming against a different dataset/sky/solver shape.
         self._ckpt_meta = dict(
-            n_tiles=int(self.n_tiles), n_stations=int(pipe.n),
+            n_tiles=-1 if self.n_tiles is None else int(self.n_tiles),
+            n_stations=int(pipe.n),
             n_clusters=int(sky.n_clusters), kmax=int(pipe.kmax),
             tilesz=int(meta["tilesz"]))
         self.ckpt_path = (sol.checkpoint_path(solution_path)
-                          if solution_path else None)
+                          if solution_path and not self.open_ended
+                          else None)
         ck = None
-        if getattr(pipe.cfg, "resume", False):
+        if getattr(pipe.cfg, "resume", False) and self.open_ended:
+            log("resume: not applicable to a live stream; ignoring")
+        elif getattr(pipe.cfg, "resume", False):
             if self.ckpt_path is None:
                 log("resume: no solutions file -> no checkpoint; "
                     "starting fresh")
@@ -1160,47 +1247,72 @@ class TileStepper:
 
     # -- device-owner half --------------------------------------------------
 
-    def step(self, ti, tile, stg, io_wait=0.0):
+    def step(self, ti, tile, stg, io_wait=0.0, degrade=False):
         p = self.p
         cfg, ms, sky, meta = p.cfg, p.ms, p.sky, p.ms.meta
         log = self.log
         self.aw.check()  # async write failure -> fail at the boundary
         bubble = io_wait
         t0 = time.time()
+        # streaming: the transport stamped this tile's arrival; the
+        # SLO observation (arrival -> residual durably written) is
+        # submitted to the ordered writer AFTER the residual write
+        t_arr = stg.pop("_t_arrival", None)
         u, v, w = stg["u"], stg["v"], stg["w"]
         sta1, sta2 = stg["sta1"], stg["sta2"]
         x8, flags, wt = stg["x8"], stg["flags"], stg["wt"]
         tile_beam = stg["beam"]
 
-        solver = p._solve_first if self.first else p._solve_rest
-        J_prev = self.J          # the last-good chain (quarantine)
-        J_r8 = jnp.asarray(utils.jones_c2r_np(self.J), p.rdt)
-        t_solve = time.perf_counter()
-        Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8,
-                             tile_beam, tile_idx=ti)
-        self.first = False
-        res_0 = float(info["res_0"])
-        res_1 = float(info["res_1"])
-        mean_nu = float(info["mean_nu"])
-        self.J = utils.jones_r2c_np(np.asarray(Jd_r8))
-        dtrace.emit("phase", name="solve", tile=ti,
-                    dur_s=time.perf_counter() - t_solve)
-        obs.observe("tile_solve_seconds",
-                    time.perf_counter() - t_solve)
+        degraded = bool(degrade) and not cfg.per_channel_bfgs
+        quarantined = False
+        if degraded:
+            # late-tile degradation (stream_tile_late): the tile
+            # missed its per-tile deadline, so its solve is SKIPPED
+            # and its solutions/residual come from the LAST-GOOD
+            # Jones — the quarantine writeback, triggered by the
+            # arrival clock instead of divergence. Bounded staleness
+            # for bounded latency; the chain, divergence watermark
+            # and boost state stay untouched, exactly as quarantine.
+            res_0 = res_1 = mean_nu = float("nan")
+            info = None
+            log(f"tile {ti}: Late (deadline exceeded; writing "
+                "last-good-Jones residual)")
+            obs.inc("stream_tiles_degraded_total")
+            dtrace.emit("degraded", tile=ti)
+        else:
+            solver = p._solve_first if self.first else p._solve_rest
+            J_prev = self.J          # the last-good chain (quarantine)
+            J_r8 = jnp.asarray(utils.jones_c2r_np(self.J), p.rdt)
+            t_solve = time.perf_counter()
+            Jd_r8, info = solver(x8, u, v, w, sta1, sta2, wt, J_r8,
+                                 tile_beam, tile_idx=ti)
+            self.first = False
+            res_0 = float(info["res_0"])
+            res_1 = float(info["res_1"])
+            mean_nu = float(info["mean_nu"])
+            self.J = utils.jones_r2c_np(np.asarray(Jd_r8))
+            dtrace.emit("phase", name="solve", tile=ti,
+                        dur_s=time.perf_counter() - t_solve)
+            obs.observe("tile_solve_seconds",
+                        time.perf_counter() - t_solve)
         # solve_nan: the poisoned-tile chaos seam (a NaN/nonfinite
         # residual drives the divergence policy below)
-        if faults.active() and faults.fires("solve_nan", key=ti):
+        if not degraded and faults.active() \
+                and faults.fires("solve_nan", key=ti):
             res_1 = float("nan")
 
         # divergence handling (fullbatch_mode.cpp:605-621): res_1 of
         # exactly 0.0 means fully flagged data and always takes the
         # reference reset; a genuinely divergent solve takes the
-        # configured policy
-        quarantined = False
-        diverged = res_1 == 0.0 or not np.isfinite(res_1) or (
-                self.res_prev is not None
-                and res_1 > RES_RATIO * self.res_prev)
-        if diverged and res_1 != 0.0 and self.on_diverge == "quarantine":
+        # configured policy. A degraded tile never enters it — its
+        # (skipped) solve produced nothing to judge.
+        diverged = not degraded and (
+                res_1 == 0.0 or not np.isfinite(res_1) or (
+                    self.res_prev is not None
+                    and res_1 > RES_RATIO * self.res_prev))
+        if degraded:
+            pass
+        elif diverged and res_1 != 0.0 and self.on_diverge == "quarantine":
             # quarantine: the poisoned solve never enters the chain —
             # this tile's solutions/residuals come from the LAST-GOOD
             # Jones, the divergence watermark and boost state stay
@@ -1249,6 +1361,13 @@ class TileStepper:
                     p._write_residual_tile, ti, tile, res_r,
                     bg=self.depth > 0)
 
+        if t_arr is not None:
+            # the streaming SLO: arrival -> residual durably written.
+            # Submitted to the SAME ordered writer queue immediately
+            # after this tile's writes, so the stamp is taken only
+            # once they landed (depth 0 runs it inline right here)
+            self.aw.submit(self._observe_stream_latency, ti, t_arr)
+
         if self.writer and self.ckpt_path:
             # checkpoint this tile boundary. Submitted to the SAME
             # ordered writer queue AFTER the tile's solution/residual
@@ -1263,17 +1382,29 @@ class TileStepper:
 
         self._last_tile = ti
         dt = (time.time() - t0) / 60.0
-        log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
-            f"final={res_1:.6g}, Time spent={dt:.3g} minutes, "
-            f"nu={mean_nu:.2f}")
+        if not degraded:
+            log(f"Timeslot: {ti} Residual: initial={res_0:.6g}, "
+                f"final={res_1:.6g}, Time spent={dt:.3g} minutes, "
+                f"nu={mean_nu:.2f}")
         rec = {"tile": ti, "res_0": res_0, "res_1": res_1,
                "mean_nu": mean_nu, "minutes": dt}
         if quarantined:
             rec["quarantined"] = True
+        if degraded:
+            rec["degraded"] = True
         self.history.append(rec)
         _emit_tile_record(ti, res_0, res_1, mean_nu, info, dt,
                           bubble_s=bubble, overlap=self.depth)
         return rec
+
+    def _observe_stream_latency(self, ti, t_arr):
+        """Writer-queue job: the per-tile arrival-to-write latency
+        observation (TILE_LAT_BUCKETS ladder — declared at stream
+        open). Runs strictly after the tile's residual write by
+        AsyncWriter ordering."""
+        lat = time.monotonic() - t_arr
+        obs.observe("stream_tile_latency_seconds", lat)
+        dtrace.emit("stream_latency", tile=ti, latency_s=lat)
 
     def _save_checkpoint(self, state: dict) -> None:
         """Writer-thread half of the checkpoint: runs strictly after
@@ -1413,6 +1544,7 @@ class TileStepper:
             if self.writer:
                 self.writer.close()
         if raise_pending and self.ckpt_path \
+                and self.n_tiles is not None \
                 and self._last_tile >= self.n_tiles - 1:
             try:
                 os.remove(self.ckpt_path)
@@ -1425,16 +1557,26 @@ def run(cfg: RunConfig, log=print):
 
     The three run modes of the reference main.cpp:288-299 (fullbatch /
     stochastic / stochastic-consensus) dispatch here; stochastic modes live
-    in sagecal_tpu.stochastic.
+    in sagecal_tpu.stochastic. ``stream_source`` set dispatches the
+    live-ingest driver (sagecal_tpu.stream; MIGRATION.md "Streaming
+    mode") — the transport owns dataset materialization.
     """
-    ms = ds.open_dataset(cfg.ms, cfg.ms_list, tilesz=cfg.tile_size,
-                         data_column=cfg.input_column,
-                         out_column=cfg.output_column)
+    strm = None
+    if getattr(cfg, "stream_source", None):
+        from sagecal_tpu import stream as tstream
+        strm, ms = tstream.open_stream(cfg, log=log)
+    else:
+        ms = ds.open_dataset(cfg.ms, cfg.ms_list, tilesz=cfg.tile_size,
+                             data_column=cfg.input_column,
+                             out_column=cfg.output_column)
     meta = ms.meta
     sky = skymodel.read_sky_cluster(cfg.sky_model, cfg.cluster_file,
                                     meta["ra0"], meta["dec0"], meta["freq0"],
                                     cfg.format_3)
     pipe = FullBatchPipeline(cfg, ms, sky, log=log)
+    if strm is not None:
+        return pipe.run(solution_path=cfg.solutions_file, log=log,
+                        stream=strm)
     if cfg.simulation != SimulationMode.OFF:
         return pipe.run_simulation(log=log)
     return pipe.run(solution_path=cfg.solutions_file,

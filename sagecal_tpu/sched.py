@@ -80,26 +80,49 @@ def start_host_copy(*arrays) -> None:
             fn()
 
 
+class EndOfStream(Exception):
+    """Raised by an open-ended producer (``n=None``) — by ``fn`` or by
+    the ``arrive`` hook — to signal clean end of input. NOT an error:
+    the Prefetcher converts it into normal iterator/poll() completion,
+    exactly as if a known ``n`` had been reached."""
+
+
 class Prefetcher:
     """Produce ``fn(i)`` for ``i in range(n)`` ``depth`` items ahead.
 
     Iterating yields ``(i, item, wait_s)`` in index order; ``wait_s``
-    is the host time spent BLOCKED on the item. ``depth <= 0`` runs
-    ``fn`` inline (the synchronous reference path) and ``wait_s`` is
-    then the full production time. Producer exceptions re-raise in the
-    consumer with the original traceback; abandoning the iterator
-    (``close()``/GC) cancels the thread.
+    is the host time spent BLOCKED on the item, EXCLUDING any
+    arrival/pacing wait (attributed separately — see below).
+    ``depth <= 0`` runs ``fn`` inline (the synchronous reference path)
+    and ``wait_s`` is then the full production time. Producer
+    exceptions re-raise in the consumer with the original traceback;
+    abandoning the iterator (``close()``/GC) cancels the thread.
+
+    ``n=None`` runs OPEN-ENDED: items are produced for i = 0, 1, ...
+    until ``fn`` (or the ``arrive`` hook) raises :class:`EndOfStream`
+    — the live-ingest regime where the tile count is not known at
+    start (sagecal_tpu.stream).
+
+    Arrival attribution (diag phase ``arrival_wait``): time spent
+    waiting for an item to BECOME AVAILABLE — the ``pace_s`` ingest
+    clock or the ``arrive`` hook's block-until-arrival — is its own
+    phase, never folded into the ``read`` production phase or the
+    consumer's io wait. The producer side emits it ``bg``-tagged; the
+    consumer side emits the portion of its own block that overlapped
+    the wait-for-arrival (so the io bubble stays an honest measure of
+    read/stage cost, not of the tenant's data rate).
     """
 
     #: poll() sentinels (serve scheduler protocol)
     EMPTY = object()    # production still in flight — try again later
     DONE = object()     # all n items consumed
 
-    def __init__(self, fn, n: int, depth: int = 1, name: str = "read",
-                 context=None, ready_event=None,
-                 join_timeout_s: float = 5.0, pace_s: float = 0.0):
+    def __init__(self, fn, n: int | None, depth: int = 1,
+                 name: str = "read", context=None, ready_event=None,
+                 join_timeout_s: float = 5.0, pace_s: float = 0.0,
+                 arrive=None):
         self.fn = fn
-        self.n = int(n)
+        self.n = None if n is None else int(n)
         self.depth = int(depth)
         self.name = name
         self.join_timeout_s = float(join_timeout_s)
@@ -109,6 +132,13 @@ class Prefetcher:
         # quasi-real-time regime, arXiv:1410.2101). Pure wait — the
         # produced bytes, and therefore every output, are unchanged.
         self.pace_s = max(0.0, float(pace_s))
+        # true-streaming arrival hook (sagecal_tpu.stream): a callable
+        # ``arrive(cancel_event) -> t_arrival`` that blocks until the
+        # NEXT item is available and returns its arrival timestamp
+        # (time.monotonic domain), or raises EndOfStream. Supersedes
+        # pace_s when set. Must honor the cancel event so close()
+        # stays prompt.
+        self._arrive = arrive
         self._t0 = time.monotonic()
         # zero-arg context-manager factory entered for the producer
         # thread's lifetime (serve: routes the thread's diag emits to
@@ -133,6 +163,43 @@ class Prefetcher:
 
     # -- producer thread ---------------------------------------------------
 
+    def _wait_arrival(self, i):
+        """Block until item ``i`` is AVAILABLE (the pace_s ingest
+        clock, or the ``arrive`` transport hook). Returns
+        ``(waited_s, t_arrival)`` with ``t_arrival`` in the
+        time.monotonic domain; raises :class:`EndOfStream` when the
+        arrive hook reports end of input. This wait is attributed as
+        the ``arrival_wait`` phase by the caller — NEVER as read/io
+        time: it measures the tenant's data rate, not our cost."""
+        if self._arrive is not None:
+            t0 = time.monotonic()
+            t_arr = self._arrive(self._cancel)
+            return time.monotonic() - t0, t_arr
+        if self.pace_s > 0.0:
+            # ingest pacing: wait out the synthetic arrival time (the
+            # cancel event bounds the wait so close() stays prompt)
+            t0 = time.monotonic()
+            due = self._t0 + i * self.pace_s
+            while not self._cancel.is_set():
+                delay = due - time.monotonic()
+                if delay <= 0:
+                    break
+                self._cancel.wait(min(delay, 0.2))
+            now = time.monotonic()
+            return now - t0, max(due, t0)
+        return 0.0, time.monotonic()
+
+    def _emit_arrival(self, i, waited, bg, observe=True):
+        """The ``arrival_wait`` diag phase (+ metric). The consumer
+        side passes ``observe=False`` — its overlap with the producer's
+        wait is the SAME wall time, and the metric must count each
+        waited second once."""
+        if waited > 0.0:
+            dtrace.emit("phase", name="arrival_wait", tile=i,
+                        dur_s=waited, bg=bg)
+            if observe:
+                obs.observe("tile_arrival_wait_seconds", waited)
+
     def _call(self, i):
         """One production, with the fault-tolerance layer around it:
         the ``reader_thread`` injection point (thread-death chaos
@@ -143,15 +210,6 @@ class Prefetcher:
         Retrying the whole ``fn(i)`` is safe by the staging contract:
         reads are pure and a producer's only durable side effect
         (``DonatedRing.stage``) is its final statement."""
-        if self.pace_s > 0.0:
-            # ingest pacing: wait out the synthetic arrival time (the
-            # cancel event bounds the wait so close() stays prompt)
-            due = self._t0 + i * self.pace_s
-            while not self._cancel.is_set():
-                delay = due - time.monotonic()
-                if delay <= 0:
-                    break
-                self._cancel.wait(min(delay, 0.2))
         faults.inject("reader_thread", key=i)
         return faults.retry_transient(self.fn, (i,), what="read", key=i)
 
@@ -174,43 +232,73 @@ class Prefetcher:
 
     def _produce_loop(self):
         try:
-            for i in range(self.n):
+            i = 0
+            while self.n is None or i < self.n:
                 if self._cancel.is_set():
                     return
+                try:
+                    waited, t_arr = self._wait_arrival(i)
+                except EndOfStream:
+                    break
+                if self._cancel.is_set():
+                    return
+                self._emit_arrival(i, waited, bg=True)
                 t0 = time.perf_counter()
-                item = self._call(i)
+                try:
+                    item = self._call(i)
+                except EndOfStream:
+                    break
                 # the background production time — NOT the consumer's
-                # io wait; tagged bg so attribution stays honest
+                # io wait, and NOT the arrival wait (emitted above);
+                # tagged bg so attribution stays honest
                 dur = time.perf_counter() - t0
                 dtrace.emit("phase", name=self.name, tile=i,
                             dur_s=dur, bg=True)
                 obs.observe("prefetch_read_seconds", dur)
-                if not self._put((i, item)):
+                if not self._put((i, item, t_arr)):
                     return
+                i += 1
         except BaseException as e:      # surface in the consumer
-            self._put((None, e))
+            self._put((None, e, 0.0))
             return
-        self._put((None, None))
+        self._put((None, None, 0.0))
 
     # -- consumer ----------------------------------------------------------
 
     def __iter__(self):
         if self.depth <= 0:
-            for i in range(self.n):
+            i = 0
+            while self.n is None or i < self.n:
+                try:
+                    waited, _t_arr = self._wait_arrival(i)
+                except EndOfStream:
+                    return
+                self._emit_arrival(i, waited, bg=False)
                 t0 = time.perf_counter()
-                item = self._call(i)
+                try:
+                    item = self._call(i)
+                except EndOfStream:
+                    return
                 yield i, item, time.perf_counter() - t0
+                i += 1
             return
         try:
             while True:
-                t0 = time.perf_counter()
-                i, item = self._q.get()
-                wait = time.perf_counter() - t0
+                t0 = time.monotonic()
+                i, item, t_arr = self._q.get()
+                t1 = time.monotonic()
+                wait = t1 - t0
                 if i is None:
                     if item is not None:
                         raise item
                     return
-                yield i, item, wait
+                # split the block: the part spent while the item had
+                # not yet ARRIVED is arrival wait (the tenant's data
+                # rate), only the remainder is the io bubble (our
+                # read/stage cost)
+                arr = min(max(t_arr - t0, 0.0), wait)
+                self._emit_arrival(i, arr, bg=False, observe=False)
+                yield i, item, wait - arr
         finally:
             self.close()
 
@@ -228,15 +316,22 @@ class Prefetcher:
         if self._poll_done:
             return self.DONE
         if self.depth <= 0:
-            if self._poll_next >= self.n:
+            if self.n is not None and self._poll_next >= self.n:
                 self._poll_done = True
                 return self.DONE
             i = self._poll_next
+            try:
+                waited, _t_arr = self._wait_arrival(i)
+                self._emit_arrival(i, waited, bg=False)
+                t0 = time.perf_counter()
+                item = self._call(i)
+            except EndOfStream:
+                self._poll_done = True
+                return self.DONE
             self._poll_next += 1
-            t0 = time.perf_counter()
-            return i, self._call(i), time.perf_counter() - t0
+            return i, item, time.perf_counter() - t0
         try:
-            i, item = self._q.get_nowait()
+            i, item, _t_arr = self._q.get_nowait()
         except queue.Empty:
             return self.EMPTY
         if i is None:

@@ -94,9 +94,19 @@ def config_from_dict(d: dict) -> RunConfig:
     return RunConfig(**kw)
 
 
+#: default submit priority of a streaming job: above the batch default
+#: (0) so the queue's priority-FIFO admits streams first and the
+#: scheduler's preemption policy has a priority gap to act on; an
+#: explicit submit priority always wins
+STREAM_DEFAULT_PRIORITY = 10
+
+
 def job_kind(cfg: RunConfig) -> str:
     """Same dispatch as cli.main: stochastic if -N>0, simulation for
-    -a modes, fullbatch (tile-interleaved) otherwise."""
+    -a modes, stream for live ingest, fullbatch (tile-interleaved)
+    otherwise."""
+    if getattr(cfg, "stream_source", None):
+        return "stream"
     if cfg.n_epochs > 0:
         return "stochastic"
     if cfg.simulation != SimulationMode.OFF:
@@ -188,10 +198,18 @@ class Server:
                     or not cfg.sky_model or not cfg.cluster_file:
                 raise ValueError("config needs ms (or ms_list), "
                                  "sky_model and cluster_file")
+            kind = job_kind(cfg)
+            # streams are latency-SLO work: they default ABOVE batch
+            # priority so they admit first and may preempt batch at a
+            # tile boundary (serve/scheduler.py preemption policy)
+            default_prio = (STREAM_DEFAULT_PRIORITY
+                            if kind == "stream" else 0)
             job = jq.Job(req.get("job_id") or uuid.uuid4().hex[:12],
-                         cfg, priority=int(req.get("priority", 0)),
+                         cfg,
+                         priority=int(req.get("priority",
+                                              default_prio)),
                          trace_path=req.get("trace"),
-                         kind=job_kind(cfg),
+                         kind=kind,
                          deadline_s=req.get("deadline_s"),
                          on_diverge=req.get("on_diverge", "none"))
             self.queue.submit(job)

@@ -198,8 +198,13 @@ def job_bucket(job) -> str | None:
         tb = int(getattr(cfg, "tile_bucket", 0) or 0)
         if tb:
             tilesz = pcache.resolve_bucket(tilesz, tb)
+        # a stream job runs the SAME compiled program set as a
+        # fullbatch job of its shape (the transport only changes who
+        # clocks the reader) — normalize the kind so streams route to
+        # workers already holding warm same-shape batch programs
+        kind = "fullbatch" if job.kind == "stream" else job.kind
         job.bucket = pcache.token(
-            job.kind, tilesz, int(meta["nbase"]),
+            kind, tilesz, int(meta["nbase"]),
             int(meta["n_stations"]), list(meta["freqs"]),
             cfg.sky_model, cfg.cluster_file,
             int(cfg.solver_mode), cfg.max_em_iter, cfg.max_iter,

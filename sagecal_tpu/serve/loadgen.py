@@ -273,6 +273,12 @@ def replay(client, spec, fixtures, workdir: str, log=print,
                    queue_wait_s=qw, e2e_s=e2e,
                    migrations=snap["migrations"],
                    ms=job["ms"], solutions=job["solutions"])
+        if snap.get("kind") == "stream" or snap.get("tiles_late"):
+            # streaming tenants (a template whose config carries
+            # stream_source): per-tile lateness rides the row so a
+            # bench can gate on it without re-polling
+            row["tiles_late"] = snap.get("tiles_late", 0)
+            row["tiles_degraded"] = snap.get("tiles_degraded", 0)
         if "worker" in snap:
             # router replay: which worker PROCESS ran the job (the
             # per-worker routing view; "device" is worker-local)

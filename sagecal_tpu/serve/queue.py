@@ -79,7 +79,10 @@ class Job:
         self.job_id = job_id
         self.cfg = cfg
         self.priority = int(priority)
-        self.kind = kind            # fullbatch | stochastic | sim | mpi
+        self.kind = kind    # fullbatch | stochastic | sim | mpi | stream
+        #   ("stream": live tile ingest, per-TILE deadline semantics —
+        #   cfg.tile_deadline_s, arrival->write — on top of the job
+        #   deadline below; MIGRATION.md "Streaming mode")
         self.argv = argv            # mpi jobs: the raw cli_mpi argv
         self.trace_path = trace_path
         # per-job deadline, relative to submission; the scheduler stops
@@ -129,6 +132,14 @@ class Job:
         self.migrate_to: int | None = None
         self.bucket: str | None = None
         self.migrations: list = []
+        # stream-preemption request (serve/scheduler.py policy): the
+        # owner loop yields this job to its checkpoint at the next
+        # tile boundary so a queued higher-priority stream can admit —
+        # same machinery as migration, target None. Batch-only.
+        self.preempt_requested = False
+        # streaming per-tile lateness accounting (stream jobs only)
+        self.tiles_late = 0
+        self.tiles_degraded = 0
         # the tile a (possibly resumed) run actually started at — 0
         # for a fresh run, the checkpoint watermark + 1 for a resume.
         # Surfaced in the snapshot so a CROSS-PROCESS router can price
@@ -160,6 +171,9 @@ class Job:
             "device": self.device,
             "migrations": self.migrations,
             "resume_start_tile": self.resume_start_tile,
+            # streaming lateness accounting (stream jobs; 0 otherwise)
+            "tiles_late": self.tiles_late,
+            "tiles_degraded": self.tiles_degraded,
         }
 
     def expired(self, now: float | None = None) -> bool:
@@ -302,12 +316,19 @@ class JobQueue:
                                                placer)
 
     def _next_admissible_solo(self, est_bytes_fn, worker_ix) -> Job | None:
-        """Lock held. The pre-fleet admission path, verbatim."""
+        """Lock held. The pre-fleet admission path — verbatim for
+        QUEUED-only populations. MIGRATING jobs (which solo mode only
+        ever sees after a stream PREEMPTION yielded a batch job to its
+        checkpoint) re-enter the same priority-FIFO line: the
+        higher-priority stream admits first, and the preempted batch
+        job resumes as soon as a slot frees — never re-taking the slot
+        ahead of the stream that preempted it."""
         running = [j for j in self._jobs.values()
                    if j.state == RUNNING]
         if len(running) >= self.max_inflight:
             return None
-        queued = [j for j in self._jobs.values() if j.state == QUEUED]
+        queued = [j for j in self._jobs.values()
+                  if j.state in (QUEUED, MIGRATING)]
         queued.sort(key=lambda j: (-j.priority, self._seq[j.job_id]))
         used = sum(j.staged_bytes for j in running)
         for job in queued:

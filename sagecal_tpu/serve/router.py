@@ -628,9 +628,13 @@ class Router:
                 if rj.expired(now):
                     self._finish_locked(rj, jq.DEADLINE_EXCEEDED)
             queued = [rj for rj in queued if rj.state == jq.QUEUED]
-            # resuming hops re-admit ahead of every queued job (they
-            # already held a slot — the jq.MIGRATING discipline)
-            queued.sort(key=lambda rj: (not rj.resume, -rj.priority,
+            # priority strictly first (a high-priority STREAM job must
+            # admit before a batch job it preempted can resume — the
+            # same discipline as jq._next_admissible_solo); among equal
+            # priorities, resuming hops re-admit ahead of every queued
+            # job (they already held a slot — the jq.MIGRATING
+            # discipline)
+            queued.sort(key=lambda rj: (-rj.priority, not rj.resume,
                                         rj.seq))
             for rj in queued:
                 target = self._place(rj)

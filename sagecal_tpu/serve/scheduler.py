@@ -102,18 +102,25 @@ def job_telemetry_ctx(tracer, job_id, ordinal: int = 0, device=None):
 class _RunningJob:
     """Worker-side live state of one running tile-interleaved job."""
 
-    def __init__(self, job, pipe, stepper, prefetcher, tracer, ctx):
+    def __init__(self, job, pipe, stepper, prefetcher, tracer, ctx,
+                 stream=None):
         self.job = job
         self.pipe = pipe
         self.stepper = stepper
         self.pf = prefetcher
         self.tracer = tracer
         self.ctx = ctx                  # per-job telemetry context
+        self.stream = stream            # live TileStream (stream jobs)
         # live convergence health over the per-tile residual stream
         self.health = ohealth.ConvergenceHealth()
 
     def teardown(self, raise_pending: bool = False):
-        self.pf.close()
+        self.pf.close()                 # stops the reader thread first:
+        if self.stream is not None:     # nobody is inside wait_next/
+            try:                        # take when the transport closes
+                self.stream.close()
+            except Exception:
+                pass
         try:
             self.stepper.close(raise_pending=raise_pending)
         finally:
@@ -357,15 +364,26 @@ class Scheduler:
             self._run_opaque(w, job, tracer, ctx)
             return None
         with ctx():
+            strm = None
             if job.kind == "stochastic":
                 st = stochastic.stepper(cfg, log=self._job_log(job),
                                         trace_ctx=ctx)
                 ms = st.ms
             else:
-                ms = ds.open_dataset(cfg.ms, cfg.ms_list,
-                                     tilesz=cfg.tile_size,
-                                     data_column=cfg.input_column,
-                                     out_column=cfg.output_column)
+                if job.kind == "stream":
+                    # live ingest: the transport owns arrival; tiles
+                    # land in a normal SimMS spool so staging, write-
+                    # back and the solve chain below are IDENTICAL to
+                    # a batch job over the same tiles (the bit-identity
+                    # gate, tests/test_stream.py)
+                    from sagecal_tpu import stream as tstream
+                    strm, ms = tstream.open_stream(
+                        cfg, log=self._job_log(job))
+                else:
+                    ms = ds.open_dataset(cfg.ms, cfg.ms_list,
+                                         tilesz=cfg.tile_size,
+                                         data_column=cfg.input_column,
+                                         out_column=cfg.output_column)
                 meta = ms.meta
                 sky = skymodel.read_sky_cluster(
                     cfg.sky_model, cfg.cluster_file, meta["ra0"],
@@ -375,8 +393,10 @@ class Scheduler:
                 st = pipe.stepper(
                     write_residuals=True,
                     solution_path=cfg.solutions_file,
-                    max_tiles=cfg.max_timeslots or None,
+                    max_tiles=(None if strm is not None
+                               else cfg.max_timeslots or None),
                     log=self._job_log(job), trace_ctx=ctx,
+                    open_ended=strm is not None,
                     # divergence quarantine is the stepper's policy;
                     # the job-level "fail" circuit-breaker lives in
                     # _step_ready
@@ -406,18 +426,37 @@ class Scheduler:
                 self.migrations_done += 1
                 obs.inc("serve_migrations_total")
 
-            def produce(j, _ms=ms, _st=st):
-                i = _st.start_tile + j
-                tile = _ms.read_tile(i)
-                return i, tile, _st.stage(i, tile)
+            if strm is not None:
+                # open-ended reader clocked by the transport: the
+                # arrive hook blocks on wait_next (attributed as the
+                # arrival_wait phase, not io bubble) and take() hands
+                # over the already-arrived tile; the arrival stamp
+                # rides the staged dict to the stepper, which closes
+                # the arrival->durable-write latency loop
+                def produce(j, _st=st, _strm=strm):
+                    i, tile, t_arr = _strm.take()
+                    stg = _st.stage(i, tile)
+                    stg["_t_arrival"] = t_arr
+                    return i, tile, stg
 
-            pf = sched.Prefetcher(
-                produce, st.n_tiles - st.start_tile, depth=st.depth,
-                name=f"job-{job.job_id}", context=ctx,
-                ready_event=w.ready,
-                pace_s=float(getattr(cfg, "tile_arrival_s", 0.0) or 0.0))
+                pf = sched.Prefetcher(
+                    produce, None, depth=st.depth,
+                    name=f"job-{job.job_id}", context=ctx,
+                    ready_event=w.ready, arrive=strm.wait_next)
+            else:
+                def produce(j, _ms=ms, _st=st):
+                    i = _st.start_tile + j
+                    tile = _ms.read_tile(i)
+                    return i, tile, _st.stage(i, tile)
+
+                pf = sched.Prefetcher(
+                    produce, st.n_tiles - st.start_tile, depth=st.depth,
+                    name=f"job-{job.job_id}", context=ctx,
+                    ready_event=w.ready,
+                    pace_s=float(getattr(cfg, "tile_arrival_s", 0.0)
+                                 or 0.0))
         return _RunningJob(job, getattr(st, "p", None), st, pf, tracer,
-                           ctx)
+                           ctx, stream=strm)
 
     def _run_opaque(self, w: _Worker, job, tracer, ctx) -> None:
         """Simulation / mpi / tile-batch / consensus-stochastic jobs:
@@ -491,8 +530,10 @@ class Scheduler:
                 continue
             if rj is not None:          # opaque jobs already finished
                 w.running.append(rj)
+                ntxt = ("live stream" if job.n_tiles is None
+                        else f"{job.n_tiles} tiles")
                 self.log(f"[{job.job_id}] running on device {w.ix} "
-                         f"({job.n_tiles} tiles, "
+                         f"({ntxt}, "
                          f"~{job.staged_bytes / 1e6:.0f} MB staged)")
             admitted = True
 
@@ -525,7 +566,8 @@ class Scheduler:
         self.log(f"[{job.job_id}] {state}"
                  + (f": {job.error}" if exc is not None else ""))
 
-    def _yield_for_migration(self, w: _Worker, rj) -> None:
+    def _yield_for_migration(self, w: _Worker, rj,
+                             reason: str = "migrate") -> None:
         """Tile-boundary half of a migration: flush this job's writes
         (the checkpoint sidecar lands LAST on the ordered writer
         queue, so the watermark names only durably-written tiles),
@@ -533,7 +575,13 @@ class Scheduler:
         to the target as a RESUME. The ``migrate_abort`` chaos seam
         fires between the durable flush and the re-queue; recovery is
         the same re-queue with the pin dropped — the checkpoint is
-        already on disk, so an aborted handoff loses zero tiles."""
+        already on disk, so an aborted handoff loses zero tiles.
+
+        ``reason="preempt"`` is the stream-priority path: the target
+        is None (re-queue UNPINNED on this same device's queue, behind
+        the higher-priority stream in the priority FIFO) and the
+        migrations record carries the reason so the bench's zero-rerun
+        gate can find the preemption legs."""
         job = rj.job
         target = job.migrate_to
         job.migrate_to = None
@@ -554,14 +602,14 @@ class Scheduler:
         job.migrations.append(dict(
             src=w.ix, dst=target, tile=rj.stepper._last_tile,
             yield_s=round(time.perf_counter() - t0, 6),
-            t_yield=time.time()))
+            t_yield=time.time(), reason=reason))
         self.log(f"[{job.job_id}] yielded at tile "
-                 f"{rj.stepper._last_tile} for migration "
+                 f"{rj.stepper._last_tile} for {reason} "
                  f"{w.ix} -> {target}")
         try:
             faults.inject("migrate_abort", key=job.job_id)
             self.q.requeue_for_migration(job, target)
-            if self.placer is not None:
+            if self.placer is not None and target is not None:
                 self.placer.rehome(fleet.job_bucket(job), target)
         except BaseException as e:
             # mid-migration death: the handoff is gone but the
@@ -613,6 +661,16 @@ class Scheduler:
                         self._yield_for_migration(w, rj)
                         progressed = True
                         break
+                if job.preempt_requested:
+                    # stream-priority preemption: yield this batch job
+                    # to its checkpoint at this tile boundary so the
+                    # queued higher-priority stream admits; it resumes
+                    # from the watermark (zero tiles re-run) once the
+                    # priority FIFO reaches it again
+                    job.preempt_requested = False
+                    self._yield_for_migration(w, rj, reason="preempt")
+                    progressed = True
+                    break
                 try:
                     with rj.ctx():
                         r = rj.pf.poll()
@@ -637,8 +695,30 @@ class Scheduler:
                                             key=f"{job.job_id}:{ti}"):
                                 import os as _os
                                 _os._exit(17)
+                            degrade = False
+                            if job.kind == "stream":
+                                # per-tile deadline check at the last
+                                # host moment before the solve: a late
+                                # tile is counted, and (late_policy=
+                                # degrade) skips the solve in favour
+                                # of a last-good-Jones writeback so
+                                # the stream never stalls behind it
+                                from sagecal_tpu import pipeline as _pl
+                                late, degrade = _pl.stream_tile_late(
+                                    job.cfg, ti, stg,
+                                    key=f"{job.job_id}:{ti}")
+                                if late:
+                                    job.tiles_late += 1
+                                if degrade:
+                                    job.tiles_degraded += 1
                             t0 = time.perf_counter()
-                            rec = rj.stepper.step(ti, tile, stg, wait)
+                            # the degrade kwarg is TileStepper-only
+                            # (the stochastic stepper shares the step
+                            # contract but has no deadline policy)
+                            kw = ({"degrade": degrade}
+                                  if job.kind == "stream" else {})
+                            rec = rj.stepper.step(ti, tile, stg, wait,
+                                                  **kw)
                             dt = time.perf_counter() - t0
                             w.busy_s += dt
                     if r is sched.Prefetcher.DONE:
@@ -655,7 +735,10 @@ class Scheduler:
                     # entered the chain, so it must not poison the
                     # health watermark either — it is already counted
                     # in tiles_quarantined_total and the diag trace.
-                    if not rec.get("quarantined"):
+                    # a DEGRADED tile never solved: its nan residual
+                    # is a lateness artifact, not a convergence signal
+                    if not rec.get("quarantined") \
+                            and not rec.get("degraded"):
                         job.health = rj.health.update(rec["res_1"])
                         job.health_detail = rj.health.snapshot()
                     w.last_progress_t = time.time()
@@ -684,6 +767,38 @@ class Scheduler:
                     break
         return progressed
 
+    def _maybe_preempt(self, w: _Worker) -> None:
+        """Stream-priority preemption policy. Runs AFTER an admission
+        pass: a stream job still QUEUED at that point is blocked on
+        capacity, not placement. If its priority beats a running,
+        checkpointable batch job on this worker, ask the lowest-
+        priority such victim to yield at its next tile boundary
+        (``preempt_requested`` -> ``_yield_for_migration(reason=
+        "preempt")``). The victim re-queues UNPINNED behind the stream
+        in the priority FIFO and resumes from its durable watermark —
+        zero completed tiles re-run, outputs bit-identical (the same
+        guarantees the migration machinery already gates). At most one
+        yield is in flight fleet-wide, mirroring ``_rebalance``."""
+        jobs = self.q.jobs()
+        waiting = [j for j in jobs
+                   if j.state == jq.QUEUED and j.kind == "stream"]
+        if not waiting:
+            return
+        if any(j.state == jq.MIGRATING or j.migrate_to is not None
+               or j.preempt_requested for j in jobs):
+            return                      # a handoff is already in flight
+        top = max(waiting, key=lambda j: j.priority)
+        cands = [rj for rj in w.running
+                 if rj.job.priority < top.priority
+                 and self._migratable(rj)]
+        if not cands:
+            return
+        victim = min(cands, key=lambda rj: rj.job.priority)
+        victim.job.preempt_requested = True
+        self.log(f"[{victim.job.job_id}] preempting on device {w.ix} "
+                 f"for stream job {top.job_id} "
+                 f"(priority {victim.job.priority} < {top.priority})")
+
     def _worker_loop(self, w: _Worker) -> None:
         """Drive one device until stopped, or — when the queue is
         draining — until everything accepted has finished."""
@@ -693,6 +808,7 @@ class Scheduler:
                     self._finish(w, rj, jq.CANCELLED)
                 return
             self._admit(w)
+            self._maybe_preempt(w)
             progressed = self._step_ready(w)
             if not w.running:
                 if self.q.draining and self.q.idle():

@@ -339,6 +339,37 @@ def test_diag_overlap_attribution(tmp_path):
     assert st0["overlap"] == 0 and st0["bubble_s"] >= 0.0
 
 
+def test_diag_arrival_wait_split_from_io_bubble(tmp_path):
+    """ISSUE 16 satellite: time spent waiting for a tile to ARRIVE
+    (ingest pacing / a live stream transport) is emitted as the
+    ``arrival_wait`` phase — the producer's wall wait bg-tagged, the
+    consumer's overlapping block un-tagged — and ``overlap_stats``
+    reports it as ``arrival_wait_s``, excluded from BOTH busy and
+    bubble (a tenant's data rate is not a pipeline stall)."""
+    from sagecal_tpu import sched
+
+    tr = tmp_path / "arrival.jsonl"
+    trace.enable(str(tr))
+    try:
+        pf = sched.Prefetcher(lambda i: i * 2, 3, depth=1, pace_s=0.03)
+        assert [x for _, x, _ in pf] == [0, 2, 4]
+    finally:
+        trace.disable()
+    recs = trace.read(str(tr))
+    arr = [r for r in recs if r["ev"] == "phase"
+           and r["name"] == "arrival_wait"]
+    assert arr, "paced production emitted no arrival_wait phase"
+    # the producer thread's true wall wait is bg-tagged (tiles 1, 2
+    # each paced 30 ms behind the previous)
+    bg_wait = sum(r["dur_s"] for r in arr if r.get("bg"))
+    assert bg_wait >= 0.04
+    st = trace.overlap_stats(recs)
+    assert st["arrival_wait_s"] > 0.0
+    # split OUT of the io bubble: nothing here blocked on data
+    # movement, so the arrival wait must not surface as bubble/busy
+    assert st["bubble_s"] == 0.0 and st["busy_s"] == 0.0
+
+
 def test_overlap_stats_math():
     recs = [
         {"t": 0.0, "ev": "run_start"},

@@ -645,6 +645,48 @@ def test_serve_socket_drop_client_reconnects(tmp_path, server):
             faults.disable()
 
 
+@pytest.mark.slow
+def test_client_wait_and_pipeline_reconnect_mid_stream(tmp_path, server):
+    """ISSUE 16 satellite: a connection drop in the MIDDLE of a live
+    client session — during submit, during a wait() status poll, and
+    during a pipelined batch — reconnects and completes WITHOUT
+    duplicating the submit (exactly one job exists end to end)."""
+    msA, skyf, clusf = _make_dataset(tmp_path, "wd.ms", seed=11)
+    base = _base_config(skyf, clusf)
+    with Client(port=server.port) as c:
+        assert c.request(op="ping")["pong"]     # connection warm
+        # drop fires inside the submit request: the resend must read
+        # the server-side duplicate refusal as "the first send landed"
+        faults.enable([{"point": "socket_drop", "kind": "fatal",
+                        "times": 1}])
+        try:
+            ja = c.submit(dict(base, ms=msA))
+        finally:
+            faults.disable()
+        # drop fires under a wait() status poll mid-job
+        faults.enable([{"point": "socket_drop", "kind": "fatal",
+                        "times": 1}])
+        try:
+            snap = c.wait(ja, timeout_s=300)
+        finally:
+            faults.disable()
+        assert snap["state"] == jq.DONE
+        # drop mid pipelined batch: the WHOLE batch re-sends, replies
+        # come back in order
+        faults.enable([{"point": "socket_drop", "kind": "fatal",
+                        "times": 1}])
+        try:
+            rows = c.pipeline([{"op": "status", "job_id": ja},
+                               {"op": "ping"}])
+        finally:
+            faults.disable()
+        assert rows[0]["ok"] and rows[0]["job"]["job_id"] == ja
+        assert rows[1]["pong"]
+        # the no-duplicate gate: one submit call -> exactly one job
+        jobs = c.status()
+        assert len(jobs) == 1 and jobs[0]["job_id"] == ja
+
+
 def test_client_duplicate_job_id_still_raises_without_resend(tmp_path,
                                                              server):
     """A GENUINE duplicate job id (no reconnect/resend happened) must

@@ -590,3 +590,68 @@ def test_sentinel_fleet_committed_bank_loads():
         assert spec["field"] in rec, spec["field"]
     assert rec["bit_identical"] is True
     assert rec["migration"]["tiles_rerun"] == 0
+
+
+def _write_stream_bank(dirpath, rnd, rec, platform="cpu"):
+    with open(os.path.join(dirpath, f"STREAM_r{rnd:02d}.json"),
+              "w") as f:
+        json.dump({"platform": platform, "date": "2026-08-07",
+                   "results": {"11-stream-latency": rec}}, f)
+
+
+def _stream_rec(**kw):
+    rec = dict(p99_latency_s=0.58, late_frac=0.0,
+               batch_tiles_rerun=0, shape="stream test")
+    rec.update(kw)
+    return rec
+
+
+def test_sentinel_stream_cross_round(tmp_path, capsys):
+    """ISSUE 16 satellite: the streaming bank (STREAM_rNN.json) is
+    judged like the FLEET/MESH2D/SCALEOUT banks — newest pair, named
+    metric, improvements never fail; a fattened p99 arrival->write
+    tail, ANY missed per-tile deadline, or batch tiles RE-RUN across
+    stream preemptions fails with the metric named."""
+    d = str(tmp_path)
+    _write_stream_bank(d, 16, _stream_rec())
+    assert sentinel.stream_cross_round_check("cpu", d) == []
+    _write_stream_bank(d, 17, _stream_rec(p99_latency_s=0.4))
+    assert sentinel.stream_cross_round_check("cpu", d) == []
+    _write_stream_bank(d, 18, _stream_rec(p99_latency_s=1.5))
+    v = sentinel.stream_cross_round_check("cpu", d)
+    assert len(v) == 1 and v[0]["metric"] == "stream_p99_latency"
+    assert "STREAM r18" in v[0]["msg"]
+    _write_stream_bank(d, 19, _stream_rec(late_frac=0.25,
+                                          batch_tiles_rerun=2))
+    v = sentinel.stream_cross_round_check("cpu", d)
+    assert {x["metric"] for x in v} == {"stream_late_frac",
+                                        "stream_batch_rerun"}
+    # the CLI lane fails with the metric named (needs any BENCH bank
+    # present so main() has a platform to check)
+    shutil.copy(os.path.join(REPO, "BENCH_CPU_r09.json"),
+                os.path.join(d, "BENCH_CPU_r09.json"))
+    rc = sentinel.main(["--fast", "--no-probes", "--platform", "cpu",
+                        "--bank-dir", d])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "stream_late_frac" in err or "late" in err
+    assert sentinel.load_stream_banks("tpu", d) == []
+
+
+def test_sentinel_stream_committed_bank_loads():
+    """The committed STREAM round parses, declares its platform,
+    carries every toleranced field, and banked the acceptance gates:
+    p99 arrival->write under the stated budget while a batch job
+    shared the device, ZERO late tiles, ZERO batch tiles re-run
+    across preemptions (>= 1 preemption actually exercised), and
+    per-job bit-identity vs the batch path."""
+    banks = sentinel.load_stream_banks("cpu", REPO)
+    assert banks, "no committed STREAM_rNN.json"
+    rec = banks[-1][2]["11-stream-latency"]
+    for spec in sentinel.STREAM_TOLERANCES.values():
+        assert spec["field"] in rec, spec["field"]
+    assert rec["p99_latency_s"] <= rec["budget_s"]
+    assert rec["late_frac"] == 0.0
+    assert rec["batch_tiles_rerun"] == 0
+    assert rec["preemptions"] >= 1
+    assert rec["bit_identical"] is True

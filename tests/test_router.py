@@ -351,25 +351,29 @@ def test_place_bucket_affinity_capacity_and_pins():
     assert r._place(job) == "wa"
 
 
-def test_dispatch_pass_is_strict_head_of_line_and_resume_first():
+def test_dispatch_pass_is_strict_head_of_line_priority_first():
+    """Dispatch order is strict priority first (a queued STREAM job
+    must admit before a preempted batch job resumes — ISSUE 16), then
+    resume-before-fresh at EQUAL priority (a recovering job never
+    waits behind new work of its own class), then FIFO."""
     r = _mk_router()
     _add_worker(r, "wa", capacity=1)
     j1 = _add_job(r, "j1", state=jq.QUEUED)
     j2 = _add_job(r, "j2", state=jq.QUEUED)
     j2.priority = 5                     # higher priority: the head
     j3 = _add_job(r, "j3", state=jq.QUEUED)
-    j3.resume = True                    # recovering: ahead of everyone
+    j3.resume = True                    # recovering: ahead of its class
     order = []
     r._forward_submit = lambda rj, w: order.append(rj.job_id)  # stub
     r._dispatch_pass()
-    assert order == ["j3"]              # capacity 1: only the head
-    assert j3.state == rt.DISPATCHED and j3.worker_id == "wa"
-    assert j1.state == jq.QUEUED and j2.state == jq.QUEUED
-    j3.state = jq.DONE                  # slot frees
+    assert order == ["j2"]              # capacity 1: only the head
+    assert j2.state == rt.DISPATCHED and j2.worker_id == "wa"
+    assert j1.state == jq.QUEUED and j3.state == jq.QUEUED
+    j2.state = jq.DONE                  # slot frees
     r._dispatch_pass()
-    assert order == ["j3", "j2"]        # then priority, then FIFO
+    assert order == ["j2", "j3"]        # equal priority: resume first
     # deadline expiry at the dispatch pass, before any slot is burnt
-    j2.state = jq.DONE
+    j3.state = jq.DONE
     j1.deadline_t = time.time() - 1
     r._dispatch_pass()
     assert j1.state == jq.DEADLINE_EXCEEDED

@@ -1,0 +1,350 @@
+"""Streaming-calibration gates (sagecal_tpu.stream, ISSUE 16).
+
+The contracts under test (MIGRATION.md "Streaming mode"):
+
+- the three transports (generator / spool tail / socket) deliver the
+  SAME tiles in index order with honest arrival timestamps, count
+  drops as index gaps (never stalls), and end cleanly;
+- an open-ended ``sched.Prefetcher`` (``n=None`` + ``arrive`` hook)
+  runs until :class:`sagecal_tpu.sched.EndOfStream` and attributes
+  the transport wait as the ``arrival_wait`` phase, not io bubble;
+- a streamed run's written residuals AND solutions are BIT-IDENTICAL
+  to the same tiles run as a batch job (the refuse-to-bank gate's
+  unit-size twin);
+- a late tile (``tile_late`` chaos point / ``tile_deadline_s``) is
+  counted and, under ``late_policy="degrade"``, written back with the
+  last-good Jones instead of stalling the stream;
+- through the server: a stream job preempts a running batch job at a
+  tile boundary, the batch job resumes from its checkpoint with ZERO
+  completed tiles re-run, and both jobs' outputs stay bit-identical
+  to solo runs.
+
+The FAST subset (everything except the live-server test) is in the CI
+fail-fast step.
+"""
+
+import math
+import os
+import sys
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from sagecal_tpu import faults, pipeline, sched, skymodel  # noqa: E402
+from sagecal_tpu import stream as tstream  # noqa: E402
+from sagecal_tpu.io import dataset as ds  # noqa: E402
+from sagecal_tpu.obs import metrics as ometrics  # noqa: E402
+from sagecal_tpu.rime import predict as rp  # noqa: E402
+from sagecal_tpu.serve import queue as jq  # noqa: E402
+from sagecal_tpu.serve.api import Client, Server, config_from_dict  # noqa: E402
+from sagecal_tpu.stream import transport as ttr  # noqa: E402
+
+SKY = "P0A 0 40 0 40 0 0 3.0 0 0 0 0 0 0 0 0 150e6\n"
+CLUSTER = "0 1 P0A\n"
+
+
+@pytest.fixture(autouse=True)
+def _clean_plans():
+    """Never leak a fault plan or obs registry across tests."""
+    faults.disable()
+    ometrics.disable()
+    yield
+    faults.disable()
+    ometrics.disable()
+
+
+def _make_fixture(tmp_path, name, n_tiles=3, seed=11):
+    skyf = tmp_path / "sky.txt"
+    if not skyf.exists():
+        skyf.write_text(SKY)
+        (tmp_path / "sky.txt.cluster").write_text(CLUSTER)
+    ra0 = (41 / 60) * math.pi / 12
+    dec0 = 40 * math.pi / 180
+    srcs = skymodel.parse_sky_model(str(skyf), ra0, dec0, 150e6)
+    sky = skymodel.build_cluster_sky(
+        srcs, skymodel.parse_cluster_file(str(skyf) + ".cluster"))
+    dsky = rp.sky_to_device(sky, jnp.float64)
+    Jt = ds.random_jones(1, sky.nchunk, 6, seed=5, scale=0.1)
+    tiles = [ds.simulate_dataset(dsky, n_stations=6, tilesz=4,
+                                 freqs=np.array([150e6]), ra0=ra0,
+                                 dec0=dec0, jones=Jt, nchunk=sky.nchunk,
+                                 noise_sigma=0.01, seed=seed + t)
+             for t in range(n_tiles)]
+    msdir = tmp_path / name
+    ds.SimMS.create(str(msdir), tiles)
+    return str(msdir), str(skyf), str(skyf) + ".cluster"
+
+
+def _base_config(skyf, clusf, **kw):
+    cfg = dict(sky_model=skyf, cluster_file=clusf, solver_mode=0,
+               max_em_iter=1, max_iter=2, max_lbfgs=2, tile_size=4,
+               solve_fuse="on", solve_promote="off")
+    cfg.update(kw)
+    return cfg
+
+
+def _corrected(msdir, n):
+    out = ds.SimMS(msdir, data_column="CORRECTED_DATA")
+    return [out.read_tile(i).x.copy() for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+def test_tile_lat_buckets_span():
+    """Satellite: the streaming latency ladder spans 1 ms .. 60 s with
+    real sub-100ms resolution (the job-scale default buckets clamp
+    there)."""
+    b = ometrics.TILE_LAT_BUCKETS
+    assert b[0] == 0.001 and b[-1] == 60.0
+    assert list(b) == sorted(b)
+    assert sum(1 for x in b if x < 0.1) >= 6
+
+
+def test_generator_stream_orders_arrivals_and_drops(tmp_path):
+    ms, _, _ = _make_fixture(tmp_path, "g.ms", n_tiles=3)
+    src = ds.SimMS(ms, data_column="DATA")
+    strm = tstream.GeneratorStream(src, interval_s=0.02)
+    t_run0 = time.monotonic()
+    events = list(strm)
+    assert [e[0] for e in events] == [0, 1, 2]
+    arr = [e[2] for e in events]
+    # scheduled arrivals: strictly increasing at the interval, and the
+    # last tile was not available before its due time
+    assert arr == sorted(arr)
+    assert arr[2] - arr[0] == pytest.approx(0.04, abs=0.005)
+    assert time.monotonic() - t_run0 >= 0.035
+    # take() is idempotent until the next wait_next (retry safety)
+    strm2 = tstream.GeneratorStream(src, interval_s=0.0)
+    strm2.wait_next()
+    a = strm2.take()
+    b = strm2.take()
+    assert a[0] == b[0] == 0 and a[2] == b[2]
+    with pytest.raises(sched.EndOfStream):
+        for _ in range(10):
+            strm2.wait_next()
+
+    # a dropped tile is an index GAP plus a counter, never a stall
+    ometrics.enable()
+    faults.enable([{"point": "tile_dropped", "at": [1]}])
+    strm3 = tstream.GeneratorStream(src, interval_s=0.0)
+    assert [e[0] for e in strm3] == [0, 2]
+    reg = ometrics.get()
+    assert reg.get("stream_tiles_dropped_total").value() == 1
+
+
+def test_tail_stream_follows_spool(tmp_path):
+    src, _, _ = _make_fixture(tmp_path, "t.ms", n_tiles=3)
+    spool = str(tmp_path / "spool.ms")
+    ometrics.enable()
+    faults.enable([{"point": "tile_dropped", "at": [1]}])
+    try:
+        feeder = ttr.TailFeeder(src, spool, interval_s=0.02).start()
+        ttr.wait_for_meta(spool)
+        stream = ttr.TailStream(ds.SimMS(spool, data_column="DATA"))
+        events = list(stream)
+        feeder.join()
+    finally:
+        faults.disable()
+    assert [e[0] for e in events] == [0, 2]     # tile 1 dropped on send
+    reg = ometrics.get()
+    assert reg.get("stream_tiles_dropped_total").value() == 1
+    ref = ds.SimMS(src, data_column="DATA")
+    for i, tile, t_arr in events:
+        assert np.array_equal(tile.x, ref.read_tile(i).x)
+        assert t_arr <= time.monotonic()
+
+
+def test_socket_stream_round_trip(tmp_path):
+    src, _, _ = _make_fixture(tmp_path, "s.ms", n_tiles=3)
+    spool = str(tmp_path / "sspool.ms")
+    feeder = ttr.SocketFeeder(src, interval_s=0.01).start()
+    strm = ttr.SocketStream("127.0.0.1", feeder.port, spool)
+    meta = strm.handshake()
+    assert meta["tilesz"] == 4
+    strm.ms = ds.SimMS(spool, data_column="DATA")
+    events = list(strm)
+    feeder.join()
+    strm.close()
+    assert [e[0] for e in events] == [0, 1, 2]
+    ref = ds.SimMS(src, data_column="DATA")
+    for i, tile, _ in events:
+        assert np.array_equal(tile.x, ref.read_tile(i).x)
+    # the spool is a normal SimMS afterwards (write-back compatible)
+    assert ds.SimMS(spool, data_column="DATA").n_tiles == 3
+
+
+# ---------------------------------------------------------------------------
+# open-ended Prefetcher + arrival attribution
+# ---------------------------------------------------------------------------
+
+def test_open_ended_prefetcher_arrive_hook():
+    arrived = []
+
+    def arrive(cancel):
+        if len(arrived) >= 4:
+            raise sched.EndOfStream
+        arrived.append(time.monotonic())
+        return arrived[-1]
+
+    pf = sched.Prefetcher(lambda i: i * 10, None, depth=1,
+                          arrive=arrive)
+    got = list(pf)
+    assert [g[:2] for g in got] == [(0, 0), (1, 10), (2, 20), (3, 30)]
+
+    # poll() path reaches DONE at end of stream too
+    arrived.clear()
+    pf = sched.Prefetcher(lambda i: i, None, depth=1, arrive=arrive)
+    out = []
+    while True:
+        r = pf.poll()
+        if r is sched.Prefetcher.EMPTY:
+            time.sleep(0.002)
+            continue
+        if r is sched.Prefetcher.DONE:
+            break
+        out.append(r[0])
+    assert out == [0, 1, 2, 3]
+    assert pf.poll() is sched.Prefetcher.DONE
+
+
+# ---------------------------------------------------------------------------
+# lateness policy
+# ---------------------------------------------------------------------------
+
+def test_stream_tile_late_policy(tmp_path):
+    ometrics.enable()
+    cfg = config_from_dict(dict(
+        sky_model="x", cluster_file="y", tile_deadline_s=0.05,
+        late_policy="degrade"))
+    # young tile: on time
+    assert pipeline.stream_tile_late(
+        cfg, 0, {"_t_arrival": time.monotonic()}) == (False, False)
+    # stale tile: late + degraded
+    old = {"_t_arrival": time.monotonic() - 1.0}
+    assert pipeline.stream_tile_late(cfg, 1, dict(old)) == (True, True)
+    # count-only policy
+    cfg2 = config_from_dict(dict(
+        sky_model="x", cluster_file="y", tile_deadline_s=0.05,
+        late_policy="count"))
+    assert pipeline.stream_tile_late(cfg2, 2, dict(old)) == (True, False)
+    # the chaos point forces lateness regardless of age
+    faults.enable([{"point": "tile_late", "at": [3]}])
+    assert pipeline.stream_tile_late(
+        cfg, 3, {"_t_arrival": time.monotonic()}) == (True, True)
+    reg = ometrics.get()
+    assert reg.get("stream_tiles_late_total").value() == 3
+
+
+# ---------------------------------------------------------------------------
+# streamed run == batch run (bit-identity), degrade path, SLO histogram
+# ---------------------------------------------------------------------------
+
+def test_stream_run_bit_identical_to_batch(tmp_path):
+    msS, skyf, clusf = _make_fixture(tmp_path, "bs.ms", seed=11)
+    msB, _, _ = _make_fixture(tmp_path, "bb.ms", seed=11)
+    base = _base_config(skyf, clusf)
+    ometrics.enable()
+    hist = pipeline.run(config_from_dict(dict(
+        base, ms=msS, stream_source="gen:0.01",
+        solutions_file=str(tmp_path / "sS.txt"))), log=lambda *a: None)
+    pipeline.run(config_from_dict(dict(
+        base, ms=msB,
+        solutions_file=str(tmp_path / "sB.txt"))), log=lambda *a: None)
+    assert len(hist) == 3 and not any(r.get("degraded") for r in hist)
+    for a, b in zip(_corrected(msS, 3), _corrected(msB, 3)):
+        assert np.array_equal(a, b)
+    assert (tmp_path / "sS.txt").read_text() \
+        == (tmp_path / "sB.txt").read_text()
+    # the arrival-to-durable-write SLO histogram observed every tile
+    m = ometrics.get().get("stream_tile_latency_seconds")
+    assert m is not None and m.percentile(0.99) is not None
+
+
+def test_stream_run_degrades_late_tile(tmp_path):
+    msS, skyf, clusf = _make_fixture(tmp_path, "ds.ms", seed=11)
+    base = _base_config(skyf, clusf)
+    ometrics.enable()
+    faults.enable([{"point": "tile_late", "at": [1]}])
+    try:
+        hist = pipeline.run(config_from_dict(dict(
+            base, ms=msS, stream_source="gen:0",
+            solutions_file=str(tmp_path / "sD.txt"))),
+            log=lambda *a: None)
+    finally:
+        faults.disable()
+    flags = [bool(r.get("degraded")) for r in hist]
+    assert flags == [False, True, False]
+    assert math.isnan(hist[1]["res_1"])     # never solved
+    reg = ometrics.get()
+    assert reg.get("stream_tiles_late_total").value() == 1
+    assert reg.get("stream_tiles_degraded_total").value() == 1
+    # the degraded tile's residual WAS written (last-good Jones): the
+    # stream never stalls, and the output column is fully populated
+    out = _corrected(msS, 3)
+    assert all(np.all(np.isfinite(t)) for t in out)
+
+
+# ---------------------------------------------------------------------------
+# through the server: preemption, zero re-run, bit-identity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serve_stream_preempts_batch_zero_rerun_bit_identical(tmp_path):
+    """The tentpole serve gate: with one device and max_inflight=1, a
+    stream job (default priority 10) submitted while a batch job runs
+    preempts it at a tile boundary; the stream completes; the batch
+    job resumes from its checkpoint with zero completed tiles re-run;
+    BOTH jobs' residuals + solutions are bit-identical to solo runs."""
+    msS, skyf, clusf = _make_fixture(tmp_path, "ss.ms", n_tiles=3,
+                                     seed=11)
+    msS2, _, _ = _make_fixture(tmp_path, "ss2.ms", n_tiles=3, seed=11)
+    msB, _, _ = _make_fixture(tmp_path, "sb.ms", n_tiles=6, seed=50)
+    msB2, _, _ = _make_fixture(tmp_path, "sb2.ms", n_tiles=6, seed=50)
+    base = _base_config(skyf, clusf, tile_arrival_s=0.05)
+    srv = Server(port=0, max_inflight=1)
+    srv.start()
+    try:
+        with Client(port=srv.port) as c:
+            jb = c.submit(dict(base, ms=msB,
+                               solutions_file=str(tmp_path / "b.txt")))
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 60:
+                if c.status(jb)["state"] == jq.RUNNING:
+                    break
+                time.sleep(0.01)
+            js = c.submit(dict(base, ms=msS, stream_source="gen:0.05",
+                               tile_deadline_s=30.0,
+                               solutions_file=str(tmp_path / "s.txt")))
+            snapS = c.wait(js, timeout_s=300)
+            snapB = c.wait(jb, timeout_s=300)
+    finally:
+        srv.stop()
+    assert snapS["state"] == jq.DONE and snapB["state"] == jq.DONE
+    assert snapS["kind"] == "stream" and snapS["priority"] == 10
+    assert snapS["tiles_late"] == 0
+    # the batch job was preempted (reason recorded) and re-ran nothing
+    assert snapB["migrations"], "batch job was never preempted"
+    assert all(m["reason"] == "preempt" for m in snapB["migrations"])
+    assert all(m["tiles_rerun"] == 0 for m in snapB["migrations"])
+
+    base_ref = _base_config(skyf, clusf)
+    pipeline.run(config_from_dict(dict(
+        base_ref, ms=msS2,
+        solutions_file=str(tmp_path / "s_ref.txt"))), log=lambda *a: None)
+    pipeline.run(config_from_dict(dict(
+        base_ref, ms=msB2,
+        solutions_file=str(tmp_path / "b_ref.txt"))), log=lambda *a: None)
+    for a, b in zip(_corrected(msS, 3), _corrected(msS2, 3)):
+        assert np.array_equal(a, b)
+    for a, b in zip(_corrected(msB, 6), _corrected(msB2, 6)):
+        assert np.array_equal(a, b)
+    assert (tmp_path / "s.txt").read_text() \
+        == (tmp_path / "s_ref.txt").read_text()
+    assert (tmp_path / "b.txt").read_text() \
+        == (tmp_path / "b_ref.txt").read_text()

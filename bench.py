@@ -623,6 +623,32 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
             trip = _lower_cost(lm_trip, S((K, P, P), fa), p, S((K,), fa),
                                p, x8, coh, s1, s2, wt, S((B,), i),
                                S((), i))
+        elif use_pk:
+            # fused block-Cholesky damping trip (kernel="pallas",
+            # inner="chol"): lm.py carries the B-independent per-
+            # baseline blocks and executes sweep_pallas.
+            # chol_solve_blocks_shift (assemble + factor WITHOUT the
+            # symmetrize pass + solve) followed by one fused-sweep
+            # row pass at the trial point. Pricing the dense
+            # _chol_solve_shift here would price a body the pallas
+            # path no longer executes (the PR 3 phantom-bytes class);
+            # the retry lax.cond is excluded for the same reason.
+            def lm_trip(pp, qq, pq, Db, JTe, mu, p, x8, coh, s1, s2,
+                        cid, wt):
+                fac = swp.GNBlocks(pp=pp, qq=qq, pq=pq, D=Db)
+                dp, _ = swp.chol_solve_blocks_shift(
+                    fac, JTe, mu + 1e-9, s1, s2, N, reduced=reduced)
+                Jn = ne.jones_r2c((p + dp).reshape(K, N, 8))
+                # blocks AND acceptance cost from the body's single
+                # fused row pass (lm.py); no separate cost evaluation
+                return swp.gn_blocks(x8, Jn, coh, s1, s2, cid, wt, N,
+                                     K, nb_)
+
+            trip = _lower_cost(
+                lm_trip, S((K, nb_, 2, 4, 4), fa),
+                S((K, nb_, 2, 4, 4), fa), S((K, nb_, 2, 2, 4, 4), fa),
+                S((K, N, 2, 4, 4), fa), p, S((K,), fa), p, x8, coh,
+                s1, s2, cid, wt)
         else:
             def lm_trip(JTJ, JTe, mu, p, x8, coh, s1, s2, cid, wt):
                 # price the executed all-ok solve body, NOT
@@ -638,9 +664,6 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
                 Jn = ne.jones_r2c((p + dp).reshape(K, N, 8))
                 # normal equations AND acceptance cost from the body's
                 # single row pass (lm.py); no separate cost evaluation
-                if use_pk:
-                    return swp.normal_equations_fused(
-                        x8, Jn, coh, s1, s2, cid, wt, N, K, nb_)
                 return ne.normal_equations(x8, Jn, coh, s1, s2, cid, wt,
                                            N, K, row_period=int(nbase))
 
@@ -1962,7 +1985,12 @@ def stamp_family(rec: dict, platform: str, family: str,
     other's rounds (regression-gated in tests/test_router.py)."""
     import glob as _glob
     import re as _re
-    bank_dir = bank_dir or HERE
+    # SAGECAL_BANK_DIR: the burn-down --dry-run's scratch-bank
+    # redirect — bench configs stamp their family records there
+    # instead of the repo root, so a CI rehearsal never touches the
+    # committed rounds (tools_dev/burndown.py)
+    bank_dir = (bank_dir or os.environ.get("SAGECAL_BANK_DIR")
+                or HERE)
     if not _re.fullmatch(r"[A-Z][A-Z0-9]*", family):
         raise ValueError(
             f"stamp_family: family {family!r} must match "

@@ -149,6 +149,28 @@ STREAM_TOLERANCES = {
                                better="lower"),
 }
 
+#: kernel-melt tolerances (BSCALING_rNN.json, tools_dev/northstar.py
+#: --b-scaling --inner both --kernel both — the kernel on/off x inner
+#: chol/cg ladder, ISSUE 17): the pallas-vs-xla per-cluster delta in
+#: PERCENT at the full-B rung (the fused-chol melt headline) and at
+#: the quarter-B floor, per inner, plus the cg-vs-chol price under
+#: the pallas kernel. All fields are signed percentages (negative =
+#: pallas/cg cheaper), so slack is ABSOLUTE percentage points — a
+#: relative slack on a near-zero or negative delta would be
+#: meaningless. Fields absent from the earlier round (the round-17
+#: full-B/small-rung additions vs r11) are skipped by ``compare`` and
+#: start being judged the first round after both sides carry them.
+KMELT_TOLERANCES = {
+    "kmelt_full_chol": dict(field="full_pallas_vs_xla_pct_chol",
+                            abs=8.0, better="lower"),
+    "kmelt_floor_chol": dict(field="floor_pallas_vs_xla_pct_chol",
+                             abs=8.0, better="lower"),
+    "kmelt_floor_cg": dict(field="floor_pallas_vs_xla_pct_cg",
+                           abs=15.0, better="lower"),
+    "kmelt_cg_price": dict(field="cg_vs_chol_pct_pallas",
+                           abs=80.0, better="lower"),
+}
+
 
 def assert_table_contract(header: str) -> None:
     """Every toleranced metric with a named table column must find it
@@ -279,6 +301,34 @@ def load_stream_banks(platform: str, bank_dir: str = HERE):
     return load_banks(platform, bank_dir, pattern="STREAM_r*.json")
 
 
+def load_kmelt_banks(platform: str, bank_dir: str = HERE):
+    """Round-stamped kernel-melt ladders (BSCALING_rNN.json), oldest
+    first. BSCALING records predate :func:`bench.stamp_family` and are
+    BARE — no ``{"results": {...}}`` envelope — so this loader adapts
+    them to the ``load_banks`` tuple shape by wrapping each record
+    under the single config name ``"b-scaling"``. Platform hygiene is
+    the same: a record whose declared platform mismatches is skipped.
+    Round 7 (chol-vs-cg only, no kernel axis) carries none of the
+    :data:`KMELT_TOLERANCES` fields and drops out of the comparison
+    via the absent-field guard in :func:`compare`."""
+    out = []
+    for p in sorted(glob.glob(os.path.join(bank_dir,
+                                           "BSCALING_r*.json"))):
+        m = re.search(r"_r(\d+)\.json$", p)
+        if not m:
+            continue
+        try:
+            with open(p) as f:
+                d = json.load(f)
+        except Exception:
+            continue
+        if d.get("platform") != platform:
+            continue
+        out.append((int(m.group(1)), p, {"b-scaling": d}))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
 def _family_cross_round_check(banks, tolerances: dict,
                               tag: str) -> list:
     """Newest round of a record family vs the most recent earlier one,
@@ -351,6 +401,21 @@ def stream_cross_round_check(platform: str,
     return _family_cross_round_check(
         load_stream_banks(platform, bank_dir), STREAM_TOLERANCES,
         "STREAM")
+
+
+def kmelt_cross_round_check(platform: str,
+                            bank_dir: str = HERE) -> list:
+    """Newest kernel-melt round vs the most recent earlier one, judged
+    against :data:`KMELT_TOLERANCES` — a later round regressing the
+    fused-chol pallas-vs-xla delta at full B, fattening the quarter-B
+    floor under either inner, or inflating the cg trip price under the
+    kernel fails CI with the metric named (the ISSUE 17 satellite,
+    mirroring the FLEET/MESH2D/SCALEOUT/STREAM families). The compare
+    body is shape-guarded: a ladder banked at a different north-star
+    shape makes no cross-round claim."""
+    return _family_cross_round_check(
+        load_kmelt_banks(platform, bank_dir), KMELT_TOLERANCES,
+        "KMELT")
 
 
 def cross_round_check(platform: str, bank_dir: str = HERE) -> list:
@@ -571,19 +636,22 @@ def probe_kernel() -> list:
     wt = jnp.ones((B, 8), jnp.float32)
     J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex64), (1, N, 1, 1))
 
-    @functools.partial(jax.jit, static_argnames=("kern",))
-    def _solve(x8, coh, s1, s2, cid, wt, J0, kern):
-        cfg = lm_mod.LMConfig(itmax=3, kernel=kern)
+    @functools.partial(jax.jit, static_argnames=("kern", "inner"))
+    def _solve(x8, coh, s1, s2, cid, wt, J0, kern, inner):
+        cfg = lm_mod.LMConfig(itmax=3, kernel=kern, inner=inner)
         J, _ = lm_mod.lm_solve(x8, coh, s1, s2, cid, wt, J0, N,
                                row_period=nb, config=cfg)
         return J
 
-    def solve(kern):
+    def solve(kern, inner="chol"):
         return _solve(x8, coh, s1, s2, cid, wt, J0,
-                      kern=kern).block_until_ready()
+                      kern=kern, inner=inner).block_until_ready()
 
     solve("xla")                               # warm the default path
-    solve("pallas")                            # kernel on (may compile)
+    # kernel on, BOTH inner dispatches (may compile): "chol" is the
+    # ISSUE 17 fused block-Cholesky stage, "cg" the matrix-free inner
+    solve("pallas", "chol")
+    solve("pallas", "cg")
     with guard.CompileGuard() as g:
         solve("xla")                           # back to default: cached
     if g.compiles:
@@ -591,9 +659,19 @@ def probe_kernel() -> list:
                  "field": "compiles", "live": float(g.compiles),
                  "banked": 0.0, "limit": 0.0, "source": "probe",
                  "msg": (f"probe/kernel: returning to kernel='xla' "
-                         f"after a pallas solve added {g.compiles} "
-                         "compiles — the kernel flag poisons the "
-                         "default path's compile cache")}]
+                         f"after pallas chol+cg solves added "
+                         f"{g.compiles} compiles — the kernel flag "
+                         "poisons the default path's compile cache")}]
+    with guard.CompileGuard() as g2:
+        solve("pallas", "chol")     # re-entry: fused-chol stays cached
+    if g2.compiles:
+        return [{"config": "probe", "metric": "cache",
+                 "field": "compiles", "live": float(g2.compiles),
+                 "banked": 0.0, "limit": 0.0, "source": "probe",
+                 "msg": (f"probe/kernel: re-entering the pallas "
+                         f"fused-chol dispatch added {g2.compiles} "
+                         "compiles — the chol stage does not cache "
+                         "as its own static program")}]
     return []
 
 
@@ -667,13 +745,22 @@ def main(argv=None) -> int:
     viol = []
     for plat in platforms:
         banks = load_banks(plat, args.bank_dir)
-        if not banks:
+        # a bank dir holding ONLY standalone family records (the
+        # burn-down's scratch dir: BSCALING/MESH2D without a BENCH
+        # series) is still a checkable bank — don't bail to rc 2
+        if not banks and not any(
+                ld(plat, args.bank_dir) for ld in
+                (load_fleet_banks, load_mesh_banks,
+                 load_scaleout_banks, load_stream_banks,
+                 load_kmelt_banks)):
             continue
         checked_any = True
-        newest = banks[-1]
-        print(f"sentinel: {plat} bank r{newest[0]:02d} "
-              f"({len(banks)} rounds, {os.path.basename(newest[1])})")
-        viol.extend(cross_round_check(plat, args.bank_dir))
+        if banks:
+            newest = banks[-1]
+            print(f"sentinel: {plat} bank r{newest[0]:02d} "
+                  f"({len(banks)} rounds, "
+                  f"{os.path.basename(newest[1])})")
+            viol.extend(cross_round_check(plat, args.bank_dir))
         fleet = load_fleet_banks(plat, args.bank_dir)
         if fleet:
             print(f"sentinel: {plat} fleet bank r{fleet[-1][0]:02d} "
@@ -694,6 +781,11 @@ def main(argv=None) -> int:
             print(f"sentinel: {plat} stream bank r{strm[-1][0]:02d} "
                   f"({len(strm)} rounds)")
             viol.extend(stream_cross_round_check(plat, args.bank_dir))
+        km = load_kmelt_banks(plat, args.bank_dir)
+        if km:
+            print(f"sentinel: {plat} kmelt bank r{km[-1][0]:02d} "
+                  f"({len(km)} rounds)")
+            viol.extend(kmelt_cross_round_check(plat, args.bank_dir))
         if not args.fast:
             viol.extend(rerun_check(plat, args.bank_dir))
     if not checked_any:

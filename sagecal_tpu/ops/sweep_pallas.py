@@ -168,45 +168,25 @@ def _mb_entry(a, ri, d, ci):
     return (-1.0, "r", a, d)                    # ri == 1, ci == 1
 
 
-def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
-                  jpr_ref, jpi_ref, jqr_ref, jqi_ref, pp_ref, qq_ref,
-                  pq_ref, jte_ref, cost_ref, *, acc, reduced, st,
-                  kmax):
-    """One (chunk, time-block) grid cell of the fused sweep.
+def _sweep_body(x, w, cw, chre, chim, jpr, jpi, jqr, jqi, *, acc,
+                reduced, st):
+    """The fused sweep's per-cell math, shared by the per-visit kernel
+    (:func:`_sweep_kernel`) and the multi-visit K-major kernel
+    (:func:`_visits_kernel`).
 
-    Refs: x/w/cw [bt, nb, 8] storage; cid [bt, nb] int32 (row chunk
-    ids); chr/chi [bt, nb, 2, 2] acc (coherency re/im); jp*/jq*
-    [1, nb, 2, 2] acc (THIS chunk's per-baseline Jones re/im). Outputs
-    accumulate across time cells per chunk (out index_map pinned to the
-    chunk axis): pp/qq [1, 2, 4, 4, nb], pq [1, 2, 2, 4, 4, nb],
-    jte [1, 2, 2, 4, nb] (side p/q first), cost [1, nb] — acc dtype.
+    Inputs: x/w/cw [bt, nb, 8] in acc (weights already chunk-masked);
+    chre/chim [bt, nb, 2, 2]; jpr/jpi/jqr/jqi [nb, 2, 2]. Returns the
+    time-contracted per-baseline partials (pp [2, 4, 4, nb],
+    qq [2, 4, 4, nb], pq [2, 2, 4, 4, nb], jte [2, 2, 4, nb] side
+    p/q first, cost [nb]) — elementwise the same accumulation chains
+    the pre-refactor kernel wrote per (a, i, j), just stacked.
     """
-    k = pl.program_id(0)
-
-    @pl.when(pl.program_id(1) == 0)
-    def _init():
-        pp_ref[...] = jnp.zeros_like(pp_ref)
-        qq_ref[...] = jnp.zeros_like(qq_ref)
-        pq_ref[...] = jnp.zeros_like(pq_ref)
-        jte_ref[...] = jnp.zeros_like(jte_ref)
-        cost_ref[...] = jnp.zeros_like(cost_ref)
-
-    x = x_ref[...].astype(acc)                  # [bt, nb, 8]
-    w = w_ref[...].astype(acc)
-    cw = cw_ref[...].astype(acc)
-    if kmax > 1:
-        # hybrid-chunk row mask: this cell contributes chunk k's rows
-        # only (chunk blocks are time-contiguous, so whole planes
-        # usually mask 0/1; the multiply keeps it branch-free)
-        mk = (cid_ref[...] == k).astype(acc)    # [bt, nb]
-        w = w * mk[..., None]
-        cw = cw * mk[..., None]
-    Cr = _cplx_mats(chr_ref[...], "C")          # [bt, nb] planes
-    Ci = _cplx_mats(chi_ref[...], "C")
-    Pr = _cplx_mats(jpr_ref[0], "P")            # [nb] planes
-    Pi = _cplx_mats(jpi_ref[0], "P")
-    Qr = _cplx_mats(jqr_ref[0], "Q")
-    Qi = _cplx_mats(jqi_ref[0], "Q")
+    Cr = _cplx_mats(chre, "C")                  # [bt, nb] planes
+    Ci = _cplx_mats(chim, "C")
+    Pr = _cplx_mats(jpr, "P")                   # [nb] planes
+    Pi = _cplx_mats(jpi, "P")
+    Qr = _cplx_mats(jqr, "Q")
+    Qi = _cplx_mats(jqi, "Q")
 
     def cpx_mm(Xr, Xi, xn, Yr, Yi, yn, conj_t=False):
         """2x2 complex matmul on plane dicts: X @ Y (or X @ Y^H)."""
@@ -273,14 +253,17 @@ def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
                 rw2[(a, o, ri)] = r_ * wv * wv
                 rcp = r_ * comp(cw, a, o, ri)
                 rc = rcp * rcp if rc is None else rc + rcp * rcp
-    cost_ref[0, :] += jnp.sum(rc, axis=0)
+    cost = jnp.sum(rc, axis=0)
 
     def tsum(p):                                # [bt, nb] -> [nb]
         return jnp.sum(p, axis=0)
 
     # per-baseline Gram/gradient partials, signs folded at trace time
+    pp_rows = []
     for a in range(2):
+        rows = []
         for i in range(4):
+            cols = []
             for j in range(4):
                 accu = None
                 for o in range(2):
@@ -289,9 +272,15 @@ def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
                         sj, mj = MA(o, ri, j)
                         t = (si * sj) * (w2[(a, o, ri)] * mi * mj)
                         accu = t if accu is None else accu + t
-                pp_ref[0, a, i, j, :] += tsum(accu)
+                cols.append(tsum(accu))
+            rows.append(jnp.stack(cols))
+        pp_rows.append(jnp.stack(rows))
+    pp = jnp.stack(pp_rows)                     # [2, 4, 4, nb]
+    qq_rows = []
     for o in range(2):
+        rows = []
         for i in range(4):
+            cols = []
             for j in range(4):
                 accu = None
                 for a in range(2):
@@ -300,10 +289,17 @@ def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
                         sj, mj = MB(a, ri, j)
                         t = (si * sj) * (w2[(a, o, ri)] * mi * mj)
                         accu = t if accu is None else accu + t
-                qq_ref[0, o, i, j, :] += tsum(accu)
+                cols.append(tsum(accu))
+            rows.append(jnp.stack(cols))
+        qq_rows.append(jnp.stack(rows))
+    qq = jnp.stack(qq_rows)                     # [2, 4, 4, nb]
+    pq_outer = []
     for a in range(2):
+        pq_inner = []
         for o in range(2):
+            rows = []
             for i in range(4):
+                cols = []
                 for j in range(4):
                     accu = None
                     for ri in range(2):
@@ -311,8 +307,14 @@ def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
                         sj, mj = MB(a, ri, j)
                         t = (si * sj) * (w2[(a, o, ri)] * mi * mj)
                         accu = t if accu is None else accu + t
-                    pq_ref[0, a, o, i, j, :] += tsum(accu)
+                    cols.append(tsum(accu))
+                rows.append(jnp.stack(cols))
+            pq_inner.append(jnp.stack(rows))
+        pq_outer.append(jnp.stack(pq_inner))
+    pq = jnp.stack(pq_outer)                    # [2, 2, 4, 4, nb]
+    jp_rows = []
     for a in range(2):
+        cols = []
         for i in range(4):
             accu = None
             for o in range(2):
@@ -320,8 +322,11 @@ def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
                     si, mi = MA(o, ri, i)
                     t = si * (rw2[(a, o, ri)] * mi)
                     accu = t if accu is None else accu + t
-            jte_ref[0, 0, a, i, :] += tsum(accu)
+            cols.append(tsum(accu))
+        jp_rows.append(jnp.stack(cols))
+    jq_rows = []
     for o in range(2):
+        cols = []
         for i in range(4):
             accu = None
             for a in range(2):
@@ -329,7 +334,92 @@ def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
                     si, mi = MB(a, ri, i)
                     t = si * (rw2[(a, o, ri)] * mi)
                     accu = t if accu is None else accu + t
-            jte_ref[0, 1, o, i, :] += tsum(accu)
+            cols.append(tsum(accu))
+        jq_rows.append(jnp.stack(cols))
+    jte = jnp.stack([jnp.stack(jp_rows), jnp.stack(jq_rows)])
+    return pp, qq, pq, jte, cost
+
+
+def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
+                  jpr_ref, jpi_ref, jqr_ref, jqi_ref, pp_ref, qq_ref,
+                  pq_ref, jte_ref, cost_ref, *, acc, reduced, st,
+                  kmax):
+    """One (chunk, time-block) grid cell of the fused sweep.
+
+    Refs: x/w/cw [bt, nb, 8] storage; cid [bt, nb] int32 (row chunk
+    ids); chr/chi [bt, nb, 2, 2] acc (coherency re/im); jp*/jq*
+    [1, nb, 2, 2] acc (THIS chunk's per-baseline Jones re/im). Outputs
+    accumulate across time cells per chunk (out index_map pinned to the
+    chunk axis): pp/qq [1, 2, 4, 4, nb], pq [1, 2, 2, 4, 4, nb],
+    jte [1, 2, 2, 4, nb] (side p/q first), cost [1, nb] — acc dtype.
+    """
+    k = pl.program_id(0)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        pp_ref[...] = jnp.zeros_like(pp_ref)
+        qq_ref[...] = jnp.zeros_like(qq_ref)
+        pq_ref[...] = jnp.zeros_like(pq_ref)
+        jte_ref[...] = jnp.zeros_like(jte_ref)
+        cost_ref[...] = jnp.zeros_like(cost_ref)
+
+    x = x_ref[...].astype(acc)                  # [bt, nb, 8]
+    w = w_ref[...].astype(acc)
+    cw = cw_ref[...].astype(acc)
+    if kmax > 1:
+        # hybrid-chunk row mask: this cell contributes chunk k's rows
+        # only (chunk blocks are time-contiguous, so whole planes
+        # usually mask 0/1; the multiply keeps it branch-free)
+        mk = (cid_ref[...] == k).astype(acc)    # [bt, nb]
+        w = w * mk[..., None]
+        cw = cw * mk[..., None]
+    pp, qq, pq, jte, cost = _sweep_body(
+        x, w, cw, chr_ref[...], chi_ref[...], jpr_ref[0], jpi_ref[0],
+        jqr_ref[0], jqi_ref[0], acc=acc, reduced=reduced, st=st)
+    pp_ref[0] += pp
+    qq_ref[0] += qq
+    pq_ref[0] += pq
+    jte_ref[0] += jte
+    cost_ref[0, :] += cost
+
+
+def _visits_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
+                   jpr_ref, jpi_ref, jqr_ref, jqi_ref, pp_ref, qq_ref,
+                   pq_ref, jte_ref, cost_ref, *, acc, reduced, st,
+                   kmax):
+    """One (time-block, visit*chunk) grid cell of the MULTI-VISIT
+    K-major sweep: V cluster visits share one grid so the per-call
+    floor (and any row operand the visits share — weights, cost
+    weights, chunk ids — see :func:`sweep_blocks_visits`) amortizes
+    across directions.
+
+    The grid is (T//bt, V*K) with the time axis OUTER: for a fixed
+    time block the inner axis sweeps every (visit, chunk) cell, so a
+    shared row block's index_map is constant across consecutive cells
+    (fetched once per time block, not once per visit). Each output
+    block is written exactly ONCE (cell (t, vk) owns out[t, vk]) — the
+    cross-time reduction happens outside the kernel, keeping the
+    revisit pattern trivially legal for compiled Mosaic. Refs carry a
+    leading singleton visit axis (shared operands are pinned to index
+    0 by their spec); jones refs are [1, 1, nb, 2, 2] (visit, chunk).
+    """
+    k = pl.program_id(1) % kmax
+
+    x = x_ref[0].astype(acc)                    # [bt, nb, 8]
+    w = w_ref[0].astype(acc)
+    cw = cw_ref[0].astype(acc)
+    if kmax > 1:
+        mk = (cid_ref[0] == k).astype(acc)      # [bt, nb]
+        w = w * mk[..., None]
+        cw = cw * mk[..., None]
+    pp, qq, pq, jte, cost = _sweep_body(
+        x, w, cw, chr_ref[0], chi_ref[0], jpr_ref[0, 0], jpi_ref[0, 0],
+        jqr_ref[0, 0], jqi_ref[0, 0], acc=acc, reduced=reduced, st=st)
+    pp_ref[0, 0] = pp
+    qq_ref[0, 0] = qq
+    pq_ref[0, 0] = pq
+    jte_ref[0, 0] = jte
+    cost_ref[0, 0, :] = cost
 
 
 @functools.partial(jax.jit, static_argnames=("row_period", "kmax",
@@ -421,6 +511,198 @@ def sweep_blocks(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
     return pp, qq, pq, jtep, jteq, jnp.sum(cost, axis=-1)
 
 
+@functools.partial(jax.jit, static_argnames=("row_period", "kmax",
+                                             "vsize", "batched",
+                                             "block_t", "interpret"))
+def sweep_blocks_visits(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
+                        row_period: int, kmax: int, vsize: int,
+                        batched: tuple, block_t: int = 0,
+                        interpret: bool | None = None):
+    """V cluster visits in ONE K-major grid: the multi-cluster schedule
+    that amortizes the per-visit pallas_call floor (and every SHARED
+    row operand's traffic) across directions.
+
+    ``batched`` is a static 6-tuple of bools for (x8, J, coh, chunk_id,
+    wt, cost_wt): True means the operand carries a leading [V] visit
+    axis, False means ONE array is shared by all visits — the kernel
+    body is identical either way; only the BlockSpec index_map changes
+    (shared operands pin the visit index to 0, so with the time axis
+    outer a shared row block is fetched once per time block instead of
+    once per (visit, chunk) cell). sta1/sta2 are always shared (global
+    station geometry). Outputs are per-cell [T//bt, V*K, ...] blocks
+    written exactly once, reduced over the time axis OUTSIDE the
+    kernel — same values as ``jax.vmap(sweep_blocks)`` up to that sum
+    order. Returns the :func:`sweep_blocks` tuple with a leading [V]
+    axis on every output.
+    """
+    xb, jb, cb, cidb, wb, cwb = batched
+    V = int(vsize)
+    B = x8.shape[-2]
+    nb = int(row_period)
+    T = B // nb
+    K = int(kmax)
+    st = x8.dtype
+    acc = dtp.acc_dtype(st)
+    reduced = dtp.is_reduced(st)
+    if interpret is None:
+        interpret = interpret_default()
+    s1b, s2b = sta1[:nb], sta2[:nb]
+    Jp = jnp.take(J, s1b, axis=-3)          # [(V,) K, nb, 2, 2] complex
+    Jq = jnp.take(J, s2b, axis=-3)
+    bt = block_t if block_t else _pick_bt(T, nb, jnp.dtype(acc).itemsize)
+    if T % bt:
+        raise ValueError(
+            f"block_t={bt} does not divide the {T} timeslots — the "
+            f"(T//bt, V*K) grid would silently drop the tail rows")
+    grid = (T // bt, K * V)                     # time OUTER, visits inner
+
+    def vmap_ix(b):
+        return (lambda t, vk: (vk // K, t, 0, 0)) if b \
+            else (lambda t, vk: (0, t, 0, 0))
+
+    def row_spec(b):
+        return pl.BlockSpec((1, bt, nb, 8), vmap_ix(b))
+
+    def coh_spec(b):
+        return pl.BlockSpec((1, bt, nb, 2, 2),
+                            (lambda t, vk: (vk // K, t, 0, 0, 0)) if b
+                            else (lambda t, vk: (0, t, 0, 0, 0)))
+
+    cid_spec = pl.BlockSpec((1, bt, nb),
+                            (lambda t, vk: (vk // K, t, 0)) if cidb
+                            else (lambda t, vk: (0, t, 0)))
+    jones_spec_b = pl.BlockSpec(
+        (1, 1, nb, 2, 2), lambda t, vk: (vk // K, vk % K, 0, 0, 0))
+    jones_spec_s = pl.BlockSpec(
+        (1, 1, nb, 2, 2), lambda t, vk: (0, vk % K, 0, 0, 0))
+    jones_spec = jones_spec_b if jb else jones_spec_s
+
+    def rows(a, b):                             # free view, no copy
+        return a.reshape(((V,) if b else (1,)) + (T, nb, 8))
+
+    def cohv(a, b):
+        return a.reshape(((V,) if b else (1,)) + (T, nb, 2, 2))
+
+    def jonesv(a, b):
+        return a.reshape(((V,) if b else (1,)) + (K, nb, 2, 2))
+
+    def kernel(*refs):
+        _visits_kernel(*refs, acc=acc, reduced=reduced, st=st, kmax=K)
+
+    nt = T // bt
+    n_flops = SWEEP_FLOPS_PER_ROW * B * 8 * K * V
+    n_bytes = int(K * V * (3 * B * 8 * jnp.dtype(st).itemsize
+                           + 2 * B * 4 * jnp.dtype(acc).itemsize)
+                  + nt * K * V * (2 * 32 + 64 + 16 + 1) * nb
+                  * jnp.dtype(acc).itemsize)
+    pp, qq, pq, jte, cost = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[row_spec(xb), row_spec(wb), row_spec(cwb), cid_spec,
+                  coh_spec(cb), coh_spec(cb), jones_spec, jones_spec,
+                  jones_spec, jones_spec],
+        out_specs=[
+            pl.BlockSpec((1, 1, 2, 4, 4, nb),
+                         lambda t, vk: (t, vk, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 1, 2, 4, 4, nb),
+                         lambda t, vk: (t, vk, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 1, 2, 2, 4, 4, nb),
+                         lambda t, vk: (t, vk, 0, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 1, 2, 2, 4, nb),
+                         lambda t, vk: (t, vk, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 1, nb), lambda t, vk: (t, vk, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nt, V * K, 2, 4, 4, nb), acc),
+            jax.ShapeDtypeStruct((nt, V * K, 2, 4, 4, nb), acc),
+            jax.ShapeDtypeStruct((nt, V * K, 2, 2, 4, 4, nb), acc),
+            jax.ShapeDtypeStruct((nt, V * K, 2, 2, 4, nb), acc),
+            jax.ShapeDtypeStruct((nt, V * K, nb), acc),
+        ],
+        cost_estimate=pl.CostEstimate(flops=n_flops,
+                                      bytes_accessed=n_bytes,
+                                      transcendentals=0),
+        interpret=interpret,
+    )(rows(x8, xb), rows(wt, wb), rows(cost_wt, cwb),
+      chunk_id.reshape(((V,) if cidb else (1,)) + (T, nb))
+      .astype(jnp.int32),
+      cohv(coh.real.astype(acc), cb), cohv(coh.imag.astype(acc), cb),
+      jonesv(Jp.real.astype(acc), jb), jonesv(Jp.imag.astype(acc), jb),
+      jonesv(Jq.real.astype(acc), jb), jonesv(Jq.imag.astype(acc), jb))
+    # reduce the per-cell time axis, split (V, K), restore caller
+    # layouts ([V, K, nb, ...] — everything stays [nbase]-sized)
+    pp = jnp.sum(pp, axis=0).reshape((V, K) + pp.shape[2:])
+    qq = jnp.sum(qq, axis=0).reshape((V, K) + qq.shape[2:])
+    pq = jnp.sum(pq, axis=0).reshape((V, K) + pq.shape[2:])
+    jte = jnp.sum(jte, axis=0).reshape((V, K) + jte.shape[2:])
+    cost = jnp.sum(cost, axis=0).reshape(V, K, nb)
+    pp = jnp.moveaxis(pp, -1, 2)                # [V, K, nb, 2, 4, 4]
+    qq = jnp.moveaxis(qq, -1, 2)
+    pq = jnp.moveaxis(pq, -1, 2)                # [V, K, nb, 2, 2, 4, 4]
+    jtep = jnp.moveaxis(jte[:, :, 0], -1, 2)    # [V, K, nb, 2, 4]
+    jteq = jnp.moveaxis(jte[:, :, 1], -1, 2)
+    return pp, qq, pq, jtep, jteq, jnp.sum(cost, axis=-1)
+
+
+@functools.lru_cache(maxsize=None)
+def _sweep_vmappable(row_period: int, kmax: int, block_t: int,
+                     interpret):
+    """:func:`sweep_blocks` wrapped in jax.custom_batching.custom_vmap,
+    specialized per static signature (cached so repeated traces reuse
+    one callable — custom_vmap identity is object identity).
+
+    Un-vmapped calls behave exactly like sweep_blocks. Under jax.vmap
+    (the SAGE driver's in-flight group lanes: ``_group_update`` vmaps
+    the whole per-cluster solve), the batching rule routes the V
+    stacked visits onto the K-major visits grid
+    (:func:`sweep_blocks_visits`) instead of jax's default
+    prepend-a-grid-dim rule — one kernel call whose SHARED operands
+    (typically the row weights and chunk ids) are fetched once per
+    time block rather than broadcast per visit. Batched station maps
+    (never produced by the solvers — station geometry is global) fall
+    back to a serial lax.map."""
+
+    @jax.custom_batching.custom_vmap
+    def fn(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt):
+        return sweep_blocks(x8, J, coh, sta1, sta2, chunk_id, wt,
+                            cost_wt, row_period, kmax, block_t=block_t,
+                            interpret=interpret)
+
+    @fn.def_vmap
+    def _rule(axis_size, in_batched, x8, J, coh, sta1, sta2, chunk_id,
+              wt, cost_wt):
+        xb, jb, cb, s1bt, s2bt, cidb, wb, cwb = in_batched
+        out_b = (True,) * 6
+        if s1bt or s2bt:
+            def one(i):
+                def pick(a, b):
+                    return jax.lax.dynamic_index_in_dim(
+                        a, i, 0, keepdims=False) if b else a
+                return fn(pick(x8, xb), pick(J, jb), pick(coh, cb),
+                          pick(sta1, s1bt), pick(sta2, s2bt),
+                          pick(chunk_id, cidb), pick(wt, wb),
+                          pick(cost_wt, cwb))
+            return jax.lax.map(one, jnp.arange(axis_size)), out_b
+        outs = sweep_blocks_visits(
+            x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt, row_period,
+            kmax, axis_size, (xb, jb, cb, cidb, wb, cwb),
+            block_t=block_t, interpret=interpret)
+        return outs, out_b
+
+    return fn
+
+
+def _sweep_dispatch(x8, J, coh, sta1, sta2, chunk_id, wt, cw,
+                    row_period: int, kmax: int, block_t: int,
+                    interpret):
+    """The wrapper entry both operator assemblies route through: plain
+    sweep_blocks semantics outside vmap, the K-major multi-visit grid
+    under it (see :func:`_sweep_vmappable`)."""
+    return _sweep_vmappable(int(row_period), int(kmax), int(block_t),
+                            interpret)(x8, J, coh, sta1, sta2, chunk_id,
+                                       wt, cw)
+
+
 def _station_aggregates(pp, qq, jtep, jteq, s1b, s2b, N: int):
     """(D [K, N, 2, 4, 4], JTe [K, 8N]) from the per-baseline partials —
     the [nbase]-sized scatter shared by the dense and matrix-free
@@ -443,14 +725,118 @@ def gn_blocks(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
     contract as normal_eq.gn_factors, with the [B]-pass fused and the
     carried operator B-INDEPENDENT ([K, nbase]-sized)."""
     cw = wt if cost_wt is None else cost_wt
-    pp, qq, pq, jtep, jteq, cost = sweep_blocks(
+    pp, qq, pq, jtep, jteq, cost = _sweep_dispatch(
         x8, J, coh, sta1, sta2, chunk_id, wt, cw, row_period, kmax,
-        block_t=block_t, interpret=interpret)
+        block_t, interpret)
     nb = int(row_period)
     s1b, s2b = sta1[:nb], sta2[:nb]
     D, JTe = _station_aggregates(pp, qq, jtep, jteq, s1b, s2b,
                                  n_stations)
     return GNBlocks(pp=pp, qq=qq, pq=pq, D=D), JTe, cost
+
+
+def _assemble_damped(fac: GNBlocks, shift, sta1, sta2,
+                     n_stations: int):
+    """Dense [K, 8N, 8N] (damped) normal matrix from the per-baseline
+    blocks — the ONE place the blocks expand densely, shared by the
+    dense wrapper (:func:`normal_equations_fused`, ``shift=None``) and
+    the fused-Cholesky solve stage (:func:`chol_solve_blocks_shift`).
+
+    ``shift`` (None or [K]) folds into the [K, N, 2, 4, 4] station
+    diagonals BEFORE the 8x8 expansion: the assembled matrix's
+    diagonal lives entirely in D (pq couples distinct stations only),
+    so this is elementwise identical to ``JTJ + shift * I`` on the
+    dense matrix while skipping the [K, 8N, 8N] eye-add pass the
+    dense carry used to pay per damping trip."""
+    K, nb = fac.pp.shape[0], fac.pp.shape[1]
+    N = n_stations
+    acc = fac.pp.dtype
+    s1b, s2b = sta1[:nb], sta2[:nb]
+    D = fac.D
+    if shift is not None:
+        eye4 = jnp.eye(4, dtype=acc)
+        D = D + shift[:, None, None, None, None] * eye4
+    eye2 = jnp.eye(2, dtype=acc)
+    Dfull = jnp.einsum("knaij,ab->knaibj", D, eye2).reshape(K, N, 8, 8)
+    pq8 = jnp.transpose(fac.pq, (0, 1, 2, 4, 3, 5)).reshape(K, nb, 8, 8)
+    pq8T = jnp.transpose(fac.pq, (0, 1, 3, 5, 2, 4)).reshape(K, nb, 8, 8)
+    idx = jnp.arange(N, dtype=sta1.dtype)
+    JTJ = jnp.zeros((K, N, 8, N, 8), acc)
+    for k in range(K):                          # K <= MAX_CHUNKS, static
+        JTJ = JTJ.at[k, s1b, :, s2b, :].add(pq8[k])
+        JTJ = JTJ.at[k, s2b, :, s1b, :].add(pq8T[k])
+    JTJ = JTJ.at[:, idx, :, idx, :].add(jnp.swapaxes(Dfull, 0, 1))
+    return JTJ.reshape(K, 8 * N, 8 * N)
+
+
+def chol_solve_blocks_shift(fac: GNBlocks, JTe, shift, sta1, sta2,
+                            n_stations: int, reduced: bool = False):
+    """ONE batched assemble+factor+solve attempt of the damped system
+    (JTJ(fac) + shift I) dp = JTe from the per-baseline blocks; returns
+    (dp, ok) with ok = dp all-finite per chunk.
+
+    This is the executed all-ok body of :func:`solve_damped_blocks` —
+    bench.solver_trip_cost prices THIS function under
+    (kernel='pallas', inner='chol') because XLA cost analysis sums
+    both branches of the retry lax.cond (the same phantom-bytes class
+    lm._chol_solve_shift exists for). The assembled matrix is exactly
+    symmetric by construction (pp/qq are elementwise symmetric in
+    (i, j); pq enters with its exact transpose), so the factorization
+    skips cho_factor's symmetrize pass (``symmetrize_input=False``)
+    with bit-identical results: (a + a)/2 == a exactly in binary
+    floating point. ``reduced`` routes the bf16/f16 storage policies
+    through the LU body (jnp.linalg.solve) — the same
+    trajectory-tolerance contract as lm._lu_solve_shift."""
+    A = _assemble_damped(fac, shift, sta1, sta2, n_stations)
+    if reduced:
+        dp = jnp.linalg.solve(A, JTe[..., None])[..., 0]
+    else:
+        L = jax.lax.linalg.cholesky(A, symmetrize_input=False)
+        dp = jax.scipy.linalg.cho_solve((L, True), JTe[..., None])[..., 0]
+    return dp, jnp.all(jnp.isfinite(dp), axis=-1)
+
+
+def solve_damped_blocks(fac: GNBlocks, JTe, mu, jitter, sta1, sta2,
+                        n_stations: int, rho=0.0,
+                        reduced: bool = False):
+    """lm._solve_damped on the per-baseline blocks carry: solve
+    (JTJ + (mu + jitter [+ rho]) I) dp = JTe batched over chunks
+    without ever CARRYING the dense [K, 8N, 8N] matrix — the blocks
+    assemble, factor and solve inside this call, so the LM state stays
+    [K, nbase]-sized and the eye-add / symmetrize / dense-select
+    passes of the dense carry disappear.
+
+    Retry semantics preserved exactly: a failed factorization
+    (non-finite dp) gets ONE jittered retry with the regularization
+    floor boosted to 1e-3 * max|diag| per chunk — the diagonal read
+    straight from the [K, N, 2, 4, 4] D blocks (the dense diagonal
+    lives entirely there) plus the ADMM ``rho`` shift, matching the
+    dense path's boost on its rho-augmented matrix. Chunks that still
+    fail return dp = 0 and recover through mu-growth. The retry hides
+    behind a lax.cond so the all-ok common case pays one
+    factorization; ``rho`` rides the solve shift (the blocks are never
+    rho-augmented), mirroring the inner='cg' convention."""
+    shift = mu + jitter + rho
+
+    def solve(sh):
+        return chol_solve_blocks_shift(fac, JTe, sh, sta1, sta2,
+                                       n_stations, reduced=reduced)
+
+    dp, ok = solve(shift)
+
+    def done():
+        return jnp.where(ok[:, None], dp, 0.0), ok
+
+    def retry():
+        dd = jnp.diagonal(fac.D, axis1=-2, axis2=-1)    # [K, N, 2, 4]
+        diag_max = jnp.max(jnp.abs(dd.reshape(dd.shape[0], -1)),
+                           axis=-1) + rho
+        dp2, ok2 = solve(shift + 1e-3 * jnp.maximum(diag_max, 1e-30))
+        dpw = jnp.where(ok[:, None], dp,
+                        jnp.where(ok2[:, None], dp2, 0.0))
+        return dpw, ok | ok2
+
+    return jax.lax.cond(jnp.all(ok), done, retry)
 
 
 def normal_equations_fused(x8, J, coh, sta1, sta2, chunk_id, wt,
@@ -461,28 +847,19 @@ def normal_equations_fused(x8, J, coh, sta1, sta2, chunk_id, wt,
     ``kernel='pallas'``: the fused sweep produces the per-baseline
     blocks in one [B]-pass per chunk; the dense [K, 8N, 8N] expansion
     is the same [nbase]/[N]-sized scatter tail as the XLA
-    baseline-major path."""
+    baseline-major path (shared with the fused-Cholesky solve stage —
+    :func:`_assemble_damped` with ``shift=None`` is bit-identical to
+    the pre-refactor inline tail)."""
     N = n_stations
     cw = wt if cost_wt is None else cost_wt
-    pp, qq, pq, jtep, jteq, cost = sweep_blocks(
+    pp, qq, pq, jtep, jteq, cost = _sweep_dispatch(
         x8, J, coh, sta1, sta2, chunk_id, wt, cw, row_period, kmax,
-        block_t=block_t, interpret=interpret)
+        block_t, interpret)
     nb = int(row_period)
-    K = int(kmax)
     s1b, s2b = sta1[:nb], sta2[:nb]
-    acc = pp.dtype
     D, JTe = _station_aggregates(pp, qq, jtep, jteq, s1b, s2b, N)
-    eye2 = jnp.eye(2, dtype=acc)
-    Dfull = jnp.einsum("knaij,ab->knaibj", D, eye2).reshape(K, N, 8, 8)
-    pq8 = jnp.transpose(pq, (0, 1, 2, 4, 3, 5)).reshape(K, nb, 8, 8)
-    pq8T = jnp.transpose(pq, (0, 1, 3, 5, 2, 4)).reshape(K, nb, 8, 8)
-    idx = jnp.arange(N)
-    JTJ = jnp.zeros((K, N, 8, N, 8), acc)
-    for k in range(K):                          # K <= MAX_CHUNKS, static
-        JTJ = JTJ.at[k, s1b, :, s2b, :].add(pq8[k])
-        JTJ = JTJ.at[k, s2b, :, s1b, :].add(pq8T[k])
-    JTJ = JTJ.at[:, idx, :, idx, :].add(jnp.swapaxes(Dfull, 0, 1))
-    return JTJ.reshape(K, 8 * N, 8 * N), JTe, cost
+    fac = GNBlocks(pp=pp, qq=qq, pq=pq, D=D)
+    return _assemble_damped(fac, None, sta1, sta2, N), JTe, cost
 
 
 def _matvec_kernel(pp_ref, qq_ref, pq_ref, vp_ref, vq_ref, yp_ref,

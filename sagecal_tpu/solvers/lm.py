@@ -80,8 +80,11 @@ class LMConfig(NamedTuple):
     # row-pass kernel for the normal-equation / matrix-free assembly:
     # "xla" (bit-frozen default) or "pallas" — the fused-sweep kernel
     # (ops/sweep_pallas.py): one streaming [B]-pass per damping
-    # iteration emitting per-baseline Gram blocks, and under
-    # inner="cg" a B-INDEPENDENT O(nbase) blocks matvec per PCG trip.
+    # iteration emitting per-baseline Gram blocks; under inner="chol"
+    # the damped system assembles+factors+solves straight from those
+    # blocks (sweep_pallas.solve_damped_blocks — the dense [K,8N,8N]
+    # matrix is never CARRIED across iterations), and under inner="cg"
+    # each PCG trip is a B-INDEPENDENT O(nbase) blocks matvec.
     # Applies when the problem is single-chunk baseline-major
     # (sweep_pallas.supported); falls back to the XLA path otherwise.
     # Parity is tolerance-gated, not bit (MIGRATION.md "Pallas
@@ -97,7 +100,12 @@ class LMConfig(NamedTuple):
 
 class LMState(NamedTuple):
     p: jax.Array        # [K, 8N] real parameters
-    JTJ: jax.Array      # inner="chol": [K, 8N, 8N] normal matrix at p;
+    JTJ: jax.Array      # inner="chol": [K, 8N, 8N] normal matrix at p
+                        # (kernel="pallas": sweep_pallas.GNBlocks — the
+                        # B-independent per-baseline blocks; the dense
+                        # matrix only ever exists inside the fused
+                        # assemble+factor+solve, sweep_pallas.
+                        # solve_damped_blocks);
                         # inner="cg": normal_eq.GNFactors (matrix-free op)
     JTe: jax.Array      # [K, 8N] gradient at p
     mu: jax.Array       # [K]
@@ -389,6 +397,13 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         _tilesz = x8.shape[0] // row_period
         os_ntper = -(-_tilesz // int(os.n_subsets))
 
+    # fused block-Cholesky stage (kernel="pallas", inner="chol"): carry
+    # the B-independent per-baseline Gram blocks instead of the dense
+    # [K, 8N, 8N] matrix; the damped system assembles, factors and
+    # solves inside sweep_pallas.solve_damped_blocks each trip (the
+    # reduced OS fast path keeps its dense subset-sliced carry)
+    blocks_chol = swp is not None and not inner_cg and not os_ntper
+
     def nrm_eq(p, w=None, cw=None, os_subset=None):
         """Normal equations + acceptance cost from ONE row pass: ``w``
         weights JTJ/JTe (subset weights under OS), ``cw`` the cost
@@ -408,7 +423,7 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
                 op = op + admm_rho * jnp.eye(op.shape[-1], dtype=op.dtype)
                 cost = aug_cost(p, cost)
             return op, JTe, cost
-        if inner_cg:
+        if inner_cg or blocks_chol:
             if swp is not None:
                 op, JTe, cost = swp.gn_blocks(
                     x8, J, coh, sta1, sta2, chunk_id,
@@ -434,7 +449,9 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         if admm is not None:
             d = p - admm_bz
             JTe = JTe - admm_y - admm_rho * d
-            if not inner_cg:
+            if not inner_cg and not blocks_chol:
+                # the blocks/matrix-free operators are never formed
+                # densely: their ADMM rho-term rides the solve shift
                 op = op + admm_rho * jnp.eye(op.shape[-1], dtype=op.dtype)
             cost = aug_cost(p, cost)
         return op, JTe, cost
@@ -470,7 +487,7 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     else:
         JTJ0, JTe0, cost0 = nrm_eq(p0)
         live0 = jnp.ones((kmax,), bool)
-    if inner_cg:
+    if inner_cg or blocks_chol:
         # max diag of the (never-formed) dense matrix: the matrix
         # diagonal lives entirely in the station-diagonal blocks D, and
         # the chol path's ADMM += rho I rides the diag as a uniform
@@ -495,6 +512,14 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
                 s.JTJ, s.JTe, s.mu, config.jitter, rho_aug, sta1, sta2,
                 chunk_id, kmax, n_stations, row_period, config.cg_tol,
                 config.cg_maxiter, active=~s.stop & chunk_mask)
+        elif blocks_chol:
+            # fused assemble+factor+solve from the per-baseline blocks
+            # (the dense matrix exists only inside this call); same
+            # nonfinite -> boosted-jitter retry -> dp = 0 semantics
+            dp, ok = swp.solve_damped_blocks(
+                s.JTJ, s.JTe, s.mu, config.jitter, sta1, sta2,
+                n_stations, rho=rho_aug, reduced=reduced)
+            trips = jnp.zeros((), jnp.int32)
         else:
             dp, ok = _solve_damped(s.JTJ, s.JTe, s.mu, config.jitter,
                                    reduced=reduced)
@@ -539,11 +564,13 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
             adopt = accept | (~s.live & chunk_mask)
         else:
             adopt = accept
-        if inner_cg and swp is not None:
+        if (inner_cg or blocks_chol) and swp is not None:
             # the blocks operator is per-(chunk, baseline) and
             # B-independent: the per-chunk adopt select broadcasts over
             # each leaf's leading K axis — a rejected chunk keeps its
-            # entering blocks, exactly the dense path's kept JTJ
+            # entering blocks, exactly the dense path's kept JTJ (and
+            # under the fused-chol stage this select is [K, nbase]-sized
+            # where the dense carry's was [K, 8N, 8N])
             JTJ = jax.tree.map(
                 lambda new, old: jnp.where(
                     adopt.reshape(adopt.shape + (1,) * (new.ndim - 1)),

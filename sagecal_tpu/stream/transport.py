@@ -8,11 +8,22 @@ marker (``stream.end``, a one-line JSON ``{"n": <final index>}``).
 
 Framing (socket): every frame is an 8-byte big-endian length followed
 by a UTF-8 JSON header, then a second length-prefixed binary body
-(empty for meta/end frames). Header kinds::
+(empty for meta/end frames). The schema is VERSIONED: the meta frame
+(always first on the wire) carries ``magic`` + ``v``, and the
+consumer's handshake refuses a missing/foreign magic or a version it
+does not speak — loudly, with both sides' versions named — instead of
+mis-parsing frames from an incompatible peer. Header kinds::
 
-    {"kind": "meta", "meta": {...}}        # SimMS meta.json content
+    {"kind": "meta", "magic": "sagecal-tile-stream", "v": 1,
+     "meta": {...}}                        # SimMS meta.json content
     {"kind": "tile", "i": 7}               # body = tile npz bytes
     {"kind": "end",  "n": 12}              # final next-index
+
+Version history: v1 = the frame kinds above (ISSUE 16 wire format,
+stamped since ISSUE 17). Bump ``FRAME_VERSION`` on ANY change to the
+header fields or body encoding — the handshake is exact-match, not
+ranged: a reader that could half-parse a newer writer is the failure
+mode the refusal exists to prevent.
 
 The feeders (:class:`SocketFeeder`, :class:`TailFeeder`) are the
 test/bench harness side: they replay an existing on-disk SimMS on an
@@ -39,6 +50,11 @@ from sagecal_tpu.stream import TileStream
 
 END_MARKER = "stream.end"
 _LEN = struct.Struct(">Q")
+#: socket frame schema identity: the meta handshake's magic string and
+#: exact-match version (module docstring "Framing"). A mismatch is a
+#: refusal, never a best-effort parse.
+FRAME_MAGIC = "sagecal-tile-stream"
+FRAME_VERSION = 1
 #: polling quantum for file-tail waits: small enough that visibility
 #: latency is noise against any real tile cadence, large enough that
 #: an idle tail is not a busy loop
@@ -185,6 +201,18 @@ class SocketStream(TileStream):
         if hdr.get("kind") != "meta":
             raise ValueError(
                 f"stream socket: expected meta frame, got {hdr!r}")
+        if hdr.get("magic") != FRAME_MAGIC:
+            raise ValueError(
+                f"stream socket: frame magic {hdr.get('magic')!r} is "
+                f"not {FRAME_MAGIC!r} — the peer is not a sagecal "
+                "tile-stream feeder (or predates the versioned "
+                "schema); refusing to parse its frames")
+        if hdr.get("v") != FRAME_VERSION:
+            raise ValueError(
+                f"stream socket: frame schema v{hdr.get('v')} from "
+                f"the feeder, this consumer speaks v{FRAME_VERSION} "
+                "exactly — upgrade the older side; mixed versions "
+                "would mis-parse tile frames, not degrade gracefully")
         os.makedirs(self.spool, exist_ok=True)
         mp = os.path.join(self.spool, "meta.json")
         if not os.path.exists(mp):
@@ -350,6 +378,8 @@ class SocketFeeder(_FeederBase):
             if conn is None:
                 return
             self._send_frame(conn, {"kind": "meta",
+                                    "magic": FRAME_MAGIC,
+                                    "v": FRAME_VERSION,
                                     "meta": self.meta})
             t0 = time.monotonic()
             for k in range(self.n_tiles):

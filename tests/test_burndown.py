@@ -1,0 +1,104 @@
+"""Burn-down harness orchestration units (ISSUE 17 tentpole c).
+
+The end-to-end rehearsal is the CI ``burndown`` job (``python
+tools_dev/burndown.py --dry-run``); these are the fast structural
+gates: both modes build the SAME queue (names/order), the dry run
+pins CPU + small shapes + scratch banking while real mode scrubs a
+leaked JAX_PLATFORMS and aborts only on a dead probe, and --only
+rejects unknown step names instead of silently running nothing.
+"""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "burndown", os.path.join(REPO, "tools_dev", "burndown.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _Args:
+    def __init__(self, dry_run, bank_dir="/tmp/bank"):
+        self.dry_run = dry_run
+        self.bank_dir = bank_dir
+
+
+def test_same_queue_both_modes():
+    bd = _load()
+    dry = bd.build_steps(_Args(True))
+    real = bd.build_steps(_Args(False))
+    names = [s["name"] for s in dry]
+    assert names == [s["name"] for s in real]
+    assert names == ["probe", "mosaic-kernels", "kernel-cache",
+                     "b-scaling", "bf16-kernels", "mesh2d", "fleet",
+                     "sentinel"]
+
+
+def test_dry_pins_cpu_real_scrubs_leak():
+    bd = _load()
+    for s in bd.build_steps(_Args(True)):
+        assert s["env"]["JAX_PLATFORMS"] == "cpu", s["name"]
+    for s in bd.build_steps(_Args(False)):
+        # None means "pop from the child env" in run_step — the
+        # documented flaky-TPU workaround must not fake a dead chip
+        assert s["env"]["JAX_PLATFORMS"] is None, s["name"]
+
+
+def test_abort_only_on_real_probe():
+    bd = _load()
+    dry = {s["name"]: s for s in bd.build_steps(_Args(True))}
+    real = {s["name"]: s for s in bd.build_steps(_Args(False))}
+    assert real["probe"]["abort_on_fail"]
+    assert not dry["probe"].get("abort_on_fail")
+    for name, s in real.items():
+        if name != "probe":
+            assert not s.get("abort_on_fail"), name
+
+
+def test_bank_dir_threads_to_banking_steps():
+    bd = _load()
+    steps = {s["name"]: s for s in bd.build_steps(_Args(True, "/b"))}
+    for name in ("b-scaling", "mesh2d", "sentinel"):
+        cmd = steps[name]["cmd"]
+        assert cmd[cmd.index("--bank-dir") + 1] == "/b", name
+    # fleet stamps through the env fallback (bench call sites don't
+    # thread a bank_dir); dry mode also forces the CPU bench path
+    assert steps["fleet"]["env"]["SAGECAL_BANK_DIR"] == "/b"
+    assert steps["fleet"]["env"]["SAGECAL_BENCH_CPU"] == "1"
+    assert "SAGECAL_BENCH_CPU" not in bd.build_steps(
+        _Args(False, "/b"))[6]["env"]
+
+
+def test_only_rejects_unknown_step():
+    bd = _load()
+    with pytest.raises(SystemExit):
+        bd.main(["--dry-run", "--only", "no-such-step",
+                 "--bank-dir", "/tmp/_bd_unused"])
+
+
+def test_run_step_env_and_timeout(tmp_path, monkeypatch):
+    bd = _load()
+    monkeypatch.setenv("BD_POP", "leaked")
+    logs = []
+    res = bd.run_step(dict(name="t", timeout=30,
+                           env={"BD_SET": "1", "BD_POP": None},
+                           cmd=[sys.executable, "-c",
+                                "import os,sys\n"
+                                "assert os.environ['BD_SET']=='1'\n"
+                                "assert 'BD_POP' not in os.environ"]),
+                      log=lambda *a, **k: logs.append(a))
+    assert res["ok"] and res["rc"] == 0
+    assert res["cmd"].endswith("<inline>")
+    res = bd.run_step(dict(name="t2", timeout=1, env=None,
+                           cmd=[sys.executable, "-c",
+                                "import time; time.sleep(5)"]),
+                      log=lambda *a, **k: None)
+    assert not res["ok"] and res["rc"] == -9

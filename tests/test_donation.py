@@ -168,6 +168,8 @@ def _admm_inputs(pb, F):
                    (x8F, uF, vF, wF, freqs, wtF, fr, J0r)]
 
 
+@pytest.mark.slow  # ~27 s (round-17 tier-1 rebalance); still a CI
+# fail-fast gate — ci.yml runs it by -k without the 'not slow' filter
 def test_admm_host_loop_donation_bit_identical(problem):
     """The donated ADMM host-loop carry == the identical runner built
     with donate=False, bit for bit."""

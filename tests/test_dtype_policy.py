@@ -521,3 +521,34 @@ def test_config1_trip_bytes_drop_30pct():
     assert f32 and bf16, "trip pricing unavailable"
     drop = 1.0 - bf16["bytes_accessed"] / f32["bytes_accessed"]
     assert drop >= 0.30, f"bf16 trip bytes drop {drop:.1%} < 30%"
+
+
+def test_pallas_chol_trip_prices_fused_body():
+    """ISSUE 17 satellite: solver_trip_cost(kernel='pallas',
+    inner='chol') must price the EXECUTED fused block-Cholesky body
+    (gn_blocks sweep + chol_solve_blocks_shift), not the dead dense-XLA
+    branch — the same phantom-bytes class the PR 3 gate above pins for
+    the dtype melt. Gated structurally: the pallas-chol price exists,
+    differs from the xla-chol price (a shared dead program would price
+    identically), and differs from the pallas-cg price (the two inner
+    bodies are different programs)."""
+    import importlib.util, os, sys
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("bench", bench)
+    spec.loader.exec_module(bench)
+    shape = dict(kmax=1, n_stations=62, B=18910, nbase=1891)
+    xla = bench.solver_trip_cost(3, dtype=jnp.float32, kernel="xla",
+                                 inner="chol", **shape)
+    pal = bench.solver_trip_cost(3, dtype=jnp.float32, kernel="pallas",
+                                 inner="chol", **shape)
+    pcg = bench.solver_trip_cost(3, dtype=jnp.float32, kernel="pallas",
+                                 inner="cg", **shape)
+    assert xla and pal and pcg, "trip pricing unavailable"
+    assert pal["bytes_accessed"] > 0 and pal["flops"] > 0
+    assert pal["bytes_accessed"] != xla["bytes_accessed"], \
+        "pallas-chol priced identically to the dense XLA branch"
+    assert pal["bytes_accessed"] != pcg["bytes_accessed"], \
+        "pallas-chol priced identically to the pallas-cg body"

@@ -747,6 +747,8 @@ def test_cli_faults_and_resume_flags(tmp_path):
 # migrate_abort: a job killed mid-migration resumes from the watermark
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~27 s (round-17 tier-1 rebalance); still a CI
+# fail-fast gate — ci.yml runs it by -k without the 'not slow' filter
 def test_migrate_abort_resumes_from_watermark_zero_tiles_lost(tmp_path):
     """The ISSUE 12 chaos seam: ``migrate_abort`` kills the migration
     handoff AFTER the source device flushed the checkpoint and BEFORE

@@ -237,6 +237,8 @@ def test_loadgen_schedule_is_deterministic():
 # the live 2-virtual-device fleet
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~71 s (round-17 tier-1 rebalance); still a CI
+# fail-fast gate — ci.yml runs it by -k without the 'not slow' filter
 def test_fleet_two_devices_bucket_affine_and_bit_identical(tmp_path):
     """Four bucket-affine jobs (2x tilesz 4, 2x tilesz 5) through a
     2-device fleet: same-bucket jobs land on the same device (the
@@ -317,6 +319,8 @@ def test_fleet_two_devices_bucket_affine_and_bit_identical(tmp_path):
             == (tmp_path / f"solo_{solf}").read_text()
 
 
+@pytest.mark.slow  # ~31 s (round-17 tier-1 rebalance); still a CI
+# fail-fast gate — ci.yml runs it by -k without the 'not slow' filter
 def test_fleet_work_steals_to_idle_device(tmp_path):
     """Work stealing: two paced jobs forced onto device 0 (same
     bucket) while device 1 idles with an empty queue — the controller

@@ -219,6 +219,8 @@ def _interval0_args(sky, tiles, nf, freqs, J0F):
 # bounded staleness (the CI fail-fast subset's heart)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~65 s (round-17 tier-1 rebalance); still a CI
+# fail-fast gate — ci.yml runs it by -k without the 'not slow' filter
 def test_stale_s0_bit_identical_and_slow_envelope():
     """(a) With no fault plan the stale runner is BIT-identical to the
     synchronous blocked chain (block_f=1) — every output array, every

@@ -655,3 +655,66 @@ def test_sentinel_stream_committed_bank_loads():
     assert rec["batch_tiles_rerun"] == 0
     assert rec["preemptions"] >= 1
     assert rec["bit_identical"] is True
+
+
+def _write_kmelt_bank(dirpath, rnd, rec, platform="cpu"):
+    # BSCALING records are banked BARE (northstar.py b_scaling), not
+    # in the {"results": ...} envelope — the loader wraps them
+    with open(os.path.join(dirpath, f"BSCALING_r{rnd:02d}.json"),
+              "w") as f:
+        json.dump(dict(rec, platform=platform), f)
+
+
+def _kmelt_rec(**kw):
+    rec = dict(shape="N=64 M=48 -j5 -g 3 hybrid-chunks",
+               full_pallas_vs_xla_pct_chol=-10.9,
+               floor_pallas_vs_xla_pct_chol=9.4,
+               floor_pallas_vs_xla_pct_cg=-53.3,
+               cg_vs_chol_pct_pallas=173.2)
+    rec.update(kw)
+    return rec
+
+
+def test_sentinel_kmelt_cross_round(tmp_path, capsys):
+    """ISSUE 17 satellite: the kernel-melt bank (BSCALING_rNN.json)
+    is judged like the other families — newest pair, named metric,
+    improvements never fail; a melted full-B chol win, a regressed
+    small-rung floor, or an exploded cg-on-kernel price fails with
+    the metric named."""
+    d = str(tmp_path)
+    _write_kmelt_bank(d, 17, _kmelt_rec())
+    assert sentinel.kmelt_cross_round_check("cpu", d) == []
+    _write_kmelt_bank(d, 18, _kmelt_rec(
+        full_pallas_vs_xla_pct_chol=-14.0,
+        floor_pallas_vs_xla_pct_chol=4.0))
+    assert sentinel.kmelt_cross_round_check("cpu", d) == []
+    _write_kmelt_bank(d, 19, _kmelt_rec(
+        full_pallas_vs_xla_pct_chol=2.0,       # kernel lost its win
+        floor_pallas_vs_xla_pct_cg=-20.0,      # cg floor regressed
+        cg_vs_chol_pct_pallas=300.0))          # cg price exploded
+    v = sentinel.kmelt_cross_round_check("cpu", d)
+    assert {x["metric"] for x in v} == {"kmelt_full_chol",
+                                        "kmelt_floor_cg",
+                                        "kmelt_cg_price"}
+    assert all("KMELT r19" in x["msg"] for x in v)
+    # the CLI lane fails with the metric named — and a bank dir with
+    # ONLY family records (the burn-down scratch dir) is still checked
+    rc = sentinel.main(["--fast", "--no-probes", "--platform", "cpu",
+                        "--bank-dir", d])
+    assert rc == 1
+    assert "kmelt_full_chol" in capsys.readouterr().err
+    assert sentinel.load_kmelt_banks("tpu", d) == []
+
+
+def test_sentinel_kmelt_committed_bank_loads():
+    """The committed kernel-melt round parses, declares its platform,
+    and the newest round carries every toleranced field (r07 predates
+    the headline fields and is skipped by the absent-field guard, not
+    crashed on)."""
+    banks = sentinel.load_kmelt_banks("cpu", REPO)
+    assert banks, "no committed BSCALING_rNN.json"
+    rec = banks[-1][2]["b-scaling"]
+    for spec in sentinel.KMELT_TOLERANCES.values():
+        assert spec["field"] in rec, spec["field"]
+    # the priced small-rung regression is ON the record, per rung
+    assert isinstance(rec["small_rung_pallas_vs_xla_pct_chol"], list)

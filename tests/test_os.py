@@ -115,6 +115,7 @@ def test_osrlm_no_longer_aliases_rlm():
     assert not np.allclose(np.asarray(J_os), np.asarray(J_rlm))
 
 
+@pytest.mark.slow  # ~24 s (round-17 tier-1 rebalance, wave 2)
 def test_os_deterministic_rotation():
     """randomize=False uses the (k % n_subsets) rotation — reproducible."""
     sky, tile, *arrs = _problem()

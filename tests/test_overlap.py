@@ -165,6 +165,8 @@ def _assert_bitident(msdir, n_tiles, tmp_path, run, tag=""):
     return h0
 
 
+@pytest.mark.slow  # ~77 s (round-17 tier-1 rebalance — full-suite
+# CI lane; the beam-path bit-identity variant below stays in-window)
 def test_bitident_solo(tmp_path):
     msdir, skyf, clusf = _make_dataset(tmp_path)
     cfg = _cfg(msdir, skyf, clusf)

@@ -100,6 +100,8 @@ def test_cli_main_missing_args():
     assert cli.main([]) == 2
 
 
+@pytest.mark.slow  # ~34 s (round-17 tier-1 rebalance, wave 2 —
+# full-suite CI lane)
 def test_graft_entry_compiles():
     import importlib.util
     spec = importlib.util.spec_from_file_location(

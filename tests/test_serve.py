@@ -522,6 +522,8 @@ def test_serve_stochastic_job_opaque(tmp_path, server):
 # fleet migration: tile-boundary bit-identity, zero tiles re-run (ISSUE 12)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow  # ~33 s (round-17 tier-1 rebalance); still a CI
+# fail-fast gate — ci.yml runs it by -k without the 'not slow' filter
 def test_pipeline_cross_device_resume_bit_identical(tmp_path):
     """Pipeline-level migration gate: a run whose first tiles solved
     on device A and whose remainder resumed (from the PR 9 checkpoint

@@ -179,6 +179,60 @@ def test_socket_stream_round_trip(tmp_path):
     assert ds.SimMS(spool, data_column="DATA").n_tiles == 3
 
 
+def test_socket_handshake_refuses_schema_mismatch(tmp_path):
+    """ISSUE 17 satellite: the meta handshake is versioned — a peer
+    with a foreign/absent magic or a different frame-schema version is
+    REFUSED with both sides named, never half-parsed."""
+    src, _, _ = _make_fixture(tmp_path, "v.ms", n_tiles=1)
+
+    class _BadMeta(ttr.SocketFeeder):
+        def __init__(self, src_path, hdr_patch):
+            super().__init__(src_path, interval_s=0.0)
+            self._patch = hdr_patch
+
+        def _run(self):
+            conn = None
+            try:
+                while not self._stop.is_set():
+                    try:
+                        conn, _ = self._srv.accept()
+                        break
+                    except TimeoutError:
+                        continue
+                if conn is None:
+                    return
+                hdr = {"kind": "meta", "magic": ttr.FRAME_MAGIC,
+                       "v": ttr.FRAME_VERSION, "meta": self.meta}
+                hdr.update(self._patch)
+                for k, v in list(hdr.items()):
+                    if v is None:
+                        del hdr[k]
+                self._send_frame(conn, hdr)
+            finally:
+                if conn is not None:
+                    conn.close()
+                self._srv.close()
+
+    cases = [({"magic": "someone-elses-protocol"}, "magic"),
+             ({"magic": None}, "magic"),            # pre-versioned peer
+             ({"v": ttr.FRAME_VERSION + 1}, f"v{ttr.FRAME_VERSION}")]
+    for patch, needle in cases:
+        feeder = _BadMeta(src, patch).start()
+        strm = ttr.SocketStream("127.0.0.1", feeder.port,
+                                str(tmp_path / "vspool.ms"))
+        with pytest.raises(ValueError, match=needle):
+            strm.handshake()
+        strm.close()
+        feeder.close()
+    # and the good path still hands the meta through
+    feeder = ttr.SocketFeeder(src, interval_s=0.0).start()
+    strm = ttr.SocketStream("127.0.0.1", feeder.port,
+                            str(tmp_path / "okspool.ms"))
+    assert strm.handshake()["tilesz"] == 4
+    strm.close()
+    feeder.close()
+
+
 # ---------------------------------------------------------------------------
 # open-ended Prefetcher + arrival attribution
 # ---------------------------------------------------------------------------

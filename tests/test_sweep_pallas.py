@@ -358,6 +358,131 @@ def test_roofline_pallas_cost():
     assert full["bytes_accessed"] > 0
 
 
+@pytest.mark.parametrize("K,T,N", [(1, 5, 6), (2, 4, 6)])
+def test_fused_chol_solve_matches_dense(K, T, N):
+    """ISSUE 17 tentpole (a): solve_damped_blocks — the fused
+    assemble/factor/solve stage on the per-baseline blocks — lands on
+    the dense reference (_normal_equations_dense + shift*I + cho_solve)
+    to machine epsilon (modulo the documented summation-order freedom
+    of the sweep itself), across weight classes x cost_wt x the ADMM
+    rho-shift x K in {1, 2}. The shift folds into the station
+    diagonals BEFORE the 8x8 expansion — this gate pins that the fold
+    is the same damped system, not an approximation of it."""
+    x8, coh, s1, s2, cid, _, nbase = _toy(N=N, T=T, K=K, seed=30)
+    rng = np.random.default_rng(31)
+    p = jnp.asarray(rng.normal(size=(K, N, 8)))
+    cw = jnp.asarray(rng.random((x8.shape[0], 8)))
+    mu = jnp.asarray(rng.random(K) + 0.5)
+    for rho in (0.0, 2.0):
+        for name, wt in _wt_variants(x8.shape[0], nbase, 32):
+            J, (JTJ_d, JTe_d, _) = _dense_ref(x8, coh, s1, s2, cid,
+                                              wt, N, K, p)
+            shift = mu + 1e-9 + rho
+            A = JTJ_d + shift[:, None, None] * jnp.eye(8 * N)
+            dp_ref = jax.scipy.linalg.cho_solve(
+                jax.scipy.linalg.cho_factor(A), JTe_d[..., None])[..., 0]
+            fac, JTe_b, _ = swp.gn_blocks(x8, J, coh, s1, s2, cid, wt,
+                                          N, K, nbase, cost_wt=cw,
+                                          interpret=True)
+            dp, ok = swp.solve_damped_blocks(fac, JTe_b, mu, 1e-9,
+                                             s1, s2, N, rho=rho)
+            assert bool(jnp.all(ok)), (name, rho)
+            scale = float(jnp.abs(dp_ref).max()) + 1e-30
+            np.testing.assert_allclose(np.asarray(dp),
+                                       np.asarray(dp_ref),
+                                       atol=5e-8 * scale,
+                                       err_msg=f"{name} rho={rho}")
+
+
+def test_fused_chol_retry_boosts_jitter():
+    """The nonfinite -> boosted-jitter retry contract: a singular
+    system (zero blocks, zero shift) fails its first factorization and
+    must come back finite through the 1e-3 * max|diag| boost; a
+    well-damped first attempt must solve exactly (diagonal system)."""
+    K, N, nb = 1, 4, 6
+    p, q = np.triu_indices(N, k=1)
+    s1 = jnp.asarray(p.astype(np.int32))
+    s2 = jnp.asarray(q.astype(np.int32))
+    z = jnp.zeros((K, nb, 2, 4, 4))
+    fac = swp.GNBlocks(pp=z, qq=z, pq=jnp.zeros((K, nb, 2, 2, 4, 4)),
+                       D=jnp.zeros((K, N, 2, 4, 4)))
+    JTe = jnp.ones((K, 8 * N))
+    # jitter > 0: A = jitter*I, dp = JTe / jitter exactly, no retry
+    dp, ok = swp.solve_damped_blocks(fac, JTe, jnp.zeros(K), 0.25,
+                                     s1, s2, N)
+    assert bool(jnp.all(ok))
+    np.testing.assert_array_equal(np.asarray(dp),
+                                  np.asarray(JTe / 0.25))
+    # zero shift: first attempt factors the zero matrix (non-finite),
+    # the retry's boosted floor must return a finite answer
+    dp0, ok0 = swp.solve_damped_blocks(fac, JTe, jnp.zeros(K), 0.0,
+                                       s1, s2, N)
+    assert np.all(np.isfinite(np.asarray(dp0)))
+
+
+@pytest.mark.parametrize("batch_wt", [False, True])
+def test_visits_batching_matches_serial(batch_wt):
+    """ISSUE 17 tentpole (b): vmapping the sweep over cluster visits
+    (sage's G-lane jax.vmap) routes onto ONE K-major pallas grid
+    (sweep_blocks_visits) instead of V serial pallas_calls — and must
+    produce what the serial per-visit sweep produces, for shared AND
+    batched weight operands (the OS/IRLS lanes batch wt; the uniform
+    sage group shares it)."""
+    V, K, N, T = 3, 2, 6, 4
+    x8, coh, s1, s2, cid, _, nbase = _toy(N=N, T=T, K=K, seed=33)
+    rng = np.random.default_rng(34)
+    Js = jnp.asarray(rng.normal(size=(V, K, N, 2, 2))
+                     + 1j * rng.normal(size=(V, K, N, 2, 2)))
+    if batch_wt:
+        wt = jnp.asarray(rng.random((V, x8.shape[0], 8)))
+        in_axes = (0, 0)
+    else:
+        wt = jnp.asarray(rng.random((x8.shape[0], 8)))
+        in_axes = (0, None)
+
+    def one(J, w):
+        fac, JTe, cost = swp.gn_blocks(x8, J, coh, s1, s2, cid, w,
+                                       N, K, nbase, interpret=True)
+        return fac.pp, fac.qq, fac.pq, fac.D, JTe, cost
+
+    got = jax.vmap(one, in_axes=in_axes)(Js, wt)
+    for v in range(V):
+        ref = one(Js[v], wt[v] if batch_wt else wt)
+        for g, r, nm in zip(got, ref,
+                            ("pp", "qq", "pq", "D", "JTe", "cost")):
+            scale = float(jnp.abs(r).max()) + 1e-30
+            np.testing.assert_allclose(np.asarray(g[v]), np.asarray(r),
+                                       atol=5e-9 * scale,
+                                       err_msg=f"lane {v} {nm}")
+
+
+def test_visits_batched_stations_fall_back():
+    """Batched sta1/sta2 operands (no solver does this, but the vmap
+    rule must stay total): the dispatch falls back to the serial
+    per-lane sweep and still matches it."""
+    V, K, N, T = 2, 1, 5, 3
+    x8, coh, s1, s2, cid, _, nbase = _toy(N=N, T=T, K=K, seed=35)
+    rng = np.random.default_rng(36)
+    Js = jnp.asarray(rng.normal(size=(V, K, N, 2, 2))
+                     + 1j * rng.normal(size=(V, K, N, 2, 2)))
+    s1v = jnp.stack([s1, s1])
+    s2v = jnp.stack([s2, s2])
+    wt = jnp.ones((x8.shape[0], 8))
+
+    def one(J, a, b):
+        _, JTe, cost = swp.gn_blocks(x8, J, coh, a, b, cid, wt,
+                                     N, K, nbase, interpret=True)
+        return JTe, cost
+
+    got = jax.vmap(one, in_axes=(0, 0, 0))(Js, s1v, s2v)
+    for v in range(V):
+        ref = one(Js[v], s1, s2)
+        for g, r in zip(got, ref):
+            scale = float(jnp.abs(r).max()) + 1e-30
+            np.testing.assert_allclose(np.asarray(g[v]), np.asarray(r),
+                                       atol=5e-9 * scale)
+
+
 @pytest.mark.slow
 def test_fused_equations_heavy_shape():
     """Bench-config-1-sized equivalence (N=62, K=2): the heavy-shape

@@ -163,6 +163,8 @@ def test_solve_knobs_force_modes():
         np.testing.assert_allclose(r, r_ref, rtol=1e-6)
 
 
+@pytest.mark.slow  # ~180 s: the heaviest single test in the tree
+# (round-17 tier-1 rebalance — runs in the full-suite CI lane)
 def test_tile_batch_beam_path(tmp_path):
     """VERDICT r5 item 7: the beam path batches too — per-tile beam
     tables are a gmst leading axis. Batched beam residuals track the

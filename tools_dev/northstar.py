@@ -137,8 +137,10 @@ def b_scaling(args):
 
     ``--kernel xla|pallas|both`` additionally selects the row-pass
     kernel (SageConfig.kernel; ops/sweep_pallas.py). With more than one
-    (inner, kernel) combination the run writes the round-11 comparison
-    record BSCALING_r11.json — kernel on/off x inner chol/cg per B
+    (inner, kernel) combination the run writes the banked comparison
+    record BSCALING_r17.json (round 11 introduced the series; round 17
+    adds the fused-chol/K-major cells plus explicit full-B and
+    small-rung headline fields) — kernel on/off x inner chol/cg per B
     rung, with EXECUTED trip counts (solver/cg) per cell so the floor
     melt and the cg trip price are compared at equal work, measured
     deltas in JSON rather than prose. The SAGECAL_BENCH_KERNEL env var
@@ -259,7 +261,8 @@ def b_scaling(args):
                "platform": platform,
                "inner": inner, "kernel": kern,
                **ladder_fields(ladders[combos[0]])}
-        out_path = os.path.join(HERE, "BSCALING.json")
+        out_path = os.path.join(getattr(args, "bank_dir", None) or HERE,
+                                "BSCALING.json")
     elif len(kernels) == 1 and kernels[0] == "xla":
         per = {i: ladder_fields(ladders[(i, "xla")]) for i in inners}
         # the PR-3 headline: how much of the B-independent floor does
@@ -280,7 +283,8 @@ def b_scaling(args):
                "chol": per["chol"], "cg": per["cg"],
                "cg_vs_chol": deltas,
                "floor_cg_vs_chol_pct": deltas[-1]["cg_vs_chol_pct"]}
-        out_path = os.path.join(HERE, "BSCALING_r07.json")
+        out_path = os.path.join(getattr(args, "bank_dir", None) or HERE,
+                                "BSCALING_r07.json")
     else:
         # round-11 record: kernel on/off x inner chol/cg — the fused-
         # sweep melt as measured deltas. Per (inner, kernel) ladders
@@ -329,19 +333,29 @@ def b_scaling(args):
                 rows = [d for d in kernel_deltas if d["inner"] == i]
                 rec[f"floor_pallas_vs_xla_pct_{i}"] = \
                     rows[-1]["pallas_vs_xla_pct"]
+                # round-17 headline: the FULL-B rung per inner (the
+                # fused-chol melt acceptance cell), plus every sub-full
+                # rung stated as its own field so a small-B regression
+                # is PRICED in the banked record rather than buried in
+                # the ladder rows
+                rec[f"full_pallas_vs_xla_pct_{i}"] = \
+                    rows[0]["pallas_vs_xla_pct"]
+                rec[f"small_rung_pallas_vs_xla_pct_{i}"] = [
+                    d["pallas_vs_xla_pct"] for d in rows[1:]]
             if set(inners) >= {"chol", "cg"}:
                 for k in kernels:
                     c = per[f"chol-{k}"]["rows"][0]["ms_per_cluster"]
                     g = per[f"cg-{k}"]["rows"][0]["ms_per_cluster"]
                     rec[f"cg_vs_chol_pct_{k}"] = round(
                         100.0 * (g - c) / c, 1)
+        bank_dir = getattr(args, "bank_dir", None) or HERE
         if banked_pair:
-            out_path = os.path.join(HERE, "BSCALING_r11.json")
+            out_path = os.path.join(bank_dir, "BSCALING_r17.json")
         else:
-            out_path = os.path.join(HERE, "BSCALING_EXPLORE.json")
+            out_path = os.path.join(bank_dir, "BSCALING_EXPLORE.json")
             print(f"# partial (inner, kernel) combo set {combos}: "
                   f"writing {os.path.basename(out_path)}, not the "
-                  f"banked BSCALING_r11.json")
+                  f"banked BSCALING_r17.json")
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps(rec))
@@ -943,7 +957,8 @@ def mesh2d(args):
             json.dump(rec, f, indent=1, default=float)
         return 1
     path = _bench.stamp_family(rec, "cpu", "MESH2D",
-                               "10-mesh2d-northstar", first_round=13)
+                               "10-mesh2d-northstar", first_round=13,
+                               bank_dir=getattr(args, "bank_dir", None))
     print(f"mesh2d: banked {os.path.basename(path)}")
     print(json.dumps(rec))
     return 0
@@ -981,7 +996,7 @@ def main():
                     help="row-pass kernel (sage.SageConfig.kernel; "
                          "ops/sweep_pallas.py fused sweep); 'both' "
                          "runs the --b-scaling ladder kernel-on/off "
-                         "and banks BSCALING_r11.json; defaults to "
+                         "and banks BSCALING_r17.json; defaults to "
                          "SAGECAL_BENCH_KERNEL when set")
     ap.add_argument("--multichip", action="store_true",
                     help="run the ADMM shape on a virtual multi-device "
@@ -1035,6 +1050,11 @@ def main():
                     help="rounds the injected slow subband straggles")
     ap.add_argument("--reps", type=int, default=3,
                     help="warm sweep timings per shape (--b-scaling)")
+    ap.add_argument("--bank-dir", default=None,
+                    help="write banked records (BSCALING*/MESH2D_rNN) "
+                         "here instead of tools_dev/ — the burn-down "
+                         "--dry-run's scratch-bank mode; committed "
+                         "records are never touched when set")
     args = ap.parse_args()
     if args.inner == "both" and not args.b_scaling:
         # "both" is the --b-scaling comparison mode only; silently

@@ -17,6 +17,10 @@
 #
 #   1. full bench on the chip -> BENCH_TPU_r05.json + commit
 #   2. north-star at the measured-best settings if no TPU record exists
+#   3. burn-down queue (tools_dev/burndown.py): every pending kernel/
+#      scaling verdict — Mosaic sweep+chol parity, kernel cache,
+#      b-scaling ladder, bf16 melt, 2-D mesh, fleet — banked and
+#      sentinel-checked unattended while the window lasts
 #
 # Usage: bash tools_dev/tpu_wake.sh   (from the repo root)
 set -e
@@ -123,3 +127,13 @@ PYEOF
 else
     git checkout -- NORTHSTAR.json BENCH_TABLE.md 2>/dev/null || true
 fi
+
+# Burn-down queue (ISSUE 17): every remaining hardware verdict in one
+# command. burndown.py continues past individual failures and writes
+# BURNDOWN.json, so || true — a half-burned window still banks what
+# landed; only commit record files that actually appeared.
+echo "== burn-down queue =="
+timeout 9000 $PY tools_dev/burndown.py || true
+git add -- BURNDOWN.json BSCALING_r*.json MESH2D_r*.json \
+    FLEET_r*.json 2>/dev/null || true
+git commit -m "Bank burn-down records from a healthy TPU window" || true

@@ -108,6 +108,14 @@ def build_parser() -> argparse.ArgumentParser:
            "thread (bit-identical outputs; default 1 = double-"
            "buffered). 0 = fully synchronous reference loop — the "
            "debugging escape hatch")
+    a("--prior-cache", choices=("off", "read", "readwrite"),
+      default="off",
+      help="warm-start solution prior store (serve/priors.py): read = "
+           "seed J0 from a banked same-key solution (sky/cluster "
+           "content + station set + band + solver family), readwrite "
+           "= also bank this run's final chain. Changes iteration "
+           "counts, never the convergence target; off (default) is "
+           "bit- and compile-count-identical to pre-prior behavior")
     a("--dtype-policy", choices=("f32", "bf16", "f16"), default="f32",
       help="storage dtype for the [B]-data (visibilities, weights, "
            "staged residual tiles, Wirtinger factors) with f32 "
@@ -221,6 +229,7 @@ def config_from_args(args) -> RunConfig:
         dtype_policy=args.dtype_policy,
         tile_bucket=args.tile_bucket,
         prefetch=args.prefetch,
+        prior_cache=args.prior_cache,
         resume=bool(args.resume),
         shard_baselines=bool(args.shard_baselines))
 

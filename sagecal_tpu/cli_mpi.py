@@ -24,6 +24,7 @@ import numpy as np
 from sagecal_tpu import skymodel, utils
 from sagecal_tpu.config import SolverMode
 from sagecal_tpu.obs import metrics as obs
+from sagecal_tpu.serve import priors as ppriors
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -75,6 +76,13 @@ def build_parser() -> argparse.ArgumentParser:
     a("-r", "--rho", type=float, default=5.0)
     a("-G", "--rho-file", default=None)
     a("-C", "--adaptive-rho", type=int, default=0)
+    a("--prior-cache", choices=("off", "read", "readwrite"),
+      default="off",
+      help="solution prior store (serve/priors.py): 'read' seeds J0 "
+           "and the per-cluster rho schedule from a matching banked "
+           "run, 'readwrite' also banks this run's final solutions; "
+           "'off' (default) keeps the cold start bit-frozen. An "
+           "explicit -q/-G always wins over the prior.")
     a("-T", "--max-timeslots", type=int, default=0)
     a("-K", "--skip-timeslots", type=int, default=0)
     a("-U", "--use-global-solution", type=int, default=0)
@@ -366,12 +374,51 @@ def _main_consensus(args, dtrace) -> int:
               + f"; stations {n}, clusters {sky.n_clusters} "
               f"(Mt={sky.n_eff_clusters})")
 
+    # --prior-cache read/readwrite: seed this run from the solution
+    # prior store (serve/priors.py, family "admm"). All-or-nothing
+    # across subbands — any band refusing (station-set/cluster
+    # mismatch) cold-starts EVERY band, a prior never partially seeds.
+    # An explicit -q solution file or -G rho file always wins.
+    prior_mode = getattr(args, "prior_cache", "off")
+    prior_k = None
+    prior_J0 = None
+    prior_rho = None
+    if prior_mode != "off":
+        prior_k = ppriors.prior_key(
+            args.sky_model, args.cluster_file, n, float(freqs.mean()),
+            "admm")
+    if ppriors.reads(prior_mode) and not args.init_solutions:
+        span = float(meta0["tilesz"]) * float(meta0["tdelta"])
+        pt = (float(args.skip_timeslots)
+              + (np.arange(kmax) + 0.5) / kmax) * span
+        seeds = []
+        for f in range(nf):
+            Jf, rho_p = ppriors.PRIORS.seed(
+                prior_k, pt, float(freqs[f]), n, sky.n_clusters)
+            if Jf is None:
+                seeds = []
+                prior_rho = None
+                break
+            seeds.append(Jf)
+            if prior_rho is None:
+                prior_rho = rho_p
+        if seeds:
+            prior_J0 = np.stack(seeds)   # [nf, M, kmax, n, 2, 2]
+            if is_writer:
+                print(f"prior-cache: J0 seeded for {nf} subband(s) "
+                      "from the solution prior store")
+
     rho0 = args.rho
     if args.rho_file:
         # per-cluster regularization (readsky.c:780): passed through as an
         # [M] array; admm.py broadcasts it per subband
         rho0 = skymodel.read_cluster_rho(args.rho_file, sky.cluster_ids,
                                          default_rho=args.rho)
+    elif prior_rho is not None:
+        # banked per-cluster consensus rho seeds the schedule (the
+        # previous run's converged regularization beats the scalar -r
+        # default; -G stays authoritative when given)
+        rho0 = prior_rho
 
     Bpoly = cpoly.setup_polynomials(freqs, float(freqs.mean()),
                                     args.npoly, args.polytype)
@@ -544,6 +591,12 @@ def _main_consensus(args, dtrace) -> int:
             Jinit = np.tile(utils.jones_c2r_np(np.asarray(Jq))[None],
                             (nf, 1, 1, 1, 1))
     J0 = Jinit.copy()
+    if prior_J0 is not None:
+        # prior-cache warm chain start. Jinit stays the cold identity:
+        # the per-subband divergence reset below still recovers to the
+        # reference cold start, so a bad prior costs one reset, never
+        # the run (same contract as pipeline.TileStepper).
+        J0 = utils.jones_c2r_np(prior_J0)
 
     # spatial-model solution file ("spatial_"+solfile,
     # sagecal_master.cpp:472-498): header + two centroid-coordinate
@@ -873,6 +926,24 @@ def _main_consensus(args, dtrace) -> int:
         # from the --prefetch 0 inline-write behavior
         source.close()
         aw.close()
+    if ppriors.writes(prior_mode) and stop > start:
+        # bank the last accepted chain (J0 already has the divergence
+        # resets applied) + the final per-cluster rho, subband-mean of
+        # the mesh's [F, M] schedule. Runs only after aw.close() — the
+        # banked prior can only name durably written outputs.
+        try:
+            span = float(meta0["tilesz"]) * float(meta0["tdelta"])
+            pt = (float(stop - 1)
+                  + (np.arange(kmax) + 0.5) / kmax) * span
+            Jc = utils.jones_r2c_np(np.asarray(J0))  # [F, M, K, N, 2, 2]
+            rho_f = np.asarray(fetch(rhoF))[:nf]
+            rho_m = rho_f.mean(axis=0) if rho_f.ndim == 2 else None
+            ppriors.PRIORS.bank(
+                prior_k, np.transpose(Jc, (0, 2, 1, 3, 4, 5)), pt,
+                freqs.astype(np.float64), rho=rho_m)
+        except Exception as e:
+            if is_writer:
+                print(f"prior-cache: bank skipped ({e})")
     if writer:
         writer.close()
     if spatial_file is not None:

@@ -229,6 +229,19 @@ class RunConfig:
     # bit-identical to batch).
     late_policy: str = "degrade"
 
+    # --prior-cache : warm-start solution prior store
+    # (sagecal_tpu.serve.priors; MIGRATION.md "Solution prior cache").
+    # "read": seed J0 (and the ADMM ρ schedule) from a banked solution
+    # of the same sky/cluster content + station set + band + solver
+    # family, interpolated onto this run's intervals/subbands;
+    # "readwrite": additionally bank this run's final chain on
+    # completion. Tolerance-work, not bit-work: seeding changes
+    # iteration counts, never the convergence target (gated warm-vs-
+    # cold at bench time, WARM_r*.json). "off" (the default) never
+    # touches the store — every existing banked record and bit-parity
+    # gate stays frozen.
+    prior_cache: str = "off"
+
     # --- observability
     profile_dir: str | None = None     # --profile : jax.profiler trace of
     #                                    the first solve interval

@@ -54,7 +54,10 @@ class ADMMConfig(NamedTuple):
     n_admm: int = 10
     npoly: int = 2
     poly_type: int = 2
-    rho: float = 5.0             # scalar, or [M] per-cluster array (-G file)
+    # scalar, or [M] per-cluster array: an explicit -G rho file, or a
+    # banked schedule seeded by --prior-cache read (serve/priors.py —
+    # the previous run's converged per-cluster rho; -G wins over it)
+    rho: float = 5.0
     adaptive_rho: bool = False
     manifold_iters: int = 20     # master :740 Niter
     sage: sage.SageConfig = sage.SageConfig()

@@ -149,6 +149,28 @@ STREAM_TOLERANCES = {
                                better="lower"),
 }
 
+#: warm-start prior-cache tolerances (WARM_rNN.json, bench config
+#: 12-warm-start — content-keyed solution reuse across jobs, ISSUE
+#: 18): the fraction of solver sweeps the prior seed saves on repeat-
+#: field jobs vs the cold control, the warm wall per job, the warm/
+#: cold final-residual ratio (the tolerance-not-bit quality envelope
+#: — warm must CONVERGE as well, just in fewer sweeps; the bench
+#: refuses to bank when this regresses), and the prior-store +
+#: router prior-affinity hit rates on the repeat stream. Judged
+#: cross-round like the FLEET/MESH2D/SCALEOUT/STREAM families.
+WARM_TOLERANCES = {
+    "warm_sweeps_reduction": dict(field="sweeps_reduction_frac",
+                                  abs=0.15, better="higher"),
+    "warm_wall_per_job": dict(field="wall_per_job_warm_s", rel=0.50,
+                              better="lower"),
+    "warm_residual_ratio": dict(field="residual_ratio_warm_vs_cold",
+                                abs=0.05, better="lower"),
+    "warm_prior_hit_rate": dict(field="prior_hit_rate", abs=0.02,
+                                better="higher"),
+    "warm_router_affinity": dict(field="router_prior_affinity_hit_rate",
+                                 abs=0.02, better="higher"),
+}
+
 #: kernel-melt tolerances (BSCALING_rNN.json, tools_dev/northstar.py
 #: --b-scaling --inner both --kernel both — the kernel on/off x inner
 #: chol/cg ladder, ISSUE 17): the pallas-vs-xla per-cluster delta in
@@ -301,6 +323,12 @@ def load_stream_banks(platform: str, bank_dir: str = HERE):
     return load_banks(platform, bank_dir, pattern="STREAM_r*.json")
 
 
+def load_warm_banks(platform: str, bank_dir: str = HERE):
+    """Round-stamped warm-start prior-cache records (WARM_rNN.json),
+    oldest first."""
+    return load_banks(platform, bank_dir, pattern="WARM_r*.json")
+
+
 def load_kmelt_banks(platform: str, bank_dir: str = HERE):
     """Round-stamped kernel-melt ladders (BSCALING_rNN.json), oldest
     first. BSCALING records predate :func:`bench.stamp_family` and are
@@ -401,6 +429,19 @@ def stream_cross_round_check(platform: str,
     return _family_cross_round_check(
         load_stream_banks(platform, bank_dir), STREAM_TOLERANCES,
         "STREAM")
+
+
+def warm_cross_round_check(platform: str,
+                           bank_dir: str = HERE) -> list:
+    """Newest warm-start round vs the most recent earlier one, judged
+    against :data:`WARM_TOLERANCES` — a later round shrinking the
+    sweeps the prior seed saves, slowing the warm wall per job,
+    letting warm convergence quality drift off the cold control, or
+    going cold on the prior-store / router prior-affinity hit rates
+    fails CI with the metric named (the ISSUE 18 satellite, mirroring
+    the FLEET/MESH2D/SCALEOUT/STREAM families)."""
+    return _family_cross_round_check(
+        load_warm_banks(platform, bank_dir), WARM_TOLERANCES, "WARM")
 
 
 def kmelt_cross_round_check(platform: str,
@@ -752,7 +793,7 @@ def main(argv=None) -> int:
                 ld(plat, args.bank_dir) for ld in
                 (load_fleet_banks, load_mesh_banks,
                  load_scaleout_banks, load_stream_banks,
-                 load_kmelt_banks)):
+                 load_warm_banks, load_kmelt_banks)):
             continue
         checked_any = True
         if banks:
@@ -781,6 +822,11 @@ def main(argv=None) -> int:
             print(f"sentinel: {plat} stream bank r{strm[-1][0]:02d} "
                   f"({len(strm)} rounds)")
             viol.extend(stream_cross_round_check(plat, args.bank_dir))
+        warm = load_warm_banks(plat, args.bank_dir)
+        if warm:
+            print(f"sentinel: {plat} warm bank r{warm[-1][0]:02d} "
+                  f"({len(warm)} rounds)")
+            viol.extend(warm_cross_round_check(plat, args.bank_dir))
         km = load_kmelt_banks(plat, args.bank_dir)
         if km:
             print(f"sentinel: {plat} kmelt bank r{km[-1][0]:02d} "

@@ -32,6 +32,7 @@ from sagecal_tpu import coords, dtypes as dtp, faults, sched, skymodel, utils
 from sagecal_tpu.config import RunConfig, SimulationMode, SolverMode
 from sagecal_tpu.serve import cache as pcache
 from sagecal_tpu.serve import fleet as pfleet
+from sagecal_tpu.serve import priors as ppriors
 from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.obs import metrics as obs
 from sagecal_tpu.solvers import normal_eq as ne
@@ -630,6 +631,44 @@ class FullBatchPipeline:
                 J0 = Jq
         return J0
 
+    # -- warm-start prior store (sagecal_tpu.serve.priors) -----------------
+
+    def _interval_times(self, ti: int) -> np.ndarray:
+        """Mid-times (seconds from observation start) of tile ``ti``'s
+        ``kmax`` solve intervals — the temporal axis the prior store
+        interpolates stored chains on. Clusters with fewer than kmax
+        chunks are seeded on the kmax grid anyway (their extra k
+        columns are masked out of the solve by ``cmask``)."""
+        meta = self.ms.meta
+        span = float(meta["tilesz"]) * float(meta["tdelta"])
+        return (float(ti)
+                + (np.arange(self.kmax) + 0.5) / self.kmax) * span
+
+    def prior_key(self) -> str | None:
+        """This run's key in the solution prior store: sky/cluster
+        content digest + station count + band center + solver family
+        (priors.prior_key). Cached; None = unkeyable (no seeding, no
+        banking — never an error)."""
+        if not hasattr(self, "_prior_key"):
+            self._prior_key = ppriors.prior_key(
+                self.cfg.sky_model, self.cfg.cluster_file, self.n,
+                self.ms.meta["freq0"],
+                ppriors.solver_family(self.cfg.solver_mode))
+        return self._prior_key
+
+    def prior_initial_jones(self, start_tile: int = 0):
+        """Warm J0 seed [M, kmax, N, 2, 2] interpolated from a banked
+        same-key solution, or None (cold start — a miss, a refusal,
+        or prior_cache off). An explicit ``-q`` init_solutions file
+        always wins: that is the operator's seed, not the cache's."""
+        mode = getattr(self.cfg, "prior_cache", "off")
+        if not ppriors.reads(mode) or self.cfg.init_solutions:
+            return None
+        J0, _rho = ppriors.PRIORS.seed(
+            self.prior_key(), self._interval_times(start_tile),
+            self.ms.meta["freq0"], self.n, self.sky.n_clusters)
+        return J0
+
     # -- overlapped execution (sagecal_tpu.sched) --------------------------
 
     def _prefetch_depth(self, prefetch) -> int:
@@ -1156,6 +1195,28 @@ class TileStepper:
         self.first = True
         self.res_prev = None
         self.start_tile = 0
+        # warm-start prior seed (serve/priors.py): a banked same-key
+        # solution replaces the cold identity start and enters the
+        # chain as WARM state (first=False — the boosted cold solver
+        # exists for identity starts, solvers/sage.py inflight_warm).
+        # pinit stays the cold identity: a divergence reset still
+        # recovers to the reference start + re-armed boost, so a bad
+        # seed costs one reset, never the run. A checkpoint restore
+        # (below) overrides the seed — the checkpointed chain IS the
+        # job's own state. Under readwrite the post-solve chain is
+        # accumulated per tile and banked at a clean close.
+        self._prior_mode = getattr(pipe.cfg, "prior_cache", "off")
+        self._prior_banked: list = []
+        self._prior_res2 = 0.0          # sum |written residual|^2
+        self._prior_res_tiles = 0       # over this many banked tiles
+        if ck is None:
+            Jp = pipe.prior_initial_jones(self.start_tile)
+            if Jp is not None:
+                self.J = Jp
+                self.first = False
+                log("prior-cache: J0 seeded from the solution prior "
+                    "store (cold identity kept as the divergence-"
+                    "reset target)")
         if ck is not None:
             # restore the EXACT chain state at the watermark: the
             # warm-start Jones (full precision — the text file is
@@ -1335,6 +1396,12 @@ class TileStepper:
         else:
             self.res_prev = (res_1 if self.res_prev is None
                              else min(self.res_prev, res_1))
+        if ppriors.writes(self._prior_mode) and not degraded \
+                and not quarantined and not diverged:
+            # prior-store accumulation: only chain states that the
+            # divergence policy accepted — a reset/quarantined tile's
+            # J must never be banked as a seed for the next job
+            self._prior_banked.append((ti, self.J.copy()))
 
         if cfg.per_channel_bfgs:
             bubble += self._step_per_channel(ti, tile, stg, info)
@@ -1360,6 +1427,17 @@ class TileStepper:
                 bubble += self.aw.submit(
                     p._write_residual_tile, ti, tile, res_r,
                     bg=self.depth > 0)
+                if ppriors.writes(self._prior_mode) and not degraded \
+                        and not quarantined and not diverged:
+                    # banked-chain quality rides the same ordered
+                    # queue: the UNWEIGHTED norm of the residual this
+                    # job writes. The solver's robust res_1 is the
+                    # wrong figure here — nu re-weighting IMPROVES it
+                    # while the written residual drifts, which is
+                    # exactly the degradation the store must refuse
+                    bubble += self.aw.submit(
+                        self._accum_prior_quality, res_r,
+                        tile.x.shape[0])
 
         if t_arr is not None:
             # the streaming SLO: arrival -> residual durably written.
@@ -1388,6 +1466,13 @@ class TileStepper:
                 f"nu={mean_nu:.2f}")
         rec = {"tile": ti, "res_0": res_0, "res_1": res_1,
                "mean_nu": mean_nu, "minutes": dt}
+        if isinstance(info, dict) and "solver_iters" in info:
+            # executed inner-solver trips — the sweeps-to-convergence
+            # signal the serve layer aggregates per job (loadgen
+            # replay rows; the warm-vs-cold bench). The solve already
+            # synced on res_0/res_1, so this fetch adds no wait.
+            rec["solver_iters"] = int(
+                np.asarray(info["solver_iters"]).sum())
         if quarantined:
             rec["quarantined"] = True
         if degraded:
@@ -1405,6 +1490,46 @@ class TileStepper:
         lat = time.monotonic() - t_arr
         obs.observe("stream_tile_latency_seconds", lat)
         dtrace.emit("stream_latency", tile=ti, latency_s=lat)
+
+    def _accum_prior_quality(self, res_r, n_rows) -> None:
+        """Writer-queue job: fold one banked tile's written-residual
+        power into the prior-quality accumulator. Runs right after
+        the tile's residual write on the same ordered queue, so the
+        buffer is already host-side; bucket padding rows (never
+        solved on) are sliced off like the MS write does."""
+        r = np.asarray(res_r, np.float64)[:n_rows]
+        self._prior_res2 += float(np.sum(np.square(r)))
+        self._prior_res_tiles += 1
+
+    def _bank_priors(self) -> None:
+        """Writer-queue job: bank the completed chain in the solution
+        prior store (close() submits it only on a clean completion).
+        Best-effort — a store refusal logs and moves on; a finished
+        job must never fail on its own write-back."""
+        p = self.p
+        try:
+            tis = [t for t, _ in self._prior_banked]
+            Js = np.stack([J for _, J in self._prior_banked])
+            T, M, K, N = Js.shape[:4]
+            times = np.concatenate(
+                [p._interval_times(int(t)) for t in tis])
+            # [T, M, K, N, 2, 2] -> [1 band, T*K intervals, M, N, 2, 2]
+            Jt = np.transpose(Js, (0, 2, 1, 3, 4, 5)).reshape(
+                1, T * K, M, N, 2, 2)
+            # quality = mean written-residual power per banked tile
+            # (accumulated by _accum_prior_quality on this same
+            # ordered queue, so every tile has landed by now): the
+            # store's refuse-to-degrade guard — a warm repeat whose
+            # chain fits the data worse than the entry it seeded from
+            # must not supersede it (generational drift). Runs that
+            # write no residuals bank quality-less (always supersede).
+            quality = (self._prior_res2 / self._prior_res_tiles
+                       if self._prior_res_tiles else None)
+            ppriors.PRIORS.bank(p.prior_key(), Jt, times,
+                                [float(p.ms.meta["freq0"])],
+                                quality=quality)
+        except Exception as e:
+            self.log(f"prior-cache: bank skipped ({e})")
 
     def _save_checkpoint(self, state: dict) -> None:
         """Writer-thread half of the checkpoint: runs strictly after
@@ -1538,6 +1663,17 @@ class TileStepper:
         A COMPLETED run (every tile stepped, writes flushed clean)
         removes its checkpoint sidecar; a failed/killed run keeps it —
         that file IS the ``resume=true`` re-entry point."""
+        if raise_pending and self._prior_banked and (
+                self.open_ended
+                or (self.n_tiles is not None
+                    and self._last_tile >= self.n_tiles - 1)):
+            # prior-store write-back rides the ORDERED writer thread:
+            # submitted after every tile's writes and before the close
+            # flush, so a banked prior can only ever name a chain
+            # whose outputs durably landed. Open-ended (stream) jobs
+            # bank whatever accumulated at their clean close — a live
+            # stream has no "last tile", EndOfStream is the end.
+            self.aw.submit(self._bank_priors)
         try:
             self.aw.close(raise_pending=raise_pending)
         finally:

@@ -172,22 +172,36 @@ def fleet_devices(n: int | None):
 # -- job shape-buckets -------------------------------------------------------
 
 
-def job_bucket(job) -> str | None:
-    """Affinity token of the job's compiled-program set, cheap enough
-    for the admission path (dataset HEADER only — never the data).
-    Computed ONCE per job (success, no-config and unreadable-dataset
-    outcomes all cached — the admission path runs under the queue
-    lock, and re-opening a broken dataset on every pass would
-    serialize the whole API behind filesystem errors); None places by
-    load alone, and an unreadable dataset fails properly at job
-    start, not at placement."""
-    if getattr(job, "bucket", None) is not None \
-            or getattr(job, "_bucket_done", False):
-        return job.bucket
+def _job_tokens(job) -> None:
+    """Compute + cache the job's affinity tokens in ONE dataset-header
+    open, cheap enough for the admission path (HEADER only — never
+    the data). Computed ONCE per job (success, no-config and
+    unreadable-dataset outcomes all cached — the admission path runs
+    under the queue lock, and re-opening a broken dataset on every
+    pass would serialize the whole API behind filesystem errors).
+    Three tokens land:
+
+    - ``job.bucket`` — the compiled-PROGRAM set token. A stream job
+      runs the same programs as a fullbatch job of its shape (the
+      transport only changes who clocks the reader), so its kind is
+      normalized to fullbatch here.
+    - ``job.bucket_place`` — the PLACEMENT token. For stream jobs this
+      is a DEDICATED token (real kind, same shape parts): a live
+      stream's placement identity is stronger than program sharing —
+      the router prefers the worker already hosting this stream
+      family's programs AND priors, and only falls back to the
+      normalized program token (ROADMAP item-1 remainder).
+    - ``job.prior_token`` — the solution prior store key
+      (serve/priors.py): sky/cluster content + station set + band +
+      solver family. Routes repeat fields at the worker holding their
+      warm-start priors.
+    """
+    if getattr(job, "_bucket_done", False):
+        return
     job._bucket_done = True
     cfg = job.cfg
     if cfg is None:
-        return None
+        return
     try:
         from sagecal_tpu.io import dataset as ds
         ms = ds.open_dataset(cfg.ms, cfg.ms_list, tilesz=cfg.tile_size,
@@ -198,13 +212,8 @@ def job_bucket(job) -> str | None:
         tb = int(getattr(cfg, "tile_bucket", 0) or 0)
         if tb:
             tilesz = pcache.resolve_bucket(tilesz, tb)
-        # a stream job runs the SAME compiled program set as a
-        # fullbatch job of its shape (the transport only changes who
-        # clocks the reader) — normalize the kind so streams route to
-        # workers already holding warm same-shape batch programs
-        kind = "fullbatch" if job.kind == "stream" else job.kind
-        job.bucket = pcache.token(
-            kind, tilesz, int(meta["nbase"]),
+        parts = (
+            tilesz, int(meta["nbase"]),
             int(meta["n_stations"]), list(meta["freqs"]),
             cfg.sky_model, cfg.cluster_file,
             int(cfg.solver_mode), cfg.max_em_iter, cfg.max_iter,
@@ -215,9 +224,46 @@ def job_bucket(job) -> str | None:
             int(cfg.beam_mode), bool(cfg.per_channel_bfgs),
             int(getattr(cfg, "tile_batch", 1) or 1),
             int(cfg.simulation))
-        return job.bucket
+        kind = "fullbatch" if job.kind == "stream" else job.kind
+        job.bucket = pcache.token(kind, *parts)
+        job.bucket_place = (pcache.token(job.kind, *parts)
+                            if job.kind == "stream" else job.bucket)
+        from sagecal_tpu.serve import priors as ppriors
+        fam = ppriors.solver_family(cfg.solver_mode)
+        job.prior_token = ppriors.prior_key(
+            cfg.sky_model, cfg.cluster_file,
+            int(meta["n_stations"]), meta["freq0"], fam)
     except Exception:
-        return None
+        return
+
+
+def job_bucket(job) -> str | None:
+    """The compiled-program affinity token (see :func:`_job_tokens`);
+    None places by load alone, and an unreadable dataset fails
+    properly at job start, not at placement."""
+    if getattr(job, "bucket", None) is not None:
+        return job.bucket
+    _job_tokens(job)
+    return getattr(job, "bucket", None)
+
+
+def job_placement_bucket(job) -> str | None:
+    """The placement token: the program token for batch jobs, a
+    DEDICATED same-shape token for stream jobs (see
+    :func:`_job_tokens`)."""
+    if getattr(job, "bucket_place", None) is not None:
+        return job.bucket_place
+    _job_tokens(job)
+    return getattr(job, "bucket_place", None)
+
+
+def job_prior_token(job) -> str | None:
+    """The solution prior store key of this job's field/band/solver
+    family (serve/priors.py; header-only — see :func:`_job_tokens`)."""
+    if getattr(job, "prior_token", None) is not None:
+        return job.prior_token
+    _job_tokens(job)
+    return getattr(job, "prior_token", None)
 
 
 # -- placement ---------------------------------------------------------------

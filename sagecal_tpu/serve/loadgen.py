@@ -29,7 +29,10 @@ A spec is a JSON object (all fields defaulted — ``{}`` is valid)::
 ``arrival.process``: ``"poisson"`` (exponential inter-arrival at
 ``rate_per_s``), ``"uniform"`` (fixed spacing ``1/rate_per_s``) or
 ``"burst"`` (everything at t=0 — the backlog-drain regime whose
-queue-wait tail shows fleet capacity). Template ``config`` fields are
+queue-wait tail shows fleet capacity). A template's ``repeat``
+(default 0) grows its draw weight with every draw — repeat-field
+traffic, the regime the warm-start prior cache (serve/priors.py,
+bench ``12-warm-start``) is built for. Template ``config`` fields are
 RunConfig names (serve ``submit`` semantics); ``tile_arrival_s``
 there turns on streaming-ingest pacing (config.py) — the
 ingest-limited regime where per-device throughput is bounded by
@@ -68,9 +71,9 @@ CLUSTER = """\
 """
 
 DEFAULT_TEMPLATE = dict(
-    name="bucketA", weight=1.0, n_stations=16, tilesz=4, n_tiles=6,
-    nchan=24, noise_sigma=0.02, priority=[0], deadline_s=None,
-    config={})
+    name="bucketA", weight=1.0, repeat=0.0, n_stations=16, tilesz=4,
+    n_tiles=6, nchan=24, noise_sigma=0.02, priority=[0],
+    deadline_s=None, config={})
 
 DEFAULT_SPEC = dict(
     seed=12, n_jobs=8,
@@ -113,12 +116,22 @@ def schedule(spec) -> list:
     spec = load_spec(spec)
     rng = random.Random(int(spec["seed"]))
     tmpls = spec["templates"]
-    weights = [float(t["weight"]) for t in tmpls]
     arr = spec["arrival"]
+    # "repeat" models repeat-field traffic (the warm-start prior-cache
+    # regime): each draw of a template multiplies its effective weight
+    # by (1 + repeat * draws_so_far), so a re-observed field grows
+    # stickier the more it is observed. repeat=0 (default) is the old
+    # static mix — same seed, same schedule, bit for bit.
+    draws = {t_["name"]: 0 for t_ in tmpls}
     t = 0.0
     out = []
     for i in range(int(spec["n_jobs"])):
+        weights = [float(t_["weight"])
+                   * (1.0 + float(t_.get("repeat", 0.0))
+                      * draws[t_["name"]])
+                   for t_ in tmpls]
         tmpl = rng.choices(tmpls, weights=weights)[0]
+        draws[tmpl["name"]] += 1
         prio = rng.choice(list(tmpl["priority"]))
         out.append(dict(t=round(t, 6), template=tmpl["name"],
                         job_id=f"replay-{spec['seed']}-{i:03d}",
@@ -272,6 +285,7 @@ def replay(client, spec, fixtures, workdir: str, log=print,
                    state=snap["state"], device=snap["device"],
                    queue_wait_s=qw, e2e_s=e2e,
                    migrations=snap["migrations"],
+                   solver_iters=int(snap.get("solver_iters") or 0),
                    ms=job["ms"], solutions=job["solutions"])
         if snap.get("kind") == "stream" or snap.get("tiles_late"):
             # streaming tenants (a template whose config carries
@@ -286,11 +300,22 @@ def replay(client, spec, fixtures, workdir: str, log=print,
             row["hops"] = snap.get("hops", [])
         rows.append(row)
     n_done = states.get("done", 0)
+    # per-template sweeps-to-convergence: total executed solver sweeps
+    # per finished job of each template (Job.snapshot solver_iters) —
+    # the warm-start bench's primary axis (warm vs cold at equal
+    # convergence quality is fewer sweeps, not a different answer)
+    sweeps = {}
+    for row in rows:
+        if row["state"] == "done":
+            sweeps.setdefault(row["template"], []).append(
+                row["solver_iters"])
     rec = dict(
         n_jobs=len(jobs), states=states, wall_s=round(wall, 3),
         throughput_jobs_per_s=round(n_done / wall, 4) if wall else 0.0,
         queue_wait_p50_s=_pct(waits, 50), queue_wait_p99_s=_pct(waits, 99),
         e2e_p50_s=_pct(e2es, 50), e2e_p99_s=_pct(e2es, 99),
+        sweeps_by_template={k: round(float(np.mean(v)), 3)
+                            for k, v in sorted(sweeps.items()) if v},
         jobs=rows)
     log(f"loadgen: {n_done}/{len(jobs)} done in {wall:.2f}s "
         f"({rec['throughput_jobs_per_s']:.3f} jobs/s, p99 queue wait "

@@ -131,6 +131,12 @@ class Job:
         self.pinned_device: int | None = None
         self.migrate_to: int | None = None
         self.bucket: str | None = None
+        # placement + prior-affinity tokens (fleet._job_tokens, cached
+        # alongside bucket): the dedicated stream placement token
+        # (= bucket for batch kinds) and the solution prior store key
+        # (serve/priors.py) the router routes repeat fields by
+        self.bucket_place: str | None = None
+        self.prior_token: str | None = None
         self.migrations: list = []
         # stream-preemption request (serve/scheduler.py policy): the
         # owner loop yields this job to its checkpoint at the next
@@ -140,6 +146,11 @@ class Job:
         # streaming per-tile lateness accounting (stream jobs only)
         self.tiles_late = 0
         self.tiles_degraded = 0
+        # executed inner-solver trips accumulated over stepped tiles
+        # (pipeline tile rec "solver_iters") — the sweeps-to-
+        # convergence signal the loadgen replay aggregates per
+        # template to price warm-vs-cold starts
+        self.solver_iters = 0
         # the tile a (possibly resumed) run actually started at — 0
         # for a fresh run, the checkpoint watermark + 1 for a resume.
         # Surfaced in the snapshot so a CROSS-PROCESS router can price
@@ -174,6 +185,9 @@ class Job:
             # streaming lateness accounting (stream jobs; 0 otherwise)
             "tiles_late": self.tiles_late,
             "tiles_degraded": self.tiles_degraded,
+            # executed inner-solver trips (sweeps-to-convergence; 0
+            # for opaque jobs that never report per-tile recs)
+            "solver_iters": self.solver_iters,
         }
 
     def expired(self, now: float | None = None) -> bool:

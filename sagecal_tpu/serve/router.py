@@ -101,6 +101,7 @@ class WorkerInfo:
         self.last_hb_t = 0.0
         self.heartbeats = 0
         self.buckets: dict = {}         # token -> [device ordinals]
+        self.priors: set = set()        # solution prior store keys held
         self.cache: dict = {}           # worker PROGRAMS.stats()
         self.counts: dict = {}          # worker queue counts()
         self.tiles_done = 0
@@ -136,6 +137,7 @@ class WorkerInfo:
                                 if self.last_hb_t else None),
             "heartbeats": self.heartbeats,
             "buckets": len(self.buckets),
+            "priors": len(self.priors),
             "cache": dict(self.cache,
                           hit_rate=(self.cache.get("hits", 0) / n)
                           if n else 0.0),
@@ -164,6 +166,12 @@ class RJob:
         self.hops: list = []            # completed + in-flight hop records
         self.n_dispatches = 0
         self.bucket: str | None = None
+        # dedicated placement token (= bucket except for stream jobs)
+        # and the solution prior store key — the prior-affinity
+        # routing signal; routed_by records which signal won placement
+        self.bucket_place: str | None = None
+        self.prior: str | None = None
+        self.routed_by: str | None = None
         self._bucket_done = False
         self.started_t: float | None = None
         self.finished_t: float | None = None
@@ -217,21 +225,30 @@ class RJob:
         return snap
 
 
-def _bucket_token(payload: dict) -> str | None:
-    """The job's affinity token from its submit payload — the same
-    ``fleet.job_bucket`` digest the in-process placer uses, computed
-    against the shared filesystem (dataset HEADER only). None (opaque
-    mpi jobs, unreadable datasets) routes by load alone."""
+def _affinity_tokens(payload: dict):
+    """(program bucket, placement bucket, prior key) of a submit
+    payload — the same ``fleet._job_tokens`` digests the in-process
+    placer and the prior store use, computed against the shared
+    filesystem (dataset HEADER only, one open for all three). All-None
+    (opaque mpi jobs, unreadable datasets) routes by load alone."""
     cfg_dict = payload.get("config")
     if not cfg_dict or payload.get("mpi_argv") is not None:
-        return None
+        return None, None, None
     try:
         from sagecal_tpu.serve import fleet
         cfg = sapi.config_from_dict(cfg_dict)
         job = jq.Job("_probe", cfg, kind=sapi.job_kind(cfg))
-        return fleet.job_bucket(job)
+        return (fleet.job_bucket(job),
+                fleet.job_placement_bucket(job),
+                fleet.job_prior_token(job))
     except Exception:
-        return None
+        return None, None, None
+
+
+def _bucket_token(payload: dict) -> str | None:
+    """The program-bucket half of :func:`_affinity_tokens` (kept for
+    probe/test callers that only price program sharing)."""
+    return _affinity_tokens(payload)[0]
 
 
 class Router:
@@ -267,6 +284,10 @@ class Router:
         self.dispatches = 0
         self.migrations = 0
         self.recoveries = 0
+        # prior-affinity placement accounting: of the placements that
+        # HAD a prior key, how many landed on a worker holding it
+        self.prior_place_hits = 0
+        self.prior_place_total = 0
         self.lease_evictions = 0
         self._srv = None
         self._dispatcher = threading.Thread(
@@ -312,6 +333,8 @@ class Router:
             w.heartbeats += 1
             if "buckets" in req:
                 w.buckets = dict(req["buckets"])
+            if "priors" in req:
+                w.priors = set(req["priors"])
             if "cache" in req:
                 w.cache = dict(req["cache"])
             if "counts" in req:
@@ -554,8 +577,14 @@ class Router:
 
     def _place(self, rj: RJob) -> str | None:
         """Lock held. Target worker id for ``rj``, or None (blocked).
-        Mirrors fleet.Placer one level up: pin > inventory/sticky
-        bucket affinity > least-load; capacity budgeted per worker."""
+        Mirrors fleet.Placer one level up: pin > prior-affinity >
+        placement-bucket affinity (live inventory, then the stream
+        program-token fallback, then the sticky map) > least-load;
+        capacity budgeted per worker. Prior affinity ranks ABOVE the
+        bucket: a worker holding this field's banked priors saves
+        solver sweeps on EVERY tile, which dominates the one-time
+        compile a warm program set saves. ``rj.routed_by`` records
+        which signal won (the prior-affinity hit-rate source)."""
         now = time.time()
         assigned: dict[str, int] = {}
         for j in self.jobs.values():
@@ -575,6 +604,7 @@ class Router:
                 # fleet behind a pin that can never be satisfied
                 rj.pinned_worker = None
             else:
+                rj.routed_by = "pin"
                 return rj.pinned_worker if any(
                     w.worker_id == rj.pinned_worker for w in free) \
                     else None
@@ -585,19 +615,39 @@ class Router:
             # per dispatch pass), outside no lock contention concerns:
             # the dispatcher is the only caller
             rj._bucket_done = True
-            rj.bucket = _bucket_token(rj.payload)
-        if rj.bucket is not None:
+            rj.bucket, rj.bucket_place, rj.prior = \
+                _affinity_tokens(rj.payload)
+        if rj.prior is not None:
+            for w in free:
+                if rj.prior in w.priors:
+                    rj.routed_by = "prior"
+                    return w.worker_id
+        if rj.bucket_place is not None:
             # live inventory beats the sticky map: a worker that
             # REPORTS warm programs for this token is the affinity home
             for w in free:
-                if rj.bucket in w.buckets:
+                if rj.bucket_place in w.buckets:
+                    rj.routed_by = "bucket"
                     return w.worker_id
-            home = self._affinity.get(rj.bucket)
+        if rj.bucket is not None and rj.bucket != rj.bucket_place:
+            # stream fallback: no worker hosted this stream family yet
+            # — any worker with warm same-shape BATCH programs still
+            # beats a cold one (the pre-dedicated-token behavior)
+            for w in free:
+                if rj.bucket in w.buckets:
+                    rj.routed_by = "bucket_prog"
+                    return w.worker_id
+        for tok in (rj.bucket_place, rj.bucket):
+            if tok is None:
+                continue
+            home = self._affinity.get(tok)
             if home is not None and any(
                     w.worker_id == home for w in free):
+                rj.routed_by = "sticky"
                 return home
         free.sort(key=lambda w: (assigned.get(w.worker_id, 0),
                                  w.registered_t))
+        rj.routed_by = "load"
         return free[0].worker_id
 
     # -- the dispatcher loop -------------------------------------------------
@@ -617,7 +667,8 @@ class Router:
             need = [rj for rj in self.jobs.values()
                     if rj.state == jq.QUEUED and not rj._bucket_done]
         for rj in need:
-            rj.bucket = _bucket_token(rj.payload)
+            rj.bucket, rj.bucket_place, rj.prior = \
+                _affinity_tokens(rj.payload)
             rj._bucket_done = True
         to_submit = []
         with self._lock:
@@ -640,6 +691,14 @@ class Router:
                 target = self._place(rj)
                 if target is None:
                     break               # strict head-of-line
+                if rj.prior is not None:
+                    # prior-affinity hit rate: of placements that HAD
+                    # a prior key, how many the prior signal routed
+                    self.prior_place_total += 1
+                    if rj.routed_by == "prior":
+                        self.prior_place_hits += 1
+                        ometrics.inc(
+                            "router_prior_affinity_hits_total")
                 rj.state = DISPATCHED
                 rj.worker_id = target
                 rj.pinned_worker = None
@@ -664,8 +723,9 @@ class Router:
                 self.dispatches += 1
                 ometrics.inc("router_dispatches_total",
                              worker=w.worker_id)
-                if rj.bucket is not None:
-                    self._affinity[rj.bucket] = w.worker_id
+                for tok in (rj.bucket, rj.bucket_place):
+                    if tok is not None:
+                        self._affinity[tok] = w.worker_id
             self.log(f"router: [{rj.job_id}] -> {w.worker_id}"
                      + (" (resume)" if rj.resume else ""))
         except Exception as e:
@@ -809,6 +869,12 @@ class Router:
                 tiles_done=sum(w["tiles_done"] for w in workers),
                 cache_hit_rate_min=min(rates, default=0.0),
                 bucket_affinity=dict(self._affinity),
+                prior_affinity={
+                    "hits": self.prior_place_hits,
+                    "total": self.prior_place_total,
+                    "hit_rate": (self.prior_place_hits
+                                 / self.prior_place_total)
+                    if self.prior_place_total else 0.0},
                 draining=self._draining,
             )
             # refresh point-in-time gauges alongside the snapshot so
@@ -978,10 +1044,15 @@ class WorkerAgent:
 
     def _heartbeat_payload(self) -> dict:
         from sagecal_tpu.serve import cache as pcache
+        from sagecal_tpu.serve import priors as ppriors
         srv = self.server
         return {"op": "worker_heartbeat", "worker_id": self.worker_id,
                 "jobs": [j.snapshot() for j in srv.queue.jobs()],
                 "buckets": srv.scheduler.bucket_inventory(),
+                # solution prior store inventory (serve/priors.py):
+                # the router routes repeat fields at the worker
+                # already holding their warm-start priors
+                "priors": ppriors.PRIORS.inventory(),
                 "cache": pcache.PROGRAMS.stats(),
                 "counts": srv.queue.counts(),
                 "tiles_done": srv.scheduler.tiles_done}

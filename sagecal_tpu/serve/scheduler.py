@@ -74,6 +74,7 @@ from sagecal_tpu.obs import health as ohealth
 from sagecal_tpu.obs import metrics as obs
 from sagecal_tpu.serve import cache as pcache
 from sagecal_tpu.serve import fleet
+from sagecal_tpu.serve import priors as ppriors
 from sagecal_tpu.serve import queue as jq
 
 
@@ -295,7 +296,11 @@ class Scheduler:
                    n_devices=n_dev, devices=devices,
                    migrations=self.migrations_done,
                    migrations_aborted=self.migrations_aborted,
-                   unhealthy_jobs=self.unhealthy_jobs())
+                   unhealthy_jobs=self.unhealthy_jobs(),
+                   # warm-start prior store (serve/priors.py):
+                   # process-wide hit/bank/refusal accounting — the
+                   # serve half of the warm-vs-cold bench record
+                   priors=ppriors.PRIORS.stats())
         if spans:
             out["mesh_spans"] = spans
         return out
@@ -311,9 +316,18 @@ class Scheduler:
 
     def _note_bucket(self, job, ordinal: int) -> None:
         b = fleet.job_bucket(job)
-        if b is not None:
-            with self._bucket_lock:
+        bp = fleet.job_placement_bucket(job)
+        with self._bucket_lock:
+            if b is not None:
                 self._buckets.setdefault(b, set()).add(int(ordinal))
+            if bp is not None and bp != b:
+                # a stream job's DEDICATED placement token is claimed
+                # alongside its normalized program token, so the
+                # router can route a repeat stream at the worker that
+                # hosted the stream itself — not just any worker with
+                # warm same-shape batch programs (ROADMAP item-1
+                # remainder)
+                self._buckets.setdefault(bp, set()).add(int(ordinal))
 
     def unhealthy_jobs(self) -> list:
         """RUNNING jobs whose convergence health is stalled/diverging
@@ -746,6 +760,8 @@ class Scheduler:
                             device=str(w.ix))
                     obs.inc("serve_tiles_done_total", job=job.job_id)
                     job.tiles_done += 1
+                    job.solver_iters += int(
+                        rec.get("solver_iters") or 0)
                     w.tiles_done += 1
                     progressed = True
                     if job.health == ohealth.DIVERGING \

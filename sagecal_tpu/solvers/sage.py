@@ -164,7 +164,10 @@ class SageConfig(NamedTuple):
     # within 4% of sequential over 3 sweeps with zero rejections, G=8
     # converges where undamped rejection stalls). Callers whose J0 is
     # already near a solution (pipeline warm tiles, ADMM iterations
-    # > 0) set inflight_warm=True to skip the cold restriction.
+    # > 0, a J0 seeded from the solution prior store —
+    # serve/priors.py: TileStepper enters the chain with first=False
+    # so the warm solver runs from tile 0) set inflight_warm=True to
+    # skip the cold restriction.
     inflight: int = 1
     inflight_warm: bool = False
     # row baseline period of the [tilesz, nbase] visibility layout

@@ -718,3 +718,67 @@ def test_sentinel_kmelt_committed_bank_loads():
         assert spec["field"] in rec, spec["field"]
     # the priced small-rung regression is ON the record, per rung
     assert isinstance(rec["small_rung_pallas_vs_xla_pct_chol"], list)
+
+
+def _write_warm_bank(dirpath, rnd, rec, platform="cpu"):
+    with open(os.path.join(dirpath, f"WARM_r{rnd:02d}.json"),
+              "w") as f:
+        json.dump({"platform": platform, "date": "2026-08-07",
+                   "results": {"12-warm-start": rec}}, f)
+
+
+def _warm_rec(**kw):
+    rec = dict(sweeps_reduction_frac=0.5, wall_per_job_warm_s=1.0,
+               residual_ratio_warm_vs_cold=1.0, prior_hit_rate=1.0,
+               router_prior_affinity_hit_rate=1.0, shape="warm test")
+    rec.update(kw)
+    return rec
+
+
+def test_sentinel_warm_cross_round(tmp_path, capsys):
+    """ISSUE 18 satellite: the warm-start bank (WARM_rNN.json) is
+    judged like the STREAM/KMELT banks — newest pair, named metric,
+    improvements never fail; a shrunken sweeps saving, a fattened
+    warm wall, a degraded warm residual envelope, or a dropped
+    prior/router hit rate fails with the metric named."""
+    d = str(tmp_path)
+    _write_warm_bank(d, 18, _warm_rec())
+    assert sentinel.warm_cross_round_check("cpu", d) == []
+    _write_warm_bank(d, 19, _warm_rec(sweeps_reduction_frac=0.6,
+                                      wall_per_job_warm_s=0.8))
+    assert sentinel.warm_cross_round_check("cpu", d) == []
+    _write_warm_bank(d, 20, _warm_rec(
+        sweeps_reduction_frac=0.1,             # saving shrank
+        residual_ratio_warm_vs_cold=1.2,       # warm quality degraded
+        prior_hit_rate=0.5))                   # store stopped hitting
+    v = sentinel.warm_cross_round_check("cpu", d)
+    assert {x["metric"] for x in v} == {"warm_sweeps_reduction",
+                                        "warm_residual_ratio",
+                                        "warm_prior_hit_rate"}
+    assert all("WARM r20" in x["msg"] for x in v)
+    # the CLI lane fails with the metric named
+    rc = sentinel.main(["--fast", "--no-probes", "--platform", "cpu",
+                        "--bank-dir", d])
+    assert rc == 1
+    assert "warm_sweeps_reduction" in capsys.readouterr().err
+    assert sentinel.load_warm_banks("tpu", d) == []
+
+
+def test_sentinel_warm_committed_bank_loads():
+    """The committed WARM round parses, declares its platform,
+    carries every toleranced field, and banked the acceptance gates:
+    warm jobs spend measurably fewer sweeps than the cold control at
+    equal residual quality (within the envelope), the store actually
+    hit, the router's prior affinity actually routed, and the off
+    lane stayed bit-identical to the frozen cold start."""
+    banks = sentinel.load_warm_banks("cpu", REPO)
+    assert banks, "no committed WARM_rNN.json"
+    rec = banks[-1][2]["12-warm-start"]
+    for spec in sentinel.WARM_TOLERANCES.values():
+        assert spec["field"] in rec, spec["field"]
+    assert rec["sweeps_reduction_frac"] > 0.0
+    assert (rec["residual_ratio_warm_vs_cold"]
+            <= 1.0 + rec["res_envelope"])
+    assert rec["prior_hit_rate"] > 0.0
+    assert rec["router_prior_affinity_hits"] >= 1
+    assert rec["off_bit_identical"] is True

@@ -351,6 +351,74 @@ def test_place_bucket_affinity_capacity_and_pins():
     assert r._place(job) == "wa"
 
 
+def test_place_prior_affinity_ranks_above_bucket():
+    """Prior affinity (ISSUE 18): a worker advertising this field's
+    banked prior wins over one advertising warm programs — saved
+    sweeps on every tile dominate the one-time compile — and the
+    hit-rate counters ride the dispatch pass."""
+    r = _mk_router()
+    _add_worker(r, "wa", capacity=2, buckets=("B",), t=1.0)
+    wb = _add_worker(r, "wb", capacity=2, t=2.0)
+    wb.priors = {"P"}
+    job = rt.RJob("j1", {"config": {}}, 0)
+    job._bucket_done = True
+    job.bucket = job.bucket_place = "B"
+    assert r._place(job) == "wa"          # bucket inventory
+    assert job.routed_by == "bucket"
+    job.prior = "P"
+    assert r._place(job) == "wb"          # prior ABOVE bucket
+    assert job.routed_by == "prior"
+    # prior home full: falls back down the ladder, not head-of-line
+    _add_job(r, "r1", worker="wb")
+    _add_job(r, "r2", worker="wb")
+    assert r._place(job) == "wa"
+    assert job.routed_by == "bucket"
+    # counters: of placements that HAD a prior key, how many landed
+    # on the prior home (counted once per dispatch, not per retry)
+    r.jobs.clear()
+    qj = _add_job(r, "q1", state=jq.QUEUED)
+    qj.bucket = qj.bucket_place = "B"
+    qj.prior = "P"
+    nop = _add_job(r, "q2", state=jq.QUEUED)   # no prior: not counted
+    r._forward_submit = lambda rj, w: None     # stub the data plane
+    r._dispatch_pass()
+    assert qj.worker_id == "wb" and qj.routed_by == "prior"
+    assert nop.worker_id is not None and nop.prior is None
+    assert (r.prior_place_hits, r.prior_place_total) == (1, 1)
+    m = r.metrics()
+    assert m["prior_affinity"] == {"hits": 1, "total": 1,
+                                   "hit_rate": 1.0}
+
+
+def test_stream_jobs_get_dedicated_placement_token(tmp_path):
+    """ROADMAP item-1 remainder: a stream job shares the PROGRAM
+    bucket with the same-shape batch job (the transport only changes
+    who clocks the reader) but carries its OWN placement token, so
+    placement can prefer the worker already hosting this stream
+    family without losing the program-token fallback."""
+    from sagecal_tpu.serve import fleet
+    msdir, skyf, clusf = _make_dataset(tmp_path, "tok.ms")
+    cfg_b = config_from_dict(_base_config(skyf, clusf, ms=msdir))
+    cfg_s = config_from_dict(_base_config(
+        skyf, clusf, ms=msdir, stream_source="gen:0.1"))
+    jb = jq.Job("jb", cfg_b, kind="fullbatch")
+    js = jq.Job("js", cfg_s, kind="stream")
+    assert fleet.job_bucket(jb) is not None
+    assert fleet.job_bucket(js) == fleet.job_bucket(jb)
+    assert fleet.job_placement_bucket(jb) == fleet.job_bucket(jb)
+    assert fleet.job_placement_bucket(js) != fleet.job_bucket(js)
+    # the prior key is kind-independent: the same field warms both
+    assert fleet.job_prior_token(jb) is not None
+    assert fleet.job_prior_token(js) == fleet.job_prior_token(jb)
+    # the router's token probe agrees with the fleet accessors
+    b, bp, pr = rt._affinity_tokens(
+        {"config": dict(_base_config(skyf, clusf, ms=msdir,
+                                     stream_source="gen:0.1"))})
+    assert (b, bp, pr) == (fleet.job_bucket(js),
+                           fleet.job_placement_bucket(js),
+                           fleet.job_prior_token(js))
+
+
 def test_dispatch_pass_is_strict_head_of_line_priority_first():
     """Dispatch order is strict priority first (a queued STREAM job
     must admit before a preempted batch job resumes — ISSUE 16), then

@@ -38,10 +38,14 @@ landed. The queue:
 7. ``fleet``          — bench config 9-fleet-throughput (compute-
                         bound scaling); stamps FLEET_rNN.json via
                         SAGECAL_BANK_DIR.
-8. ``sentinel``       — sagecal_tpu.obs.sentinel --fast over the bank
+8. ``warm-start``     — bench config 12-warm-start (warm-vs-cold
+                        sweeps saving, prior/router hit rates, the
+                        off bit-identity gate); stamps WARM_rNN.json
+                        via SAGECAL_BANK_DIR.
+9. ``sentinel``       — sagecal_tpu.obs.sentinel --fast over the bank
                         dir: every record this run stamped is judged
-                        by its tolerance family (KMELT/MESH2D/FLEET)
-                        before the window closes.
+                        by its tolerance family (KMELT/MESH2D/FLEET/
+                        WARM) before the window closes.
 
 ``--dry-run`` rehearses the SAME queue on CPU at small shapes into a
 scratch bank dir (interpret-mode kernels, virtual devices), so the
@@ -137,6 +141,12 @@ def build_steps(args):
              timeout=600 if dry else 900,
              cmd=[PY, os.path.join(ROOT, "bench.py"),
                   "--config", "9-fleet-throughput"]),
+        dict(name="warm-start",
+             env={**env, "SAGECAL_BANK_DIR": bank,
+                  **({"SAGECAL_BENCH_CPU": "1"} if dry else {})},
+             timeout=900 if dry else 1200,
+             cmd=[PY, os.path.join(ROOT, "bench.py"),
+                  "--config", "12-warm-start"]),
         dict(name="sentinel", env=env, timeout=600,
              cmd=[PY, "-m", "sagecal_tpu.obs.sentinel", "--fast",
                   "--platform", plat, "--bank-dir", bank]

@@ -26,6 +26,30 @@ checks all five statically, before a TPU ever compiles the program:
 - ``cond-cost``         — ``lax.cond`` branches that inline heavy ops
   instead of calling a module-level priceable function.
 
+The fleet stack's threading discipline is checked by four more rules
+(threadlint, ISSUE 19 — see :mod:`sagecal_tpu.analysis.threadlint`
+and MIGRATION.md "Thread contracts"):
+
+- ``shared-state``      — mutable state written from more than one
+  inferred thread role without a named lock (roles from
+  ``threading.Thread`` spawn sites + the ``# thread-role:``
+  annotation grammar);
+- ``lock-order``        — cycles in the static ``with lock:``
+  acquisition-order graph, and non-reentrant self-nests;
+- ``handoff-ownership`` — producers touching objects already handed
+  to a queue/ring/writer consumer (ring stages flag reads too: the
+  consumer DONATES those buffers);
+- ``scope-discipline``  — thread-local telemetry scopes entered
+  outside ``with`` or leaked across a spawn.
+
+The runtime complement is :mod:`sagecal_tpu.analysis.threadsan`
+(``pytest --sanitize-threads``): instrumented locks that fail tests
+on observed acquisition-order inversions or unlocked access to
+registered structures, with ``faults.py``'s ``lock_acquire`` point
+supplying deterministic interleaving pressure. A ``# jaxlint:
+disable`` whose rule no longer fires on its line is itself a finding
+(stale-suppression audit).
+
 Usage::
 
     python -m sagecal_tpu.analysis                # report everything

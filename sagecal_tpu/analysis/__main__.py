@@ -34,7 +34,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m sagecal_tpu.analysis",
         description="jaxlint: tracer-safety / donation / retrace / "
-                    "host-sync / dtype / cond-cost static analysis")
+                    "host-sync / dtype / cond-cost static analysis, "
+                    "plus the threadlint concurrency contracts "
+                    "(shared-state / lock-order / handoff-ownership "
+                    "/ scope-discipline) and the stale-suppression "
+                    "audit")
     ap.add_argument("paths", nargs="*",
                     help="files or directories (default: the "
                          "sagecal_tpu package)")

@@ -48,7 +48,25 @@ RULES = {
         "module-level priceable function"),
     "suppression": (
         "malformed jaxlint suppression (missing reason or unknown "
-        "rule)"),
+        "rule), or a stale one whose rule no longer fires there"),
+    # -- threadlint (ISSUE 19): the concurrency contracts ---------------
+    "shared-state": (
+        "module-global or instance mutable state written from more "
+        "than one thread role without a named Lock/Queue/thread-local "
+        "guarding it"),
+    "lock-order": (
+        "inconsistent lock acquisition order (a cycle in the static "
+        "with-nesting graph) or nested reacquisition of a "
+        "non-reentrant lock — a deadlock window"),
+    "handoff-ownership": (
+        "object handed to an inter-thread queue/ring/writer and then "
+        "read or mutated by the producer (the host-object "
+        "generalization of use-after-donate)"),
+    "scope-discipline": (
+        "thread-scoped telemetry context (dtrace.scope / "
+        "obs.scope_labels / fleet.device_scope / fleet.job_scope) "
+        "entered outside a with statement or spanning a thread spawn "
+        "— scope stacks are strictly thread-local"),
 }
 
 # modules whose host loops are hot-path territory for host-sync, and
@@ -137,6 +155,43 @@ def parse_suppressions(lines):
             target = j + 1 if j < len(lines) else i
         supp.setdefault(target, []).append((frozenset(rules), reason, i))
     return supp, bad
+
+
+# ---------------------------------------------------------------------------
+# thread roles: ``# thread-role: <role>[, <role>]`` (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+_ROLE_RE = re.compile(r"#\s*thread-role:\s*([A-Za-z0-9_][A-Za-z0-9_, -]*)")
+
+
+def parse_thread_roles(lines):
+    """{applies-to-line (1-based): (role, ...)} — the threadlint role
+    annotation grammar. Attachment follows the suppression rule: a
+    trailing comment annotates its own line, a standalone comment
+    annotates the next code line. Placed on (or above) a ``def``, it
+    declares which thread role(s) execute that function's body,
+    overriding spawn-site inference — the escape hatch for roles the
+    static spawn graph cannot see (e.g. a method called from another
+    class's worker thread)."""
+    out: dict = {}
+    for i, raw in enumerate(lines, start=1):
+        m = _ROLE_RE.search(raw)
+        if not m:
+            continue
+        roles = tuple(r.strip() for r in m.group(1).split(",")
+                      if r.strip())
+        if not roles:
+            continue
+        target = i
+        if raw.lstrip().startswith("#"):
+            j = i
+            while j < len(lines) and (
+                    not lines[j].strip()
+                    or lines[j].lstrip().startswith("#")):
+                j += 1
+            target = j + 1 if j < len(lines) else i
+        out[target] = roles
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -295,6 +350,8 @@ class ModuleCtx:
                         self.module_names.add(t.id)
         self.jits = self._index_jits()
         self.traced = self._traced_bodies()
+        self.thread_roles = parse_thread_roles(self.lines)
+        self.lock_names, self.rlock_names = self._index_locks()
 
     # -- jit registry ------------------------------------------------------
 
@@ -409,6 +466,53 @@ class ModuleCtx:
                         changed = True
         return traced
 
+    # -- lock registry (threadlint) ----------------------------------------
+
+    _LOCK_CTORS = ("threading.Lock", "threading.RLock",
+                   "threading.Condition", "Lock", "RLock", "Condition",
+                   "threadsan.make_lock", "threadsan.make_rlock",
+                   "make_lock", "make_rlock")
+    _RLOCK_CTORS = ("threading.RLock", "RLock", "threadsan.make_rlock",
+                    "make_rlock")
+
+    def _index_locks(self):
+        """Names (attribute or binding) assigned a lock constructor
+        anywhere in the module: ``self._lock = threading.Lock()`` marks
+        ``_lock``. The shared-state guard test and the lock-order
+        acquisition graph both key on this set (plus the name
+        heuristic — any name containing 'lock')."""
+        locks: set = set()
+        rlocks: set = set()
+        for node in ast.walk(self.tree):
+            val = None
+            targets = ()
+            if isinstance(node, ast.Assign):
+                val, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value:
+                val, targets = node.value, (node.target,)
+            if not isinstance(val, ast.Call):
+                continue
+            fn = dotted(val.func)
+            if fn not in self._LOCK_CTORS:
+                continue
+            for t in targets:
+                name = t.id if isinstance(t, ast.Name) else (
+                    t.attr if isinstance(t, ast.Attribute) else None)
+                if name is None:
+                    continue
+                locks.add(name)
+                if fn in self._RLOCK_CTORS:
+                    rlocks.add(name)
+        return locks, rlocks
+
+    def is_lockish(self, name: str) -> bool:
+        """Heuristic lock identity for a bare attribute/binding name:
+        assigned a lock constructor in this module, or named like one
+        (``_lock``, ``clock``, ``mutex``)."""
+        low = name.lower()
+        return (name in self.lock_names or "lock" in low
+                or "mutex" in low)
+
     # -- per-checker conveniences ------------------------------------------
 
     def enclosing_functions(self, node):
@@ -487,9 +591,9 @@ class ModuleCtx:
 def _checkers():
     # late import: checkers import core for helpers
     from sagecal_tpu.analysis import (condcost, donate, dtype_rules,
-                                      hostsync, retrace)
+                                      hostsync, retrace, threadlint)
     return (donate.check, retrace.check, hostsync.check,
-            dtype_rules.check, condcost.check)
+            dtype_rules.check, condcost.check, threadlint.check)
 
 
 def _fingerprint(findings):
@@ -557,16 +661,34 @@ def run_paths(paths, root=None):
         for line, msg in bad:
             raw.append(Finding("suppression", ctx.relpath, line, 0, msg,
                                ctx.lines[line - 1].strip()))
+        matched: set = set()
         for f in raw:
             hit = None
-            for rules, reason, _cl in supp.get(f.line, ()):
+            for rules, reason, cl in supp.get(f.line, ()):
                 if f.rule in rules:
                     hit = reason
+                    matched.add(cl)
                     break
             if hit is not None and f.rule != "suppression":
                 suppressed.append((f, hit))
             else:
                 findings.append(f)
+        # stale-suppression audit (ISSUE 19): a well-formed directive
+        # whose rule no longer fires on its target line is DEAD — the
+        # violation it excused was fixed (or moved), and a lingering
+        # disable would silently swallow the next regression there.
+        # Directives with unknown rules already produced a finding
+        # above; only known-rule, reasoned directives are audited.
+        for target, entries in supp.items():
+            for rules, _reason, cl in entries:
+                if cl in matched or not rules <= set(RULES):
+                    continue
+                findings.append(Finding(
+                    "suppression", ctx.relpath, cl, 0,
+                    f"stale suppression: no {'/'.join(sorted(rules))} "
+                    f"finding fires on its target line ({target}) — "
+                    "remove the dead disable",
+                    ctx.lines[cl - 1].strip()))
     return _fingerprint(findings), suppressed, errors
 
 

@@ -1,0 +1,740 @@
+"""threadlint: concurrency contracts of the fleet stack (ISSUE 19).
+
+PRs 5-18 grew a threaded fleet around the solvers — Prefetcher /
+AsyncWriter / DonatedRing thread roles (sched.py), per-device _Worker
+owner loops and a work-stealing controller (serve/scheduler.py), router
+lease/heartbeat/dispatch threads (serve/router.py), the stream
+transports feeding the Prefetcher (stream/), the metrics registry
+running inside every instrumented loop (obs/metrics.py) and the priors
+LRU banking on the writer thread (serve/priors.py). The discipline that
+keeps those threads honest was unwritten; these four rules write it
+down and check it statically:
+
+- ``shared-state``       — instance/module mutable state written from
+  more than one *thread role* without a named lock (or sync primitive)
+  guarding the write. Roles are inferred from ``threading.Thread``
+  spawn sites (the ``name=`` kwarg, or the target's name) propagated
+  through the intra-class/module call graph, and can be declared
+  explicitly with the ``# thread-role: <role>`` annotation grammar
+  (:func:`core.parse_thread_roles`). ``__init__`` writes are
+  construction (happens-before the spawn) and exempt.
+- ``lock-order``         — the static acquisition-order graph: every
+  ``with <lock>:`` nested (lexically, or through a same-module call)
+  inside another ``with <lock>:`` adds an edge; a cycle is a deadlock
+  window, and a self-edge on a non-reentrant lock is a self-deadlock.
+- ``handoff-ownership``  — an object placed on an inter-thread queue
+  (``.put``/``.put_nowait``), a DonatedRing slot (``.stage``) or the
+  AsyncWriter (``.submit``) belongs to the consumer: the producer must
+  not mutate it afterwards (nor read it, for ring slots — the consumer
+  DONATES those, so this is PR 5's read-after-donate generalized to
+  host objects).
+- ``scope-discipline``   — ``dtrace.scope`` / ``obs.scope_labels`` /
+  ``fleet.device_scope`` / ``fleet.job_scope`` stacks are STRICTLY
+  thread-local (tests/test_diag.py pins it). A scope factory call must
+  be a ``with`` context expression (or returned from a factory for the
+  owning thread to enter); entering one around a thread spawn leaks
+  nothing into the new thread — the spawned thread must enter its own
+  scope via a ``context=`` factory (sched.Prefetcher/AsyncWriter), so
+  a bare spawn inside a scope body is a finding.
+
+The runtime complement is :mod:`sagecal_tpu.analysis.threadsan` — the
+``--sanitize-threads`` instrumented-lock registry that observes real
+acquisition orders and lock-held invariants under test.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sagecal_tpu.analysis.core import dotted
+
+_THREAD_CTORS = ("threading.Thread", "Thread")
+#: sched primitives whose constructor SPAWNS a thread — creating one
+#: inside a telemetry scope without a context= factory loses the
+#: scope's routing for everything the new thread emits
+_SPAWNING_CTORS = ("Prefetcher", "sched.Prefetcher",
+                   "AsyncWriter", "sched.AsyncWriter")
+_SCOPE_SUFFIXES = (".scope", ".scope_labels", ".device_scope",
+                   ".job_scope")
+#: method calls that mutate their receiver in place
+_MUTATORS = frozenset((
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "clear", "remove", "discard", "sort", "reverse",
+    "move_to_end", "appendleft", "popleft", "fill", "resize",
+))
+
+
+def check(ctx):
+    out = []
+    out.extend(_check_shared_state(ctx))
+    out.extend(_check_lock_order(ctx))
+    out.extend(_check_handoff(ctx))
+    out.extend(_check_scope(ctx))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# role inference
+# ---------------------------------------------------------------------------
+
+def _spawn_role(call, fallback):
+    """The role name of one ``threading.Thread(...)`` spawn: the
+    literal ``name=`` kwarg, the constant prefix of an f-string name
+    (``f"prefetch-{name}"`` -> ``prefetch``), else the target's own
+    name."""
+    for kw in call.keywords:
+        if kw.arg != "name":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return v.value
+        if isinstance(v, ast.JoinedStr):
+            for part in v.values:
+                if (isinstance(part, ast.Constant)
+                        and isinstance(part.value, str)
+                        and part.value.strip("-_ ")):
+                    return part.value.strip("-_ ")
+    return fallback
+
+
+def _spawn_sites(ctx):
+    """(class_spawns, func_spawns): ``{(class_name, method): role}``
+    for ``Thread(target=self.m)`` and ``{func_name: role}`` for
+    ``Thread(target=f)`` spawn sites."""
+    class_spawns: dict = {}
+    func_spawns: dict = {}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and dotted(node.func) in _THREAD_CTORS):
+            continue
+        target = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if target is None:
+            continue
+        d = dotted(target)
+        if d is None:
+            continue
+        if d.startswith("self.") and "." not in d[5:]:
+            cls = _enclosing_class(ctx, node)
+            if cls is not None:
+                class_spawns[(cls.name, d[5:])] = _spawn_role(node, d[5:])
+        elif "." not in d:
+            func_spawns[d] = _spawn_role(node, d)
+    return class_spawns, func_spawns
+
+
+def _enclosing_class(ctx, node):
+    cur = ctx.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = ctx.parents.get(cur)
+    return None
+
+
+def _def_roles(ctx, fn):
+    """Explicit ``# thread-role:`` annotation on a def (or the line
+    above it / its decorators), else None."""
+    for line in range(fn.lineno, getattr(fn.body[0], "lineno",
+                                         fn.lineno)):
+        if line in ctx.thread_roles:
+            return ctx.thread_roles[line]
+    return ctx.thread_roles.get(fn.lineno)
+
+
+def _method_roles(ctx, cls, methods, spawn_roles):
+    """{method_name: set(roles)} for one class.
+
+    Seeds: spawn targets get their spawn role, annotated defs their
+    declared roles (annotation wins over inference). Seed roles
+    propagate through the ``self.<m>()`` call graph into un-annotated
+    callees. Every externally callable entry point (a method no other
+    method calls, spawn targets excluded) additionally seeds the
+    implicit ``caller`` role, which propagates the same way but never
+    INTO a spawn target — its body runs only on its own thread."""
+    calls: dict = {name: set() for name in methods}
+    for name, fn in methods.items():
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call):
+                d = dotted(sub.func)
+                if (d and d.startswith("self.") and "." not in d[5:]
+                        and d[5:] in methods):
+                    calls[name].add(d[5:])
+    annotated = {}
+    for name, fn in methods.items():
+        ann = _def_roles(ctx, fn)
+        if ann:
+            annotated[name] = set(ann)
+    roles: dict = {name: set(annotated.get(name, ()))
+                   for name in methods}
+    for name, role in spawn_roles.items():
+        if name in roles and name not in annotated:
+            roles[name].add(role)
+    seeded = {n for n, r in roles.items() if r}
+    called = set()
+    for tgts in calls.values():
+        called |= tgts
+    for name in methods:
+        if (name not in called and name not in spawn_roles
+                and name not in annotated):
+            roles[name].add("caller")
+    changed = True
+    while changed:
+        changed = False
+        for name, tgts in calls.items():
+            for callee in tgts:
+                if callee in annotated or callee in spawn_roles:
+                    continue
+                # never propagate INTO a seeded spawn/annotation body,
+                # and never propagate the construction-time role out of
+                # __init__ (it runs happens-before every spawn)
+                if name == "__init__":
+                    continue
+                add = roles[name] - roles[callee]
+                if add:
+                    roles[callee] |= add
+                    changed = True
+    return roles
+
+
+# ---------------------------------------------------------------------------
+# shared-state
+# ---------------------------------------------------------------------------
+
+def _write_targets(stmt):
+    """Bare Name / self-attribute names written by an assignment
+    statement's target(s): ``self.X = `` / ``self.X[i] = `` /
+    ``self.X.y = `` all write X (the last two mutate the object X
+    holds)."""
+    targets = (stmt.targets if isinstance(stmt, ast.Assign)
+               else [stmt.target])
+    out = []
+    stack = list(targets)
+    while stack:
+        t = stack.pop()
+        if isinstance(t, (ast.Tuple, ast.List)):
+            stack.extend(t.elts)
+        elif isinstance(t, ast.Starred):
+            stack.append(t.value)
+        elif isinstance(t, (ast.Subscript, ast.Attribute)):
+            base = t.value
+            d = dotted(base)
+            if d and d.startswith("self.") and "." not in d[5:]:
+                out.append(("self", d[5:], t))
+            elif isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name) and t.value.id == "self":
+                out.append(("self", t.attr, t))
+    return out
+
+
+def _guarded(ctx, node, stop_at=None):
+    """True when ``node`` sits (lexically) inside a ``with <lock>:``
+    whose context expression is lock-like."""
+    cur = node
+    while cur is not None and cur is not stop_at:
+        cur = ctx.parents.get(cur)
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                expr = item.context_expr
+                d = dotted(expr)
+                if d is None and isinstance(expr, ast.Call):
+                    d = dotted(expr.func)
+                if d and ctx.is_lockish(d.split(".")[-1]):
+                    return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            return False
+    return False
+
+
+def _method_attr_writes(fn):
+    """(attr, node) pairs for every instance-state write in ``fn``:
+    plain/aug assignment to ``self.X`` (or through it) and mutating
+    method calls ``self.X.append(...)``."""
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.Assign, ast.AugAssign)):
+            for _kind, attr, tgt in _write_targets(sub):
+                yield attr, sub
+        elif isinstance(sub, ast.Call):
+            d = dotted(sub.func)
+            if (d and d.startswith("self.") and d.count(".") == 2
+                    and d.split(".")[-1] in _MUTATORS):
+                yield d.split(".")[1], sub
+
+
+def _check_shared_state(ctx):
+    out = []
+    class_spawns, func_spawns = _spawn_sites(ctx)
+    # -- instance state, per class --------------------------------------
+    for cls in [n for n in ast.walk(ctx.tree)
+                if isinstance(n, ast.ClassDef)]:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        spawn_roles = {m: r for (c, m), r in class_spawns.items()
+                       if c == cls.name}
+        roles = _method_roles(ctx, cls, methods, spawn_roles)
+        # attr -> {role -> first write node}, plus unguarded writes
+        by_attr: dict = {}
+        unguarded: dict = {}
+        for name, fn in methods.items():
+            if name in ("__init__", "__new__", "__post_init__"):
+                continue
+            for attr, node in _method_attr_writes(fn):
+                rec = by_attr.setdefault(attr, set())
+                rec.update(roles[name] or {"caller"})
+                if not _guarded(ctx, node, stop_at=fn):
+                    unguarded.setdefault(attr, (node, name))
+        for attr, role_set in sorted(by_attr.items()):
+            if len(role_set) < 2 or attr not in unguarded:
+                continue
+            if ctx.is_lockish(attr):
+                continue          # rebinding a lock attr is its own sin
+            node, mname = unguarded[attr]
+            out.append(ctx.finding(
+                "shared-state", node,
+                f"{cls.name}.{attr} is written from thread roles "
+                f"{'/'.join(sorted(role_set))} (here in {mname}) "
+                "without a lock guarding the write — guard it, make "
+                "one role the sole writer, or annotate the true role "
+                "with '# thread-role:'"))
+    # -- module globals --------------------------------------------------
+    mod_roles: dict = {}
+    for name, fn in ctx.module_defs.items():
+        ann = _def_roles(ctx, fn)
+        if ann:
+            mod_roles[name] = set(ann)
+        elif name in func_spawns:
+            mod_roles[name] = {func_spawns[name]}
+        else:
+            mod_roles[name] = {"caller"}
+    g_writes: dict = {}
+    for name, fn in ctx.module_defs.items():
+        gnames = {n for sub in ast.walk(fn)
+                  if isinstance(sub, ast.Global) for n in sub.names}
+        if not gnames:
+            continue
+        for sub in ast.walk(fn):
+            if not isinstance(sub, (ast.Assign, ast.AugAssign)):
+                continue
+            tgts = (sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target])
+            for t in tgts:
+                if isinstance(t, ast.Name) and t.id in gnames:
+                    rec = g_writes.setdefault(t.id, (set(), []))
+                    rec[0].update(mod_roles[name])
+                    if not _guarded(ctx, sub, stop_at=fn):
+                        rec[1].append(sub)
+    for gname, (role_set, nodes) in sorted(g_writes.items()):
+        if len(role_set) < 2 or not nodes or ctx.is_lockish(gname):
+            continue
+        out.append(ctx.finding(
+            "shared-state", nodes[0],
+            f"module global {gname} is written from thread roles "
+            f"{'/'.join(sorted(role_set))} without a lock guarding "
+            "the write"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+def _lock_key(ctx, expr, node):
+    """Normalized identity of a lock-like with-context expression, or
+    None. ``self.X`` resolves through the enclosing class
+    (``Router._lock``); other dotted forms keep their tail attribute
+    (``w.clock`` -> ``clock`` — attribute identity is module-wide)."""
+    d = dotted(expr)
+    if d is None and isinstance(expr, ast.Call):
+        d = dotted(expr.func)
+    if d is None:
+        return None
+    tail = d.split(".")[-1]
+    if not ctx.is_lockish(tail):
+        return None
+    if d.startswith("self.") and "." not in d[5:]:
+        cls = _enclosing_class(ctx, node)
+        return f"{cls.name}.{tail}" if cls is not None else tail
+    if "." not in d:
+        return d
+    return tail
+
+
+def _with_locks(ctx, node):
+    """Lock keys acquired by one With statement, in item order."""
+    return [k for k in (_lock_key(ctx, item.context_expr, node)
+                        for item in node.items) if k is not None]
+
+
+def _direct_acquires(ctx, fn):
+    """Lock keys a function acquires at its own (non-nested-def) level,
+    paired with the acquiring With nodes."""
+    out = []
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.With):
+            continue
+        fns = ctx.enclosing_functions(sub)
+        if not fns or fns[0] is not fn:
+            continue
+        for key in _with_locks(ctx, sub):
+            out.append((key, sub))
+    return out
+
+
+def _check_lock_order(ctx):
+    # acquisition closure per function: which locks can a call into it
+    # end up holding (direct withs + same-module callees', to fixpoint)
+    defs: dict = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls = _enclosing_class(ctx, node)
+            defs[(cls.name if cls else None, node.name)] = node
+    acquires = {k: {key for key, _n in _direct_acquires(ctx, fn)}
+                for k, fn in defs.items()}
+    callees: dict = {}
+    for k, fn in defs.items():
+        tgts = set()
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = dotted(sub.func)
+            if d is None:
+                continue
+            if d.startswith("self.") and "." not in d[5:]:
+                key = (k[0], d[5:])
+                if key in defs:
+                    tgts.add(key)
+            elif "." not in d and (None, d) in defs:
+                tgts.add((None, d))
+        callees[k] = tgts
+    changed = True
+    while changed:
+        changed = False
+        for k, tgts in callees.items():
+            for t in tgts:
+                add = acquires[t] - acquires[k]
+                if add:
+                    acquires[k] |= add
+                    changed = True
+
+    edges: dict = {}            # (a, b) -> reporting node
+
+    def _record(a, b, node):
+        edges.setdefault((a, b), node)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        inner = _with_locks(ctx, node)
+        if not inner:
+            continue
+        # multi-item withs acquire left-to-right
+        for i, a in enumerate(inner):
+            for b in inner[i + 1:]:
+                _record(a, b, node)
+        # held locks from enclosing withs in the same function
+        held = []
+        fns = ctx.enclosing_functions(node)
+        stop = fns[0] if fns else None
+        cur = ctx.parents.get(node)
+        while cur is not None and cur is not stop:
+            if isinstance(cur, ast.With):
+                held.extend(_with_locks(ctx, cur))
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+            cur = ctx.parents.get(cur)
+        for a in held:
+            for b in inner:
+                _record(a, b, node)
+        # call-through: a call made while holding `inner` reaches a
+        # function whose closure acquires more locks
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = dotted(sub.func)
+            if d is None:
+                continue
+            key = None
+            if d.startswith("self.") and "." not in d[5:]:
+                cls = _enclosing_class(ctx, node)
+                key = (cls.name if cls else None, d[5:])
+            elif "." not in d:
+                key = (None, d)
+            if key is None or key not in acquires:
+                continue
+            for a in inner:
+                for b in acquires[key]:
+                    _record(a, b, sub)
+
+    out = []
+    graph: dict = {}
+    for (a, b), _n in edges.items():
+        graph.setdefault(a, set()).add(b)
+
+    def _reaches(src, dst):
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(graph.get(n, ()))
+        return False
+
+    reported = set()
+    for (a, b), node in sorted(edges.items(),
+                               key=lambda e: (e[1].lineno,
+                                              e[0])):
+        if a == b:
+            if a.split(".")[-1] in ctx.rlock_names:
+                continue
+            out.append(ctx.finding(
+                "lock-order", node,
+                f"nested reacquisition of non-reentrant lock {a} — "
+                "self-deadlock unless it is an RLock"))
+            continue
+        if frozenset((a, b)) in reported:
+            continue
+        if _reaches(b, a):
+            reported.add(frozenset((a, b)))
+            out.append(ctx.finding(
+                "lock-order", node,
+                f"lock acquisition order cycle: {a} -> {b} here, but "
+                f"{b} -> ... -> {a} elsewhere in this module — pick "
+                "one global order"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# handoff-ownership
+# ---------------------------------------------------------------------------
+
+def _linear(body):
+    """Depth-first linearization of a statement list (parents before
+    their bodies — approximately lexical order)."""
+    for st in body:
+        yield st
+        for fld in ("body", "orelse", "finalbody"):
+            sub = getattr(st, fld, None)
+            if sub and not isinstance(st, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                yield from _linear(sub)
+        for h in getattr(st, "handlers", []) or []:
+            yield from _linear(h.body)
+
+
+def _handoffs_in(stmt):
+    """(names, reads_flagged, call) for each handoff call in ``stmt``:
+    queue ``.put``/``.put_nowait`` (arg 0), ring ``.stage`` (arg 1,
+    reads flagged — the consumer donates it), writer ``.submit``
+    (args after the job fn)."""
+    for sub in ast.walk(stmt):
+        if not (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)):
+            continue
+        attr = sub.func.attr
+        recv = dotted(sub.func.value) or ""
+        handed, reads = (), False
+        if attr in ("put", "put_nowait") and sub.args:
+            handed = (sub.args[0],)
+        elif attr == "stage" and "ring" in recv.lower() \
+                and len(sub.args) >= 2:
+            handed, reads = (sub.args[1],), True
+        elif attr == "submit" and "writ" in recv.lower() \
+                and len(sub.args) >= 2:
+            handed = tuple(sub.args[1:])
+        if not handed:
+            continue
+        names = set()
+        for h in handed:
+            if isinstance(h, ast.Name):
+                names.add(h.id)
+            elif isinstance(h, (ast.Tuple, ast.List)):
+                names.update(e.id for e in h.elts
+                             if isinstance(e, ast.Name))
+        if names:
+            yield names, reads, sub
+
+
+def _rebinds(stmt, name):
+    """Does ``stmt`` rebind ``name`` (assignment target / for target /
+    with-as)? AugAssign counts as a rebind for mutation tracking (the
+    old object is replaced, not mutated through the handle)."""
+    if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        tgts = (stmt.targets if isinstance(stmt, ast.Assign)
+                else [stmt.target])
+        for t in tgts:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name) and n.id == name \
+                        and isinstance(n.ctx, ast.Store):
+                    return True
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        for n in ast.walk(stmt.target):
+            if isinstance(n, ast.Name) and n.id == name:
+                return True
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                for n in ast.walk(item.optional_vars):
+                    if isinstance(n, ast.Name) and n.id == name:
+                        return True
+    return False
+
+
+def _violating_use(stmt, name, reads_flagged, skip_call):
+    """A node in ``stmt`` that mutates ``name``'s object (attr/index
+    store through it, mutating method call on it) — or, when
+    ``reads_flagged``, any load of it at all. ``skip_call`` is the
+    handoff call itself."""
+    for sub in ast.walk(stmt):
+        if sub is skip_call or isinstance(sub, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef)):
+            continue
+        if isinstance(sub, (ast.Assign, ast.AugAssign)):
+            tgts = (sub.targets if isinstance(sub, ast.Assign)
+                    else [sub.target])
+            for t in tgts:
+                if isinstance(t, (ast.Attribute, ast.Subscript)) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == name:
+                    return t
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and isinstance(sub.func.value, ast.Name) \
+                and sub.func.value.id == name \
+                and sub.func.attr in _MUTATORS:
+            return sub
+        if reads_flagged and isinstance(sub, ast.Name) \
+                and sub.id == name and isinstance(sub.ctx, ast.Load):
+            # the handoff call's own argument list was skipped above
+            if not _inside(sub, skip_call):
+                return sub
+    return None
+
+
+def _inside(node, ancestor):
+    for sub in ast.walk(ancestor):
+        if sub is node:
+            return True
+    return False
+
+
+def _check_handoff(ctx):
+    out = []
+    for fn in [n for n in ast.walk(ctx.tree)
+               if isinstance(n, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef))]:
+        stmts = list(_linear(fn.body))
+        order = {id(s): i for i, s in enumerate(stmts)}
+        seen_calls: set = set()
+        for names, reads, call in (
+                h for s in stmts for h in _handoffs_in(s)):
+            if id(call) in seen_calls:
+                continue          # compound stmts linearize twice
+            seen_calls.add(id(call))
+            h_stmt = call
+            while ctx.parents.get(h_stmt) is not None and not (
+                    isinstance(h_stmt, ast.stmt)):
+                h_stmt = ctx.parents[h_stmt]
+            hix = order.get(id(h_stmt))
+            if hix is None:
+                continue
+            loop = ctx.enclosing_loop(call, stop_at=fn)
+            for name in sorted(names):
+                seq = stmts[hix + 1:]
+                if loop is not None:
+                    # loop-carried: after the handoff, the next
+                    # iteration re-enters the loop body from the top
+                    body = list(_linear(loop.body))
+                    upto = [s for s in body
+                            if order.get(id(s), -1) <= hix]
+                    seq = seq + upto
+                for st in seq:
+                    if st is h_stmt:
+                        continue
+                    if _rebinds(st, name):
+                        break
+                    bad = _violating_use(st, name, reads, call)
+                    if bad is not None:
+                        verb = ("read or mutated" if reads
+                                else "mutated")
+                        out.append(ctx.finding(
+                            "handoff-ownership", bad,
+                            f"'{name}' was handed to the consumer at "
+                            f"line {call.lineno} "
+                            f"({dotted(call.func)}) and is {verb} by "
+                            "the producer here — the consumer owns it "
+                            "now; copy before handoff or stop "
+                            "touching it"))
+                        break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scope-discipline
+# ---------------------------------------------------------------------------
+
+def _scope_call_name(node):
+    if not isinstance(node, ast.Call):
+        return None
+    d = dotted(node.func)
+    if d is None or "." not in d:
+        return None
+    return d if d.endswith(_SCOPE_SUFFIXES) else None
+
+
+def _check_scope(ctx):
+    out = []
+    for node in ast.walk(ctx.tree):
+        name = _scope_call_name(node)
+        if name is None:
+            continue
+        parent = ctx.parents.get(node)
+        if isinstance(parent, ast.withitem):
+            continue
+        if isinstance(parent, (ast.Return, ast.Yield, ast.Lambda)):
+            continue          # factory: the owning thread enters it
+            #                   (lambda: dtrace.scope(t) is the
+            #                   context= idiom sched's threads use)
+        out.append(ctx.finding(
+            "scope-discipline", node,
+            f"{name}(...) used outside a with statement — scope "
+            "stacks are strictly thread-local, so a scope object that "
+            "escapes its creating thread (stored, passed along, "
+            "entered manually) routes nothing; enter it with "
+            "'with' on the owning thread or return it from a factory"))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):
+            continue
+        if not any(_scope_call_name(item.context_expr)
+                   for item in node.items):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            d = dotted(sub.func)
+            if d in _THREAD_CTORS:
+                out.append(ctx.finding(
+                    "scope-discipline", sub,
+                    "thread spawned inside a thread-scoped telemetry "
+                    "context — the scope does NOT extend to the new "
+                    "thread (stacks are thread-local); hand the "
+                    "thread its own scope factory "
+                    "(context=/trace_ctx=, see "
+                    "serve.scheduler.job_telemetry_ctx)"))
+            elif d in _SPAWNING_CTORS and not any(
+                    kw.arg in ("context", "trace_ctx")
+                    for kw in sub.keywords):
+                out.append(ctx.finding(
+                    "scope-discipline", sub,
+                    f"{d}(...) spawns a worker thread inside a "
+                    "thread-scoped telemetry context without a "
+                    "context= factory — the worker's emits will not "
+                    "route to this scope"))
+    return out

@@ -1,0 +1,242 @@
+"""threadsan: the runtime half of the ISSUE 19 concurrency contracts.
+
+:mod:`threadlint` checks the lock-order and shared-state contracts
+statically; this module checks them on the *observed* executions. It
+is opt-in (``pytest --sanitize-threads``, mirroring the PR 4
+``--sanitize`` lane) and carries the same no-op-when-disabled
+guarantee as dtrace/obs/faults: with the sanitizer off,
+
+- :func:`make_lock` / :func:`make_rlock` return plain
+  ``threading.Lock()`` / ``threading.RLock()`` objects — production
+  code pays nothing, not even a wrapper attribute hop;
+- :func:`guard` is one module-attribute load and an ``is None`` test.
+
+``tests/test_threadsan.py::test_off_is_identical`` pins both (bit- and
+compile-count-identity of a solve with the module imported but
+disabled).
+
+Enabled, :func:`make_lock` returns a :class:`SanLock`: a wrapper that
+keeps a per-thread stack of held instrumented locks and a process-wide
+acquisition-order edge set ``{(outer, inner)}``. Acquiring ``B`` while
+holding ``A`` records the edge ``A -> B``; if ``B -> A`` was ever
+observed (on ANY thread, at any earlier time — orders are a global
+contract, so a single-threaded test still catches an inversion), the
+acquire raises :class:`ThreadSanError`. This is the classic potential-
+deadlock detector: it does not need the unlucky interleaving to fire,
+only both orders to ever execute.
+
+:func:`guard` is the shared-structure contract: production code that
+mutates a registered structure calls ``threadsan.guard(self._lock,
+"PriorStore._d")`` first; under the sanitizer this raises unless the
+calling thread actually holds that lock. Off, it is a no-op.
+
+Deterministic interleaving pressure comes from faults.py: when a fault
+plan arms the ``lock_acquire`` point, every instrumented acquire draws
+from the plan's counted/seeded schedule and injects a short sleep on a
+hit — enough to shake loose latent orderings without nondeterministic
+fuzzing. faults is imported lazily (faults -> obs -> ... must not
+import us back at module load).
+
+Stdlib-only, like everything in ``analysis/`` — importing this from
+production modules adds no dependency edge beyond ``threading``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+__all__ = [
+    "ThreadSanError", "SanLock", "active", "enable", "disable",
+    "guard", "make_lock", "make_rlock", "violations",
+]
+
+
+class ThreadSanError(AssertionError):
+    """An observed violation of a concurrency contract.
+
+    Subclasses AssertionError so an armed sanitizer fails tests the
+    same way a failed assert does, even without the conftest fixture.
+    """
+
+
+class _Sanitizer:
+    """Process-wide acquisition-order book-keeping (one per enable)."""
+
+    def __init__(self, pressure: bool = False):
+        self.pressure = pressure
+        self._mu = threading.Lock()      # guards edges/violations
+        #: (outer_name, inner_name) -> "thread/site" of first sighting
+        self.edges: dict = {}
+        self.violations: list = []
+        self._tls = threading.local()
+
+    # -- per-thread held stack ------------------------------------------
+    def held(self):
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    # -- the order contract ---------------------------------------------
+    def note_acquire(self, lock: "SanLock"):
+        stack = self.held()
+        tname = threading.current_thread().name
+        with self._mu:
+            for outer in stack:
+                if outer is lock:        # reentrant re-acquire: no edge
+                    continue
+                fwd = (outer.name, lock.name)
+                rev = (lock.name, outer.name)
+                if rev in self.edges and fwd not in self.edges:
+                    msg = (f"lock order inversion: {tname} acquires "
+                           f"{lock.name} while holding {outer.name}, "
+                           f"but the opposite order was observed at "
+                           f"{self.edges[rev]}")
+                    self.violations.append(msg)
+                    raise ThreadSanError(msg)
+                self.edges.setdefault(fwd, tname)
+        stack.append(lock)
+
+    def note_release(self, lock: "SanLock"):
+        stack = self.held()
+        # release order need not be LIFO (it nearly always is); remove
+        # the most recent entry for this lock
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is lock:
+                del stack[i]
+                return
+
+    def check_held(self, lock: "SanLock", what: str):
+        if lock not in self.held():
+            tname = threading.current_thread().name
+            msg = (f"unlocked access: {tname} touched {what} without "
+                   f"holding {lock.name}")
+            with self._mu:
+                self.violations.append(msg)
+            raise ThreadSanError(msg)
+
+    # -- deterministic pressure -----------------------------------------
+    def maybe_stall(self, lock: "SanLock"):
+        if not self.pressure:
+            return
+        from sagecal_tpu import faults     # lazy: faults imports obs
+        kind = faults.draw("lock_acquire", key=lock.name)
+        if kind is None:
+            return
+        import time
+        # widen the race window deterministically: the plan's counted
+        # schedule decides WHICH acquires stall, not the wall clock
+        time.sleep(0.002 if kind == "fatal" else 0.0005)
+
+
+_SAN: _Sanitizer | None = None           # None = disabled (the fast path)
+
+
+def active() -> bool:
+    return _SAN is not None
+
+
+def enable(pressure: bool = False) -> None:
+    """Arm the sanitizer. Locks made by :func:`make_lock` AFTER this
+    call are instrumented; locks made before stay plain (re-create the
+    structures under test, as the conftest lane does by arming before
+    collection)."""
+    global _SAN
+    _SAN = _Sanitizer(pressure=pressure)
+
+
+def disable() -> None:
+    global _SAN
+    _SAN = None
+
+
+def violations(clear: bool = False) -> list:
+    """Messages for every contract violation observed so far (raises
+    already surfaced them; this is for the per-test conftest sweep,
+    which also catches violations swallowed by broad except blocks)."""
+    san = _SAN
+    if san is None:
+        return []
+    with san._mu:
+        out = list(san.violations)
+        if clear:
+            san.violations.clear()
+    return out
+
+
+class SanLock:
+    """An instrumented ``threading.Lock``/``RLock`` stand-in.
+
+    Context-manager and acquire/release compatible with the real
+    thing; every acquisition is checked against the process-wide
+    order book and recorded on the per-thread held stack.
+    """
+
+    __slots__ = ("name", "_inner", "reentrant")
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = name
+        self.reentrant = reentrant
+        self._inner = (threading.RLock() if reentrant
+                       else threading.Lock())
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        san = _SAN
+        if san is not None:
+            san.maybe_stall(self)
+            san.note_acquire(self)       # raises on inversion
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok and san is not None:
+            san.note_release(self)       # failed try-acquire: unwind
+        return ok
+
+    def release(self):
+        self._inner.release()
+        san = _SAN
+        if san is not None:
+            san.note_release(self)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        inner = self._inner
+        return inner.locked() if hasattr(inner, "locked") else False
+
+    def __repr__(self):                  # pragma: no cover - debugging
+        return f"<SanLock {self.name!r} reentrant={self.reentrant}>"
+
+
+def make_lock(name: str):
+    """A mutex for production structures: plain ``threading.Lock()``
+    when the sanitizer is off (zero overhead), :class:`SanLock` when
+    armed. ``name`` is the lock's identity in the order book — use the
+    ``Class.attr`` form threadlint reports so the two tools agree."""
+    if _SAN is None:
+        return threading.Lock()
+    return SanLock(name, reentrant=False)
+
+
+def make_rlock(name: str):
+    """Reentrant variant of :func:`make_lock` — re-acquisition by the
+    holder records no order edge and is never an inversion."""
+    if _SAN is None:
+        return threading.RLock()
+    return SanLock(name, reentrant=True)
+
+
+def guard(lock, what: str) -> None:
+    """Assert (under the sanitizer only) that the calling thread holds
+    ``lock`` before touching the structure named ``what``. With the
+    sanitizer off — or when ``lock`` is a plain stdlib lock from a
+    disabled-time :func:`make_lock` — this is a no-op."""
+    san = _SAN
+    if san is None:
+        return
+    if isinstance(lock, SanLock):
+        san.check_held(lock, what)

@@ -65,6 +65,8 @@ import json
 import threading
 import time
 
+from sagecal_tpu.analysis import threadsan
+
 # record fields guaranteed on every line (the schema tests key on this)
 REQUIRED_FIELDS = ("t", "ev")
 
@@ -131,7 +133,7 @@ class Tracer:
         # prefetch and writer threads concurrently with the main loop;
         # TextIOWrapper.write is not thread-safe, so one lock keeps
         # every JSONL line atomic
-        self._lock = threading.Lock()
+        self._lock = threadsan.make_lock("Tracer._lock")
         self._t0 = time.time()
         self.emit("run_start", **run_meta)
 

@@ -106,6 +106,15 @@ POINTS = (
     #                     continues — a live stream must survive loss
     #                     without stalling (gated in tests/
     #                     test_stream.py)
+    "lock_acquire",     # analysis/threadsan: deterministic
+    #                     interleaving pressure — an armed sanitizer
+    #                     draws here on every instrumented lock
+    #                     acquire (key = lock name) and stalls briefly
+    #                     on a hit, widening race windows on the
+    #                     plan's counted schedule instead of relying
+    #                     on the OS scheduler to be unlucky. Queried
+    #                     via draw(); only meaningful under
+    #                     --sanitize-threads
 )
 
 _KINDS = ("transient", "fatal")

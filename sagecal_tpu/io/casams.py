@@ -43,6 +43,7 @@ import os
 
 import numpy as np
 
+from sagecal_tpu.analysis import threadsan
 from sagecal_tpu.io.dataset import (VisTile, generate_baselines,
                                     _tiles_prefetch_impl, C_M_S)
 
@@ -93,7 +94,6 @@ class CasaMS:
                  data_column: str = "DATA",
                  out_column: str = "CORRECTED_DATA",
                  tables_mod=None):
-        import threading
         self._ct = tables_mod or _tables()
         self.path = path
         # overlapped execution (sagecal_tpu.sched) reads tile t+N on
@@ -101,7 +101,7 @@ class CasaMS:
         # python-casacore table objects are NOT thread-safe, so all
         # column access on this MS serializes through one lock
         # (SimMS needs none: per-tile npz files, distinct paths)
-        self._io_lock = threading.Lock()
+        self._io_lock = threadsan.make_lock("CasaMS._io_lock")
         self._t = self._ct.table(path, readonly=False, ack=False)
         self._ts = self._t.sort("TIME,ANTENNA1,ANTENNA2")
         self.data_column = data_column

@@ -40,6 +40,8 @@ from __future__ import annotations
 
 import threading
 
+from sagecal_tpu.analysis import threadsan
+
 #: default histogram ladder (seconds): latency-shaped, 1 ms .. 600 s.
 #: Kept coarse on purpose — SLO readout needs p50/p90/p99 stability,
 #: not microsecond resolution, and every bucket is one counter per
@@ -261,7 +263,9 @@ class Registry:
 
     def __init__(self):
         self._metrics: dict = {}
-        self._lock = threading.RLock()
+        # reentrant: declaration helpers re-enter through the
+        # declare-then-update convenience paths
+        self._lock = threadsan.make_rlock("Registry._lock")
 
     # -- declaration --------------------------------------------------------
 

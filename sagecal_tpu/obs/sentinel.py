@@ -716,6 +716,87 @@ def probe_kernel() -> list:
     return []
 
 
+def _aliased_params(compiled) -> set:
+    """Parameter indices the compiled executable's
+    ``input_output_alias`` attribute names as donated-and-aliased.
+    Parsed from the HLO text — the one representation every backend
+    emits — by balanced-brace scan of the attribute payload (entries
+    look like ``{ {}: (1, {}, may-alias) }``: output-index tree,
+    then (param, param-index-tree, kind))."""
+    txt = compiled.as_text()
+    out: set = set()
+    key = "input_output_alias={"
+    start = txt.find(key)
+    if start < 0:
+        return out
+    i = start + len(key) - 1
+    depth, j = 0, i
+    while j < len(txt):                 # balanced-brace payload scan
+        if txt[j] == "{":
+            depth += 1
+        elif txt[j] == "}":
+            depth -= 1
+            if depth == 0:
+                break
+        j += 1
+    for m in re.finditer(r"\(\s*(\d+)\s*,", txt[i:j + 1]):
+        out.add(int(m.group(1)))
+    return out
+
+
+def probe_donation() -> list:
+    """Donation ground truth (ISSUE 19): the jaxlint use-after-donate
+    checker and the DonatedRing both PROMISE ``donate_argnums``
+    aliases the donated input into the output — the promise the whole
+    staged-buffer memory budget rests on — but only the lowered
+    program knows whether XLA honored it. Compile the residual-shaped
+    hot program twin-wise (donated / undonated) and read the
+    executable's ``input_output_alias`` table: the donated twin must
+    alias the visibility parameter, the undonated twin must not (which
+    also proves the parse is not vacuously empty)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    B = 64
+    # residual-shaped: params (index 0) consulted, visibilities
+    # (index 1, donated in pipeline.py's _residuals jit) rewritten
+    # in place — same shape/dtype out as the donated input
+    J = jnp.asarray(rng.normal(size=(B, 2, 2))
+                    + 1j * rng.normal(size=(B, 2, 2)), jnp.complex64)
+    V = jnp.asarray(rng.normal(size=(B, 2, 2))
+                    + 1j * rng.normal(size=(B, 2, 2)), jnp.complex64)
+
+    def residuals(J, V):
+        return V - J @ V @ jnp.conj(jnp.swapaxes(J, -1, -2))
+
+    # jaxlint: disable=retrace -- one-shot probe: compiling IS the probe
+    donated = jax.jit(residuals, donate_argnums=(1,)).lower(J, V).compile()
+    # jaxlint: disable=retrace -- one-shot probe: compiling IS the probe
+    plain = jax.jit(residuals).lower(J, V).compile()
+    aliased = _aliased_params(donated)
+    viol = []
+    if 1 not in aliased:
+        viol.append({"config": "probe", "metric": "donation",
+                     "field": "input_output_alias", "live": 0.0,
+                     "banked": 1.0, "limit": 1.0, "source": "probe",
+                     "msg": ("probe/donation: donate_argnums=(1,) on "
+                             "the residual-shaped program did NOT "
+                             "alias parameter 1 in the compiled "
+                             "executable — donation is a no-op on "
+                             "this backend/version and the staged-"
+                             "buffer memory budget is double-counted")})
+    if _aliased_params(plain):
+        viol.append({"config": "probe", "metric": "donation",
+                     "field": "input_output_alias", "live": 1.0,
+                     "banked": 0.0, "limit": 0.0, "source": "probe",
+                     "msg": ("probe/donation: the UNDONATED twin "
+                             "reports aliased parameters — the alias "
+                             "parse is broken (vacuous probe)")})
+    return viol
+
+
 # ---------------------------------------------------------------------------
 # full mode: re-run the fast bench configs and compare to the bank
 # ---------------------------------------------------------------------------
@@ -843,6 +924,7 @@ def main(argv=None) -> int:
         viol.extend(probe_cache())
         viol.extend(probe_faults())
         viol.extend(probe_kernel())
+        viol.extend(probe_donation())
     if args.json:
         print(json.dumps(viol, indent=1))
     for v in viol:

@@ -52,6 +52,7 @@ import threading
 import time
 
 from sagecal_tpu import faults
+from sagecal_tpu.analysis import threadsan
 from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.obs import metrics as obs
 
@@ -379,6 +380,12 @@ class AsyncWriter:
         # zero-arg context-manager factory entered for the writer
         # thread's lifetime (serve: per-job diag scope, as Prefetcher)
         self._ctx = context
+        # _exc has TWO writers — the writer thread (job failure) and
+        # the closing caller (flush timeout) — and first-failure-wins
+        # semantics; the lock makes that race a rule instead of luck
+        # (threadlint shared-state; instrumented under
+        # --sanitize-threads)
+        self._exc_lock = threadsan.make_lock("AsyncWriter._exc")
         self._exc = None
         self._raised = False
         self._q: queue.Queue = queue.Queue(maxsize=max(maxsize, 1))
@@ -400,7 +407,9 @@ class AsyncWriter:
             try:
                 if job is self._STOP:
                     return
-                if self._exc is None:   # fail-stop: drain, don't run
+                with self._exc_lock:
+                    failed = self._exc is not None
+                if not failed:          # fail-stop: drain, don't run
                     fn, args, kwargs = job
                     # writer_thread: the thread-death injection point;
                     # then bounded transient retry — submitted jobs are
@@ -411,7 +420,9 @@ class AsyncWriter:
                     faults.retry_transient(fn, args, kwargs,
                                            what="write")
             except BaseException as e:
-                self._exc = e
+                with self._exc_lock:
+                    if self._exc is None:   # first failure wins
+                        self._exc = e
             finally:
                 self._q.task_done()
 
@@ -419,9 +430,11 @@ class AsyncWriter:
         """Re-raise a pending writer failure (original traceback).
         Raises once: after it fired, the run is already unwinding and
         the cleanup-path re-check must not mask the original."""
-        if self._exc is not None and not self._raised:
+        with self._exc_lock:
+            exc = self._exc
+        if exc is not None and not self._raised:
             self._raised = True
-            raise self._exc
+            raise exc
 
     def submit(self, fn, *args, **kwargs) -> float:
         self.check()
@@ -474,16 +487,20 @@ class AsyncWriter:
             if not flushed or self._thread.is_alive():
                 _warn_join_timeout("writer", "async-writer",
                                    self.join_timeout_s)
-                if self._exc is None:
-                    # an abandoned flush means submitted writes may
-                    # never have landed: that is a FAILURE the
-                    # raise_pending path must surface — a run whose
-                    # last writes hang must not report success (and
-                    # must not delete its resume checkpoint)
-                    self._exc = TimeoutError(
-                        "async-writer failed to flush within "
-                        f"{self.join_timeout_s:.0f}s; submitted "
-                        "writes may not have landed")
+                with self._exc_lock:
+                    if self._exc is None:
+                        # an abandoned flush means submitted writes
+                        # may never have landed: that is a FAILURE the
+                        # raise_pending path must surface — a run
+                        # whose last writes hang must not report
+                        # success (and must not delete its resume
+                        # checkpoint). The hung writer may still fail
+                        # later; whichever lands first under the lock
+                        # wins, neither is silently lost
+                        self._exc = TimeoutError(
+                            "async-writer failed to flush within "
+                            f"{self.join_timeout_s:.0f}s; submitted "
+                            "writes may not have landed")
             self._thread = None
         if raise_pending:
             self.check()
@@ -514,10 +531,12 @@ class DonatedRing:
         self._bufs = [None] * self.depth
         self._live = [False] * self.depth
         self._tags = [None] * self.depth
-        self._lock = threading.Lock()
+        self._lock = threadsan.make_lock("DonatedRing._lock")
 
+    # thread-role: prefetch, caller
     def stage(self, tag: int, buf) -> None:
         with self._lock:
+            threadsan.guard(self._lock, "DonatedRing slots")
             i = tag % self.depth
             if self._live[i]:
                 raise RuntimeError(
@@ -531,6 +550,7 @@ class DonatedRing:
     def take(self, tag: int):
         """The buffer for ``tag``, exactly once (caller donates it)."""
         with self._lock:
+            threadsan.guard(self._lock, "DonatedRing slots")
             i = tag % self.depth
             if not self._live[i] or self._tags[i] != tag:
                 raise RuntimeError(

@@ -45,6 +45,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from sagecal_tpu.analysis import threadsan
 from sagecal_tpu.obs import metrics as obs
 
 # -- content tokens ---------------------------------------------------------
@@ -127,7 +128,7 @@ class ProgramCache:
     def __init__(self, maxsize: int = 64):
         self.maxsize = int(maxsize)
         self._d: OrderedDict = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = threadsan.make_lock("ProgramCache._lock")
         self.hits = 0
         self.misses = 0
         # per-device hit/miss accounting (fleet mode): keyed by the

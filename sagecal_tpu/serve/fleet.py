@@ -52,6 +52,7 @@ import threading
 
 import numpy as np
 
+from sagecal_tpu.analysis import threadsan
 from sagecal_tpu.serve import cache as pcache
 
 _tls = threading.local()
@@ -113,7 +114,7 @@ def current_job() -> str | None:
 
 # -- mesh spans: which devices a mesh/mpi job's SPMD programs cover ---------
 
-_spans_lock = threading.Lock()
+_spans_lock = threadsan.make_lock("fleet._spans_lock")
 _MESH_SPANS: dict = {}     # job_id -> {"devices": [...], "axes": ...}
 
 
@@ -291,7 +292,7 @@ class Placer:
         # is called from a yielding owner thread outside it — the
         # affinity map carries its own lock so a mid-iteration insert
         # can never corrupt a concurrent place()
-        self._lock = threading.Lock()
+        self._lock = threadsan.make_lock("Placer._lock")
         self._affinity: dict[str, int] = {}     # bucket -> ordinal
 
     def _fits(self, st: dict, est_bytes: int) -> bool:

@@ -53,6 +53,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from sagecal_tpu.analysis import threadsan
 from sagecal_tpu.obs import metrics as obs
 from sagecal_tpu.serve import cache as pcache
 
@@ -207,7 +208,7 @@ class PriorStore:
     def __init__(self, maxsize: int = 16):
         self.maxsize = int(maxsize)
         self._d: OrderedDict = OrderedDict()      # key -> prior dict
-        self._lock = threading.Lock()
+        self._lock = threadsan.make_lock("PriorStore._lock")
         self.hits = 0
         self.misses = 0
         self.banked = 0
@@ -228,6 +229,7 @@ class PriorStore:
             return False
         entry = make_prior(J, times, freqs, rho=rho, quality=quality)
         with self._lock:
+            threadsan.guard(self._lock, "PriorStore._d")
             old = self._d.get(key)
             if (old is not None and old["quality"] is not None
                     and entry["quality"] is not None
@@ -250,6 +252,7 @@ class PriorStore:
         """The newest entry under ``key`` (hit/miss counted), or
         None."""
         with self._lock:
+            threadsan.guard(self._lock, "PriorStore._d")
             if key is not None and key in self._d:
                 self._d.move_to_end(key)
                 self.hits += 1

@@ -34,10 +34,10 @@ snapshots and submits/cancels.
 from __future__ import annotations
 
 import itertools
-import threading
 import time
 import traceback
 
+from sagecal_tpu.analysis import threadsan
 from sagecal_tpu.obs import metrics as obs
 
 #: bucket ladder for the per-job SLO histograms (queue-wait / run /
@@ -216,7 +216,7 @@ class JobQueue:
         self._jobs: dict[str, Job] = {}
         self._order = itertools.count()   # FIFO tiebreak within priority
         self._seq: dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = threadsan.make_lock("JobQueue._lock")
         self._draining = False
 
     # -- submission / lookup ------------------------------------------------

@@ -74,6 +74,7 @@ import time
 import uuid
 
 from sagecal_tpu import faults
+from sagecal_tpu.analysis import threadsan
 from sagecal_tpu.obs import export as oexport
 from sagecal_tpu.obs import metrics as ometrics
 from sagecal_tpu.serve import api as sapi
@@ -111,7 +112,7 @@ class WorkerInfo:
         # the per-worker lock — never the router-wide lock (network I/O
         # must not serialize the registry)
         self.client: sapi.Client | None = None
-        self.clock = threading.Lock()
+        self.clock = threadsan.make_lock("WorkerInfo.clock")
 
     def alive(self, now: float | None = None) -> bool:
         return (not self.evicted
@@ -273,7 +274,9 @@ class Router:
         self.log = log
         self.registry = ometrics.enable()
         self.t0 = time.time()
-        self._lock = threading.RLock()
+        # reentrant: route/recover paths re-enter through helpers that
+        # take the registry lock themselves
+        self._lock = threadsan.make_rlock("Router._lock")
         self.workers: dict[str, WorkerInfo] = {}
         self.jobs: dict[str, RJob] = {}
         self._seq = itertools.count()
@@ -1074,13 +1077,25 @@ class WorkerAgent:
         self._sock = s
         self._f = s.makefile("rwb")
 
-    def _drop(self) -> None:
+    def _interrupt(self) -> None:
+        """Close the connection WITHOUT rebinding the refs — the only
+        socket operation another thread may perform. ``stop()`` uses
+        it to unblock a ``readline`` on the agent thread (closing a
+        socket from another thread is the documented interruption
+        idiom); the agent thread observes the OSError and runs its own
+        :meth:`_drop`. Rebinding here instead raced the agent
+        mid-roundtrip with an uncaught AttributeError (threadlint
+        shared-state, round 19)."""
         for o in (self._f, self._sock):
             try:
                 if o is not None:
                     o.close()
             except OSError:
                 pass
+
+    # thread-role: worker-agent
+    def _drop(self) -> None:
+        self._interrupt()
         self._f = self._sock = None
 
     def _roundtrip(self, obj: dict) -> dict:
@@ -1152,7 +1167,7 @@ class WorkerAgent:
 
     def stop(self) -> None:
         self._stop.set()
-        self._drop()
+        self._interrupt()       # agent thread owns (and nulls) the refs
 
 
 # ---------------------------------------------------------------------------

@@ -69,6 +69,7 @@ import time
 import numpy as np
 
 from sagecal_tpu import faults, sched
+from sagecal_tpu.analysis import threadsan
 from sagecal_tpu.diag import trace as dtrace
 from sagecal_tpu.obs import health as ohealth
 from sagecal_tpu.obs import metrics as obs
@@ -222,7 +223,7 @@ class Scheduler:
         # (serve/router.py) via the worker heartbeat so fleet-level
         # placement can follow warm caches across PROCESS boundaries
         # the way the in-process Placer follows them across devices
-        self._bucket_lock = threading.Lock()
+        self._bucket_lock = threadsan.make_lock("Scheduler._bucket_lock")
         self._buckets: dict = {}        # token -> set of ordinals
 
     # -- lifecycle ----------------------------------------------------------
@@ -312,12 +313,14 @@ class Scheduler:
         eviction from the LRU program cache is rare enough that a
         stale claim costs one cold compile, never correctness)."""
         with self._bucket_lock:
+            threadsan.guard(self._bucket_lock, "Scheduler._buckets")
             return {b: sorted(s) for b, s in self._buckets.items()}
 
     def _note_bucket(self, job, ordinal: int) -> None:
         b = fleet.job_bucket(job)
         bp = fleet.job_placement_bucket(job)
         with self._bucket_lock:
+            threadsan.guard(self._bucket_lock, "Scheduler._buckets")
             if b is not None:
                 self._buckets.setdefault(b, set()).add(int(ordinal))
             if bp is not None and bp != b:

@@ -89,9 +89,35 @@ def pytest_addoption(parser):
         "--sanitize", action="store_true", default=False,
         help="run under jax_enable_checks + debug-NaNs (the CI slow "
              "lane around the fast solver subset)")
+    parser.addoption(
+        "--sanitize-threads", action="store_true", default=False,
+        help="arm analysis/threadsan: instrumented locks record "
+             "per-thread acquisition orders and fail the test on an "
+             "observed order inversion or an unlocked access to a "
+             "registered shared structure (the CI lane around the "
+             "serve/stream fast subsets)")
 
 
 def pytest_configure(config):
     if config.getoption("--sanitize"):
         jax.config.update("jax_enable_checks", True)
         jax.config.update("jax_debug_nans", True)
+    if config.getoption("--sanitize-threads"):
+        # armed before collection: every threadsan.make_lock() in
+        # structures the tests construct returns an instrumented lock
+        from sagecal_tpu.analysis import threadsan
+        threadsan.enable()
+
+
+@pytest.fixture(autouse=True)
+def _threadsan_sweep(request):
+    """Per-test sweep under --sanitize-threads: violations raise at
+    the acquire site, but a broad except (or a background thread's
+    swallowed traceback) can hide one — the sweep fails the test that
+    provoked it regardless."""
+    yield
+    if not request.config.getoption("--sanitize-threads"):
+        return
+    from sagecal_tpu.analysis import threadsan
+    bad = threadsan.violations(clear=True)
+    assert not bad, "thread sanitizer violations:\n" + "\n".join(bad)

@@ -693,6 +693,50 @@ _VIOLATIONS = {
             return jnp.einsum("ij,jk->ik", x, w)
         return jax.lax.cond(x.ndim > 1, lambda: x, heavy)
     """,
+    "shared-state": """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.items = []
+            self._thread = threading.Thread(target=self._run,
+                                            name="pump-loop")
+
+        def _run(self):
+            self.items.append(1)
+
+        def push(self, x):
+            self.items.append(x)
+    """,
+    "lock-order": """
+    import threading
+
+    class Banks:
+        def __init__(self):
+            self.a_lock = threading.Lock()
+            self.b_lock = threading.Lock()
+
+        def first(self):
+            with self.a_lock:
+                with self.b_lock:
+                    pass
+
+        def second(self):
+            with self.b_lock:
+                with self.a_lock:
+                    pass
+    """,
+    "handoff-ownership": """
+    def produce(q, n):
+        batch = [n]
+        q.put(batch)
+        batch.append(n + 1)
+    """,
+    "scope-discipline": """
+    def bad(dtrace, tracer):
+        s = dtrace.scope(tracer)
+        return s
+    """,
 }
 
 
@@ -716,3 +760,375 @@ def test_ci_gate_fails_on_injected_violations(tmp_path):
             cwd=REPO, capture_output=True, text=True)
         assert r.returncode != 0, (rule, r.stdout, r.stderr)
         assert rule in r.stdout, (rule, r.stdout)
+
+
+# ---------------------------------------------------------------------------
+# threadlint: shared-state (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+def test_shared_state_two_roles_unguarded_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.items = []
+            self._thread = threading.Thread(target=self._run,
+                                            name="pump-loop")
+
+        def _run(self):
+            self.items.append(1)
+
+        def push(self, x):
+            self.items.append(x)
+    """)
+    assert _rules(f) == ["shared-state"]
+    assert "pump-loop" in f[0].message and "caller" in f[0].message
+
+
+def test_shared_state_lock_guarded_twin_clean(tmp_path):
+    f, _ = _lint(tmp_path, """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.items = []
+            self._lock = threading.Lock()
+            self._thread = threading.Thread(target=self._run,
+                                            name="pump-loop")
+
+        def _run(self):
+            with self._lock:
+                self.items.append(1)
+
+        def push(self, x):
+            with self._lock:
+                self.items.append(x)
+    """)
+    assert f == []
+
+
+def test_shared_state_role_annotation_unifies(tmp_path):
+    """A '# thread-role:' annotation declaring the true role silences
+    the finding: both writers are the SAME thread."""
+    f, _ = _lint(tmp_path, """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.items = []
+            self._thread = threading.Thread(target=self._run,
+                                            name="pump-loop")
+
+        def _run(self):
+            self.items.append(1)
+
+        # thread-role: pump-loop
+        def flush(self):
+            self.items.clear()
+    """)
+    assert f == []
+
+
+def test_shared_state_suppressed_twin(tmp_path):
+    f, supp = _lint(tmp_path, """
+    import threading
+
+    class Pump:
+        def __init__(self):
+            self.items = []
+            self._thread = threading.Thread(target=self._run,
+                                            name="pump-loop")
+
+        def _run(self):
+            # jaxlint: disable=shared-state -- append is atomic here
+            self.items.append(1)
+
+        def push(self, x):
+            self.items.append(x)
+    """)
+    assert f == []
+    assert len(supp) == 1
+
+
+def test_parse_thread_roles_grammar():
+    lines = [
+        "# thread-role: writer",
+        "def close(self):",
+        "    pass",
+        "def other(self):  # thread-role: a, b",
+        "    pass",
+    ]
+    roles = core.parse_thread_roles(lines)
+    assert roles[2] == ("writer",)     # standalone: next code line
+    assert roles[4] == ("a", "b")      # trailing: its own line
+
+
+# ---------------------------------------------------------------------------
+# threadlint: lock-order
+# ---------------------------------------------------------------------------
+
+def test_lock_order_cycle_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    import threading
+
+    class Banks:
+        def __init__(self):
+            self.a_lock = threading.Lock()
+            self.b_lock = threading.Lock()
+
+        def first(self):
+            with self.a_lock:
+                with self.b_lock:
+                    pass
+
+        def second(self):
+            with self.b_lock:
+                with self.a_lock:
+                    pass
+    """)
+    assert _rules(f) == ["lock-order"]
+    assert "cycle" in f[0].message
+
+
+def test_lock_order_consistent_twin_clean(tmp_path):
+    f, _ = _lint(tmp_path, """
+    import threading
+
+    class Banks:
+        def __init__(self):
+            self.a_lock = threading.Lock()
+            self.b_lock = threading.Lock()
+
+        def first(self):
+            with self.a_lock:
+                with self.b_lock:
+                    pass
+
+        def second(self):
+            with self.a_lock:
+                with self.b_lock:
+                    pass
+    """)
+    assert f == []
+
+
+def test_lock_order_call_through_cycle_flagged(tmp_path):
+    """The edge walks through a same-class call: holding A while
+    calling a method that takes B, against a direct B->A nest."""
+    f, _ = _lint(tmp_path, """
+    import threading
+
+    class Banks:
+        def __init__(self):
+            self.a_lock = threading.Lock()
+            self.b_lock = threading.Lock()
+
+        def deposit(self):
+            with self.a_lock:
+                self._audit()
+
+        def _audit(self):
+            with self.b_lock:
+                pass
+
+        def sweep(self):
+            with self.b_lock:
+                with self.a_lock:
+                    pass
+    """)
+    assert "lock-order" in _rules(f)
+
+
+def test_lock_order_nonreentrant_self_nest_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    import threading
+
+    class Reent:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def outer(self):
+            with self._lock:
+                self.inner()
+
+        def inner(self):
+            with self._lock:
+                pass
+    """)
+    assert _rules(f) == ["lock-order"]
+    assert "reacquisition" in f[0].message
+
+
+def test_lock_order_rlock_self_nest_clean(tmp_path):
+    f, _ = _lint(tmp_path, """
+    import threading
+
+    class Reent:
+        def __init__(self):
+            self._rl = threading.RLock()
+
+        def outer(self):
+            with self._rl:
+                self.inner()
+
+        def inner(self):
+            with self._rl:
+                pass
+    """)
+    assert f == []
+
+
+# ---------------------------------------------------------------------------
+# threadlint: handoff-ownership
+# ---------------------------------------------------------------------------
+
+def test_handoff_mutate_after_put_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def produce(q, n):
+        batch = [n]
+        q.put(batch)
+        batch.append(n + 1)
+    """)
+    assert _rules(f) == ["handoff-ownership"]
+    assert "consumer owns it" in f[0].message
+
+
+def test_handoff_read_after_ring_stage_flagged(tmp_path):
+    """Ring slots are DONATED by the consumer: even a read after
+    stage() is use-after-donate on a host handle."""
+    f, _ = _lint(tmp_path, """
+    def stage_it(ring, tag, buf):
+        ring.stage(tag, buf)
+        return buf.shape
+    """)
+    assert _rules(f) == ["handoff-ownership"]
+
+
+def test_handoff_rebind_and_fresh_twins_clean(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def produce_rebind(q, n):
+        batch = [n]
+        q.put(batch)
+        batch = [n + 1]
+        batch.append(n + 2)
+
+    def produce_fresh(q, n):
+        q.put(list(range(n)))
+
+    def read_after_put_ok(q, n):
+        batch = [n]
+        q.put(batch)
+        return len(batch)
+    """)
+    assert f == []
+
+
+def test_handoff_loop_carried_mutation_flagged(tmp_path):
+    """A mutation BEFORE the put inside a loop is after it on the next
+    iteration — the carried handle is still the consumer's."""
+    f, _ = _lint(tmp_path, """
+    def pump(q, xs):
+        batch = []
+        for x in xs:
+            batch.append(x)
+            q.put(batch)
+    """)
+    assert _rules(f) == ["handoff-ownership"]
+
+
+def test_handoff_suppressed_twin(tmp_path):
+    f, supp = _lint(tmp_path, """
+    def produce(q, n):
+        batch = [n]
+        q.put(batch)
+        # jaxlint: disable=handoff-ownership -- consumer copies on get
+        batch.append(n + 1)
+    """)
+    assert f == []
+    assert len(supp) == 1
+
+
+# ---------------------------------------------------------------------------
+# threadlint: scope-discipline
+# ---------------------------------------------------------------------------
+
+def test_scope_outside_with_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def bad(dtrace, tracer):
+        s = dtrace.scope(tracer)
+        return s
+    """)
+    assert _rules(f) == ["scope-discipline"]
+
+
+def test_scope_spawn_inside_scope_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    import threading
+
+    def bad(dtrace, tracer, fn):
+        with dtrace.scope(tracer):
+            t = threading.Thread(target=fn)
+            t.start()
+    """)
+    assert _rules(f) == ["scope-discipline"]
+    assert "does NOT extend" in f[0].message
+
+
+def test_scope_clean_twins(tmp_path):
+    """with-entry, factory return, and context= spawn factories are
+    the three blessed forms."""
+    f, _ = _lint(tmp_path, """
+    def ok_with(dtrace, tracer):
+        with dtrace.scope(tracer):
+            pass
+
+    def ok_factory(dtrace, tracer):
+        return dtrace.scope(tracer)
+
+    def ok_prefetch(Prefetcher, dtrace, produce, tracer):
+        with dtrace.scope(tracer):
+            return Prefetcher(produce,
+                              context=lambda: dtrace.scope(tracer))
+    """)
+    assert f == []
+
+
+def test_scope_prefetcher_without_context_flagged(tmp_path):
+    f, _ = _lint(tmp_path, """
+    def bad(Prefetcher, dtrace, produce, tracer):
+        with dtrace.scope(tracer):
+            return Prefetcher(produce)
+    """)
+    assert _rules(f) == ["scope-discipline"]
+    assert "context=" in f[0].message
+
+
+# ---------------------------------------------------------------------------
+# stale-suppression audit (ISSUE 19 satellite)
+# ---------------------------------------------------------------------------
+
+def test_stale_suppression_is_a_finding(tmp_path):
+    """A disable whose rule no longer fires on its target line is dead
+    armor: it would silently swallow a FUTURE real finding there."""
+    f, _ = _lint(tmp_path, """
+    def fine(x):
+        return x + 1  # jaxlint: disable=host-sync -- was needed pre-refactor
+    """)
+    assert "suppression" in _rules(f)
+    assert "stale" in f[0].message
+
+
+def test_live_suppression_not_stale(tmp_path):
+    # the matched case is test_suppression_with_reason_silences: a
+    # directive whose rule DOES fire produces neither finding
+    f, supp = _lint(tmp_path, """
+    def sweep(xs):
+        tot = 0.0
+        for x in xs:
+            # jaxlint: disable=host-sync -- convergence check needs it
+            tot += float(jnp.sum(x))
+        return tot
+    """)
+    assert f == []
+    assert len(supp) == 1

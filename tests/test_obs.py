@@ -486,6 +486,31 @@ def test_sentinel_cache_probe_green():
     assert sentinel.probe_cache() == []
 
 
+def test_sentinel_donation_probe_green():
+    """ISSUE 19 satellite: the lowered hot program really aliases its
+    donated visibility parameter (donation ground truth — the AST
+    use-after-donate checker only promises it)."""
+    assert sentinel.probe_donation() == []
+
+
+def test_sentinel_donation_alias_parse_not_vacuous():
+    """The probe's own negative control, exercised directly: the
+    undonated twin compiles with NO aliased parameters, so an empty
+    parse on the donated twin means missing aliasing, not a parser
+    that matches nothing."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((8,), jnp.float32)
+
+    def f(a, b):
+        return a + b
+
+    donated = jax.jit(f, donate_argnums=(0,)).lower(x, x).compile()
+    plain = jax.jit(f).lower(x, x).compile()
+    assert sentinel._aliased_params(donated) == {0}
+    assert sentinel._aliased_params(plain) == set()
+
+
 def _write_fleet_bank(dirpath, rnd, rec, platform="cpu"):
     with open(os.path.join(dirpath, f"FLEET_r{rnd:02d}.json"), "w") as f:
         json.dump({"platform": platform, "date": "2026-08-04",

@@ -1,0 +1,241 @@
+"""threadsan gates (ISSUE 19): the runtime half of the concurrency
+contracts.
+
+- the instrumented-lock order book detects an acquisition-order
+  inversion (the doctored lock-order twin — the runtime complement of
+  threadlint's static cycle finding), including across threads;
+- guard() fails an unlocked access to a registered shared structure;
+- RLock re-acquisition is never an inversion;
+- faults.py's ``lock_acquire`` point provides deterministic pressure;
+- and the no-op-when-disabled contract: with the sanitizer off,
+  make_lock returns PLAIN stdlib locks and a jitted solve is bit- and
+  compile-count-identical whether the module is armed elsewhere or
+  not (the acceptance gate for ``--sanitize-threads`` off).
+"""
+
+import threading
+
+import pytest
+
+from sagecal_tpu import faults
+from sagecal_tpu.analysis import threadsan
+
+
+@pytest.fixture
+def armed():
+    """Arm a FRESH sanitizer for one test and restore whatever was
+    installed before (so a --sanitize-threads run's global order book
+    never sees this test's deliberate violations)."""
+    prev = threadsan._SAN
+    threadsan.enable()
+    yield
+    threadsan._SAN = prev
+
+
+@pytest.fixture
+def armed_pressure():
+    prev = threadsan._SAN
+    threadsan.enable(pressure=True)
+    yield
+    threadsan._SAN = prev
+    faults.disable()
+
+
+# ---------------------------------------------------------------------------
+# off: plain locks, no registry
+# ---------------------------------------------------------------------------
+
+def test_off_returns_plain_stdlib_locks():
+    if threadsan.active():
+        pytest.skip("a sanitizer is armed globally")
+    assert isinstance(threadsan.make_lock("x"), type(threading.Lock()))
+    assert isinstance(threadsan.make_rlock("x"),
+                      type(threading.RLock()))
+    # guard on a plain lock: one attribute load + is-None test
+    threadsan.guard(threading.Lock(), "anything")
+    assert threadsan.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# the order book
+# ---------------------------------------------------------------------------
+
+def test_lock_order_inversion_detected(armed):
+    """The doctored lock-order-inversion twin: A->B then B->A. The
+    detector keys on observed ORDERS, not an unlucky interleaving —
+    a single thread exhibiting both orders is already a deadlock
+    window for any two threads running those paths concurrently."""
+    a = threadsan.make_lock("Twin.a_lock")
+    b = threadsan.make_lock("Twin.b_lock")
+    with a:
+        with b:
+            pass
+    with pytest.raises(threadsan.ThreadSanError, match="inversion"):
+        with b:
+            with a:
+                pass
+    assert any("inversion" in v for v in threadsan.violations())
+
+
+def test_lock_order_inversion_across_threads(armed):
+    """One order observed on a worker thread, the inverse on the main
+    thread — the book is process-wide."""
+    a = threadsan.make_lock("X.a_lock")
+    b = threadsan.make_lock("X.b_lock")
+
+    def worker():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=worker, name="order-worker")
+    t.start()
+    t.join()
+    with pytest.raises(threadsan.ThreadSanError):
+        with b:
+            with a:
+                pass
+
+
+def test_consistent_order_is_quiet(armed):
+    a = threadsan.make_lock("Q.a_lock")
+    b = threadsan.make_lock("Q.b_lock")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert threadsan.violations() == []
+
+
+def test_rlock_reentry_is_not_an_inversion(armed):
+    r = threadsan.make_rlock("R.lock")
+    with r:
+        with r:                 # reentrant: no self-edge, no raise
+            pass
+    assert threadsan.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# guard: registered-structure access without its lock
+# ---------------------------------------------------------------------------
+
+def test_guard_unlocked_access_fails(armed):
+    lk = threadsan.make_lock("Store._lock")
+    with pytest.raises(threadsan.ThreadSanError, match="unlocked"):
+        threadsan.guard(lk, "Store._d")
+    assert any("Store._d" in v for v in threadsan.violations(clear=True))
+    with lk:
+        threadsan.guard(lk, "Store._d")     # held: quiet
+    assert threadsan.violations() == []
+
+
+def test_guard_checks_the_calling_thread(armed):
+    """Holding the lock on ANOTHER thread does not license this one."""
+    lk = threadsan.make_lock("Store2._lock")
+    ready = threading.Event()
+    done = threading.Event()
+
+    def holder():
+        with lk:
+            ready.set()
+            done.wait(timeout=5)
+
+    t = threading.Thread(target=holder, name="holder")
+    t.start()
+    ready.wait(timeout=5)
+    try:
+        with pytest.raises(threadsan.ThreadSanError):
+            threadsan.guard(lk, "Store2._d")
+    finally:
+        done.set()
+        t.join()
+
+
+# ---------------------------------------------------------------------------
+# production structures under the sanitizer
+# ---------------------------------------------------------------------------
+
+def test_donated_ring_under_sanitizer(armed):
+    """Structures built AFTER arming get instrumented locks and run
+    their normal protocol cleanly."""
+    from sagecal_tpu import sched
+    ring = sched.DonatedRing(depth=2)
+    assert isinstance(ring._lock, threadsan.SanLock)
+    ring.stage(0, "buf0")
+    ring.stage(1, "buf1")
+    assert ring.take(0) == "buf0"
+    assert ring.take(1) == "buf1"
+    assert threadsan.violations() == []
+
+
+def test_async_writer_exc_lock_under_sanitizer(armed):
+    """The round-19 true positive stays fixed: a writer-job failure
+    and the caller's check() both cross _exc under its lock."""
+    from sagecal_tpu import sched
+    w = sched.AsyncWriter(enabled=True, maxsize=2)
+    assert isinstance(w._exc_lock, threadsan.SanLock)
+
+    def boom():
+        raise ValueError("disk on fire")
+
+    w.submit(boom)
+    with pytest.raises(ValueError, match="disk on fire"):
+        w.drain()
+    w.close(raise_pending=False)
+    assert threadsan.violations() == []
+
+
+# ---------------------------------------------------------------------------
+# deterministic pressure via faults.py
+# ---------------------------------------------------------------------------
+
+def test_lock_acquire_pressure_draws_from_plan(armed_pressure):
+    faults.enable([faults.Rule("lock_acquire", kind="transient",
+                               times=2)], seed=7)
+    lk = threadsan.make_lock("P.lock")
+    for _ in range(4):
+        with lk:
+            pass
+    # the plan's counted schedule consumed its two draws — no error,
+    # no violation, just widened windows
+    assert threadsan.violations() == []
+    assert not faults.draw("lock_acquire", key="P.lock")
+
+
+# ---------------------------------------------------------------------------
+# acceptance gate: --sanitize-threads off is bit- and
+# compile-count-identical
+# ---------------------------------------------------------------------------
+
+def test_off_is_bit_and_compile_identical(retrace_guard):
+    """Arming/disarming the sanitizer between identically shaped solves
+    must not change a bit of the result nor add a compile: threadsan
+    holds no jax state, and with the flag off every production lock is
+    a plain stdlib lock."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if threadsan.active():
+        pytest.skip("needs the disabled baseline")
+
+    rng = np.random.default_rng(3)
+    J = jnp.asarray(rng.normal(size=(16, 2, 2))
+                    + 1j * rng.normal(size=(16, 2, 2)), jnp.complex64)
+    V = jnp.asarray(rng.normal(size=(16, 2, 2))
+                    + 1j * rng.normal(size=(16, 2, 2)), jnp.complex64)
+
+    @jax.jit
+    def residuals(J, V):
+        return V - J @ V @ jnp.conj(jnp.swapaxes(J, -1, -2))
+
+    base = np.asarray(residuals(J, V))
+    prev = threadsan._SAN
+    try:
+        threadsan.enable()
+        armed_out = retrace_guard(lambda: residuals(J, V))
+    finally:
+        threadsan._SAN = prev
+    off_out = retrace_guard(lambda: residuals(J, V))
+    np.testing.assert_array_equal(base, np.asarray(armed_out))
+    np.testing.assert_array_equal(base, np.asarray(off_out))

@@ -389,7 +389,7 @@ def refine_trip_flops(M, kmax, n_stations, B, robust, dtype):
 
 
 def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
-                     inner="chol", kernel="xla"):
+                     inner="chol", kernel="xla", jones="full"):
     """FLOPs + bytes accessed of ONE inner solver iteration at the
     per-cluster solve shape.
 
@@ -422,9 +422,15 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
     roofline.program_cost folds in the kernel's own cost_estimate
     (roofline.pallas_cost); interpret-mode (CPU) lowerings price
     through cost_analysis directly.
+    ``jones``: the Jones parameterization (SageConfig.jones_mode,
+    round 20) — constrained modes price the REDUCED bodies the solvers
+    execute (mdim-wide Gram blocks, [K, npar N, npar N] damped solves,
+    npar = 4 diag / 2 phase vs 8 full), so equal-executed-trip
+    comparisons measure the true per-trip byte melt. ``jones="full"``
+    prices the exact pre-mode bodies (byte-frozen).
     """
     key = (int(solver_mode), kmax, n_stations, B, str(dtype), int(nbase),
-           str(inner), str(kernel))
+           str(inner), str(kernel), str(jones))
     if key in _TRIP_CACHE:
         return _TRIP_CACHE[key]
     import jax
@@ -435,7 +441,9 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
     from sagecal_tpu.solvers import normal_eq as ne
     from sagecal_tpu.solvers import rtr as rtr_mod
     K, N = kmax, n_stations
-    P = 8 * N
+    jm = str(jones)
+    md = ne.jones_mdim(jm)
+    P = 2 * md * N
     # ``dtype`` may be a reduced STORAGE dtype (SAGECAL_BENCH_DTYPE /
     # config 7): data specs carry it, solver-state specs carry the
     # accumulator dtype, and the priced bodies are the reduced ones
@@ -450,6 +458,9 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
     x8, coh = S((B, 8), f), S((B, 2, 2), c)
     s1, s2, cid = S((B,), i), S((B,), i), S((B,), i)
     wt, p = S((B, 8), f), S((K, P), fa)
+    # amplitude/reference Jones the constrained modes retract against
+    # (jones_from_params Jref); unused for jm == "full"
+    Jrf = S((K, N, 2, 2), c)
     use_pk = False
     if kernel == "pallas":
         from sagecal_tpu.ops import sweep_pallas as swp
@@ -463,7 +474,34 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
             rnu = (2.0 if int(solver_mode)
                    == int(SolverMode.RTR_OSRLM_RLBFGS) else None)
 
-            if inner == "cg" and use_pk:
+            if inner == "cg" and use_pk and jm != "full":
+                # reduced fused-sweep assembly + mdim blocks products
+                def outer(p, Jr, x8, coh, s1, s2, cid, wt):
+                    J = ne.jones_from_params(
+                        p.reshape(K, N, 2 * md), jm, Jr)
+                    cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt,
+                                            K, N, robust_nu=rnu,
+                                            mode=jm, Jref=Jr)
+                    g = jax.grad(lambda q: jnp.sum(cfn(q)))(p)
+                    g = rtr_mod.project_tangent_mode(p, g, K, N, jm)
+                    fac, _, _ = swp.gn_blocks(x8, J, coh, s1, s2, cid,
+                                              wt, N, K, nb_, jones=jm)
+                    return g, fac, cfn(p)
+
+                def hv(p, pp, qq, pq, D, v, s1, s2):
+                    fac = swp.GNBlocks(pp=pp, qq=qq, pq=pq, D=D)
+                    Hv = 2.0 * swp.gn_matvec_blocks(fac, v, s1, s2, N)
+                    return rtr_mod.project_tangent_mode(p, Hv, K, N, jm)
+
+                trip = _rl().combine(
+                    _lower_cost(outer, p, Jrf, x8, coh, s1, s2, cid, wt),
+                    _rl().scale(
+                        _lower_cost(hv, p, S((K, nb_, 2, md, md), fa),
+                                    S((K, nb_, 2, md, md), fa),
+                                    S((K, nb_, 2, 2, md, md), fa),
+                                    S((K, N, 2, md, md), fa), p, s1, s2),
+                        rtr_mod.RTRConfig().tcg_iters))
+            elif inner == "cg" and use_pk:
                 # fused-sweep assembly + B-independent blocks products
                 # (the bodies rtr.make_hess executes at kernel="pallas")
                 def outer(p, x8, coh, s1, s2, cid, wt):
@@ -488,6 +526,37 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
                                     S((K, nb_, 2, 4, 4), fa),
                                     S((K, nb_, 2, 2, 4, 4), fa),
                                     S((K, N, 2, 4, 4), fa), p, s1, s2),
+                        rtr_mod.RTRConfig().tcg_iters))
+            elif inner == "cg" and jm != "full":
+                # matrix-free trip on the reduced mode factors
+                def outer(p, Jr, x8, coh, s1, s2, cid, wt):
+                    J = ne.jones_from_params(
+                        p.reshape(K, N, 2 * md), jm, Jr)
+                    cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt,
+                                            K, N, robust_nu=rnu,
+                                            mode=jm, Jref=Jr)
+                    g = jax.grad(lambda q: jnp.sum(cfn(q)))(p)
+                    g = rtr_mod.project_tangent_mode(p, g, K, N, jm)
+                    fac, _, _ = ne.gn_factors_mode(x8, J, coh, s1, s2,
+                                                   cid, wt, N, K,
+                                                   mode=jm,
+                                                   row_period=int(nbase))
+                    return g, fac, cfn(p)
+
+                def hv(p, FA, FB, w2, D, v, s1, s2, cid):
+                    fac = ne.GNFactorsMode(FA=FA, FB=FB, w2=w2, D=D)
+                    Hv = 2.0 * ne.gn_matvec_mode(fac, v, s1, s2, cid,
+                                                 K, N)
+                    return rtr_mod.project_tangent_mode(p, Hv, K, N, jm)
+
+                trip = _rl().combine(
+                    _lower_cost(outer, p, Jrf, x8, coh, s1, s2, cid, wt),
+                    _rl().scale(
+                        _lower_cost(hv, p, S((B, 2, 2, 2, md), f),
+                                    S((B, 2, 2, 2, md), f),
+                                    S((B, 2, 2, 2), f),
+                                    S((K, N, 2, md, md), fa), p,
+                                    s1, s2, cid),
                         rtr_mod.RTRConfig().tcg_iters))
             elif inner == "cg":
                 def outer(p, x8, coh, s1, s2, cid, wt):
@@ -516,6 +585,36 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
                                     S((K, N, 2, 4, 4), fa), p,
                                     s1, s2, cid),
                         rtr_mod.RTRConfig().tcg_iters))
+            elif jm != "full":
+                # dense reduced assembly ([K, npar N, npar N]): the
+                # fused kernel (use_pk) and xla bodies price through
+                # the same mode entry points the solvers execute
+                def outer(p, Jr, x8, coh, s1, s2, cid, wt):
+                    J = ne.jones_from_params(
+                        p.reshape(K, N, 2 * md), jm, Jr)
+                    cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt,
+                                            K, N, robust_nu=rnu,
+                                            mode=jm, Jref=Jr)
+                    g = jax.grad(lambda q: jnp.sum(cfn(q)))(p)
+                    g = rtr_mod.project_tangent_mode(p, g, K, N, jm)
+                    if use_pk:
+                        JTJ, _, _ = swp.normal_equations_fused(
+                            x8, J, coh, s1, s2, cid, wt, N, K, nb_,
+                            jones=jm)
+                    else:
+                        JTJ, _, _ = ne.normal_equations_mode(
+                            x8, J, coh, s1, s2, cid, wt, N, K, mode=jm,
+                            row_period=int(nbase))
+                    return g, JTJ, cfn(p)
+
+                def hv(p, JTJ, v):
+                    Hv = 2.0 * jnp.einsum("kij,kj->ki", JTJ, v)
+                    return rtr_mod.project_tangent_mode(p, Hv, K, N, jm)
+
+                trip = _rl().combine(
+                    _lower_cost(outer, p, Jrf, x8, coh, s1, s2, cid, wt),
+                    _rl().scale(_lower_cost(hv, p, S((K, P, P), fa), p),
+                                rtr_mod.RTRConfig().tcg_iters))
             elif use_pk:
                 def outer(p, x8, coh, s1, s2, cid, wt):
                     J = ne.jones_r2c(p.reshape(K, N, 8))
@@ -555,6 +654,24 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
                     _lower_cost(outer, p, x8, coh, s1, s2, cid, wt),
                     _rl().scale(_lower_cost(hv, p, S((K, P, P), fa), p),
                                 rtr_mod.RTRConfig().tcg_iters))
+        elif (int(solver_mode) == int(SolverMode.NSD_RLBFGS)
+              and jm != "full"):
+            def nsd_outer(p, Jr, x8, coh, s1, s2, cid, wt):
+                cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt, K, N,
+                                        robust_nu=2.0, mode=jm, Jref=Jr)
+                g = jax.grad(lambda q: jnp.sum(cfn(q)))(p)
+                return rtr_mod.project_tangent_mode(p, g, K, N, jm)
+
+            def nsd_cost(p, Jr, x8, coh, s1, s2, cid, wt):
+                return rtr_mod.make_cost(x8, coh, s1, s2, cid, wt, K, N,
+                                         robust_nu=2.0, mode=jm,
+                                         Jref=Jr)(p)
+
+            trip = _rl().combine(
+                _lower_cost(nsd_outer, p, Jrf, x8, coh, s1, s2, cid, wt),
+                _rl().scale(_lower_cost(nsd_cost, p, Jrf, x8, coh, s1,
+                                        s2, cid, wt),
+                            rtr_mod.NSDConfig().ls_tries))
         elif int(solver_mode) == int(SolverMode.NSD_RLBFGS):
             def nsd_outer(p, x8, coh, s1, s2, cid, wt):
                 cfn = rtr_mod.make_cost(x8, coh, s1, s2, cid, wt, K, N,
@@ -577,21 +694,40 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
             # factorization + the initial apply. The PCG loop body
             # (matvec + apply) is priced per EXECUTED trip by
             # cg_trip_cost — lm.py counts them in info["cg_iters"].
-            def lm_trip(JTe0, mu, p, x8, coh, s1, s2, cid, wt):
-                Jn = ne.jones_r2c(p.reshape(K, N, 8))
-                if use_pk:
-                    fac, JTe, cost = swp.gn_blocks(x8, Jn, coh, s1, s2,
-                                                   cid, wt, N, K, nb_)
-                else:
-                    fac, JTe, cost = ne.gn_factors(x8, Jn, coh, s1, s2,
-                                                   cid, wt, N, K,
-                                                   row_period=int(nbase))
-                Lfac = ne.gn_precond_factor(fac.D, mu + 1e-9)
-                z0 = ne.gn_precond_apply(Lfac, JTe, K, N)
-                return fac, JTe, cost, z0
+            if jm != "full":
+                def lm_trip(JTe0, mu, p, Jr, x8, coh, s1, s2, cid, wt):
+                    Jn = ne.jones_from_params(
+                        p.reshape(K, N, 2 * md), jm, Jr)
+                    if use_pk:
+                        fac, JTe, cost = swp.gn_blocks(
+                            x8, Jn, coh, s1, s2, cid, wt, N, K, nb_,
+                            jones=jm)
+                    else:
+                        fac, JTe, cost = ne.gn_factors_mode(
+                            x8, Jn, coh, s1, s2, cid, wt, N, K, mode=jm,
+                            row_period=int(nbase))
+                    Lfac = ne.gn_precond_factor(fac.D, mu + 1e-9)
+                    z0 = ne.gn_precond_apply(Lfac, JTe, K, N)
+                    return fac, JTe, cost, z0
 
-            trip = _lower_cost(lm_trip, p, S((K,), fa), p, x8, coh, s1,
-                               s2, cid, wt)
+                trip = _lower_cost(lm_trip, p, S((K,), fa), p, Jrf, x8,
+                                   coh, s1, s2, cid, wt)
+            else:
+                def lm_trip(JTe0, mu, p, x8, coh, s1, s2, cid, wt):
+                    Jn = ne.jones_r2c(p.reshape(K, N, 8))
+                    if use_pk:
+                        fac, JTe, cost = swp.gn_blocks(
+                            x8, Jn, coh, s1, s2, cid, wt, N, K, nb_)
+                    else:
+                        fac, JTe, cost = ne.gn_factors(
+                            x8, Jn, coh, s1, s2, cid, wt, N, K,
+                            row_period=int(nbase))
+                    Lfac = ne.gn_precond_factor(fac.D, mu + 1e-9)
+                    z0 = ne.gn_precond_apply(Lfac, JTe, K, N)
+                    return fac, JTe, cost, z0
+
+                trip = _lower_cost(lm_trip, p, S((K,), fa), p, x8, coh,
+                                   s1, s2, cid, wt)
         elif (reduced and K == 1 and int(nbase) > 0
               and B % int(nbase) == 0
               and int(solver_mode)
@@ -613,16 +749,31 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
             import numpy as _np
             ntper = int(_np.sum(_np.asarray(os_ids_np)[::int(nbase)] == 0))
 
-            def lm_trip(JTJ, JTe, mu, p, x8, coh, s1, s2, wt, osids, l):
-                dp, _ = lm_mod._lu_solve_shift(JTJ, JTe, mu + 1e-9)
-                Jn = ne.jones_r2c((p + dp).reshape(K, N, 8))
-                return ne.os_subset_equations(x8, Jn, coh, s1, s2, wt,
-                                              osids, l, ntper,
-                                              int(nbase), N, wt)
+            if jm != "full":
+                def lm_trip(JTJ, JTe, mu, p, Jr, x8, coh, s1, s2, wt,
+                            osids, l):
+                    dp, _ = lm_mod._lu_solve_shift(JTJ, JTe, mu + 1e-9)
+                    Jn = ne.jones_from_params(
+                        (p + dp).reshape(K, N, 2 * md), jm, Jr)
+                    return ne.os_subset_equations_mode(
+                        x8, Jn, coh, s1, s2, wt, osids, l, ntper,
+                        int(nbase), N, wt, mode=jm)
 
-            trip = _lower_cost(lm_trip, S((K, P, P), fa), p, S((K,), fa),
-                               p, x8, coh, s1, s2, wt, S((B,), i),
-                               S((), i))
+                trip = _lower_cost(lm_trip, S((K, P, P), fa), p,
+                                   S((K,), fa), p, Jrf, x8, coh, s1, s2,
+                                   wt, S((B,), i), S((), i))
+            else:
+                def lm_trip(JTJ, JTe, mu, p, x8, coh, s1, s2, wt,
+                            osids, l):
+                    dp, _ = lm_mod._lu_solve_shift(JTJ, JTe, mu + 1e-9)
+                    Jn = ne.jones_r2c((p + dp).reshape(K, N, 8))
+                    return ne.os_subset_equations(x8, Jn, coh, s1, s2,
+                                                  wt, osids, l, ntper,
+                                                  int(nbase), N, wt)
+
+                trip = _lower_cost(lm_trip, S((K, P, P), fa), p,
+                                   S((K,), fa), p, x8, coh, s1, s2, wt,
+                                   S((B,), i), S((), i))
         elif use_pk:
             # fused block-Cholesky damping trip (kernel="pallas",
             # inner="chol"): lm.py carries the B-independent per-
@@ -633,22 +784,59 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
             # _chol_solve_shift here would price a body the pallas
             # path no longer executes (the PR 3 phantom-bytes class);
             # the retry lax.cond is excluded for the same reason.
-            def lm_trip(pp, qq, pq, Db, JTe, mu, p, x8, coh, s1, s2,
-                        cid, wt):
-                fac = swp.GNBlocks(pp=pp, qq=qq, pq=pq, D=Db)
-                dp, _ = swp.chol_solve_blocks_shift(
-                    fac, JTe, mu + 1e-9, s1, s2, N, reduced=reduced)
-                Jn = ne.jones_r2c((p + dp).reshape(K, N, 8))
-                # blocks AND acceptance cost from the body's single
-                # fused row pass (lm.py); no separate cost evaluation
-                return swp.gn_blocks(x8, Jn, coh, s1, s2, cid, wt, N,
-                                     K, nb_)
+            if jm != "full":
+                def lm_trip(pp, qq, pq, Db, JTe, mu, p, Jr, x8, coh,
+                            s1, s2, cid, wt):
+                    fac = swp.GNBlocks(pp=pp, qq=qq, pq=pq, D=Db)
+                    dp, _ = swp.chol_solve_blocks_shift(
+                        fac, JTe, mu + 1e-9, s1, s2, N, reduced=reduced)
+                    Jn = ne.jones_from_params(
+                        (p + dp).reshape(K, N, 2 * md), jm, Jr)
+                    return swp.gn_blocks(x8, Jn, coh, s1, s2, cid, wt,
+                                         N, K, nb_, jones=jm)
 
-            trip = _lower_cost(
-                lm_trip, S((K, nb_, 2, 4, 4), fa),
-                S((K, nb_, 2, 4, 4), fa), S((K, nb_, 2, 2, 4, 4), fa),
-                S((K, N, 2, 4, 4), fa), p, S((K,), fa), p, x8, coh,
-                s1, s2, cid, wt)
+                trip = _lower_cost(
+                    lm_trip, S((K, nb_, 2, md, md), fa),
+                    S((K, nb_, 2, md, md), fa),
+                    S((K, nb_, 2, 2, md, md), fa),
+                    S((K, N, 2, md, md), fa), p, S((K,), fa), p, Jrf,
+                    x8, coh, s1, s2, cid, wt)
+            else:
+                def lm_trip(pp, qq, pq, Db, JTe, mu, p, x8, coh, s1, s2,
+                            cid, wt):
+                    fac = swp.GNBlocks(pp=pp, qq=qq, pq=pq, D=Db)
+                    dp, _ = swp.chol_solve_blocks_shift(
+                        fac, JTe, mu + 1e-9, s1, s2, N, reduced=reduced)
+                    Jn = ne.jones_r2c((p + dp).reshape(K, N, 8))
+                    # blocks AND acceptance cost from the body's single
+                    # fused row pass (lm.py); no separate cost
+                    # evaluation
+                    return swp.gn_blocks(x8, Jn, coh, s1, s2, cid, wt,
+                                         N, K, nb_)
+
+                trip = _lower_cost(
+                    lm_trip, S((K, nb_, 2, 4, 4), fa),
+                    S((K, nb_, 2, 4, 4), fa),
+                    S((K, nb_, 2, 2, 4, 4), fa),
+                    S((K, N, 2, 4, 4), fa), p, S((K,), fa), p, x8, coh,
+                    s1, s2, cid, wt)
+        elif jm != "full":
+            # reduced dense damping trip: [K, npar N, npar N] damped
+            # solve + one mode-assembly row pass (the body lm.py
+            # executes under --jones diag/phase, kernel="xla")
+            def lm_trip(JTJ, JTe, mu, p, Jr, x8, coh, s1, s2, cid, wt):
+                if reduced:
+                    dp, _ = lm_mod._lu_solve_shift(JTJ, JTe, mu + 1e-9)
+                else:
+                    dp, _ = lm_mod._chol_solve_shift(JTJ, JTe, mu + 1e-9)
+                Jn = ne.jones_from_params(
+                    (p + dp).reshape(K, N, 2 * md), jm, Jr)
+                return ne.normal_equations_mode(
+                    x8, Jn, coh, s1, s2, cid, wt, N, K, mode=jm,
+                    row_period=int(nbase))
+
+            trip = _lower_cost(lm_trip, S((K, P, P), fa), p, S((K,), fa),
+                               p, Jrf, x8, coh, s1, s2, cid, wt)
         else:
             def lm_trip(JTJ, JTe, mu, p, x8, coh, s1, s2, cid, wt):
                 # price the executed all-ok solve body, NOT
@@ -677,7 +865,8 @@ def solver_trip_cost(solver_mode, kmax, n_stations, B, dtype, nbase=0,
         return None
 
 
-def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0, kernel="xla"):
+def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0, kernel="xla",
+                 jones="full"):
     """FLOPs + bytes of ONE executed PCG inner trip (lm.py
     _solve_damped_cg body under inner="cg"): one matrix-free gn_matvec
     over the Wirtinger factors + one station-block preconditioner apply
@@ -688,9 +877,11 @@ def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0, kernel="xla"):
     damping trip (solver_trip_cost), not here. ``kernel="pallas"``
     prices the B-independent blocks matvec
     (sweep_pallas.gn_matvec_blocks) instead of the [B]-row factor
-    pass — the melt the fused-sweep kernel buys the cg path."""
+    pass — the melt the fused-sweep kernel buys the cg path.
+    ``jones``: constrained modes price the mdim-wide matvec bodies
+    (gn_matvec_mode / reduced blocks) at npar N vector width."""
     key = ("cgtrip", kmax, n_stations, B, str(dtype), int(nbase),
-           str(kernel))
+           str(kernel), str(jones))
     if key in _TRIP_CACHE:
         return _TRIP_CACHE[key]
     import jax
@@ -698,6 +889,8 @@ def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0, kernel="xla"):
     from sagecal_tpu import dtypes as dtp
     from sagecal_tpu.solvers import normal_eq as ne
     K, N = kmax, n_stations
+    jm = str(jones)
+    md = ne.jones_mdim(jm)
     f = dtype
     fa = dtp.acc_dtype(dtype)
     i = jnp.int32
@@ -720,10 +913,30 @@ def cg_trip_cost(kmax, n_stations, B, dtype, nbase=0, kernel="xla"):
                 return rn, z, jnp.sum(rn * z, axis=-1)
 
             trip = _lower_cost(
-                body, S((K, nb_, 2, 4, 4), fa), S((K, nb_, 2, 4, 4), fa),
-                S((K, nb_, 2, 2, 4, 4), fa), S((K, N, 2, 4, 4), fa),
-                S((K, 8 * N), fa), S((K, 8 * N), fa), S((K,), fa),
-                S((B,), i), S((B,), i))
+                body, S((K, nb_, 2, md, md), fa),
+                S((K, nb_, 2, md, md), fa),
+                S((K, nb_, 2, 2, md, md), fa), S((K, N, 2, md, md), fa),
+                S((K, 2 * md * N), fa), S((K, 2 * md * N), fa),
+                S((K,), fa), S((B,), i), S((B,), i))
+            _TRIP_CACHE[key] = trip
+            return trip
+
+        if jm != "full":
+            def body(FA, FB, w2, Larr, v, r, shift, s1, s2, cid):
+                fac = ne.GNFactorsMode(FA=FA, FB=FB, w2=w2, D=Larr)
+                Ap = ne.gn_matvec_mode(fac, v, s1, s2, cid, K, N,
+                                       shift=shift)
+                alpha = jnp.sum(r * r, axis=-1) \
+                    / jnp.maximum(jnp.sum(v * Ap, axis=-1), 1e-30)
+                rn = r - alpha[:, None] * Ap
+                z = ne.gn_precond_apply((Larr, True), rn, K, N)
+                return rn, z, jnp.sum(rn * z, axis=-1)
+
+            trip = _lower_cost(
+                body, S((B, 2, 2, 2, md), f), S((B, 2, 2, 2, md), f),
+                S((B, 2, 2, 2), f), S((K, N, 2, md, md), fa),
+                S((K, 2 * md * N), fa), S((K, 2 * md * N), fa),
+                S((K,), fa), S((B,), i), S((B,), i), S((B,), i))
             _TRIP_CACHE[key] = trip
             return trip
 
@@ -3107,6 +3320,201 @@ def config12_warm_start(device, dtype):
     return rec
 
 
+def _stamp_jones(rec: dict, platform: str) -> str:
+    """Round-stamp the constrained-Jones record (JONES_rNN.json; first
+    round is 20 — the ISSUE 20 PR)."""
+    return stamp_family(rec, platform, "JONES", "13-jones-melt",
+                        first_round=20)
+
+
+def config13_jones_melt(device, dtype):
+    """Round-20 config: constrained-Jones traffic melt (ISSUE 20).
+
+    One per-cluster solve shape (K=1 baseline-major, the fused-kernel
+    regime) with a PHASE-CONSTRAINED truth — unit-amplitude diagonal
+    Jones, representable by every jones_mode — solved under
+    jones in {full, diag, phase} x kernel in {xla, pallas} at a fixed
+    trip budget. Banks, per leg and mode: the priced bytes/trip and
+    flops/trip of the damping trip (solver_trip_cost — the reduced
+    [K, npar N, npar N] bodies the solvers execute), measured
+    wall/step, EXECUTED trips, and the final residual norm relative
+    to the full-Jones solve.
+
+    REFUSES to bank unless (a) every mode executed the SAME trip
+    count (the equal-executed-trips comparison frame), (b) phase-mode
+    bytes/trip <= PHASE_GATE x full-mode on BOTH kernel legs (the
+    8x8 -> 2x2 Gram melt, ROADMAP item 2), (c) the constrained-truth
+    residual envelope holds — diag and phase final residual norms
+    within RES_ENVELOPE of full's (a constraint that MATCHES the
+    data's structure must not cost solution quality), and (d) the
+    mode entry points delegate bit-exactly at jones="full" (the
+    default path stays byte-frozen).
+
+    Measurement regime, stated honestly: kernel="pallas" on CPU runs
+    interpret-mode, so wall/step is meaningful only within a leg;
+    bytes/trip comes from the lowered-program pricing either way and
+    is the banked headline. The compiled-Mosaic verdict rides the
+    burn-down queue (tools_dev/burndown.py 13-jones-melt)."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    from sagecal_tpu.solvers import lm as lm_mod
+    from sagecal_tpu.solvers import normal_eq as ne
+    from sagecal_tpu.ops import sweep_pallas as swp
+
+    N, T, K = 40, 2, 1
+    nb = N * (N - 1) // 2
+    B = nb * T
+    ITMAX = 12
+    REP = 3
+    PHASE_GATE = 0.35
+    RES_ENVELOPE = 0.05
+    if not swp.supported(K, nb, B):
+        return {"error": f"shape K={K} nbase={nb} B={B} not "
+                         "fused-kernel eligible; refusing to bank"}
+
+    rng = np.random.default_rng(20)
+    i1, i2 = np.triu_indices(N, 1)
+    s1 = jnp.asarray(np.tile(i1, T).astype(np.int32))
+    s2 = jnp.asarray(np.tile(i2, T).astype(np.int32))
+    coh_np = (rng.normal(size=(B, 2, 2))
+              + 1j * rng.normal(size=(B, 2, 2))).astype(np.complex64)
+    # dominant diagonal + off-diagonal leakage: polarized enough that
+    # a diag/phase MIS-fit of full-Jones data would shows up, while
+    # the constrained truth keeps all three modes comparable
+    coh_np = coh_np + 2.0 * np.eye(2, dtype=np.complex64)
+    th = rng.uniform(-0.7, 0.7, size=(K, N, 2)).astype(np.float32)
+    d = np.exp(1j * th)
+    Jt = np.zeros((K, N, 2, 2), np.complex64)
+    Jt[..., 0, 0] = d[..., 0]
+    Jt[..., 1, 1] = d[..., 1]
+    V = np.einsum("bij,bjk,blk->bil", Jt[0][np.tile(i1, T)], coh_np,
+                  Jt[0][np.tile(i2, T)].conj())
+    V = V + 0.02 * (rng.normal(size=(B, 2, 2))
+                    + 1j * rng.normal(size=(B, 2, 2)))
+    vf = V.reshape(-1, 4)
+    x8 = jnp.asarray(np.stack([vf.real, vf.imag], -1).reshape(-1, 8),
+                     jnp.float32)
+    coh = jnp.asarray(coh_np)
+    wt = jnp.ones((B, 8), jnp.float32)
+    chunk = jnp.zeros((B,), jnp.int32)
+    J0 = jnp.asarray(np.tile(np.eye(2, dtype=np.complex64),
+                             (K, N, 1, 1)))
+
+    # gate (d): the jones="full" entry points delegate bit-exactly —
+    # the byte-frozen default path (r18 parity) is untouched by the
+    # mode layer
+    ref = ne.normal_equations(x8, jnp.asarray(Jt), coh, s1, s2, chunk,
+                              wt, N, K, row_period=nb)
+    via = ne.normal_equations_mode(x8, jnp.asarray(Jt), coh, s1, s2,
+                                   chunk, wt, N, K, mode="full",
+                                   row_period=nb)
+    for a, b in zip(ref, via):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            return {"error": "jones='full' normal_equations_mode NOT "
+                             "bit-identical to normal_equations; "
+                             "refusing to bank"}
+
+    legs = {}
+    for kern in ("xla", "pallas"):
+        per = {}
+        for jm in ("full", "diag", "phase"):
+            cfg = lm_mod.LMConfig(itmax=ITMAX, kernel=kern,
+                                  jones_mode=jm)
+            f = jax.jit(functools.partial(
+                lm_mod.lm_solve, n_stations=N, config=cfg,
+                row_period=nb))
+            J, info = f(x8, coh, s1, s2, chunk, wt, J0)
+            jax.block_until_ready(J)
+            t0 = time.perf_counter()
+            for _ in range(REP):
+                J, info = f(x8, coh, s1, s2, chunk, wt, J0)
+                jax.block_until_ready(J)
+            wall = (time.perf_counter() - t0) / REP
+            trips = int(np.asarray(info["iters"]).sum())
+            tc = solver_trip_cost(0, K, N, B, jnp.float32, nbase=nb,
+                                  inner="chol", kernel=kern, jones=jm)
+            per[jm] = dict(
+                executed_trips=trips,
+                final_cost=float(np.asarray(info["final_cost"]).sum()),
+                wall_per_step_s=round(wall / max(trips, 1), 6),
+                bytes_per_trip=None if tc is None
+                else tc["bytes_accessed"],
+                flops_per_trip=None if tc is None else tc["flops"])
+            if jm == "full":
+                # the default-config solve IS the jones="full" solve
+                # (LMConfig.jones_mode defaults to "full"): bit parity
+                # documents the frozen default
+                f0 = jax.jit(functools.partial(
+                    lm_mod.lm_solve, n_stations=N,
+                    config=lm_mod.LMConfig(itmax=ITMAX, kernel=kern),
+                    row_period=nb))
+                Jd, _ = f0(x8, coh, s1, s2, chunk, wt, J0)
+                if not np.array_equal(np.asarray(J), np.asarray(Jd)):
+                    return {"error": f"{kern}: --jones full solve NOT "
+                                     "bit-identical to the default "
+                                     "config; refusing to bank"}
+        # gate (a): equal executed trips across modes
+        tset = {m: per[m]["executed_trips"] for m in per}
+        if len(set(tset.values())) != 1:
+            return {"error": f"{kern}: unequal executed trips across "
+                             f"modes ({tset}); refusing to bank"}
+        if any(per[m]["bytes_per_trip"] is None for m in per):
+            return {"error": f"{kern}: trip pricing unavailable; "
+                             "refusing to bank"}
+        bf = per["full"]["bytes_per_trip"]
+        ratios = {m: per[m]["bytes_per_trip"] / bf for m in per}
+        # gate (b): the phase melt gate
+        if ratios["phase"] > PHASE_GATE:
+            return {"error": f"{kern}: phase bytes/trip "
+                             f"{ratios['phase']:.3f}x full "
+                             f"(> {PHASE_GATE}); refusing to bank"}
+        # gate (c): constrained-truth residual envelope (residual
+        # NORM ratio — sqrt of the summed squared cost)
+        cf = per["full"]["final_cost"]
+        res = {m: float(np.sqrt(per[m]["final_cost"] / cf))
+               for m in per}
+        for m in ("diag", "phase"):
+            if res[m] > 1.0 + RES_ENVELOPE:
+                return {"error": f"{kern}: {m} residual {res[m]:.4f}x "
+                                 f"full (> {1 + RES_ENVELOPE}); "
+                                 "refusing to bank"}
+        legs[kern] = dict(
+            modes=per,
+            bytes_per_trip_vs_full={m: round(r, 4)
+                                    for m, r in ratios.items()},
+            residual_norm_vs_full={m: round(r, 6)
+                                   for m, r in res.items()},
+            executed_trips=tset["full"])
+
+    rec = dict(
+        value=round(legs["xla"]["bytes_per_trip_vs_full"]["phase"], 4),
+        unit="phase/full bytes per trip (xla)",
+        phase_bytes_ratio_xla=legs["xla"][
+            "bytes_per_trip_vs_full"]["phase"],
+        phase_bytes_ratio_pallas=legs["pallas"][
+            "bytes_per_trip_vs_full"]["phase"],
+        diag_bytes_ratio_xla=legs["xla"][
+            "bytes_per_trip_vs_full"]["diag"],
+        diag_bytes_ratio_pallas=legs["pallas"][
+            "bytes_per_trip_vs_full"]["diag"],
+        phase_gate=PHASE_GATE, res_envelope=RES_ENVELOPE,
+        residual_envelope_met=True, full_mode_bit_identical=True,
+        legs=legs,
+        regime="phase-constrained truth (unit-amplitude diagonal "
+               "Jones), cold identity start, fixed trip budget; "
+               "pallas leg is interpret-mode on CPU so its wall axis "
+               "is within-leg only; bytes/trip is the lowered-program "
+               "price either way",
+        shape=f"N={N} K={K} B={B} nbase={nb} itmax={ITMAX} f32")
+    try:
+        rec["jones_record"] = _stamp_jones(rec,
+                                           jax.devices()[0].platform)
+    except Exception as e:        # the bench result still stands
+        log(f"# jones record stamping failed: {e}")
+    return rec
+
+
 CONFIGS = [
     ("1-fullbatch-lm", config1_fullbatch_lm),
     ("2-stochastic-lbfgs", config2_stochastic),
@@ -3120,6 +3528,7 @@ CONFIGS = [
     ("10-scaleout", config10_scaleout),
     ("11-stream-latency", config11_stream_latency),
     ("12-warm-start", config12_warm_start),
+    ("13-jones-melt", config13_jones_melt),
 ]
 
 #: configs that need a virtual multi-device fleet: run_one_config

@@ -134,6 +134,14 @@ def build_parser() -> argparse.ArgumentParser:
            "streaming [B]-pass per damping/TR iteration + B-"
            "independent blocks matvec per cg trip; interpret-mode on "
            "CPU; MIGRATION.md 'Pallas kernels')")
+    a("--jones", choices=("full", "diag", "phase"), default="full",
+      help="Jones parameterization for the solve: full = 2x2 complex "
+           "per station (bit-frozen default); diag = diagonal-only "
+           "(4 real params/station, 4x4 Gram blocks); phase = "
+           "phase-only per polarization (2 real params/station, 2x2 "
+           "Gram blocks, retraction J*exp(i*theta)). Distinct from "
+           "-J/--phase-only, which phase-projects the CORRECTION "
+           "after a full solve (MIGRATION.md 'Jones modes')")
     a("--shard-baselines", action="store_true",
       help="shard the baseline row axis of the (single) subband over "
            "all devices (P1 intra-subband parallelism)")
@@ -226,6 +234,7 @@ def config_from_args(args) -> RunConfig:
         cluster_inflight=args.inflight,
         solver_inner=args.inner,
         solver_kernel=args.kernel,
+        jones_mode=args.jones,
         dtype_policy=args.dtype_policy,
         tile_bucket=args.tile_bucket,
         prefetch=args.prefetch,

@@ -163,6 +163,11 @@ def build_parser() -> argparse.ArgumentParser:
            "bit-frozen default; pallas = fused-sweep kernel "
            "(ops/sweep_pallas.py; interpret-mode on CPU; PERF.md "
            "round 11 for the measured cg trip-price melt)")
+    a("--jones", choices=("full", "diag", "phase"), default="full",
+      help="Jones parameterization (MIGRATION.md 'Jones modes'). "
+           "Consensus ADMM requires 'full': the y/bz consensus "
+           "vectors are full-Jones parameters, so any constrained "
+           "mode is refused at startup")
     a("--host-loop", action="store_true",
       help="one device execution per ADMM iteration instead of a fully "
            "traced n_admm-iteration program")
@@ -254,6 +259,16 @@ def _main_consensus(args, dtrace) -> int:
     from sagecal_tpu.rime import predict as rp
     from sagecal_tpu.rime import residual as rr
     from sagecal_tpu.solvers import lm as lm_mod, normal_eq as nesolver, sage
+
+    if getattr(args, "jones", "full") != "full":
+        # the polynomial consensus state (y, Bz) is parameterized in
+        # full-Jones coordinates; a constrained subspace would need its
+        # own consensus algebra (lm.py/rtr.py raise the same refusal)
+        raise ValueError(
+            f"--jones {args.jones} is not supported with consensus "
+            "ADMM: the y/bz consensus vectors are full-Jones "
+            "parameters. Run the fullbatch CLI (sagecal_tpu.cli) for "
+            "constrained-Jones solves.")
 
     paths = discover_datasets(args.ms_pattern)
 

@@ -160,6 +160,17 @@ class RunConfig:
     # PCG/tCG trip; interpret-mode on CPU, compiled Mosaic on TPU;
     # tolerance-gated parity — MIGRATION.md "Pallas kernels")
     solver_kernel: str = "xla"
+    # --jones : constrained-Jones parameterization for every solver
+    # path (sage.SageConfig.jones_mode; normal_eq.JONES_MODES): "full"
+    # (2x2 complex, bit-frozen default) | "diag" (diagonal Jones, 4
+    # real params/station) | "phase" (phase-only diagonal, 2 real
+    # params/station — retraction J = J0 * exp(i theta)). Non-full
+    # modes shrink the per-baseline Gram blocks the assemblies emit
+    # (8x8 -> 4x4 / 2x2 real) and join the program-cache/prior keys.
+    # Distinct from ``phase_only`` (-J), which phase-projects the
+    # CORRECTION applied to residuals after a full-Jones solve;
+    # --jones phase constrains the SOLVE itself
+    jones_mode: str = "full"
     # --dtype-policy : storage dtype for the [B]-proportional data
     # (visibilities, weights, staged residual tiles, Wirtinger
     # factors): "f32" (identity, bit-frozen default) | "bf16" | "f16".

@@ -193,6 +193,33 @@ KMELT_TOLERANCES = {
                            abs=80.0, better="lower"),
 }
 
+#: constrained-Jones melt tolerances (JONES_rNN.json, bench config
+#: 13-jones-melt — diag/phase solver paths that shrink the per-
+#: baseline Gram traffic 8x8 -> 2x2, ISSUE 20): the phase- and diag-
+#: mode bytes/trip RATIOS vs the full-Jones path under both kernels
+#: (the melt headline — a later round fattening a ratio is the
+#: reduced path silently re-densifying), plus two boolean gates the
+#: bench itself refuses to bank without: the constrained-truth
+#: residual envelope (diag/phase must still CONVERGE, within 5% of
+#: full's residual norm on a constrained truth) and full-mode bit-
+#: identity (jones_mode="full" must stay byte-identical to the
+#: pre-mode solver). Ratio slack is ABSOLUTE — the banked values sit
+#: near zero, so a relative slack would be meaningless.
+JONES_TOLERANCES = {
+    "jones_phase_bytes_xla": dict(field="phase_bytes_ratio_xla",
+                                  abs=0.05, better="lower"),
+    "jones_phase_bytes_pallas": dict(field="phase_bytes_ratio_pallas",
+                                     abs=0.05, better="lower"),
+    "jones_diag_bytes_xla": dict(field="diag_bytes_ratio_xla",
+                                 abs=0.05, better="lower"),
+    "jones_diag_bytes_pallas": dict(field="diag_bytes_ratio_pallas",
+                                    abs=0.05, better="lower"),
+    "jones_residual_envelope": dict(field="residual_envelope_met",
+                                    abs=0.0, better="higher"),
+    "jones_full_bit_identity": dict(field="full_mode_bit_identical",
+                                    abs=0.0, better="higher"),
+}
+
 
 def assert_table_contract(header: str) -> None:
     """Every toleranced metric with a named table column must find it
@@ -329,6 +356,12 @@ def load_warm_banks(platform: str, bank_dir: str = HERE):
     return load_banks(platform, bank_dir, pattern="WARM_r*.json")
 
 
+def load_jones_banks(platform: str, bank_dir: str = HERE):
+    """Round-stamped constrained-Jones melt records (JONES_rNN.json),
+    oldest first."""
+    return load_banks(platform, bank_dir, pattern="JONES_r*.json")
+
+
 def load_kmelt_banks(platform: str, bank_dir: str = HERE):
     """Round-stamped kernel-melt ladders (BSCALING_rNN.json), oldest
     first. BSCALING records predate :func:`bench.stamp_family` and are
@@ -442,6 +475,20 @@ def warm_cross_round_check(platform: str,
     the FLEET/MESH2D/SCALEOUT/STREAM families)."""
     return _family_cross_round_check(
         load_warm_banks(platform, bank_dir), WARM_TOLERANCES, "WARM")
+
+
+def jones_cross_round_check(platform: str,
+                            bank_dir: str = HERE) -> list:
+    """Newest constrained-Jones round vs the most recent earlier one,
+    judged against :data:`JONES_TOLERANCES` — a later round fattening
+    the diag/phase bytes-per-trip ratio under either kernel (the
+    reduced Gram path re-densifying), dropping the constrained-truth
+    residual envelope, or losing full-mode bit-identity fails CI with
+    the metric named (the ISSUE 20 satellite, mirroring the FLEET/
+    MESH2D/SCALEOUT/STREAM/WARM families)."""
+    return _family_cross_round_check(
+        load_jones_banks(platform, bank_dir), JONES_TOLERANCES,
+        "JONES")
 
 
 def kmelt_cross_round_check(platform: str,
@@ -716,6 +763,79 @@ def probe_kernel() -> list:
     return []
 
 
+def probe_jones() -> list:
+    """The constrained-Jones flag's zero-cost contract (ISSUE 20):
+    ``jones_mode`` selects between independently cached programs —
+    solving in "diag" and "phase" and returning to the DEFAULT "full"
+    path must add ZERO compiles (the mode is a clean static carried
+    in the LMConfig cache key, it never poisons the bit-frozen full
+    path's compile cache), and re-entering an already-executed
+    constrained mode must be cached too. Probed live because no bank
+    records compile counts; a regression here (the mode leaking into
+    a shared cache key by value, or a data-dependent dispatch) would
+    recompile every default solve the moment anyone tries a
+    constrained mode."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sagecal_tpu.diag import guard
+    from sagecal_tpu.solvers import lm as lm_mod
+
+    rng = np.random.default_rng(0)
+    N, T = 5, 4
+    p, q = np.triu_indices(N, k=1)
+    nb = len(p)
+    B = nb * T
+    s1 = jnp.asarray(np.tile(p, T).astype(np.int32))
+    s2 = jnp.asarray(np.tile(q, T).astype(np.int32))
+    cid = jnp.zeros((B,), jnp.int32)
+    coh = jnp.asarray(rng.normal(size=(B, 2, 2))
+                      + 1j * rng.normal(size=(B, 2, 2)), jnp.complex64)
+    x8 = jnp.asarray(rng.normal(size=(B, 8)), jnp.float32)
+    wt = jnp.ones((B, 8), jnp.float32)
+    J0 = jnp.tile(jnp.eye(2, dtype=jnp.complex64), (1, N, 1, 1))
+
+    @functools.partial(jax.jit, static_argnames=("jones",))
+    def _solve(x8, coh, s1, s2, cid, wt, J0, jones):
+        cfg = lm_mod.LMConfig(itmax=3, jones_mode=jones)
+        J, _ = lm_mod.lm_solve(x8, coh, s1, s2, cid, wt, J0, N,
+                               row_period=nb, config=cfg)
+        return J
+
+    def solve(jones):
+        return _solve(x8, coh, s1, s2, cid, wt, J0,
+                      jones=jones).block_until_ready()
+
+    solve("full")                              # warm the default path
+    # constrained modes (may compile): each is its own static program
+    solve("diag")
+    solve("phase")
+    with guard.CompileGuard() as g:
+        solve("full")                          # back to default: cached
+    if g.compiles:
+        return [{"config": "probe", "metric": "cache",
+                 "field": "compiles", "live": float(g.compiles),
+                 "banked": 0.0, "limit": 0.0, "source": "probe",
+                 "msg": (f"probe/jones: returning to jones_mode="
+                         f"'full' after diag+phase solves added "
+                         f"{g.compiles} compiles — the jones flag "
+                         "poisons the default path's compile cache")}]
+    with guard.CompileGuard() as g2:
+        solve("phase")        # re-entry: constrained mode stays cached
+    if g2.compiles:
+        return [{"config": "probe", "metric": "cache",
+                 "field": "compiles", "live": float(g2.compiles),
+                 "banked": 0.0, "limit": 0.0, "source": "probe",
+                 "msg": (f"probe/jones: re-entering the phase-mode "
+                         f"dispatch added {g2.compiles} compiles — a "
+                         "constrained mode does not cache as its own "
+                         "static program")}]
+    return []
+
+
 def _aliased_params(compiled) -> set:
     """Parameter indices the compiled executable's
     ``input_output_alias`` attribute names as donated-and-aliased.
@@ -874,7 +994,8 @@ def main(argv=None) -> int:
                 ld(plat, args.bank_dir) for ld in
                 (load_fleet_banks, load_mesh_banks,
                  load_scaleout_banks, load_stream_banks,
-                 load_warm_banks, load_kmelt_banks)):
+                 load_warm_banks, load_jones_banks,
+                 load_kmelt_banks)):
             continue
         checked_any = True
         if banks:
@@ -908,6 +1029,11 @@ def main(argv=None) -> int:
             print(f"sentinel: {plat} warm bank r{warm[-1][0]:02d} "
                   f"({len(warm)} rounds)")
             viol.extend(warm_cross_round_check(plat, args.bank_dir))
+        jn = load_jones_banks(plat, args.bank_dir)
+        if jn:
+            print(f"sentinel: {plat} jones bank r{jn[-1][0]:02d} "
+                  f"({len(jn)} rounds)")
+            viol.extend(jones_cross_round_check(plat, args.bank_dir))
         km = load_kmelt_banks(plat, args.bank_dir)
         if km:
             print(f"sentinel: {plat} kmelt bank r{km[-1][0]:02d} "
@@ -924,6 +1050,7 @@ def main(argv=None) -> int:
         viol.extend(probe_cache())
         viol.extend(probe_faults())
         viol.extend(probe_kernel())
+        viol.extend(probe_jones())
         viol.extend(probe_donation())
     if args.json:
         print(json.dumps(viol, indent=1))

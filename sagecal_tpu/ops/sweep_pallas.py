@@ -107,13 +107,13 @@ class GNBlocks(NamedTuple):
     at the current point — the ``kernel='pallas'`` analogue of
     normal_eq.GNFactors. All leaves accumulate in the acc dtype.
 
-    pp: [K, nb, 2, 4, 4] station-p diagonal sub-blocks (block-diag over
-        the first complex index — the dense [8, 8] station block is
-        I2 (x) pp);
-    qq: [K, nb, 2, 4, 4] station-q diagonal sub-blocks;
-    pq: [K, nb, 2, 2, 4, 4] station-pair cross blocks (row (a, i), col
-        (o, j) of the dense [8, 8] off-diagonal block);
-    D:  [K, N, 2, 4, 4] station-aggregated diagonal blocks (the exact
+    pp: [K, nb, 2, md, md] station-p diagonal sub-blocks (block-diag
+        over the first complex index — the dense [2md, 2md] station
+        block is I2 (x) pp); md = 4/2/1 per jones mode full/diag/phase;
+    qq: [K, nb, 2, md, md] station-q diagonal sub-blocks;
+    pq: [K, nb, 2, 2, md, md] station-pair cross blocks (row (a, i),
+        col (o, j) of the dense off-diagonal block);
+    D:  [K, N, 2, md, md] station-aggregated diagonal blocks (the exact
         preconditioner / mu0 seed — identical quantity to GNFactors.D).
     """
 
@@ -169,17 +169,24 @@ def _mb_entry(a, ri, d, ci):
 
 
 def _sweep_body(x, w, cw, chre, chim, jpr, jpi, jqr, jqi, *, acc,
-                reduced, st):
+                reduced, st, jones="full"):
     """The fused sweep's per-cell math, shared by the per-visit kernel
     (:func:`_sweep_kernel`) and the multi-visit K-major kernel
     (:func:`_visits_kernel`).
 
     Inputs: x/w/cw [bt, nb, 8] in acc (weights already chunk-masked);
     chre/chim [bt, nb, 2, 2]; jpr/jpi/jqr/jqi [nb, 2, 2]. Returns the
-    time-contracted per-baseline partials (pp [2, 4, 4, nb],
-    qq [2, 4, 4, nb], pq [2, 2, 4, 4, nb], jte [2, 2, 4, nb] side
+    time-contracted per-baseline partials (pp [2, md, md, nb],
+    qq [2, md, md, nb], pq [2, 2, md, md, nb], jte [2, 2, md, nb] side
     p/q first, cost [nb]) — elementwise the same accumulation chains
     the pre-refactor kernel wrote per (a, i, j), just stacked.
+
+    ``jones`` (static) picks the constrained-Jones factor algebra at
+    TRACE time: md = 4 (full — the factor lookup reduces to the exact
+    MA/MB alias tables, so the emitted chain is unchanged), 2 (diag) or
+    1 (phase). No runtime branch: the mode only changes which +/-
+    aliases of the A/Bm (and Jones-rotated, for phase) planes the
+    unrolled loops read and how far the block indices range.
     """
     Cr = _cplx_mats(chre, "C")                  # [bt, nb] planes
     Ci = _cplx_mats(chim, "C")
@@ -231,13 +238,55 @@ def _sweep_body(x, w, cw, chre, chim, jpr, jpi, jqr, jqi, *, acc,
     fB.update({("i", i, j): q(Bi[("Z", i, j)]) for i in range(2)
                for j in range(2)})
 
-    def MA(o, ri, jcol):
-        s, part, i_, j_ = _ma_entry(o, ri, jcol // 2, jcol % 2)
-        return s, fA[(part, i_, j_)]
+    md = {"full": 4, "diag": 2, "phase": 1}[jones]
+    if jones == "full":
+        # exact MA/MB alias tables (normal_eq._ma_factor/_mb_factor);
+        # the station-diagonal index c is vacuous (FA is c-independent
+        # in full mode), so the emitted chain matches the pre-mode
+        # kernel term for term
+        def FAf(c, o, ri, m):
+            s, part, i_, j_ = _ma_entry(o, ri, m // 2, m % 2)
+            return s, fA[(part, i_, j_)]
 
-    def MB(a, ri, jcol):
-        s, part, i_, j_ = _mb_entry(a, ri, jcol // 2, jcol % 2)
-        return s, fB[(part, i_, j_)]
+        def FBf(c, a, ri, m):
+            s, part, i_, j_ = _mb_entry(a, ri, m // 2, m % 2)
+            return s, fB[(part, i_, j_)]
+    elif jones == "diag":
+        # d == c planes of the same tables: params (Re, Im) of j_cc
+        def FAf(c, o, ri, m):
+            s, part, i_, j_ = _ma_entry(o, ri, c, m)
+            return s, fA[(part, i_, j_)]
+
+        def FBf(c, a, ri, m):
+            s, part, i_, j_ = _mb_entry(a, ri, c, m)
+            return s, fB[(part, i_, j_)]
+    else:
+        # phase: FA from u = i Jp_cc A[c, o], FB from -i conj(Jq_cc)
+        # B[a, c] — Jones-rotated planes built from the UNQUANTIZED
+        # A/Bm planes then rounded at the same storage boundary as the
+        # XLA mode path (normal_eq._mode_factors + to_storage)
+        fAp, fBp = {}, {}
+        for c in range(2):
+            for o in range(2):
+                ur = (jpr[..., c, c] * Ar[("Z", c, o)]
+                      - jpi[..., c, c] * Ai[("Z", c, o)])
+                ui = (jpr[..., c, c] * Ai[("Z", c, o)]
+                      + jpi[..., c, c] * Ar[("Z", c, o)])
+                fAp[(c, o, 0)] = q(-ui)           # ri = Re
+                fAp[(c, o, 1)] = q(ur)            # ri = Im
+            for a in range(2):
+                wr = (jqr[..., c, c] * Br[("Z", a, c)]
+                      + jqi[..., c, c] * Bi[("Z", a, c)])
+                wi = (jqr[..., c, c] * Bi[("Z", a, c)]
+                      - jqi[..., c, c] * Br[("Z", a, c)])
+                fBp[(c, a, 0)] = q(wi)            # ri = Re
+                fBp[(c, a, 1)] = q(-wr)           # ri = Im
+
+        def FAf(c, o, ri, m):
+            return 1.0, fAp[(c, o, ri)]
+
+        def FBf(c, a, ri, m):
+            return 1.0, fBp[(c, a, ri)]
 
     # residual planes r[a][o][ri] (x is storage-exact in acc; the model
     # quantizes at q) and the weight planes
@@ -258,68 +307,71 @@ def _sweep_body(x, w, cw, chre, chim, jpr, jpi, jqr, jqi, *, acc,
     def tsum(p):                                # [bt, nb] -> [nb]
         return jnp.sum(p, axis=0)
 
-    # per-baseline Gram/gradient partials, signs folded at trace time
+    # per-baseline Gram/gradient partials, signs folded at trace time.
+    # Loops range over the mode's block width md; under full the FAf/FBf
+    # lookups alias MA/MB exactly, so the a/o names below ARE the old
+    # complex row/col indices and the chain is unchanged.
     pp_rows = []
     for a in range(2):
         rows = []
-        for i in range(4):
+        for i in range(md):
             cols = []
-            for j in range(4):
+            for j in range(md):
                 accu = None
                 for o in range(2):
                     for ri in range(2):
-                        si, mi = MA(o, ri, i)
-                        sj, mj = MA(o, ri, j)
+                        si, mi = FAf(a, o, ri, i)
+                        sj, mj = FAf(a, o, ri, j)
                         t = (si * sj) * (w2[(a, o, ri)] * mi * mj)
                         accu = t if accu is None else accu + t
                 cols.append(tsum(accu))
             rows.append(jnp.stack(cols))
         pp_rows.append(jnp.stack(rows))
-    pp = jnp.stack(pp_rows)                     # [2, 4, 4, nb]
+    pp = jnp.stack(pp_rows)                     # [2, md, md, nb]
     qq_rows = []
     for o in range(2):
         rows = []
-        for i in range(4):
+        for i in range(md):
             cols = []
-            for j in range(4):
+            for j in range(md):
                 accu = None
                 for a in range(2):
                     for ri in range(2):
-                        si, mi = MB(a, ri, i)
-                        sj, mj = MB(a, ri, j)
+                        si, mi = FBf(o, a, ri, i)
+                        sj, mj = FBf(o, a, ri, j)
                         t = (si * sj) * (w2[(a, o, ri)] * mi * mj)
                         accu = t if accu is None else accu + t
                 cols.append(tsum(accu))
             rows.append(jnp.stack(cols))
         qq_rows.append(jnp.stack(rows))
-    qq = jnp.stack(qq_rows)                     # [2, 4, 4, nb]
+    qq = jnp.stack(qq_rows)                     # [2, md, md, nb]
     pq_outer = []
     for a in range(2):
         pq_inner = []
         for o in range(2):
             rows = []
-            for i in range(4):
+            for i in range(md):
                 cols = []
-                for j in range(4):
+                for j in range(md):
                     accu = None
                     for ri in range(2):
-                        si, mi = MA(o, ri, i)
-                        sj, mj = MB(a, ri, j)
+                        si, mi = FAf(a, o, ri, i)
+                        sj, mj = FBf(o, a, ri, j)
                         t = (si * sj) * (w2[(a, o, ri)] * mi * mj)
                         accu = t if accu is None else accu + t
                     cols.append(tsum(accu))
                 rows.append(jnp.stack(cols))
             pq_inner.append(jnp.stack(rows))
         pq_outer.append(jnp.stack(pq_inner))
-    pq = jnp.stack(pq_outer)                    # [2, 2, 4, 4, nb]
+    pq = jnp.stack(pq_outer)                    # [2, 2, md, md, nb]
     jp_rows = []
     for a in range(2):
         cols = []
-        for i in range(4):
+        for i in range(md):
             accu = None
             for o in range(2):
                 for ri in range(2):
-                    si, mi = MA(o, ri, i)
+                    si, mi = FAf(a, o, ri, i)
                     t = si * (rw2[(a, o, ri)] * mi)
                     accu = t if accu is None else accu + t
             cols.append(tsum(accu))
@@ -327,11 +379,11 @@ def _sweep_body(x, w, cw, chre, chim, jpr, jpi, jqr, jqi, *, acc,
     jq_rows = []
     for o in range(2):
         cols = []
-        for i in range(4):
+        for i in range(md):
             accu = None
             for a in range(2):
                 for ri in range(2):
-                    si, mi = MB(a, ri, i)
+                    si, mi = FBf(o, a, ri, i)
                     t = si * (rw2[(a, o, ri)] * mi)
                     accu = t if accu is None else accu + t
             cols.append(tsum(accu))
@@ -343,7 +395,7 @@ def _sweep_body(x, w, cw, chre, chim, jpr, jpi, jqr, jqi, *, acc,
 def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
                   jpr_ref, jpi_ref, jqr_ref, jqi_ref, pp_ref, qq_ref,
                   pq_ref, jte_ref, cost_ref, *, acc, reduced, st,
-                  kmax):
+                  kmax, jones="full"):
     """One (chunk, time-block) grid cell of the fused sweep.
 
     Refs: x/w/cw [bt, nb, 8] storage; cid [bt, nb] int32 (row chunk
@@ -375,7 +427,8 @@ def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
         cw = cw * mk[..., None]
     pp, qq, pq, jte, cost = _sweep_body(
         x, w, cw, chr_ref[...], chi_ref[...], jpr_ref[0], jpi_ref[0],
-        jqr_ref[0], jqi_ref[0], acc=acc, reduced=reduced, st=st)
+        jqr_ref[0], jqi_ref[0], acc=acc, reduced=reduced, st=st,
+        jones=jones)
     pp_ref[0] += pp
     qq_ref[0] += qq
     pq_ref[0] += pq
@@ -386,7 +439,7 @@ def _sweep_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
 def _visits_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
                    jpr_ref, jpi_ref, jqr_ref, jqi_ref, pp_ref, qq_ref,
                    pq_ref, jte_ref, cost_ref, *, acc, reduced, st,
-                   kmax):
+                   kmax, jones="full"):
     """One (time-block, visit*chunk) grid cell of the MULTI-VISIT
     K-major sweep: V cluster visits share one grid so the per-call
     floor (and any row operand the visits share — weights, cost
@@ -414,7 +467,8 @@ def _visits_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
         cw = cw * mk[..., None]
     pp, qq, pq, jte, cost = _sweep_body(
         x, w, cw, chr_ref[0], chi_ref[0], jpr_ref[0, 0], jpi_ref[0, 0],
-        jqr_ref[0, 0], jqi_ref[0, 0], acc=acc, reduced=reduced, st=st)
+        jqr_ref[0, 0], jqi_ref[0, 0], acc=acc, reduced=reduced, st=st,
+        jones=jones)
     pp_ref[0, 0] = pp
     qq_ref[0, 0] = qq
     pq_ref[0, 0] = pq
@@ -423,10 +477,11 @@ def _visits_kernel(x_ref, w_ref, cw_ref, cid_ref, chr_ref, chi_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("row_period", "kmax",
-                                             "block_t", "interpret"))
+                                             "block_t", "interpret",
+                                             "jones"))
 def sweep_blocks(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
                  row_period: int, kmax: int, block_t: int = 0,
-                 interpret: bool | None = None):
+                 interpret: bool | None = None, jones: str = "full"):
     """The fused cluster-visit pass: per-(chunk, baseline) Gram blocks,
     gradient partials and the acceptance cost from one streaming
     [B]-pass per chunk.
@@ -434,11 +489,16 @@ def sweep_blocks(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
     x8/wt/cost_wt: [B, 8] (storage dtype; ``cost_wt`` may equal
     ``wt``); J: [K, N, 2, 2] complex; coh: [B, 2, 2] complex;
     sta1/sta2/chunk_id: [B] (baseline-periodic stations — only the
-    first ``row_period`` entries are used). Returns
-    (pp [K, nb, 2, 4, 4], qq [K, nb, 2, 4, 4], pq [K, nb, 2, 2, 4, 4],
-    jtep [K, nb, 2, 4], jteq [K, nb, 2, 4], cost [K]), all in the acc
-    dtype of the data.
+    first ``row_period`` entries are used). ``jones`` (static) selects
+    the constrained parameterization (normal_eq.JONES_MODES): the block
+    trailing dims shrink 4 -> md (diag 2, phase 1) at trace time.
+    Returns (pp [K, nb, 2, md, md], qq [K, nb, 2, md, md],
+    pq [K, nb, 2, 2, md, md], jtep [K, nb, 2, md], jteq [K, nb, 2, md],
+    cost [K]), all in the acc dtype of the data.
     """
+    md = {"full": 4, "diag": 2, "phase": 1}[jones]
+    if jones != "full":
+        J = J * jnp.eye(2, dtype=J.real.dtype)
     B = x8.shape[0]
     nb = int(row_period)
     T = B // nb
@@ -465,12 +525,13 @@ def sweep_blocks(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
     def kernel(*refs):
         # plain def (not functools.partial) so jaxlint's traced-body
         # closure follows pallas_call -> kernel -> _sweep_kernel
-        _sweep_kernel(*refs, acc=acc, reduced=reduced, st=st, kmax=K)
+        _sweep_kernel(*refs, acc=acc, reduced=reduced, st=st, kmax=K,
+                      jones=jones)
     n_flops = SWEEP_FLOPS_PER_ROW * B * 8 * K
     n_bytes = int(K * (3 * B * 8 * jnp.dtype(st).itemsize
                        + 2 * B * 4 * jnp.dtype(acc).itemsize)
-                  + K * (2 * 32 + 64 + 16 + 1) * nb
-                  * jnp.dtype(acc).itemsize)
+                  + K * (2 * (2 * md * md) + 4 * md * md + 4 * md + 1)
+                  * nb * jnp.dtype(acc).itemsize)
     pp, qq, pq, jte, cost = pl.pallas_call(
         kernel,
         grid=grid,
@@ -478,18 +539,21 @@ def sweep_blocks(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
                   coh_spec, jones_spec, jones_spec, jones_spec,
                   jones_spec],
         out_specs=[
-            pl.BlockSpec((1, 2, 4, 4, nb), lambda k, t: (k, 0, 0, 0, 0)),
-            pl.BlockSpec((1, 2, 4, 4, nb), lambda k, t: (k, 0, 0, 0, 0)),
-            pl.BlockSpec((1, 2, 2, 4, 4, nb),
+            pl.BlockSpec((1, 2, md, md, nb),
+                         lambda k, t: (k, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 2, md, md, nb),
+                         lambda k, t: (k, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 2, 2, md, md, nb),
                          lambda k, t: (k, 0, 0, 0, 0, 0)),
-            pl.BlockSpec((1, 2, 2, 4, nb), lambda k, t: (k, 0, 0, 0, 0)),
+            pl.BlockSpec((1, 2, 2, md, nb),
+                         lambda k, t: (k, 0, 0, 0, 0)),
             pl.BlockSpec((1, nb), lambda k, t: (k, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((K, 2, 4, 4, nb), acc),
-            jax.ShapeDtypeStruct((K, 2, 4, 4, nb), acc),
-            jax.ShapeDtypeStruct((K, 2, 2, 4, 4, nb), acc),
-            jax.ShapeDtypeStruct((K, 2, 2, 4, nb), acc),
+            jax.ShapeDtypeStruct((K, 2, md, md, nb), acc),
+            jax.ShapeDtypeStruct((K, 2, md, md, nb), acc),
+            jax.ShapeDtypeStruct((K, 2, 2, md, md, nb), acc),
+            jax.ShapeDtypeStruct((K, 2, 2, md, nb), acc),
             jax.ShapeDtypeStruct((K, nb), acc),
         ],
         cost_estimate=pl.CostEstimate(flops=n_flops,
@@ -503,21 +567,23 @@ def sweep_blocks(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
       Jp.real.astype(acc), Jp.imag.astype(acc),
       Jq.real.astype(acc), Jq.imag.astype(acc))
     # [K, .., nb] -> [K, nb, ..] caller layouts (all [nbase]-sized)
-    pp = jnp.moveaxis(pp, -1, 1)                # [K, nb, 2, 4, 4]
+    pp = jnp.moveaxis(pp, -1, 1)                # [K, nb, 2, md, md]
     qq = jnp.moveaxis(qq, -1, 1)
-    pq = jnp.moveaxis(pq, -1, 1)                # [K, nb, 2, 2, 4, 4]
-    jtep = jnp.moveaxis(jte[:, 0], -1, 1)       # [K, nb, 2, 4]
+    pq = jnp.moveaxis(pq, -1, 1)                # [K, nb, 2, 2, md, md]
+    jtep = jnp.moveaxis(jte[:, 0], -1, 1)       # [K, nb, 2, md]
     jteq = jnp.moveaxis(jte[:, 1], -1, 1)
     return pp, qq, pq, jtep, jteq, jnp.sum(cost, axis=-1)
 
 
 @functools.partial(jax.jit, static_argnames=("row_period", "kmax",
                                              "vsize", "batched",
-                                             "block_t", "interpret"))
+                                             "block_t", "interpret",
+                                             "jones"))
 def sweep_blocks_visits(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
                         row_period: int, kmax: int, vsize: int,
                         batched: tuple, block_t: int = 0,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None,
+                        jones: str = "full"):
     """V cluster visits in ONE K-major grid: the multi-cluster schedule
     that amortizes the per-visit pallas_call floor (and every SHARED
     row operand's traffic) across directions.
@@ -536,6 +602,9 @@ def sweep_blocks_visits(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
     axis on every output.
     """
     xb, jb, cb, cidb, wb, cwb = batched
+    md = {"full": 4, "diag": 2, "phase": 1}[jones]
+    if jones != "full":
+        J = J * jnp.eye(2, dtype=J.real.dtype)
     V = int(vsize)
     B = x8.shape[-2]
     nb = int(row_period)
@@ -587,14 +656,16 @@ def sweep_blocks_visits(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
         return a.reshape(((V,) if b else (1,)) + (K, nb, 2, 2))
 
     def kernel(*refs):
-        _visits_kernel(*refs, acc=acc, reduced=reduced, st=st, kmax=K)
+        _visits_kernel(*refs, acc=acc, reduced=reduced, st=st, kmax=K,
+                       jones=jones)
 
     nt = T // bt
     n_flops = SWEEP_FLOPS_PER_ROW * B * 8 * K * V
     n_bytes = int(K * V * (3 * B * 8 * jnp.dtype(st).itemsize
                            + 2 * B * 4 * jnp.dtype(acc).itemsize)
-                  + nt * K * V * (2 * 32 + 64 + 16 + 1) * nb
-                  * jnp.dtype(acc).itemsize)
+                  + nt * K * V
+                  * (2 * (2 * md * md) + 4 * md * md + 4 * md + 1)
+                  * nb * jnp.dtype(acc).itemsize)
     pp, qq, pq, jte, cost = pl.pallas_call(
         kernel,
         grid=grid,
@@ -602,21 +673,21 @@ def sweep_blocks_visits(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
                   coh_spec(cb), coh_spec(cb), jones_spec, jones_spec,
                   jones_spec, jones_spec],
         out_specs=[
-            pl.BlockSpec((1, 1, 2, 4, 4, nb),
+            pl.BlockSpec((1, 1, 2, md, md, nb),
                          lambda t, vk: (t, vk, 0, 0, 0, 0)),
-            pl.BlockSpec((1, 1, 2, 4, 4, nb),
+            pl.BlockSpec((1, 1, 2, md, md, nb),
                          lambda t, vk: (t, vk, 0, 0, 0, 0)),
-            pl.BlockSpec((1, 1, 2, 2, 4, 4, nb),
+            pl.BlockSpec((1, 1, 2, 2, md, md, nb),
                          lambda t, vk: (t, vk, 0, 0, 0, 0, 0)),
-            pl.BlockSpec((1, 1, 2, 2, 4, nb),
+            pl.BlockSpec((1, 1, 2, 2, md, nb),
                          lambda t, vk: (t, vk, 0, 0, 0, 0)),
             pl.BlockSpec((1, 1, nb), lambda t, vk: (t, vk, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((nt, V * K, 2, 4, 4, nb), acc),
-            jax.ShapeDtypeStruct((nt, V * K, 2, 4, 4, nb), acc),
-            jax.ShapeDtypeStruct((nt, V * K, 2, 2, 4, 4, nb), acc),
-            jax.ShapeDtypeStruct((nt, V * K, 2, 2, 4, nb), acc),
+            jax.ShapeDtypeStruct((nt, V * K, 2, md, md, nb), acc),
+            jax.ShapeDtypeStruct((nt, V * K, 2, md, md, nb), acc),
+            jax.ShapeDtypeStruct((nt, V * K, 2, 2, md, md, nb), acc),
+            jax.ShapeDtypeStruct((nt, V * K, 2, 2, md, nb), acc),
             jax.ShapeDtypeStruct((nt, V * K, nb), acc),
         ],
         cost_estimate=pl.CostEstimate(flops=n_flops,
@@ -636,17 +707,17 @@ def sweep_blocks_visits(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt,
     pq = jnp.sum(pq, axis=0).reshape((V, K) + pq.shape[2:])
     jte = jnp.sum(jte, axis=0).reshape((V, K) + jte.shape[2:])
     cost = jnp.sum(cost, axis=0).reshape(V, K, nb)
-    pp = jnp.moveaxis(pp, -1, 2)                # [V, K, nb, 2, 4, 4]
+    pp = jnp.moveaxis(pp, -1, 2)                # [V, K, nb, 2, md, md]
     qq = jnp.moveaxis(qq, -1, 2)
-    pq = jnp.moveaxis(pq, -1, 2)                # [V, K, nb, 2, 2, 4, 4]
-    jtep = jnp.moveaxis(jte[:, :, 0], -1, 2)    # [V, K, nb, 2, 4]
+    pq = jnp.moveaxis(pq, -1, 2)                # [V, K, nb, 2, 2, md, md]
+    jtep = jnp.moveaxis(jte[:, :, 0], -1, 2)    # [V, K, nb, 2, md]
     jteq = jnp.moveaxis(jte[:, :, 1], -1, 2)
     return pp, qq, pq, jtep, jteq, jnp.sum(cost, axis=-1)
 
 
 @functools.lru_cache(maxsize=None)
 def _sweep_vmappable(row_period: int, kmax: int, block_t: int,
-                     interpret):
+                     interpret, jones: str = "full"):
     """:func:`sweep_blocks` wrapped in jax.custom_batching.custom_vmap,
     specialized per static signature (cached so repeated traces reuse
     one callable — custom_vmap identity is object identity).
@@ -666,7 +737,7 @@ def _sweep_vmappable(row_period: int, kmax: int, block_t: int,
     def fn(x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt):
         return sweep_blocks(x8, J, coh, sta1, sta2, chunk_id, wt,
                             cost_wt, row_period, kmax, block_t=block_t,
-                            interpret=interpret)
+                            interpret=interpret, jones=jones)
 
     @fn.def_vmap
     def _rule(axis_size, in_batched, x8, J, coh, sta1, sta2, chunk_id,
@@ -686,7 +757,7 @@ def _sweep_vmappable(row_period: int, kmax: int, block_t: int,
         outs = sweep_blocks_visits(
             x8, J, coh, sta1, sta2, chunk_id, wt, cost_wt, row_period,
             kmax, axis_size, (xb, jb, cb, cidb, wb, cwb),
-            block_t=block_t, interpret=interpret)
+            block_t=block_t, interpret=interpret, jones=jones)
         return outs, out_b
 
     return fn
@@ -694,40 +765,44 @@ def _sweep_vmappable(row_period: int, kmax: int, block_t: int,
 
 def _sweep_dispatch(x8, J, coh, sta1, sta2, chunk_id, wt, cw,
                     row_period: int, kmax: int, block_t: int,
-                    interpret):
+                    interpret, jones: str = "full"):
     """The wrapper entry both operator assemblies route through: plain
     sweep_blocks semantics outside vmap, the K-major multi-visit grid
     under it (see :func:`_sweep_vmappable`)."""
     return _sweep_vmappable(int(row_period), int(kmax), int(block_t),
-                            interpret)(x8, J, coh, sta1, sta2, chunk_id,
-                                       wt, cw)
+                            interpret, str(jones))(
+        x8, J, coh, sta1, sta2, chunk_id, wt, cw)
 
 
 def _station_aggregates(pp, qq, jtep, jteq, s1b, s2b, N: int):
-    """(D [K, N, 2, 4, 4], JTe [K, 8N]) from the per-baseline partials —
-    the [nbase]-sized scatter shared by the dense and matrix-free
-    wrappers (identical structure to normal_eq's station aggregation)."""
+    """(D [K, N, 2, md, md], JTe [K, 2*md*N]) from the per-baseline
+    partials — the [nbase]-sized scatter shared by the dense and
+    matrix-free wrappers (identical structure to normal_eq's station
+    aggregation). md is read off the block shapes (4/2/1 per jones
+    mode)."""
     K = pp.shape[0]
+    md = pp.shape[-1]
     acc = pp.dtype
-    D = jnp.zeros((K, N, 2, 4, 4), acc)
+    D = jnp.zeros((K, N, 2, md, md), acc)
     D = D.at[:, s1b].add(pp).at[:, s2b].add(qq)
-    JTe = jnp.zeros((K, N, 2, 4), acc)
+    JTe = jnp.zeros((K, N, 2, md), acc)
     JTe = JTe.at[:, s1b].add(jtep).at[:, s2b].add(jteq)
-    return D, JTe.reshape(K, 8 * N)
+    return D, JTe.reshape(K, 2 * md * N)
 
 
 def gn_blocks(x8, J, coh, sta1, sta2, chunk_id, wt, n_stations: int,
               kmax: int, row_period: int, cost_wt=None, block_t: int = 0,
-              interpret: bool | None = None):
+              interpret: bool | None = None, jones: str = "full"):
     """Matrix-free operator assembly under ``kernel='pallas'``: the
     fused sweep's per-baseline Gram blocks become the PCG/tCG operator
     (:class:`GNBlocks`), plus (JTe [K, 8N], cost [K]) — the same
     contract as normal_eq.gn_factors, with the [B]-pass fused and the
-    carried operator B-INDEPENDENT ([K, nbase]-sized)."""
+    carried operator B-INDEPENDENT ([K, nbase]-sized). ``jones``
+    specializes the blocks per constrained mode (JTe is [K, 2*md*N])."""
     cw = wt if cost_wt is None else cost_wt
     pp, qq, pq, jtep, jteq, cost = _sweep_dispatch(
         x8, J, coh, sta1, sta2, chunk_id, wt, cw, row_period, kmax,
-        block_t, interpret)
+        block_t, interpret, jones)
     nb = int(row_period)
     s1b, s2b = sta1[:nb], sta2[:nb]
     D, JTe = _station_aggregates(pp, qq, jtep, jteq, s1b, s2b,
@@ -742,31 +817,36 @@ def _assemble_damped(fac: GNBlocks, shift, sta1, sta2,
     dense wrapper (:func:`normal_equations_fused`, ``shift=None``) and
     the fused-Cholesky solve stage (:func:`chol_solve_blocks_shift`).
 
-    ``shift`` (None or [K]) folds into the [K, N, 2, 4, 4] station
-    diagonals BEFORE the 8x8 expansion: the assembled matrix's
+    ``shift`` (None or [K]) folds into the [K, N, 2, md, md] station
+    diagonals BEFORE the dense (2*md)x(2*md) expansion: the assembled matrix's
     diagonal lives entirely in D (pq couples distinct stations only),
     so this is elementwise identical to ``JTJ + shift * I`` on the
     dense matrix while skipping the [K, 8N, 8N] eye-add pass the
     dense carry used to pay per damping trip."""
     K, nb = fac.pp.shape[0], fac.pp.shape[1]
+    md = fac.pp.shape[-1]
+    npar = 2 * md
     N = n_stations
     acc = fac.pp.dtype
     s1b, s2b = sta1[:nb], sta2[:nb]
     D = fac.D
     if shift is not None:
-        eye4 = jnp.eye(4, dtype=acc)
-        D = D + shift[:, None, None, None, None] * eye4
+        eyem = jnp.eye(md, dtype=acc)
+        D = D + shift[:, None, None, None, None] * eyem
     eye2 = jnp.eye(2, dtype=acc)
-    Dfull = jnp.einsum("knaij,ab->knaibj", D, eye2).reshape(K, N, 8, 8)
-    pq8 = jnp.transpose(fac.pq, (0, 1, 2, 4, 3, 5)).reshape(K, nb, 8, 8)
-    pq8T = jnp.transpose(fac.pq, (0, 1, 3, 5, 2, 4)).reshape(K, nb, 8, 8)
+    Dfull = jnp.einsum("knaij,ab->knaibj", D,
+                       eye2).reshape(K, N, npar, npar)
+    pq8 = jnp.transpose(fac.pq,
+                        (0, 1, 2, 4, 3, 5)).reshape(K, nb, npar, npar)
+    pq8T = jnp.transpose(fac.pq,
+                         (0, 1, 3, 5, 2, 4)).reshape(K, nb, npar, npar)
     idx = jnp.arange(N, dtype=sta1.dtype)
-    JTJ = jnp.zeros((K, N, 8, N, 8), acc)
+    JTJ = jnp.zeros((K, N, npar, N, npar), acc)
     for k in range(K):                          # K <= MAX_CHUNKS, static
         JTJ = JTJ.at[k, s1b, :, s2b, :].add(pq8[k])
         JTJ = JTJ.at[k, s2b, :, s1b, :].add(pq8T[k])
     JTJ = JTJ.at[:, idx, :, idx, :].add(jnp.swapaxes(Dfull, 0, 1))
-    return JTJ.reshape(K, 8 * N, 8 * N)
+    return JTJ.reshape(K, npar * N, npar * N)
 
 
 def chol_solve_blocks_shift(fac: GNBlocks, JTe, shift, sta1, sta2,
@@ -842,7 +922,8 @@ def solve_damped_blocks(fac: GNBlocks, JTe, mu, jitter, sta1, sta2,
 def normal_equations_fused(x8, J, coh, sta1, sta2, chunk_id, wt,
                            n_stations: int, kmax: int, row_period: int,
                            cost_wt=None, block_t: int = 0,
-                           interpret: bool | None = None):
+                           interpret: bool | None = None,
+                           jones: str = "full"):
     """Dense-path analogue of normal_eq.normal_equations under
     ``kernel='pallas'``: the fused sweep produces the per-baseline
     blocks in one [B]-pass per chunk; the dense [K, 8N, 8N] expansion
@@ -854,7 +935,7 @@ def normal_equations_fused(x8, J, coh, sta1, sta2, chunk_id, wt,
     cw = wt if cost_wt is None else cost_wt
     pp, qq, pq, jtep, jteq, cost = _sweep_dispatch(
         x8, J, coh, sta1, sta2, chunk_id, wt, cw, row_period, kmax,
-        block_t, interpret)
+        block_t, interpret, jones)
     nb = int(row_period)
     s1b, s2b = sta1[:nb], sta2[:nb]
     D, JTe = _station_aggregates(pp, qq, jtep, jteq, s1b, s2b, N)
@@ -865,8 +946,9 @@ def normal_equations_fused(x8, J, coh, sta1, sta2, chunk_id, wt,
 def _matvec_kernel(pp_ref, qq_ref, pq_ref, vp_ref, vq_ref, yp_ref,
                    yq_ref):
     """One VMEM-resident blocks matvec (per chunk grid cell): inputs
-    pp/qq [1, 2, 4, 4, nb], pq [1, 2, 2, 4, 4, nb], vp/vq [1, 2, 4, nb];
-    outputs yp/yq [1, 2, 4, nb].
+    pp/qq [1, 2, md, md, nb], pq [1, 2, 2, md, md, nb], vp/vq
+    [1, 2, md, nb]; outputs yp/yq [1, 2, md, nb] (md unrolled at
+    trace time from the ref block shapes).
 
     yp[a, i] = sum_j pp[a, i, j] vp[a, j]
              + sum_{o, j} pq[a, o, i, j] vq[o, j]
@@ -879,24 +961,25 @@ def _matvec_kernel(pp_ref, qq_ref, pq_ref, vp_ref, vq_ref, yp_ref,
     pq = pq_ref[0]
     vp = vp_ref[0]
     vq = vq_ref[0]
+    md = pp_ref.shape[2]
     for a in range(2):
-        for i in range(4):
+        for i in range(md):
             accu = None
-            for j in range(4):
+            for j in range(md):
                 t = pp[a, i, j, :] * vp[a, j, :]
                 accu = t if accu is None else accu + t
             for o in range(2):
-                for j in range(4):
+                for j in range(md):
                     accu = accu + pq[a, o, i, j, :] * vq[o, j, :]
             yp_ref[0, a, i, :] = accu
     for o in range(2):
-        for j in range(4):
+        for j in range(md):
             accu = None
-            for i in range(4):
+            for i in range(md):
                 t = qq[o, j, i, :] * vq[o, i, :]
                 accu = t if accu is None else accu + t
             for a in range(2):
-                for i in range(4):
+                for i in range(md):
                     accu = accu + pq[a, o, i, j, :] * vp[a, i, :]
             yq_ref[0, o, j, :] = accu
 
@@ -906,33 +989,34 @@ def _matvec_blocks_jit(pp, qq, pq, v, s1b, s2b, n_stations: int,
                        interpret: bool):
     N = n_stations
     K, nb = pp.shape[0], pp.shape[1]
+    md = pp.shape[-1]
     acc = pp.dtype
-    vr = v.reshape(K, N, 2, 4).astype(acc)
-    vp = jnp.moveaxis(jnp.take(vr, s1b, axis=1), 1, -1)  # [K, 2, 4, nb]
+    vr = v.reshape(K, N, 2, md).astype(acc)
+    vp = jnp.moveaxis(jnp.take(vr, s1b, axis=1), 1, -1)  # [K, 2, md, nb]
     vq = jnp.moveaxis(jnp.take(vr, s2b, axis=1), 1, -1)
-    spec_g = pl.BlockSpec((1, 2, 4, 4, nb), lambda k: (k, 0, 0, 0, 0))
-    spec_x = pl.BlockSpec((1, 2, 2, 4, 4, nb),
+    spec_g = pl.BlockSpec((1, 2, md, md, nb), lambda k: (k, 0, 0, 0, 0))
+    spec_x = pl.BlockSpec((1, 2, 2, md, md, nb),
                           lambda k: (k, 0, 0, 0, 0, 0))
-    spec_v = pl.BlockSpec((1, 2, 4, nb), lambda k: (k, 0, 0, 0))
-    n_bytes = int(K * (2 * 32 + 64 + 4 * 8) * nb
-                  * jnp.dtype(acc).itemsize)
+    spec_v = pl.BlockSpec((1, 2, md, nb), lambda k: (k, 0, 0, 0))
+    n_bytes = int(K * (2 * (2 * md * md) + 4 * md * md + 4 * (2 * md))
+                  * nb * jnp.dtype(acc).itemsize)
     yp, yq = pl.pallas_call(
         _matvec_kernel,
         grid=(K,),
         in_specs=[spec_g, spec_g, spec_x, spec_v, spec_v],
         out_specs=[spec_v, spec_v],
-        out_shape=[jax.ShapeDtypeStruct((K, 2, 4, nb), acc),
-                   jax.ShapeDtypeStruct((K, 2, 4, nb), acc)],
+        out_shape=[jax.ShapeDtypeStruct((K, 2, md, nb), acc),
+                   jax.ShapeDtypeStruct((K, 2, md, nb), acc)],
         cost_estimate=pl.CostEstimate(
             flops=MATVEC_FLOPS_PER_BASELINE * nb * K,
             bytes_accessed=n_bytes, transcendentals=0),
         interpret=interpret,
     )(jnp.moveaxis(pp, 1, -1), jnp.moveaxis(qq, 1, -1),
       jnp.moveaxis(pq, 1, -1), vp, vq)
-    y = jnp.zeros((K, N, 2, 4), acc)
+    y = jnp.zeros((K, N, 2, md), acc)
     y = y.at[:, s1b].add(jnp.moveaxis(yp, -1, 1))
     y = y.at[:, s2b].add(jnp.moveaxis(yq, -1, 1))
-    return y.reshape(K, 8 * N).astype(v.dtype)
+    return y.reshape(K, 2 * md * N).astype(v.dtype)
 
 
 def gn_matvec_blocks(fac: GNBlocks, v, sta1, sta2, n_stations: int,

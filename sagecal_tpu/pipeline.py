@@ -225,6 +225,7 @@ class FullBatchPipeline:
             inflight=max(1, int(getattr(cfg, "cluster_inflight", 1))),
             inner=getattr(cfg, "solver_inner", "chol"),
             kernel=getattr(cfg, "solver_kernel", "xla"),
+            jones_mode=getattr(cfg, "jones_mode", "full"),
             dtype_policy=self.dtype_policy,
             # rows are [tilesz, nbase] (io.dataset layout): lets the
             # solvers' normal-equation assembly take the baseline-major
@@ -653,7 +654,9 @@ class FullBatchPipeline:
             self._prior_key = ppriors.prior_key(
                 self.cfg.sky_model, self.cfg.cluster_file, self.n,
                 self.ms.meta["freq0"],
-                ppriors.solver_family(self.cfg.solver_mode))
+                ppriors.solver_family(
+                    self.cfg.solver_mode,
+                    getattr(self.cfg, "jones_mode", "full")))
         return self._prior_key
 
     def prior_initial_jones(self, start_tile: int = 0):
@@ -666,7 +669,8 @@ class FullBatchPipeline:
             return None
         J0, _rho = ppriors.PRIORS.seed(
             self.prior_key(), self._interval_times(start_tile),
-            self.ms.meta["freq0"], self.n, self.sky.n_clusters)
+            self.ms.meta["freq0"], self.n, self.sky.n_clusters,
+            jones_mode=getattr(self.cfg, "jones_mode", "full"))
         return J0
 
     # -- overlapped execution (sagecal_tpu.sched) --------------------------
@@ -1527,7 +1531,9 @@ class TileStepper:
                        if self._prior_res_tiles else None)
             ppriors.PRIORS.bank(p.prior_key(), Jt, times,
                                 [float(p.ms.meta["freq0"])],
-                                quality=quality)
+                                quality=quality,
+                                jones_mode=getattr(
+                                    p.cfg, "jones_mode", "full"))
         except Exception as e:
             self.log(f"prior-cache: bank skipped ({e})")
 

@@ -221,6 +221,7 @@ def _job_tokens(job) -> None:
             cfg.max_lbfgs, cfg.lbfgs_m, cfg.linsolv,
             getattr(cfg, "solver_inner", "chol"),
             getattr(cfg, "solver_kernel", "xla"),
+            getattr(cfg, "jones_mode", "full"),
             getattr(cfg, "dtype_policy", "f32"),
             int(cfg.beam_mode), bool(cfg.per_channel_bfgs),
             int(getattr(cfg, "tile_batch", 1) or 1),
@@ -230,7 +231,8 @@ def _job_tokens(job) -> None:
         job.bucket_place = (pcache.token(job.kind, *parts)
                             if job.kind == "stream" else job.bucket)
         from sagecal_tpu.serve import priors as ppriors
-        fam = ppriors.solver_family(cfg.solver_mode)
+        fam = ppriors.solver_family(cfg.solver_mode,
+                                    getattr(cfg, "jones_mode", "full"))
         job.prior_token = ppriors.prior_key(
             cfg.sky_model, cfg.cluster_file,
             int(meta["n_stations"]), meta["freq0"], fam)

@@ -73,19 +73,26 @@ def writes(mode) -> bool:
     return mode == "readwrite"
 
 
-def solver_family(solver_mode) -> str:
+def solver_family(solver_mode, jones_mode="full") -> str:
     """Coarse solver-compatibility class of a fullbatch solver mode.
 
     Seeds only flow between runs whose accepted-step geometry is
     comparable: the OS-LM/LBFGS modes (0-3) share one family, the
     Riemannian trust-region modes (4-5) another, NSD (6) its own.
-    Consensus runs pass the literal ``"admm"`` instead (cli_mpi)."""
+    Consensus runs pass the literal ``"admm"`` instead (cli_mpi).
+    A constrained Jones parameterization (``jones_mode`` of "diag" or
+    "phase", round 20) suffixes the family: a full-Jones chain has
+    off-diagonal structure a phase-only job cannot represent, so the
+    parameterizations must never cross-seed."""
     m = int(solver_mode)
     if m <= 3:
-        return "lm"
-    if m <= 5:
-        return "rtr"
-    return "nsd"
+        fam = "lm"
+    elif m <= 5:
+        fam = "rtr"
+    else:
+        fam = "nsd"
+    jm = str(jones_mode)
+    return fam if jm == "full" else f"{fam}+{jm}"
 
 
 def _file_digest(path) -> str:
@@ -113,7 +120,8 @@ def prior_key(sky_model, cluster_file, n_stations, freq0,
                         f"{float(freq0):.6e}", str(family))
 
 
-def make_prior(J, times, freqs, rho=None, quality=None) -> dict:
+def make_prior(J, times, freqs, rho=None, quality=None,
+               jones_mode="full") -> dict:
     """Validate + normalize one store entry.
 
     ``J``: [F, T, M, N, 2, 2] complex — per (subband, solve interval,
@@ -123,7 +131,10 @@ def make_prior(J, times, freqs, rho=None, quality=None) -> dict:
     [M] per-cluster consensus ρ (ADMM runs). ``quality``: optional
     convergence figure of merit (lower is better — the pipeline banks
     its mean accepted per-tile residual); the store uses it to refuse
-    replacing a better entry with a worse one."""
+    replacing a better entry with a worse one. ``jones_mode``: the
+    Jones parameterization the chain was solved under ("full",
+    "diag", "phase") — recorded so :func:`interpolate` can refuse a
+    cross-parameterization seed even if a key ever aliases."""
     J = np.asarray(J)
     times = np.asarray(times, dtype=np.float64)
     freqs = np.asarray(freqs, dtype=np.float64)
@@ -145,10 +156,15 @@ def make_prior(J, times, freqs, rho=None, quality=None) -> dict:
         if rho.shape != (J.shape[2],):
             raise ValueError(f"prior rho shape {rho.shape} vs "
                              f"M={J.shape[2]}")
+    jm = str(jones_mode)
+    if jm not in ("full", "diag", "phase"):
+        raise ValueError(f"prior jones_mode {jm!r}: expected one of "
+                         "full/diag/phase")
     return {"J": J, "times": times, "freqs": freqs, "rho": rho,
             "quality": None if quality is None else float(quality),
             "n_stations": int(J.shape[3]),
-            "n_clusters": int(J.shape[2])}
+            "n_clusters": int(J.shape[2]),
+            "jones_mode": jm}
 
 
 def _interp_band(Jb, times, t) -> np.ndarray:
@@ -169,11 +185,20 @@ def _interp_band(Jb, times, t) -> np.ndarray:
 
 
 def interpolate(prior: dict, times, freq, n_stations,
-                n_clusters) -> np.ndarray:
+                n_clusters, jones_mode="full") -> np.ndarray:
     """Seed J0 for one band: [M, K, N, 2, 2] at the K target interval
     mid-times, from the stored subband nearest ``freq``. Raises
-    ValueError on a station-set or cluster-count mismatch — a prior
-    never partially seeds (module doc "refusal")."""
+    ValueError on a station-set, cluster-count, or Jones-
+    parameterization mismatch — a prior never partially seeds
+    (module doc "refusal"). The jones_mode check is belt-and-braces
+    on top of :func:`solver_family` keying: a full-Jones chain must
+    never seed a phase-only job (off-diagonal leakage the constrained
+    solve cannot correct), nor the reverse."""
+    if str(jones_mode) != prior.get("jones_mode", "full"):
+        raise ValueError(
+            f"prior jones_mode mismatch: stored "
+            f"{prior.get('jones_mode', 'full')!r} chain, job solves "
+            f"{str(jones_mode)!r}; refusing to seed")
     if int(n_stations) != prior["n_stations"]:
         raise ValueError(
             f"prior station set mismatch: stored {prior['n_stations']} "
@@ -218,7 +243,7 @@ class PriorStore:
     # -- write side ---------------------------------------------------------
 
     def bank(self, key, J, times, freqs, rho=None,
-             quality=None) -> bool:
+             quality=None, jones_mode="full") -> bool:
         """Bank one finished job's chain under ``key`` (validated via
         :func:`make_prior`). No-op on a None key. When the held entry
         and the newcomer BOTH carry a quality figure and the held one
@@ -227,7 +252,8 @@ class PriorStore:
         superseded. Returns whether the new entry landed."""
         if key is None:
             return False
-        entry = make_prior(J, times, freqs, rho=rho, quality=quality)
+        entry = make_prior(J, times, freqs, rho=rho, quality=quality,
+                           jones_mode=jones_mode)
         with self._lock:
             threadsan.guard(self._lock, "PriorStore._d")
             old = self._d.get(key)
@@ -262,16 +288,19 @@ class PriorStore:
             obs.inc("serve_prior_misses_total")
             return None
 
-    def seed(self, key, times, freq, n_stations, n_clusters):
+    def seed(self, key, times, freq, n_stations, n_clusters,
+             jones_mode="full"):
         """(J0, rho) seed for one band, or (None, None) on a miss OR a
         refusal — the serving path never raises on a bad prior, it
-        cold-starts and counts why."""
+        cold-starts and counts why. A full-Jones entry asked to seed a
+        phase-only job (or any parameterization mismatch) is one such
+        counted refusal."""
         entry = self.lookup(key)
         if entry is None:
             return None, None
         try:
             J0 = interpolate(entry, times, freq, n_stations,
-                             n_clusters)
+                             n_clusters, jones_mode=jones_mode)
         except ValueError:
             with self._lock:
                 self.refused += 1

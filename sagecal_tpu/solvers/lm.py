@@ -96,6 +96,13 @@ class LMConfig(NamedTuple):
     # trajectory gated by tolerance, not bit parity (MIGRATION.md
     # "Dtype policy")
     dtype_policy: str = "f32"
+    # constrained-Jones parameterization (normal_eq.JONES_MODES):
+    # "full" (bit-frozen default, 8 reals/station), "diag" (4 —
+    # diagonal complex gains), "phase" (2 — phase-only, amplitudes
+    # frozen at the entry Jones; retraction J0 * exp(i theta)). The
+    # solve runs entirely in the reduced parameter space — reduced
+    # Gram blocks, reduced damped solves (MIGRATION.md "Jones modes")
+    jones_mode: str = "full"
 
 
 class LMState(NamedTuple):
@@ -266,6 +273,10 @@ def _solve_damped_cg(fac, JTe, mu, jitter, rho, sta1, sta2, chunk_id,
         def matvec(v):
             return swp.gn_matvec_blocks(fac, v, sta1, sta2, n_stations,
                                         shift=shift)
+    elif type(fac).__name__ == "GNFactorsMode":
+        def matvec(v):
+            return ne.gn_matvec_mode(fac, v, sta1, sta2, chunk_id,
+                                     kmax, n_stations, shift=shift)
     else:
         def matvec(v):
             return ne.gn_matvec(fac, v, sta1, sta2, chunk_id, kmax,
@@ -355,7 +366,30 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     wt = dtp.to_storage(wt, st)
     reduced = dtp.is_reduced(x8.dtype)
     dtype = dtp.acc_dtype(x8.dtype)
-    p0 = ne.jones_c2r(J0).reshape(kmax, -1).astype(dtype)
+    # constrained-Jones mode (static): the solve state p lives in the
+    # reduced parameter space; the full path below is byte-untouched
+    mode = config.jones_mode
+    npar = ne.jones_npar(mode)
+    if mode == "full":
+        Jref = None
+        p0 = ne.jones_c2r(J0).reshape(kmax, -1).astype(dtype)
+    else:
+        if admm is not None:
+            raise ValueError(
+                "consensus ADMM requires jones_mode='full': the y/bz "
+                f"vectors are full-Jones parameters (got {mode!r})")
+        # amplitude/off-diagonal reference: the constrained entry Jones
+        # (phase retracts multiplicatively off it; diag re-encodes it)
+        Jref = ne.jones_constrain(J0, mode)
+        p0 = ne.params_from_jones(Jref, mode).reshape(
+            kmax, -1).astype(dtype)
+
+    def p_to_J(p):
+        if mode == "full":
+            return ne.jones_r2c(p.reshape(kmax, n_stations, 8))
+        return ne.jones_from_params(
+            p.reshape(kmax, n_stations, npar), mode, Jref)
+
     if chunk_mask is None:
         chunk_mask = jnp.ones((kmax,), bool)
     inner_cg = config.inner == "cg"
@@ -412,11 +446,11 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
         operator instead of the dense [K, 8N, 8N] matrix. With the
         reduced OS fast path active, ``os_subset`` (traced index)
         routes through the subset-sliced assembly."""
-        J = ne.jones_r2c(p.reshape(kmax, n_stations, 8))
+        J = p_to_J(p)
         if os_subset is not None and os_ntper:
-            op, JTe, cost = ne.os_subset_equations(
+            op, JTe, cost = ne.os_subset_equations_mode(
                 x8, J, coh, sta1, sta2, wt, os.os_id, os_subset,
-                os_ntper, row_period, n_stations, cw)
+                os_ntper, row_period, n_stations, cw, mode=mode)
             if admm is not None:
                 d = p - admm_bz
                 JTe = JTe - admm_y - admm_rho * d
@@ -428,24 +462,22 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
                 op, JTe, cost = swp.gn_blocks(
                     x8, J, coh, sta1, sta2, chunk_id,
                     wt if w is None else w, n_stations, kmax,
-                    row_period, cost_wt=cw)
+                    row_period, cost_wt=cw, jones=mode)
             else:
-                op, JTe, cost = ne.gn_factors(x8, J, coh, sta1, sta2,
-                                              chunk_id,
-                                              wt if w is None else w,
-                                              n_stations, kmax,
-                                              cost_wt=cw,
-                                              row_period=row_period)
+                op, JTe, cost = ne.gn_factors_mode(
+                    x8, J, coh, sta1, sta2, chunk_id,
+                    wt if w is None else w, n_stations, kmax,
+                    mode=mode, cost_wt=cw, row_period=row_period)
         elif swp is not None:
             op, JTe, cost = swp.normal_equations_fused(
                 x8, J, coh, sta1, sta2, chunk_id,
                 wt if w is None else w, n_stations, kmax, row_period,
-                cost_wt=cw)
+                cost_wt=cw, jones=mode)
         else:
-            op, JTe, cost = ne.normal_equations(
+            op, JTe, cost = ne.normal_equations_mode(
                 x8, J, coh, sta1, sta2, chunk_id,
-                wt if w is None else w, n_stations, kmax, cost_wt=cw,
-                row_period=row_period)
+                wt if w is None else w, n_stations, kmax, mode=mode,
+                cost_wt=cw, row_period=row_period)
         if admm is not None:
             d = p - admm_bz
             JTe = JTe - admm_y - admm_rho * d
@@ -582,13 +614,31 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
             # adopt select maps onto rows through chunk_id — rows of a
             # rejected chunk keep the entering point's factors, exactly
             # the dense path's kept JTJ
-            ra = adopt[chunk_id][:, None, None, None]
-            JTJ = ne.GNFactors(
-                MA=jnp.where(ra, JTJn.MA, s.JTJ.MA),
-                MB=jnp.where(ra, JTJn.MB, s.JTJ.MB),
-                w2=jnp.where(ra, JTJn.w2, s.JTJ.w2),
-                D=jnp.where(adopt[:, None, None, None, None],
-                            JTJn.D, s.JTJ.D))
+            if mode == "full":
+                ra = adopt[chunk_id][:, None, None, None]
+                JTJ = ne.GNFactors(
+                    MA=jnp.where(ra, JTJn.MA, s.JTJ.MA),
+                    MB=jnp.where(ra, JTJn.MB, s.JTJ.MB),
+                    w2=jnp.where(ra, JTJn.w2, s.JTJ.w2),
+                    D=jnp.where(adopt[:, None, None, None, None],
+                                JTJn.D, s.JTJ.D))
+            else:
+                # reduced factors carry one extra mode axis — select
+                # ndim-generically per leaf (rows through chunk_id,
+                # D per chunk)
+                rab = adopt[chunk_id]
+
+                def _sel(new, old):
+                    return jnp.where(
+                        rab.reshape(rab.shape + (1,) * (new.ndim - 1)),
+                        new, old)
+
+                JTJ = ne.GNFactorsMode(
+                    FA=_sel(JTJn.FA, s.JTJ.FA),
+                    FB=_sel(JTJn.FB, s.JTJ.FB),
+                    w2=_sel(JTJn.w2, s.JTJ.w2),
+                    D=jnp.where(adopt[:, None, None, None, None],
+                                JTJn.D, s.JTJ.D))
         else:
             JTJ = jnp.where(adopt[:, None, None], JTJn, s.JTJ)
         JTe = jnp.where(adopt[:, None], JTen, s.JTe)
@@ -620,8 +670,9 @@ def lm_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
                    live=live0, k=jnp.zeros((), jnp.int32),
                    cg=jnp.zeros((), jnp.int32))
     final = jax.lax.while_loop(cond, body, init)
-    J = ne.jones_r2c(final.p.reshape(kmax, n_stations, 8))
-    J = jnp.where(chunk_mask[:, None, None, None], J, J0)
+    J = p_to_J(final.p)
+    J = jnp.where(chunk_mask[:, None, None, None], J,
+                  J0 if mode == "full" else Jref)
     return J, {"init_cost": cost0, "final_cost": final.cost,
                "iters": final.k, "cg_iters": final.cg}
 

@@ -672,21 +672,378 @@ def gn_matvec(fac: GNFactors, v, sta1, sta2, chunk_id, kmax: int,
 def gn_precond_factor(D, shift):
     """Batched tiny Cholesky of the station-block preconditioner.
 
-    M = block_diag over (k, n, a) of (D[k, n, a] + shift_k I4) — the
+    M = block_diag over (k, n, a) of (D[k, n, a] + shift_k I) — the
     EXACT station-diagonal blocks of (JTJ + shift I) (see
-    :class:`GNFactors`), factored as [K, N, 2] independent 4x4
-    Cholesky decompositions. Returns the (L, lower) pair for
-    :func:`gn_precond_apply`. ``shift``: [K] (mu + jitter [+ rho]) —
-    always > 0 on the solve path, so M is PD even for stations with no
-    usable rows in a chunk.
+    :class:`GNFactors`), factored as [K, N, 2] independent mdim x mdim
+    Cholesky decompositions (mdim = 4 full / 2 diag / 1 phase — read
+    off D's trailing shape, so the full path traces identically).
+    Returns the (L, lower) pair for :func:`gn_precond_apply`.
+    ``shift``: [K] (mu + jitter [+ rho]) — always > 0 on the solve
+    path, so M is PD even for stations with no usable rows in a chunk.
     """
-    eye4 = jnp.eye(4, dtype=D.dtype)
-    A = D + jnp.asarray(shift)[..., None, None, None, None] * eye4
+    eye = jnp.eye(D.shape[-1], dtype=D.dtype)
+    A = D + jnp.asarray(shift)[..., None, None, None, None] * eye
     return jax.scipy.linalg.cho_factor(A, lower=True)
 
 
 def gn_precond_apply(Lfac, r, kmax: int, n_stations: int):
-    """z = M^-1 r with the factored station-block preconditioner."""
-    rr = r.reshape(kmax, n_stations, 2, 4)
+    """z = M^-1 r with the factored station-block preconditioner.
+
+    The per-station block width (mdim) comes off the factor's static
+    shape, so reduced-mode solves (:func:`gn_factors_mode`) ride the
+    same apply and the full path stays bit-frozen."""
+    md = Lfac[0].shape[-1]
+    rr = r.reshape(kmax, n_stations, 2, md)
     z = jax.scipy.linalg.cho_solve(Lfac, rr[..., None])[..., 0]
-    return z.reshape(kmax, 8 * n_stations)
+    return z.reshape(kmax, 2 * md * n_stations)
+
+
+# ---------------------------------------------------------------------------
+# Constrained-Jones parameterizations (jones_mode in {full, diag, phase})
+#
+# CubiCal-style constrained terms (arXiv:1805.03410) as a PROJECTION of the
+# existing Wirtinger factors, not a new solver. Per station the real
+# parameter vector shrinks 8 -> 4 (diag: Re/Im of j00, j11) -> 2 (phase:
+# theta0, theta1 with J(theta) = diag(J0) * exp(i theta), amplitudes
+# frozen at the entry Jones). The Gram structure is unchanged: the
+# station-p Jacobian block stays block-diagonal over the diagonal index c
+# (full mode: the complex row a), with an inner mdim-wide factor
+#
+#   Gp[b, (a, o, ri), (c, m)] = delta_{ac} * FA[b, c, o, ri, m]
+#   Gq[b, (a, o, ri), (c, m)] = delta_{oc} * FB[b, c, a, ri, m]
+#
+# mdim = 4 (full, FA == MA independent of c) / 2 (diag) / 1 (phase), so
+# every per-station Gram block is [2, mdim, mdim] and the per-baseline
+# cross block [2, 2, mdim, mdim] — 8x8-real melting to 2x2 for phase.
+# The full-mode functions above are byte-untouched; the *_mode entry
+# points below delegate to them verbatim when mode == "full".
+# ---------------------------------------------------------------------------
+
+#: valid RunConfig.jones_mode / --jones values
+JONES_MODES = ("full", "diag", "phase")
+
+#: positions of the diag-mode parameters inside the full 8-real station
+#: vector (jones_c2r layout): (Re j00, Im j00, Re j11, Im j11)
+_DIAG_IDX = (0, 1, 6, 7)
+
+
+def jones_mdim(mode: str) -> int:
+    """Per-(station, diagonal-index) Gram block width for ``mode``."""
+    return {"full": 4, "diag": 2, "phase": 1}[mode]
+
+
+def jones_npar(mode: str) -> int:
+    """Real parameters per station for ``mode`` (2 * mdim)."""
+    return 2 * jones_mdim(mode)
+
+
+def jones_constrain(J, mode: str):
+    """Project a Jones chain onto the mode's feasible set (zero the
+    off-diagonal entries for diag/phase; identity for full)."""
+    if mode == "full":
+        return J
+    return J * jnp.eye(2, dtype=J.real.dtype)
+
+
+def params_from_jones(J, mode: str):
+    """[..., 2, 2] complex Jones -> [..., npar] reduced real params.
+
+    phase mode encodes the ZERO rotation (theta = 0): the caller holds
+    the constrained entry Jones as the amplitude reference ``Jref``
+    and retracts multiplicatively via :func:`jones_from_params`.
+    """
+    if mode == "full":
+        return jones_c2r(J)
+    if mode == "diag":
+        return jones_c2r(J)[..., jnp.array(_DIAG_IDX)]
+    return jnp.zeros(J.shape[:-2] + (2,), J.real.dtype)
+
+
+def jones_from_params(p, mode: str, Jref=None):
+    """[..., npar] reduced real params -> [..., 2, 2] complex Jones.
+
+    diag: additive coordinates on the diagonal entries. phase: the
+    manifold retraction J(theta) = diag(Jref) * exp(i theta) — the
+    accumulated-rotation parameterization whose additive update
+    ``p + dp`` IS the multiplicative phase retraction.
+    """
+    if mode == "full":
+        return jones_r2c(p)
+    if mode == "diag":
+        d0 = p[..., 0] + 1j * p[..., 1]
+        d1 = p[..., 2] + 1j * p[..., 3]
+    else:
+        rot = jnp.exp(1j * p)
+        d0 = Jref[..., 0, 0] * rot[..., 0]
+        d1 = Jref[..., 1, 1] * rot[..., 1]
+    z = jnp.zeros_like(d0)
+    return jnp.stack([jnp.stack([d0, z], -1),
+                      jnp.stack([z, d1], -1)], -2)
+
+
+def _mode_factors(A, Bm, Jp, Jq, mode: str):
+    """Reduced Wirtinger factors (FA, FB), each [B, 2, 2, 2, mdim].
+
+    FA[b, c, o, ri, m] = d(V[c, o])_ri / d(p-param (c, m));
+    FB[b, c, a, ri, m] = d(V[a, c])_ri / d(q-param (c, m)).
+    A = C Jq^H (A[d, o]), Bm = Jp C (Bm[a, d]) as in the full path;
+    diag/phase only touch the d == c planes.
+    """
+    if mode == "diag":
+        # complex-linear in j_cc: columns (Re, Im) exactly like the
+        # d == c entries of _ma_factor / _mb_factor
+        Ar, Ai = A.real, A.imag                        # [B, c, o]
+        FA = jnp.stack([jnp.stack([Ar, -Ai], -1),      # ri = Re
+                        jnp.stack([Ai, Ar], -1)], -2)  # ri = Im
+        Br = jnp.swapaxes(Bm.real, -1, -2)             # [B, c, a]
+        Bi = jnp.swapaxes(Bm.imag, -1, -2)
+        FB = jnp.stack([jnp.stack([Br, Bi], -1),
+                        jnp.stack([Bi, -Br], -1)], -2)
+        return FA, FB
+    # phase: dV[c, o]/dtheta_p_c = i * Jp_cc * A[c, o]
+    #        dV[a, c]/dtheta_q_c = -i * conj(Jq_cc) * Bm[a, c]
+    jpd = jnp.stack([Jp[..., 0, 0], Jp[..., 1, 1]], -1)    # [B, c]
+    jqd = jnp.stack([Jq[..., 0, 0], Jq[..., 1, 1]], -1)
+    u = jpd[..., None] * A                                 # [B, c, o]
+    w = jnp.conj(jqd)[..., None] * jnp.swapaxes(Bm, -1, -2)
+    FA = jnp.stack([-u.imag, u.real], -1)[..., None]       # [B,c,o,ri,1]
+    FB = jnp.stack([w.imag, -w.real], -1)[..., None]
+    return FA, FB
+
+
+def _mode_blocks(FA, FB, w2, rw2, pet):
+    """Per-baseline reduced Gram/gradient blocks from the mode factors.
+
+    Returns (pp [B, 2, md, md], qq, pq [B, 2, 2, md, md],
+    jtep [B, 2, md], jteq) — the direct analogue of the full path's
+    4x4 contractions, with the station-diagonal index c explicit.
+    ``w2``/``rw2``: [B, a, o, ri] squared weights / w^2 r.
+    """
+    WFA = w2[..., None] * FA                       # [B, c, o, ri, md]
+    w2q = jnp.swapaxes(w2, 1, 2)                   # [B, o, a, ri]
+    WFB = w2q[..., None] * FB                      # [B, c, a, ri, md]
+    pp = jnp.einsum("bcorm,bcorn->bcmn", WFA, FA, **pet)
+    qq = jnp.einsum("bcarm,bcarn->bcmn", WFB, FB, **pet)
+    # pq[(c, m), (c', n)] = sum_ri w2[c, c', ri] FA[c, c', ri, m]
+    #                        * FB[c', c, ri, n]
+    FBy = jnp.swapaxes(FB, 1, 2)                   # [B, c, c', ri, n]
+    pq = jnp.einsum("bcorm,bcorn->bcomn", WFA, FBy, **pet)
+    jtep = jnp.einsum("bcor,bcorm->bcm", rw2, FA, **pet)
+    rw2q = jnp.swapaxes(rw2, 1, 2)
+    jteq = jnp.einsum("bcar,bcarm->bcm", rw2q, FB, **pet)
+    return pp, qq, pq, jtep, jteq
+
+
+def _mode_dense(pp, qq, pq, jtep, jteq, sta1, sta2, chunk_id,
+                kmax: int, N: int, acc):
+    """Scatter per-baseline reduced blocks into the dense station-major
+    normal equations: (JTJ [K, npar N, npar N], JTe [K, npar N])."""
+    md = pp.shape[-1]
+    npar = 2 * md
+    D = jnp.zeros((kmax, N, 2, md, md), acc)
+    D = D.at[chunk_id, sta1].add(pp)
+    D = D.at[chunk_id, sta2].add(qq)
+    O = jnp.zeros((kmax, N, N, 2, 2, md, md), acc)
+    O = O.at[chunk_id, sta1, sta2].add(pq)
+    JTe = jnp.zeros((kmax, N, 2, md), acc)
+    JTe = JTe.at[chunk_id, sta1].add(jtep)
+    JTe = JTe.at[chunk_id, sta2].add(jteq)
+    Off = O.transpose(0, 1, 2, 3, 5, 4, 6).reshape(kmax, N, N, npar, npar)
+    JTJ = Off + jnp.swapaxes(jnp.swapaxes(Off, 1, 2), -1, -2)
+    eye2 = jnp.eye(2, dtype=acc)
+    Dfull = jnp.einsum("knaij,ab->knaibj", D, eye2).reshape(
+        kmax, N, npar, npar)
+    idx = jnp.arange(N)
+    JTJ = JTJ.at[:, idx, idx].add(Dfull)
+    JTJ = JTJ.transpose(0, 1, 3, 2, 4).reshape(kmax, npar * N, npar * N)
+    return JTJ, JTe.reshape(kmax, npar * N)
+
+
+def normal_equations_mode(x8, J, coh, sta1, sta2, chunk_id, wt,
+                          n_stations: int, kmax: int, mode: str = "full",
+                          cost_wt=None, row_period: int = 0):
+    """Mode-aware :func:`normal_equations`: reduced-dimension
+    (JTJ [K, npar N, npar N], JTe, cost [K]) for diag/phase; verbatim
+    delegation (bit-frozen) for full. ``J`` is projected onto the
+    mode's feasible set at entry, so the factor algebra's diagonal
+    assumption always holds. Weights are arbitrary (OS masks and IRLS
+    sqrt-weights ride through unchanged); ``cost_wt`` keeps the
+    full-data acceptance-cost contract of the full path.
+    """
+    if mode == "full":
+        return normal_equations(x8, J, coh, sta1, sta2, chunk_id, wt,
+                                n_stations, kmax, cost_wt=cost_wt,
+                                row_period=row_period)
+    N = n_stations
+    B = x8.shape[0]
+    st = x8.dtype
+    acc = dtp.acc_dtype(st)
+    pet = dtp.pet(st)
+    J = jones_constrain(J, mode)
+    Jp = J[chunk_id, sta1]
+    Jq = J[chunk_id, sta2]
+    A = coh @ jnp.conj(jnp.swapaxes(Jq, -1, -2))
+    Bm = Jp @ coh
+    V = Jp @ A
+    vf = V.reshape(-1, 4)
+    r = x8 - dtp.to_storage(
+        jnp.stack([vf.real, vf.imag], -1).reshape(-1, 8), st)
+    rw = r * wt
+    FA, FB = _mode_factors(A, Bm, Jp, Jq, mode)
+    FA = dtp.to_storage(FA, st)
+    FB = dtp.to_storage(FB, st)
+    rc = rw if cost_wt is None else r * cost_wt
+    rca = dtp.acc(rc)
+    w2 = (wt * wt).reshape(B, 2, 2, 2)
+    rw2 = (rw * wt).reshape(B, 2, 2, 2)
+    pp, qq, pq, jtep, jteq = _mode_blocks(FA, FB, w2, rw2, pet)
+    JTJ, JTe = _mode_dense(pp, qq, pq, jtep, jteq, sta1, sta2,
+                           chunk_id, kmax, N, acc)
+    cost = jnp.zeros((kmax,), acc).at[chunk_id].add(
+        jnp.sum(rca * rca, axis=1))
+    return JTJ, JTe, cost
+
+
+def os_subset_equations_mode(x8, J, coh, sta1, sta2, wt, os_id, subset,
+                             ntper: int, row_period: int,
+                             n_stations: int, cost_wt,
+                             mode: str = "full"):
+    """Mode-aware :func:`os_subset_equations` (reduced-dtype OS body):
+    full delegates verbatim; diag/phase assemble the reduced blocks
+    from the subset's rows only, keeping the one whole-[B] model pass
+    for the acceptance cost."""
+    if mode == "full":
+        return os_subset_equations(x8, J, coh, sta1, sta2, wt, os_id,
+                                   subset, ntper, row_period,
+                                   n_stations, cost_wt)
+    N = n_stations
+    B = x8.shape[0]
+    st = x8.dtype
+    acc = dtp.acc_dtype(st)
+    pet = dtp.pet(st)
+    nb = row_period
+    os_id = jnp.asarray(os_id)
+    bs = ntper * nb
+    start = jnp.minimum(subset * bs, B - bs)
+    J = jones_constrain(J, mode)
+    Jp = J[0][sta1]                            # kmax == 1
+    Jq = J[0][sta2]
+    Bm = Jp @ coh
+    V = Bm @ jnp.conj(jnp.swapaxes(Jq, -1, -2))
+    vf = V.reshape(-1, 4)
+    r = x8 - jnp.stack([vf.real, vf.imag], -1).reshape(-1, 8).astype(st)
+    rca = (r * cost_wt).astype(acc)
+    cost = jnp.sum(rca * rca).reshape(1)
+    sl = lambda a: jax.lax.dynamic_slice_in_dim(a, start, bs, 0)
+    wts = sl(wt) * (sl(os_id) == subset).astype(st)[:, None]
+    rs = sl(r)
+    cohs = sl(coh)
+    Jps = sl(Jp)
+    Jqs = sl(Jq)
+    As = cohs @ jnp.conj(jnp.swapaxes(Jqs, -1, -2))
+    Bms = sl(Bm)
+    FA, FB = _mode_factors(As, Bms, Jps, Jqs, mode)
+    FA = FA.astype(st)
+    FB = FB.astype(st)
+    rws = rs * wts
+    w2 = (wts * wts).reshape(bs, 2, 2, 2)
+    rw2 = (rws * wts).reshape(bs, 2, 2, 2)
+    pp, qq, pq, jtep, jteq = _mode_blocks(FA, FB, w2, rw2, pet)
+    zc = jnp.zeros((bs,), jnp.int32)
+    JTJ, JTe = _mode_dense(pp, qq, pq, jtep, jteq, sl(sta1), sl(sta2),
+                           zc, 1, N, acc)
+    return JTJ, JTe, cost
+
+
+class GNFactorsMode(NamedTuple):
+    """Reduced-mode analogue of :class:`GNFactors` (diag/phase).
+
+    FA/FB: [B, 2, 2, 2, mdim] mode Wirtinger factors
+    (:func:`_mode_factors` layout); w2: [B, 2, 2, 2] squared
+    sqrt-weights (a, o, ri); D: [K, N, 2, mdim, mdim] station-diagonal
+    Gram blocks (preconditioner + mu0 seed, exactly like the full
+    operator's).
+    """
+
+    FA: jax.Array
+    FB: jax.Array
+    w2: jax.Array
+    D: jax.Array
+
+
+def gn_factors_mode(x8, J, coh, sta1, sta2, chunk_id, wt,
+                    n_stations: int, kmax: int, mode: str = "full",
+                    cost_wt=None, row_period=0):
+    """Mode-aware :func:`gn_factors`: (:class:`GNFactorsMode`,
+    JTe [K, npar N], cost [K]) for diag/phase from one [B]-pass; full
+    delegates verbatim (bit-frozen, returns :class:`GNFactors`)."""
+    if mode == "full":
+        return gn_factors(x8, J, coh, sta1, sta2, chunk_id, wt,
+                          n_stations, kmax, cost_wt=cost_wt,
+                          row_period=row_period)
+    N = n_stations
+    B = x8.shape[0]
+    st = x8.dtype
+    acc = dtp.acc_dtype(st)
+    pet = dtp.pet(st)
+    md = jones_mdim(mode)
+    J = jones_constrain(J, mode)
+    Jp = J[chunk_id, sta1]
+    Jq = J[chunk_id, sta2]
+    A = coh @ jnp.conj(jnp.swapaxes(Jq, -1, -2))
+    Bm = Jp @ coh
+    V = Jp @ A
+    vf = V.reshape(-1, 4)
+    r = x8 - dtp.to_storage(
+        jnp.stack([vf.real, vf.imag], -1).reshape(-1, 8), st)
+    rw = r * wt
+    FA, FB = _mode_factors(A, Bm, Jp, Jq, mode)
+    FA = dtp.to_storage(FA, st)
+    FB = dtp.to_storage(FB, st)
+    rc = rw if cost_wt is None else r * cost_wt
+    rca = dtp.acc(rc)
+    w2 = (wt * wt).reshape(B, 2, 2, 2)
+    rw2 = (rw * wt).reshape(B, 2, 2, 2)
+    WFA = w2[..., None] * FA
+    w2q = jnp.swapaxes(w2, 1, 2)
+    WFB = w2q[..., None] * FB
+    pp = jnp.einsum("bcorm,bcorn->bcmn", WFA, FA, **pet)
+    qq = jnp.einsum("bcarm,bcarn->bcmn", WFB, FB, **pet)
+    jtep = jnp.einsum("bcor,bcorm->bcm", rw2, FA, **pet)
+    rw2q = jnp.swapaxes(rw2, 1, 2)
+    jteq = jnp.einsum("bcar,bcarm->bcm", rw2q, FB, **pet)
+    D = jnp.zeros((kmax, N, 2, md, md), acc)
+    D = D.at[chunk_id, sta1].add(pp)
+    D = D.at[chunk_id, sta2].add(qq)
+    JTe = jnp.zeros((kmax, N, 2, md), acc)
+    JTe = JTe.at[chunk_id, sta1].add(jtep)
+    JTe = JTe.at[chunk_id, sta2].add(jteq)
+    cost = jnp.zeros((kmax,), acc).at[chunk_id].add(
+        jnp.sum(rca * rca, axis=1))
+    return GNFactorsMode(FA=FA, FB=FB, w2=w2, D=D), \
+        JTe.reshape(kmax, 2 * md * N), cost
+
+
+def gn_matvec_mode(fac: GNFactorsMode, v, sta1, sta2, chunk_id,
+                   kmax: int, n_stations: int, shift=None):
+    """(JTJ + shift I) @ v through the reduced factors: one [B]-pass
+    of mdim-wide batched dots — the matrix-free operator the PCG/tCG
+    inner solvers ride under diag/phase modes."""
+    N = n_stations
+    md = fac.FA.shape[-1]
+    st = fac.FA.dtype
+    pet = dtp.pet(st)
+    vr = v.reshape(kmax, N, 2, md)
+    vp = dtp.to_storage(vr[chunk_id, sta1], st)    # [B, c, m]
+    vq = dtp.to_storage(vr[chunk_id, sta2], st)
+    u = (jnp.einsum("baorm,bam->baor", fac.FA, vp, **pet)
+         + jnp.einsum("boarm,bom->baor", fac.FB, vq, **pet))
+    uw = dtp.to_storage(u * fac.w2, st)
+    yp = jnp.einsum("baor,baorm->bam", uw, fac.FA, **pet)
+    yq = jnp.einsum("baor,boarm->bom", uw, fac.FB, **pet)
+    y = jnp.zeros((kmax, N, 2, md), v.dtype)
+    y = y.at[chunk_id, sta1].add(yp).at[chunk_id, sta2].add(yq)
+    y = y.reshape(kmax, 2 * md * N)
+    if shift is not None:
+        y = y + jnp.asarray(shift)[..., None] * v
+    return y

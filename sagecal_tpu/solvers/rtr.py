@@ -79,12 +79,20 @@ class RTRConfig(NamedTuple):
     # while the manifold point, tangent vectors and every accumulator
     # stay f32; "f32" is the bit-frozen identity
     dtype_policy: str = "f32"
+    # constrained-Jones parameterization (normal_eq.JONES_MODES):
+    # "full" (bit-frozen default), "diag" (4 real params/station/pol
+    # pair), "phase" (2 real params/station). Non-full modes solve and
+    # retract in the reduced space; the U(2) Sylvester gauge projection
+    # specializes to the diagonal-U(1)^2 stabilizer (see
+    # project_tangent_mode)
+    jones_mode: str = "full"
 
 
 class NSDConfig(NamedTuple):
     itmax: int = 20
     ls_tries: int = 10         # backtracking halvings per step
     alpha0: float = 0.1        # initial step relative to grad norm scale
+    jones_mode: str = "full"   # see RTRConfig.jones_mode
 
 
 def _c(p, kmax, n_stations):
@@ -129,7 +137,56 @@ def project_tangent(p, v, kmax, n_stations):
     return _r(H, kmax, n_stations)
 
 
-def station_precond(wt, sta1, sta2, chunk_id, kmax, n_stations):
+def project_tangent_mode(p, v, kmax, n_stations, mode):
+    """Gauge projection of tangent v at point p per jones_mode.
+
+    full: the U(2) Sylvester horizontal projection
+    (:func:`project_tangent`). For constrained modes the only EXACT
+    continuous symmetry of the cost is the global phase U = e^{i phi} I
+    (a scalar commutes with every coherency C, so
+    J_p U C U^H J_q^H == J_p C J_q^H identically; the two-parameter
+    diagonal subgroup diag(e^{i phi_0}, e^{i phi_1}) rotates the
+    off-diagonal coherencies and is NOT flat for polarized models —
+    projecting it out would bias the gradient). One real direction per
+    chunk:
+
+    - phase: d theta_nc / d phi = 1 for every (station, component) —
+      projection subtracts the per-chunk mean of the theta gradient;
+    - diag: d (j_ncc e^{i phi}) / d phi = i j_ncc, i.e. the single
+      direction u[n, c] = (-Im j_ncc, Re j_ncc) across ALL (Re, Im)
+      parameter slots.
+    """
+    if mode == "full":
+        return project_tangent(p, v, kmax, n_stations)
+    npar = ne.jones_npar(mode)
+    vr = v.reshape(kmax, n_stations * npar)
+    if mode == "phase":
+        return (vr - jnp.mean(vr, axis=-1, keepdims=True)).reshape(
+            kmax, -1)
+    J = ne.jones_from_params(p.reshape(kmax, n_stations, npar), "diag")
+    d = jnp.stack([J[..., 0, 0], J[..., 1, 1]], -1)    # [K, N, 2] cplx
+    u = jnp.stack([-d.imag, d.real], -1).reshape(kmax, -1)
+    num = jnp.sum(u * vr, axis=-1, keepdims=True)
+    den = jnp.maximum(jnp.sum(u * u, axis=-1, keepdims=True), 1e-30)
+    return (vr - (num / den) * u).reshape(kmax, -1)
+
+
+def _mode_p2j(mode, Jref, kmax, n_stations):
+    """params [K, npar*N] -> J [K, N, 2, 2] map for a jones_mode (the
+    full branch is the exact pre-mode jones_r2c path)."""
+    npar = ne.jones_npar(mode)
+
+    def p_to_J(p):
+        if mode == "full":
+            return ne.jones_r2c(p.reshape(kmax, n_stations, 8))
+        return ne.jones_from_params(
+            p.reshape(kmax, n_stations, npar), mode, Jref)
+
+    return p_to_J
+
+
+def station_precond(wt, sta1, sta2, chunk_id, kmax, n_stations,
+                    npar: int = 8):
     """iw diagonal preconditioner: 1 / (# live baselines per station) per
     chunk, replicated over the station's 8 params (rtr_solve.c fns_fcount,
     count_baselines baseline_utils.c)."""
@@ -142,11 +199,11 @@ def station_precond(wt, sta1, sta2, chunk_id, kmax, n_stations):
            .at[flat1].add(live).at[flat2].add(live))
     iw = 1.0 / jnp.maximum(cnt, 1.0)
     iw = iw / jnp.maximum(jnp.mean(iw), 1e-30)         # mean-normalized
-    return jnp.repeat(iw.reshape(kmax, n_stations), 8, axis=-1)
+    return jnp.repeat(iw.reshape(kmax, n_stations), npar, axis=-1)
 
 
 def make_cost(x8, coh, sta1, sta2, chunk_id, wt, kmax, n_stations,
-              admm=None, robust_nu=None):
+              admm=None, robust_nu=None, mode: str = "full", Jref=None):
     """Per-chunk cost [K] as a function of real params [K, 8N].
 
     Gaussian: sum w^2 r^2; robust: sum log(1 + (w r)^2 / nu)
@@ -158,9 +215,10 @@ def make_cost(x8, coh, sta1, sta2, chunk_id, wt, kmax, n_stations,
         admm_y, admm_bz, admm_rho = admm
         admm_y = admm_y.reshape(kmax, -1)
         admm_bz = admm_bz.reshape(kmax, -1)
+    p_to_J = _mode_p2j(mode, Jref, kmax, n_stations)
 
     def cost(p):
-        J = ne.jones_r2c(p.reshape(kmax, n_stations, 8))
+        J = p_to_J(p)
         # the residual stream stays in the data's storage dtype; the
         # norm/robust reductions upcast (identity for f32/f64)
         e = dtp.acc(ne.residual8(x8, J, coh, sta1, sta2, chunk_id) * wt)
@@ -264,13 +322,27 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     x8 = dtp.to_storage(x8, stq)
     wt = dtp.to_storage(wt, stq)
     dtype = dtp.acc_dtype(x8.dtype)
-    D = n_stations * 8
-    p0 = ne.jones_c2r(J0).reshape(kmax, -1).astype(dtype)
+    mode = config.jones_mode
+    npar = ne.jones_npar(mode)
+    D = n_stations * npar
+    if mode == "full":
+        Jref = None
+        p0 = ne.jones_c2r(J0).reshape(kmax, -1).astype(dtype)
+    else:
+        if admm is not None:
+            raise ValueError(
+                "consensus ADMM requires jones_mode='full': the y/bz "
+                "vectors are full-Jones parameters")
+        Jref = ne.jones_constrain(J0, mode)
+        p0 = ne.params_from_jones(Jref, mode).reshape(
+            kmax, -1).astype(dtype)
+    p_to_J = _mode_p2j(mode, Jref, kmax, n_stations)
     if chunk_mask is None:
         chunk_mask = jnp.ones((kmax,), bool)
 
     cost_fn = make_cost(x8, coh, sta1, sta2, chunk_id, wt, kmax,
-                        n_stations, admm=admm, robust_nu=robust_nu)
+                        n_stations, admm=admm, robust_nu=robust_nu,
+                        mode=mode, Jref=Jref)
     total = lambda p: jnp.sum(cost_fn(p))
     egrad_fn = jax.grad(total)
     # kernel="pallas": fused-sweep assembly + blocks tCG products when
@@ -287,7 +359,8 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
     # gradient/Hessian pair instead — station balance enters through the
     # row weights ``wt``.
     def rgrad_at(p):
-        return project_tangent(p, egrad_fn(p), kmax, n_stations)
+        return project_tangent_mode(p, egrad_fn(p), kmax, n_stations,
+                                    mode)
 
     admm_rho2 = None if admm is None else 2.0 * admm[2]
 
@@ -310,7 +383,7 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
             in as sqrt-curvature row weights wt*sqrt(nu)/(nu + e^2).
         The ADMM augmentation contributes its exact Hessian 2*rho*I.
         """
-        Jm = ne.jones_r2c(p.reshape(kmax, n_stations, 8))
+        Jm = p_to_J(p)
         if robust_nu is None:
             wt_eff = wt
         else:
@@ -328,49 +401,70 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
                 # O(nbase) pass (sweep_pallas.gn_matvec_blocks)
                 fac, _, _ = swp.gn_blocks(x8, Jm, coh, sta1, sta2,
                                           chunk_id, wt_eff, n_stations,
-                                          kmax, row_period)
+                                          kmax, row_period, jones=mode)
 
                 def hv(v):
                     Hv = 2.0 * swp.gn_matvec_blocks(fac, v, sta1, sta2,
                                                     n_stations)
                     if admm_rho2 is not None:
                         Hv = Hv + admm_rho2 * v
-                    return project_tangent(p, Hv, kmax, n_stations)
+                    return project_tangent_mode(p, Hv, kmax, n_stations,
+                                                mode)
                 return hv
             # matrix-free operator: JTJ @ v straight from the Wirtinger
             # factors (one [B]-pass per product), never forming the
             # [K, 8N, 8N] matrix; the unused JTe/cost outputs are
             # dead-code-eliminated by XLA
-            fac, _, _ = ne.gn_factors(x8, Jm, coh, sta1, sta2, chunk_id,
-                                      wt_eff, n_stations, kmax,
-                                      row_period=row_period)
+            if mode == "full":
+                fac, _, _ = ne.gn_factors(x8, Jm, coh, sta1, sta2,
+                                          chunk_id, wt_eff, n_stations,
+                                          kmax, row_period=row_period)
+
+                def hv(v):
+                    Hv = 2.0 * ne.gn_matvec(fac, v, sta1, sta2,
+                                            chunk_id, kmax, n_stations,
+                                            row_period=row_period)
+                    if admm_rho2 is not None:
+                        Hv = Hv + admm_rho2 * v
+                    return project_tangent(p, Hv, kmax, n_stations)
+                return hv
+            fac, _, _ = ne.gn_factors_mode(x8, Jm, coh, sta1, sta2,
+                                           chunk_id, wt_eff, n_stations,
+                                           kmax, mode=mode)
 
             def hv(v):
-                Hv = 2.0 * ne.gn_matvec(fac, v, sta1, sta2, chunk_id,
-                                        kmax, n_stations,
-                                        row_period=row_period)
-                if admm_rho2 is not None:
-                    Hv = Hv + admm_rho2 * v
-                return project_tangent(p, Hv, kmax, n_stations)
+                Hv = 2.0 * ne.gn_matvec_mode(fac, v, sta1, sta2,
+                                             chunk_id, kmax, n_stations)
+                return project_tangent_mode(p, Hv, kmax, n_stations,
+                                            mode)
             return hv
         if swp is not None:
             JTJ, _, _ = swp.normal_equations_fused(
                 x8, Jm, coh, sta1, sta2, chunk_id, wt_eff, n_stations,
-                kmax, row_period)
-        else:
+                kmax, row_period, jones=mode)
+        elif mode == "full":
             JTJ, _, _ = ne.normal_equations(
                 x8, Jm, coh, sta1, sta2, chunk_id, wt_eff, n_stations,
                 kmax, row_period=row_period)
+        else:
+            JTJ, _, _ = ne.normal_equations_mode(
+                x8, Jm, coh, sta1, sta2, chunk_id, wt_eff, n_stations,
+                kmax, mode, row_period=row_period)
 
         def hv(v):
             Hv = 2.0 * jnp.einsum("kij,kj->ki", JTJ, v)
             if admm_rho2 is not None:
                 Hv = Hv + admm_rho2 * v
-            return project_tangent(p, Hv, kmax, n_stations)
+            return project_tangent_mode(p, Hv, kmax, n_stations, mode)
         return hv
 
     cost0 = cost_fn(p0)
     xnorm0 = jnp.sqrt(_dot(p0, p0))
+    if mode == "phase":
+        # phase parameters start at theta = 0, so ||p0|| cannot seed
+        # the TR radius — use the unit-phase scale sqrt(D) instead
+        xnorm0 = jnp.maximum(xnorm0,
+                             jnp.sqrt(jnp.asarray(float(D), dtype)))
     delta_bar = config.delta_bar_frac * xnorm0
     delta0 = config.delta0_frac * xnorm0
     g0 = rgrad_at(p0)
@@ -415,8 +509,9 @@ def rtr_solve(x8, coh, sta1, sta2, chunk_id, wt, J0, n_stations: int,
                      stop=jnp.zeros((kmax,), bool),
                      k=jnp.zeros((), jnp.int32))
     final = jax.lax.while_loop(cond, body, init)
-    J = ne.jones_r2c(final.p.reshape(kmax, n_stations, 8))
-    J = jnp.where(chunk_mask[:, None, None, None], J, J0)
+    J = p_to_J(final.p)
+    J = jnp.where(chunk_mask[:, None, None, None], J,
+                  J0 if mode == "full" else Jref)
     return J, {"init_cost": cost0, "final_cost": final.cost,
                "iters": final.k}
 
@@ -471,15 +566,29 @@ def nsd_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
     Returns (J, nu, info)."""
     kmax = J0.shape[0]
     dtype = dtp.acc_dtype(x8.dtype)
-    p0 = ne.jones_c2r(J0).reshape(kmax, -1).astype(dtype)
+    mode = config.jones_mode
+    npar = ne.jones_npar(mode)
+    if mode == "full":
+        Jref = None
+        p0 = ne.jones_c2r(J0).reshape(kmax, -1).astype(dtype)
+    else:
+        if admm is not None:
+            raise ValueError(
+                "consensus ADMM requires jones_mode='full': the y/bz "
+                "vectors are full-Jones parameters")
+        Jref = ne.jones_constrain(J0, mode)
+        p0 = ne.params_from_jones(Jref, mode).reshape(
+            kmax, -1).astype(dtype)
+    p_to_J = _mode_p2j(mode, Jref, kmax, n_stations)
     if chunk_mask is None:
         chunk_mask = jnp.ones((kmax,), bool)
     nu = jnp.asarray(nu0, dtype)
 
     cost_of = lambda nu_: make_cost(x8, coh, sta1, sta2, chunk_id, wt_base,
                                     kmax, n_stations, admm=admm,
-                                    robust_nu=nu_)
-    iw = station_precond(wt_base, sta1, sta2, chunk_id, kmax, n_stations)
+                                    robust_nu=nu_, mode=mode, Jref=Jref)
+    iw = station_precond(wt_base, sta1, sta2, chunk_id, kmax, n_stations,
+                         npar=npar)
     mask = wt_base > 0
 
     itmax = (jnp.minimum(jnp.asarray(itmax_dynamic, jnp.int32),
@@ -488,7 +597,7 @@ def nsd_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
 
     def rgrad(p, nu_):
         g = jax.grad(lambda q: jnp.sum(cost_of(nu_)(q)))(p)
-        return project_tangent(p, g * iw, kmax, n_stations)
+        return project_tangent_mode(p, g * iw, kmax, n_stations, mode)
 
     def step(carry, k):
         p, p_prev, t, nu_ = carry
@@ -498,8 +607,14 @@ def nsd_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         g = rgrad(y, nu_)
         gn = jnp.sqrt(_dot(g, g))
         c_y = cfn(y)
-        alpha0 = config.alpha0 * jnp.sqrt(_dot(y, y)) \
-            / jnp.maximum(gn, 1e-30)
+        ynorm = jnp.sqrt(_dot(y, y))
+        if mode == "phase":
+            # theta starts at 0: seed the step length from the
+            # unit-phase scale instead of the (zero) point norm
+            ynorm = jnp.maximum(
+                ynorm, jnp.sqrt(jnp.asarray(float(npar * n_stations),
+                                            dtype)))
+        alpha0 = config.alpha0 * ynorm / jnp.maximum(gn, 1e-30)
 
         def ls_body(_, st):
             alpha, best_p, best_c, found = st
@@ -518,8 +633,8 @@ def nsd_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
         p_new = jnp.where((found & chunk_mask)[:, None], p_new, p)
         # nu E-step every step (inner nu/weight updates,
         # rtr_solve_robust.c:1640-1700; AECM p=2 like the TR variant)
-        e = ne.residual8(x8, ne.jones_r2c(p_new.reshape(kmax, n_stations, 8)),
-                         coh, sta1, sta2, chunk_id) * wt_base
+        e = ne.residual8(x8, p_to_J(p_new), coh, sta1, sta2,
+                         chunk_id) * wt_base
         w = rb.update_weights(e, nu_)
         nu_new = rb.update_nu_aecm(rb.mean_logsumw(w, mask), nu_, p=2,
                                    nulow=nulow, nuhigh=nuhigh)
@@ -534,8 +649,9 @@ def nsd_solve_robust(x8, coh, sta1, sta2, chunk_id, wt_base, J0,
     (p, _, _, nu), costs = jax.lax.scan(
         step, (p0, p0, jnp.ones((), dtype), nu),
         jnp.arange(config.itmax))
-    J = ne.jones_r2c(p.reshape(kmax, n_stations, 8))
-    J = jnp.where(chunk_mask[:, None, None, None], J, J0)
+    J = p_to_J(p)
+    J = jnp.where(chunk_mask[:, None, None, None], J,
+                  J0 if mode == "full" else Jref)
     # the scan body executes all config.itmax steps (budget exhaustion
     # only freezes the carry), so the executed trip count is static
     return J, nu, {"init_cost": cost0, "final_cost": costs[-1],

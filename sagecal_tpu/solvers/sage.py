@@ -239,6 +239,14 @@ class SageConfig(NamedTuple):
     # (MIGRATION.md "Dtype policy"; PERF.md round 9 for the measured
     # Δbytes/Δwall/drift trade)
     dtype_policy: str = "f32"
+    # constrained-Jones parameterization (--jones;
+    # normal_eq.JONES_MODES): "full" is the bit-frozen default; "diag"
+    # and "phase" solve every per-cluster system and the joint LBFGS
+    # refine in the reduced parameter space (4/2 real params per
+    # station), shrinking the per-baseline Gram blocks the assemblies
+    # emit (8x8 -> 4x4 / 2x2 real). J0 is constrained at entry; ADMM
+    # consensus requires "full" (the solvers refuse otherwise)
+    jones_mode: str = "full"
 
 
 _OS_MODES = (int(SolverMode.OSLM_LBFGS),
@@ -296,7 +304,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
                              cg_tol=config.cg_tol,
                              cg_maxiter=config.cg_maxiter,
                              kernel=config.kernel,
-                             dtype_policy=config.dtype_policy)
+                             dtype_policy=config.dtype_policy,
+                             jones_mode=config.jones_mode)
     nbase = int(config.nbase)
     zero_i = jnp.zeros((), jnp.int32)
 
@@ -321,7 +330,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
     if mode == int(SolverMode.RTR_OSLM_LBFGS):
         rtr_cfg = rtr_mod.RTRConfig(itmax=itcap, inner=config.inner,
                                     kernel=config.kernel,
-                                    dtype_policy=config.dtype_policy)
+                                    dtype_policy=config.dtype_policy,
+                                    jones_mode=config.jones_mode)
         Jn, info = rtr_mod.rtr_solve(
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             chunk_mask=cmask_m, config=rtr_cfg, itmax_dynamic=itermax,
@@ -332,7 +342,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
     if mode == int(SolverMode.RTR_OSRLM_RLBFGS):
         rtr_cfg = rtr_mod.RTRConfig(itmax=itcap, inner=config.inner,
                                     kernel=config.kernel,
-                                    dtype_policy=config.dtype_policy)
+                                    dtype_policy=config.dtype_policy,
+                                    jones_mode=config.jones_mode)
         Jn, nu_new, info = rtr_mod.rtr_solve_robust(
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             nu0=nu_cj, nulow=config.nulow, nuhigh=config.nuhigh,
@@ -345,7 +356,8 @@ def _cluster_solve(mode: int, xdummy, coh_m, sta1, sta2, cidx_m, cmask_m,
                 info["iters"], zero_i)
 
     if mode == int(SolverMode.NSD_RLBFGS):
-        nsd_cfg = rtr_mod.NSDConfig(itmax=2 * itcap)
+        nsd_cfg = rtr_mod.NSDConfig(itmax=2 * itcap,
+                                    jones_mode=config.jones_mode)
         Jn, nu_new, info = rtr_mod.nsd_solve_robust(
             xdummy, coh_m, sta1, sta2, cidx_m, wt_base, J_m, n_stations,
             nu0=nu_cj, nulow=config.nulow, nuhigh=config.nuhigh,
@@ -721,17 +733,26 @@ def _cluster_perm(ci, nerr_prev, weighted, key, M: int,
 
 
 def _refine_cost_fn(x8, coh, sta1, sta2, chunk_idx, wt_base, shape, M, kmax,
-                    n_stations, robust: bool, mean_nu):
+                    n_stations, robust: bool, mean_nu, mode: str = "full",
+                    Jref=None):
+    # mode != "full": ``shape`` is the reduced (M*kmax, N, npar) layout
+    # and Jref [M*kmax, N, 2, 2] carries the constrained reference
+    # point (amplitudes for the phase retraction J = Jref * exp(i θ))
+    def p_to_Jr(p):
+        if mode == "full":
+            return ne.jones_r2c(p.reshape(shape)).reshape(
+                M, kmax, n_stations, 2, 2)
+        return ne.jones_from_params(p.reshape(shape), mode, Jref).reshape(
+            M, kmax, n_stations, 2, 2)
+
     if robust:
         def cost_fn(p):
-            Jr = ne.jones_r2c(p.reshape(shape)).reshape(
-                M, kmax, n_stations, 2, 2)
+            Jr = p_to_Jr(p)
             r = (x8 - full_model8(Jr, coh, sta1, sta2, chunk_idx)) * wt_base
             return jnp.sum(jnp.log1p(r * r / mean_nu))
     else:
         def cost_fn(p):
-            Jr = ne.jones_r2c(p.reshape(shape)).reshape(
-                M, kmax, n_stations, 2, 2)
+            Jr = p_to_Jr(p)
             r = (x8 - full_model8(Jr, coh, sta1, sta2, chunk_idx)) * wt_base
             return jnp.sum(r * r)
     return cost_fn
@@ -777,6 +798,10 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
     wt_base = dtp.to_storage(wt_base, stq)
     dtype = dtp.acc_dtype(x8.dtype)
     robust = _is_robust(config.solver_mode)
+    if config.jones_mode != "full":
+        # constrained modes start (and stay) on the constraint surface;
+        # the initial residual prices the same point the solvers see
+        J0 = ne.jones_constrain(J0, config.jones_mode)
     if nu0 is None:
         nu0 = config.nulow
     if key is None:
@@ -846,18 +871,31 @@ def sagefit(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0, n_stations: int,
     # skipped in ADMM mode (sagecal_slave.cpp passes max_lbfgs=0)
     lbfgs_k = jnp.zeros((), jnp.int32)
     if config.max_lbfgs > 0 and admm is None:
-        shape = (M * kmax, n_stations, 8)
+        mode = config.jones_mode
+        npar8 = ne.jones_npar(mode)
+        shape = (M * kmax, n_stations, npar8)
         Jflat = J.reshape(M * kmax, n_stations, 2, 2)
-        p0 = ne.jones_c2r(Jflat).reshape(-1).astype(dtype)
+        if mode == "full":
+            Jref = None
+            p0 = ne.jones_c2r(Jflat).reshape(-1).astype(dtype)
+        else:
+            Jref = ne.jones_constrain(Jflat, mode)
+            p0 = ne.params_from_jones(Jref, mode).reshape(-1).astype(dtype)
         cost_fn = _refine_cost_fn(x8, coh, sta1, sta2, chunk_idx, wt_base,
                                   shape, M, kmax, n_stations, robust,
-                                  mean_nu)
+                                  mean_nu, mode=mode, Jref=Jref)
         grad_fn = jax.grad(cost_fn)
         p1, lbfgs_k = lbfgs_mod.lbfgs_fit(cost_fn, grad_fn, p0,
                                           itmax=config.max_lbfgs,
                                           M=config.lbfgs_m,
                                           return_iters=True)
-        J = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations, 2, 2)
+        if mode == "full":
+            J = ne.jones_r2c(p1.reshape(shape)).reshape(
+                M, kmax, n_stations, 2, 2)
+        else:
+            J = ne.jones_from_params(p1.reshape(shape), mode,
+                                     Jref).reshape(M, kmax, n_stations,
+                                                   2, 2)
 
     xres_f = x8 - full_model8(J, coh, sta1, sta2, chunk_idx)
     res_1 = jnp.linalg.norm(dtp.acc(xres_f * wt_base)) / n
@@ -963,15 +1001,27 @@ def _jit_refine(x8, coh, sta1, sta2, chunk_idx, J, wt_base, mean_nu,
                 n_stations, config, robust):
     M, kmax = J.shape[0], J.shape[1]
     dtype = dtp.acc_dtype(x8.dtype)
-    shape = (M * kmax, n_stations, 8)
-    p0 = ne.jones_c2r(J.reshape(M * kmax, n_stations, 2, 2)) \
-        .reshape(-1).astype(dtype)
+    mode = config.jones_mode
+    shape = (M * kmax, n_stations, ne.jones_npar(mode))
+    Jflat = J.reshape(M * kmax, n_stations, 2, 2)
+    if mode == "full":
+        Jref = None
+        p0 = ne.jones_c2r(Jflat).reshape(-1).astype(dtype)
+    else:
+        Jref = ne.jones_constrain(Jflat, mode)
+        p0 = ne.params_from_jones(Jref, mode).reshape(-1).astype(dtype)
     cost_fn = _refine_cost_fn(x8, coh, sta1, sta2, chunk_idx, wt_base,
-                              shape, M, kmax, n_stations, robust, mean_nu)
+                              shape, M, kmax, n_stations, robust, mean_nu,
+                              mode=mode, Jref=Jref)
     p1, k = lbfgs_mod.lbfgs_fit(cost_fn, jax.grad(cost_fn), p0,
                                 itmax=config.max_lbfgs, M=config.lbfgs_m,
                                 return_iters=True)
-    Jn = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations, 2, 2)
+    if mode == "full":
+        Jn = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations,
+                                                     2, 2)
+    else:
+        Jn = ne.jones_from_params(p1.reshape(shape), mode, Jref).reshape(
+            M, kmax, n_stations, 2, 2)
     res = jnp.linalg.norm(dtp.acc(
         (x8 - full_model8(Jn, coh, sta1, sta2, chunk_idx)) * wt_base)) \
         / (x8.shape[0] * 8)
@@ -1019,6 +1069,8 @@ def sagefit_host(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     wt_base = dtp.to_storage(wt_base, x8.dtype)
     dtype = dtp.acc_dtype(x8.dtype)
     robust = _is_robust(config.solver_mode)
+    if config.jones_mode != "full":
+        J0 = ne.jones_constrain(J0, config.jones_mode)
     if nu0 is None:
         nu0 = config.nulow
     if key is None:
@@ -1350,6 +1402,8 @@ def sagefit_host_tiles(x8, coh, sta1, sta2, chunk_idx, chunk_mask, J0,
     wt_base = dtp.to_storage(wt_base, x8.dtype)
     dtype = dtp.acc_dtype(x8.dtype)
     robust = _is_robust(config.solver_mode)
+    if config.jones_mode != "full":
+        J0 = ne.jones_constrain(J0, config.jones_mode)
     if nu0 is None:
         nu0 = config.nulow
 
@@ -1558,13 +1612,26 @@ def bfgsfit(x8, coh, sta1, sta2, chunk_idx, J0, n_stations: int,
     M, kmax = J0.shape[0], J0.shape[1]
     n = x8.shape[0] * 8
     robust = _is_robust(config.solver_mode)
-    shape = (M * kmax, n_stations, 8)
-    p0 = ne.jones_c2r(J0.reshape(M * kmax, n_stations, 2, 2)) \
-        .reshape(-1).astype(dtype)
+    mode = config.jones_mode
+    if mode != "full":
+        J0 = ne.jones_constrain(J0, mode)
+    shape = (M * kmax, n_stations, ne.jones_npar(mode))
+    Jflat0 = J0.reshape(M * kmax, n_stations, 2, 2)
+    if mode == "full":
+        Jref = None
+        p0 = ne.jones_c2r(Jflat0).reshape(-1).astype(dtype)
+    else:
+        Jref = Jflat0
+        p0 = ne.params_from_jones(Jref, mode).reshape(-1).astype(dtype)
 
     def cost_fn(p):
-        Jr = ne.jones_r2c(p.reshape(shape)).reshape(
-            M, kmax, n_stations, 2, 2)
+        if mode == "full":
+            Jr = ne.jones_r2c(p.reshape(shape)).reshape(
+                M, kmax, n_stations, 2, 2)
+        else:
+            Jr = ne.jones_from_params(p.reshape(shape), mode,
+                                      Jref).reshape(M, kmax, n_stations,
+                                                    2, 2)
         r = (x8 - full_model8(Jr, coh, sta1, sta2, chunk_idx)) * wt_base
         if robust:
             return jnp.sum(jnp.log1p(r * r / nu))
@@ -1575,7 +1642,12 @@ def bfgsfit(x8, coh, sta1, sta2, chunk_idx, J0, n_stations: int,
     p1, k = lbfgs_mod.lbfgs_fit(cost_fn, jax.grad(cost_fn), p0,
                                 itmax=config.max_lbfgs, M=config.lbfgs_m,
                                 return_iters=True)
-    J = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations, 2, 2)
+    if mode == "full":
+        J = ne.jones_r2c(p1.reshape(shape)).reshape(M, kmax, n_stations,
+                                                    2, 2)
+    else:
+        J = ne.jones_from_params(p1.reshape(shape), mode, Jref).reshape(
+            M, kmax, n_stations, 2, 2)
     res_1 = jnp.linalg.norm(dtp.acc(
         (x8 - full_model8(J, coh, sta1, sta2, chunk_idx)) * wt_base)) / n
     return J, {"res_0": res_0, "res_1": res_1, "lbfgs_iters": k}

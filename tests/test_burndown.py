@@ -39,7 +39,7 @@ def test_same_queue_both_modes():
     assert names == [s["name"] for s in real]
     assert names == ["probe", "mosaic-kernels", "kernel-cache",
                      "b-scaling", "bf16-kernels", "mesh2d", "fleet",
-                     "warm-start", "sentinel"]
+                     "warm-start", "jones-melt", "sentinel"]
 
 
 def test_dry_pins_cpu_real_scrubs_leak():
@@ -72,11 +72,11 @@ def test_bank_dir_threads_to_banking_steps():
     # fleet and warm-start stamp through the env fallback (bench call
     # sites don't thread a bank_dir); dry mode also forces the CPU
     # bench path
-    for name in ("fleet", "warm-start"):
+    for name in ("fleet", "warm-start", "jones-melt"):
         assert steps[name]["env"]["SAGECAL_BANK_DIR"] == "/b", name
         assert steps[name]["env"]["SAGECAL_BENCH_CPU"] == "1", name
     real = {s["name"]: s for s in bd.build_steps(_Args(False, "/b"))}
-    for name in ("fleet", "warm-start"):
+    for name in ("fleet", "warm-start", "jones-melt"):
         assert "SAGECAL_BENCH_CPU" not in real[name]["env"], name
 
 

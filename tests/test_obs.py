@@ -807,3 +807,72 @@ def test_sentinel_warm_committed_bank_loads():
     assert rec["prior_hit_rate"] > 0.0
     assert rec["router_prior_affinity_hits"] >= 1
     assert rec["off_bit_identical"] is True
+
+
+def _write_jones_bank(dirpath, rnd, rec, platform="cpu"):
+    with open(os.path.join(dirpath, f"JONES_r{rnd:02d}.json"),
+              "w") as f:
+        json.dump({"platform": platform, "date": "2026-08-07",
+                   "results": {"13-jones-melt": rec}}, f)
+
+
+def _jones_rec(**kw):
+    rec = dict(phase_bytes_ratio_xla=0.26, phase_bytes_ratio_pallas=0.09,
+               diag_bytes_ratio_xla=0.54, diag_bytes_ratio_pallas=0.31,
+               residual_envelope_met=True, full_mode_bit_identical=True,
+               shape="jones test")
+    rec.update(kw)
+    return rec
+
+
+def test_sentinel_jones_cross_round(tmp_path, capsys):
+    """ISSUE 20 satellite: the constrained-Jones bank (JONES_rNN.json)
+    is judged like the WARM/KMELT banks — newest pair, named metric,
+    improvements never fail; a fattened phase or diag bytes/trip
+    ratio (the reduced Gram path re-densifying), a dropped residual
+    envelope, or lost full-mode bit-identity fails with the metric
+    named."""
+    d = str(tmp_path)
+    _write_jones_bank(d, 20, _jones_rec())
+    assert sentinel.jones_cross_round_check("cpu", d) == []
+    _write_jones_bank(d, 21, _jones_rec(phase_bytes_ratio_xla=0.22,
+                                        diag_bytes_ratio_pallas=0.28))
+    assert sentinel.jones_cross_round_check("cpu", d) == []
+    _write_jones_bank(d, 22, _jones_rec(
+        phase_bytes_ratio_xla=0.35,            # phase re-densified
+        diag_bytes_ratio_pallas=0.60,          # diag kernel ratio blew
+        residual_envelope_met=False))          # quality gate dropped
+    v = sentinel.jones_cross_round_check("cpu", d)
+    assert {x["metric"] for x in v} == {"jones_phase_bytes_xla",
+                                        "jones_diag_bytes_pallas",
+                                        "jones_residual_envelope"}
+    assert all("JONES r22" in x["msg"] for x in v)
+    # the CLI lane fails with the metric named — and a bank dir with
+    # ONLY family records (the burn-down scratch dir) is still checked
+    rc = sentinel.main(["--fast", "--no-probes", "--platform", "cpu",
+                        "--bank-dir", d])
+    assert rc == 1
+    assert "jones_phase_bytes_xla" in capsys.readouterr().err
+    assert sentinel.load_jones_banks("tpu", d) == []
+
+
+def test_sentinel_jones_committed_bank_loads():
+    """The committed JONES round parses, declares its platform,
+    carries every toleranced field, and banked the acceptance gates:
+    phase-mode bytes/trip <= 0.35x full on BOTH kernels at equal
+    executed trips, the constrained-truth residual envelope held, and
+    jones_mode='full' stayed bit-identical to the pre-mode solver."""
+    banks = sentinel.load_jones_banks("cpu", REPO)
+    assert banks, "no committed JONES_rNN.json"
+    rec = banks[-1][2]["13-jones-melt"]
+    for spec in sentinel.JONES_TOLERANCES.values():
+        assert spec["field"] in rec, spec["field"]
+    assert rec["phase_bytes_ratio_xla"] <= rec["phase_gate"]
+    assert rec["phase_bytes_ratio_pallas"] <= rec["phase_gate"]
+    assert rec["diag_bytes_ratio_xla"] < 1.0
+    assert rec["diag_bytes_ratio_pallas"] < 1.0
+    assert rec["residual_envelope_met"] is True
+    assert rec["full_mode_bit_identical"] is True
+    for leg in rec["legs"].values():
+        trips = {m["executed_trips"] for m in leg["modes"].values()}
+        assert len(trips) == 1      # equal executed trips per leg

@@ -123,6 +123,11 @@ def test_modes_and_solver_family():
     assert priors.solver_family(4) == "rtr"
     assert priors.solver_family(5) == "rtr"
     assert priors.solver_family(6) == "nsd"
+    # constrained-Jones parameterizations are their OWN families: a
+    # full-Jones prior must never content-key onto a diag/phase job
+    assert priors.solver_family(0, "full") == "lm"
+    assert priors.solver_family(0, "diag") == "lm+diag"
+    assert priors.solver_family(4, "phase") == "rtr+phase"
 
 
 def test_prior_key_is_content_keyed(tmp_path):
@@ -210,6 +215,52 @@ def test_interpolate_refuses_mismatch():
         priors.interpolate(e, [10.], 1.4e8, 5, 2)     # station set
     with pytest.raises(ValueError, match="refusing to seed"):
         priors.interpolate(e, [10.], 1.4e8, 4, 3)     # cluster count
+
+
+def test_interpolate_refuses_jones_mode_mismatch():
+    """ISSUE 20 satellite: a full-Jones prior must never seed a
+    phase-only job (the stored solution lives in a different
+    parameterization — amplitude/off-diagonal structure a phase
+    retraction can neither represent nor correct), and vice versa.
+    Refusal, never a partial seed — same contract as the
+    station-mismatch refusal above."""
+    e = _entry(M=2, N=4)                 # default: jones_mode="full"
+    assert e["jones_mode"] == "full"
+    with pytest.raises(ValueError, match="refusing to seed"):
+        priors.interpolate(e, [10.], 1.4e8, 4, 2, jones_mode="phase")
+    with pytest.raises(ValueError, match="refusing to seed"):
+        priors.interpolate(e, [10.], 1.4e8, 4, 2, jones_mode="diag")
+    # matched mode seeds bit-exactly, constrained or not
+    rng = np.random.default_rng(7)
+    Jp = np.exp(1j * rng.normal(size=(1, 3, 2, 4, 1, 1))) \
+        * np.eye(2, dtype=complex)
+    ep = priors.make_prior(Jp, [10., 20., 30.], [1.4e8],
+                           jones_mode="phase")
+    got = priors.interpolate(ep, [10.], 1.4e8, 4, 2,
+                             jones_mode="phase")
+    assert np.array_equal(got[:, 0], ep["J"][0, 0])
+    with pytest.raises(ValueError, match="refusing to seed"):
+        priors.interpolate(ep, [10.], 1.4e8, 4, 2)    # phase -> full
+    with pytest.raises(ValueError):                   # unknown mode
+        priors.make_prior(Jp, [10., 20., 30.], [1.4e8],
+                          jones_mode="scalar")
+
+
+def test_store_seed_jones_refusal_is_cold_start():
+    """The store-level contract: a jones-mode mismatch on a key hit
+    returns (None, None) — a COUNTED cold start, indistinguishable
+    downstream from a miss — exactly like the station refusal."""
+    st = priors.PriorStore(maxsize=2)
+    e = _entry()
+    assert st.bank("k1", e["J"], e["times"], e["freqs"])   # full prior
+    J0, rho = st.seed("k1", [10.], 1.4e8, 4, 2, jones_mode="phase")
+    assert J0 is None and rho is None
+    assert st.stats()["refused"] == 1
+    # the matched-mode seed on the same key still hits (the refusal
+    # itself counted a key hit too — the key matched, the seed didn't)
+    J0, _ = st.seed("k1", [10.], 1.4e8, 4, 2, jones_mode="full")
+    assert J0 is not None
+    assert st.stats()["hits"] == 2 and st.stats()["misses"] == 0
 
 
 def test_store_seed_counts_miss_hit_refusal():

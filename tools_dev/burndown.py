@@ -42,10 +42,17 @@ landed. The queue:
                         sweeps saving, prior/router hit rates, the
                         off bit-identity gate); stamps WARM_rNN.json
                         via SAGECAL_BANK_DIR.
-9. ``sentinel``       — sagecal_tpu.obs.sentinel --fast over the bank
+9. ``jones-melt``     — bench config 13-jones-melt (constrained-Jones
+                        diag/phase vs full bytes/trip at equal
+                        executed trips + the constrained-truth
+                        residual envelope): on TPU the reduced Gram
+                        blocks compile through REAL Mosaic — the
+                        compiled verdict for the 8x8 -> 2x2 melt;
+                        stamps JONES_rNN.json via SAGECAL_BANK_DIR.
+10. ``sentinel``      — sagecal_tpu.obs.sentinel --fast over the bank
                         dir: every record this run stamped is judged
                         by its tolerance family (KMELT/MESH2D/FLEET/
-                        WARM) before the window closes.
+                        WARM/JONES) before the window closes.
 
 ``--dry-run`` rehearses the SAME queue on CPU at small shapes into a
 scratch bank dir (interpret-mode kernels, virtual devices), so the
@@ -147,6 +154,12 @@ def build_steps(args):
              timeout=900 if dry else 1200,
              cmd=[PY, os.path.join(ROOT, "bench.py"),
                   "--config", "12-warm-start"]),
+        dict(name="jones-melt",
+             env={**env, "SAGECAL_BANK_DIR": bank,
+                  **({"SAGECAL_BENCH_CPU": "1"} if dry else {})},
+             timeout=600 if dry else 900,
+             cmd=[PY, os.path.join(ROOT, "bench.py"),
+                  "--config", "13-jones-melt"]),
         dict(name="sentinel", env=env, timeout=600,
              cmd=[PY, "-m", "sagecal_tpu.obs.sentinel", "--fast",
                   "--platform", plat, "--bank-dir", bank]

@@ -204,7 +204,9 @@ def b_scaling(args):
             cfg = sage.SageConfig(max_iter=3, max_lbfgs=0,
                                   solver_mode=args.solver,
                                   nbase=tile.nbase, inner=inner,
-                                  kernel=kern)
+                                  kernel=kern,
+                                  jones_mode=getattr(args, "jones",
+                                                     "full"))
 
             def sweep():
                 # fresh state per call: the sweep program donates its
@@ -998,6 +1000,13 @@ def main():
                          "runs the --b-scaling ladder kernel-on/off "
                          "and banks BSCALING_r17.json; defaults to "
                          "SAGECAL_BENCH_KERNEL when set")
+    ap.add_argument("--jones", choices=("full", "diag", "phase"),
+                    default="full",
+                    help="Jones parameterization for the --b-scaling "
+                         "ladder (sage.SageConfig.jones_mode; round "
+                         "20): constrained modes solve/factor reduced "
+                         "Gram blocks (diag 4x4, phase 2x2 vs full "
+                         "8x8 real)")
     ap.add_argument("--multichip", action="store_true",
                     help="run the ADMM shape on a virtual multi-device "
                          "CPU mesh and bank a measured per-iteration + "
